@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Headline benchmark: single-chip cell-updates/sec at L=256, Float32.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline anchor (see BASELINE.md): the reference publishes no numbers; its
+GPU target hardware is the Summit V100 (job scripts, ``scripts/job_summit.sh``).
+A bandwidth-roofline estimate for the reference's CUDA.jl kernel on V100 is
+  900 GB/s HBM / 16 bytes-per-cell-update (2 fields x read+write x f32)
+  = 5.6e10 cell-updates/s,
+an *upper* bound for the reference (its 2D-grid serial-x kernel with
+in-kernel Distributions.Uniform sampling does not reach roofline).
+vs_baseline = measured / 5.6e10.
+"""
+
+import json
+import sys
+import time
+
+L = 256
+STEPS_PER_ROUND = 100
+ROUNDS = 5
+BASELINE_CELL_UPDATES = 5.6e10  # V100 roofline estimate, see module docstring
+
+
+def main() -> None:
+    import jax
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+
+    settings = Settings(
+        L=L,
+        Du=0.2,
+        Dv=0.1,
+        F=0.02,
+        k=0.048,
+        dt=1.0,
+        noise=0.1,
+        precision="Float32",
+        backend=backend,
+        kernel_language="Plain",
+    )
+    sim = Simulation(settings, n_devices=1)
+
+    import jax.numpy as jnp
+
+    def sync() -> float:
+        # block_until_ready does not reliably block under the axon TPU
+        # tunnel; a dependent scalar readback forces real completion.
+        return float(jnp.sum(sim.u[:1, :1, :4]))
+
+    # warmup: trigger compile
+    sim.iterate(STEPS_PER_ROUND)
+    sync()
+
+    best = float("inf")
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter()
+        sim.iterate(STEPS_PER_ROUND)
+        sync()
+        best = min(best, time.perf_counter() - t0)
+
+    cell_updates_per_s = (L**3) * STEPS_PER_ROUND / best
+    print(
+        json.dumps(
+            {
+                "metric": f"cell_updates_per_sec_per_chip_L{L}_f32",
+                "value": cell_updates_per_s,
+                "unit": "cell-updates/s",
+                "vs_baseline": cell_updates_per_s / BASELINE_CELL_UPDATES,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

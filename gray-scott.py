@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""CLI launcher (reference ``gray-scott.jl:1-15``):
+
+    python gray-scott.py <config.toml>
+
+Wall-clock for the whole run is printed on success, like the reference's
+``@time julia_main()``.
+"""
+
+import sys
+import time
+
+from grayscott_jl_tpu import julia_main
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    rc = julia_main(sys.argv[1:])
+    if rc == 0:
+        print(f"{time.perf_counter() - t0:.6f} seconds", file=sys.stderr)
+    sys.exit(rc)

@@ -1,0 +1,46 @@
+"""grayscott_jl_tpu — a TPU-native Gray-Scott reaction-diffusion framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``Rabab53/GrayScott.jl`` (see SURVEY.md): explicit-Euler 7-point-stencil
+integration of the 3D Gray-Scott system, 3D domain decomposition over a
+device mesh with ICI collective-permute halo exchange, streaming BP-style
+parallel output with Fides/VTK visualization schemas, and
+checkpoint/restart (the ``analysis`` subpackage adds the companion
+PDF-analysis workflow as it lands).
+
+Public API (mirrors the reference's ``GrayScott`` / ``Simulation`` modules):
+
+    from grayscott_jl_tpu import main, initialization, Simulation, Settings
+"""
+
+from .config.settings import (  # noqa: F401
+    Settings,
+    get_settings,
+    load_backend_and_lang,
+    parse_settings_toml,
+    resolve_precision,
+)
+from .simulation import Simulation, finalize, initialization  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def main(args):
+    """CLI driver entry point (reference ``GrayScott.main``)."""
+    from .driver import main as _main
+
+    return _main(args)
+
+
+def julia_main(args=None) -> int:
+    """Exit-code wrapper (reference ``GrayScott.julia_main``,
+    ``src/GrayScott.jl:40-48``)."""
+    import sys
+    import traceback
+
+    try:
+        main(sys.argv[1:] if args is None else args)
+    except Exception:  # noqa: BLE001 — mirror reference catch-all
+        traceback.print_exc()
+        return 1
+    return 0

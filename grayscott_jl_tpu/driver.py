@@ -1,0 +1,95 @@
+"""Simulation driver: the reference's ``GrayScott.main`` step loop.
+
+Flow (``src/GrayScott.jl:68-103``): initialization -> output stream init ->
+step loop -> write every ``plotgap`` -> close -> finalize; plus what the
+reference only declares (SURVEY defect #4): checkpoint every
+``checkpoint_freq`` and restart from ``restart_input``.
+
+Idiomatic-JAX difference: the loop advances in fused chunks — the number of
+steps to the next output/checkpoint boundary runs as one jitted
+``lax.fori_loop`` on device (halo exchange included), with host contact
+only at the boundaries. The reference instead crosses the host boundary
+every single step (``public.jl:45-71``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from .config.settings import get_settings
+from .simulation import Simulation, finalize
+from .utils.log import Logger
+
+
+def _next_boundary(step: int, period: int, limit: int) -> int:
+    """Next multiple of ``period`` after ``step``, capped at ``limit``."""
+    if period <= 0:
+        return limit
+    return min(limit, (step // period + 1) * period)
+
+
+def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
+    """Run a full simulation from CLI args (reference ``GrayScott.main``)."""
+    settings = get_settings(list(args))
+    sim = Simulation(settings, n_devices=n_devices, seed=seed)
+    log = Logger(verbose=settings.verbose)
+
+    restart_step = 0
+    if settings.restart:
+        from .io.checkpoint import load_checkpoint
+
+        u, v, restart_step = load_checkpoint(settings.restart_input, settings)
+        sim.restore(u, v, restart_step)
+        log.info(f"Restarted from {settings.restart_input} at step {restart_step}")
+
+    from .io.checkpoint import CheckpointWriter
+    from .io.stream import SimStream
+
+    stream = SimStream(settings, sim.domain, sim.dtype)
+    ckpt = CheckpointWriter(settings, sim.dtype) if settings.checkpoint else None
+
+    step = restart_step
+    t0 = time.perf_counter()
+    while step < settings.steps:
+        boundary = min(
+            _next_boundary(step, settings.plotgap, settings.steps),
+            _next_boundary(
+                step,
+                settings.checkpoint_freq if ckpt is not None else 0,
+                settings.steps,
+            ),
+        )
+        sim.iterate(boundary - step)
+        step = boundary
+
+        if settings.plotgap > 0 and step % settings.plotgap == 0:
+            log.info(
+                f"Simulation at step {step} writing output step "
+                f"{step // settings.plotgap}"
+            )
+            u, v = sim.get_fields()
+            stream.write_step(step, u, v)
+
+        if (
+            ckpt is not None
+            and settings.checkpoint_freq > 0
+            and step % settings.checkpoint_freq == 0
+        ):
+            u, v = sim.get_fields()
+            ckpt.save(step, u, v)
+            log.info(f"Checkpoint written at step {step}")
+
+    sim.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    cells = settings.L**3 * (settings.steps - restart_step)
+    log.info(
+        f"Completed {settings.steps - restart_step} steps in {elapsed:.3f}s "
+        f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
+    )
+
+    stream.close()
+    if ckpt is not None:
+        ckpt.close()
+    finalize()
+    return sim

@@ -1,0 +1,389 @@
+"""BP-lite: a streaming, step-based, self-describing array store.
+
+This plays the role ADIOS2 (the reference's only C++ native dependency,
+``Project.toml:7-8``, bound in ``src/simulation/IO.jl``) plays for the
+reference: step-based engines with ``begin_step / put / end_step`` writer
+semantics, global arrays decomposed into per-writer blocks with
+``(shape, start, count)`` boxes, named typed attributes for provenance, and
+a streaming reader with ``begin_step(timeout) -> OK | NOT_READY |
+END_OF_STREAM`` polling semantics (used by the PDF-analysis coupling,
+``src/analysis/pdfcalc.jl:112-123``).
+
+The ADIOS2 library itself is not available in this environment (zero
+egress, no wheels); BP-lite keeps the *contract* — variable names, typed
+attributes, step streaming, block decomposition — in a documented on-disk
+format. This module is the pure-Python engine and the format's
+specification; a native C++ engine for the same on-disk format is the
+``csrc/`` component (used automatically when its shared library is built
+— see ``io/native.py`` if present).
+
+On-disk layout of ``name.bp`` (a directory, like BP4/BP5)::
+
+    name.bp/
+      md.json     -- metadata: attributes, variables, per-step block index;
+                     rewritten atomically (tmp + rename) at every end_step
+                     so concurrent readers always see a consistent snapshot
+      data.<w>    -- append-only binary payload of writer w (C-order raw
+                     array bytes, little-endian)
+
+``md.json`` schema::
+
+    {
+      "format": "bplite-1",
+      "complete": false,            # true once the writer closed
+      "attributes": {name: {"dtype": str, "value": scalar|list}},
+      "variables":  {name: {"dtype": str, "shape": [..] | []}},
+      "steps": [                    # one entry per completed step
+        {name: [ {"file": "data.0", "offset": int,
+                  "start": [..], "count": [..]} , ...] }
+      ]
+    }
+
+Scalars are zero-dim variables with ``start=count=[]``.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+FORMAT_NAME = "bplite-1"
+
+
+class StepStatus(enum.Enum):
+    """Reader step states (ADIOS2 ``step_status_*`` analog)."""
+
+    OK = "ok"
+    NOT_READY = "not_ready"
+    END_OF_STREAM = "end_of_stream"
+
+
+def _md_path(path: str) -> str:
+    return os.path.join(path, "md.json")
+
+
+class BpWriter:
+    """Step-based writer engine (``ADIOS2.open(io, name, mode_write)``)."""
+
+    def __init__(self, path: str, *, writer_id: int = 0, append: bool = False):
+        self.path = path
+        self.writer_id = writer_id
+        os.makedirs(path, exist_ok=True)
+        self._data_path = os.path.join(path, f"data.{writer_id}")
+        if append and os.path.exists(_md_path(path)):
+            with open(_md_path(path), "r", encoding="utf-8") as f:
+                self._md = json.load(f)
+            self._md["complete"] = False
+            self._offset = (
+                os.path.getsize(self._data_path)
+                if os.path.exists(self._data_path)
+                else 0
+            )
+        else:
+            self._md = {
+                "format": FORMAT_NAME,
+                "complete": False,
+                "attributes": {},
+                "variables": {},
+                "steps": [],
+            }
+            with open(self._data_path, "wb"):
+                pass
+            self._offset = 0
+        self._data = open(self._data_path, "ab")
+        self._in_step = False
+        self._step_blocks: Dict[str, List[dict]] = {}
+        self._flush_md()
+
+    # -- definition phase (ADIOS2 define_attribute / define_variable) ------
+
+    def define_attribute(self, name: str, value: Any) -> None:
+        if isinstance(value, (list, tuple, np.ndarray)):
+            arr = np.asarray(value)
+            self._md["attributes"][name] = {
+                "dtype": arr.dtype.name if arr.dtype.kind != "U" else "string",
+                "value": arr.tolist(),
+            }
+        elif isinstance(value, str):
+            self._md["attributes"][name] = {"dtype": "string", "value": value}
+        elif isinstance(value, bool):
+            self._md["attributes"][name] = {"dtype": "bool", "value": value}
+        elif isinstance(value, (int, np.integer)):
+            self._md["attributes"][name] = {"dtype": "int64", "value": int(value)}
+        elif isinstance(value, (float, np.floating)):
+            self._md["attributes"][name] = {
+                "dtype": "float64",
+                "value": float(value),
+            }
+        else:
+            raise TypeError(f"Unsupported attribute type for {name!r}: {type(value)}")
+
+    def define_variable(
+        self, name: str, dtype, shape: Sequence[int] = ()
+    ) -> None:
+        self._md["variables"][name] = {
+            "dtype": np.dtype(dtype).name,
+            "shape": [int(s) for s in shape],
+        }
+
+    # -- step phase --------------------------------------------------------
+
+    def begin_step(self) -> None:
+        if self._in_step:
+            raise RuntimeError("begin_step called inside an open step")
+        self._in_step = True
+        self._step_blocks = {}
+
+    def put(
+        self,
+        name: str,
+        value,
+        *,
+        start: Optional[Sequence[int]] = None,
+        count: Optional[Sequence[int]] = None,
+    ) -> None:
+        """Write one block of variable ``name`` for the current step.
+
+        ``start``/``count`` give the block's box in the global array
+        (``IO.jl:60-67`` semantics); both default to the full variable.
+        """
+        if not self._in_step:
+            raise RuntimeError("put called outside begin_step/end_step")
+        var = self._md["variables"].get(name)
+        if var is None:
+            raise KeyError(f"Variable {name!r} not defined")
+        shape = var["shape"]
+        arr = np.asarray(value, dtype=var["dtype"])
+        if not shape:
+            # scalar variable: ascontiguousarray would promote 0-d to 1-d
+            arr = arr.reshape(())
+        else:
+            arr = np.ascontiguousarray(arr)
+        if start is None:
+            start = [0] * len(shape)
+        if count is None:
+            count = list(shape)
+        if list(arr.shape) != [int(c) for c in count]:
+            raise ValueError(
+                f"{name!r}: data shape {arr.shape} != count {tuple(count)}"
+            )
+        block = {
+            "file": os.path.basename(self._data_path),
+            "offset": self._offset,
+            "start": [int(s) for s in start],
+            "count": [int(c) for c in count],
+        }
+        data = arr.tobytes()
+        self._data.write(data)
+        self._offset += len(data)
+        self._step_blocks.setdefault(name, []).append(block)
+
+    def end_step(self) -> None:
+        """Complete the step: payload is flushed, then the metadata index is
+        atomically replaced — a streaming reader sees the step only after
+        its data is durable (ADIOS2 deferred-put flush, ``IO.jl:91-95``)."""
+        if not self._in_step:
+            raise RuntimeError("end_step called outside a step")
+        self._data.flush()
+        os.fsync(self._data.fileno())
+        self._md["steps"].append(self._step_blocks)
+        self._flush_md()
+        self._in_step = False
+        self._step_blocks = {}
+
+    def close(self) -> None:
+        if self._in_step:
+            raise RuntimeError("close called inside an open step")
+        self._md["complete"] = True
+        self._flush_md()
+        self._data.close()
+
+    def _flush_md(self) -> None:
+        tmp = _md_path(self.path) + f".tmp.{self.writer_id}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._md, f)
+        os.replace(tmp, _md_path(self.path))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class VarInfo:
+    def __init__(self, name: str, dtype: str, shape: Tuple[int, ...]):
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        self.shape = shape
+
+    def __repr__(self):
+        return f"VarInfo({self.name!r}, {self.dtype}, {self.shape})"
+
+
+class BpReader:
+    """Streaming step reader (``ADIOS2.open(io, name, mode_read)``).
+
+    Supports live coupling: ``begin_step`` polls ``md.json`` until a step
+    beyond the last-consumed one appears (NOT_READY while the writer is
+    alive, END_OF_STREAM once it closed with no new steps) — the semantics
+    the reference's pdfcalc loop relies on (``pdfcalc.jl:112-123``).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"No such BP-lite store: {path}")
+        self._consumed = 0
+        self._current: Optional[dict] = None
+        self._selections: Dict[str, Tuple[List[int], List[int]]] = {}
+        self._md: dict = {}
+        self._load_md()
+
+    def _load_md(self) -> None:
+        # The writer replaces md.json atomically; retry briefly on the
+        # window where a JSON read could race a slow filesystem.
+        for _ in range(50):
+            try:
+                with open(_md_path(self.path), "r", encoding="utf-8") as f:
+                    self._md = json.load(f)
+                return
+            except (json.JSONDecodeError, FileNotFoundError):
+                time.sleep(0.01)
+        raise RuntimeError(f"Unreadable BP-lite metadata at {self.path}")
+
+    # -- step streaming ----------------------------------------------------
+
+    def begin_step(self, timeout: float = 10.0) -> StepStatus:
+        deadline = time.monotonic() + timeout
+        while True:
+            self._load_md()
+            if self._consumed < len(self._md["steps"]):
+                self._current = self._md["steps"][self._consumed]
+                self._selections = {}
+                return StepStatus.OK
+            if self._md.get("complete"):
+                return StepStatus.END_OF_STREAM
+            if time.monotonic() >= deadline:
+                return StepStatus.NOT_READY
+            time.sleep(0.05)
+
+    def current_step(self) -> int:
+        return self._consumed
+
+    def end_step(self) -> None:
+        if self._current is None:
+            raise RuntimeError("end_step without an open step")
+        self._current = None
+        self._consumed += 1
+
+    # -- inquiry -----------------------------------------------------------
+
+    def attributes(self) -> Dict[str, Any]:
+        return {
+            k: v["value"] for k, v in self._md.get("attributes", {}).items()
+        }
+
+    def available_variables(self) -> Dict[str, VarInfo]:
+        return {
+            name: VarInfo(name, v["dtype"], tuple(v["shape"]))
+            for name, v in self._md.get("variables", {}).items()
+        }
+
+    def inquire_variable(self, name: str) -> Optional[VarInfo]:
+        return self.available_variables().get(name)
+
+    def num_steps(self) -> int:
+        return len(self._md["steps"])
+
+    def set_selection(
+        self, name: str, start: Sequence[int], count: Sequence[int]
+    ) -> None:
+        """Select a box of the global array for the next ``get`` (ADIOS2
+        ``set_selection``, used by pdfcalc's z-split, ``pdfcalc.jl:144``)."""
+        self._selections[name] = (
+            [int(s) for s in start],
+            [int(c) for c in count],
+        )
+
+    # -- data --------------------------------------------------------------
+
+    def get(self, name: str, *, step: Optional[int] = None) -> np.ndarray:
+        """Read variable ``name`` at the current (or given) step, honoring
+        any selection. Assembles the box from the step's blocks."""
+        if step is None:
+            if self._current is None:
+                raise RuntimeError("get outside begin_step/end_step "
+                                   "(or pass step=...)")
+            blocks = self._current.get(name)
+        else:
+            if not 0 <= step < len(self._md["steps"]):
+                raise IndexError(f"step {step} out of range")
+            blocks = self._md["steps"][step].get(name)
+        if blocks is None:
+            raise KeyError(f"Variable {name!r} has no data at this step")
+        info = self.inquire_variable(name)
+
+        if not info.shape:  # scalar
+            return self._read_block(blocks[0], info.dtype, ())
+
+        sel = self._selections.get(name)
+        if sel is None:
+            start = [0] * len(info.shape)
+            count = list(info.shape)
+        else:
+            start, count = sel
+        out = np.empty(count, dtype=info.dtype)
+        filled = np.zeros(count, dtype=bool)
+        sel_lo = np.array(start)
+        sel_hi = sel_lo + np.array(count)
+        for b in blocks:
+            b_lo = np.array(b["start"])
+            b_hi = b_lo + np.array(b["count"])
+            lo = np.maximum(sel_lo, b_lo)
+            hi = np.minimum(sel_hi, b_hi)
+            if np.any(lo >= hi):
+                continue
+            data = self._read_block(b, info.dtype, tuple(b["count"]))
+            src = tuple(
+                slice(int(l - bl), int(h - bl))
+                for l, h, bl in zip(lo, hi, b_lo)
+            )
+            dst = tuple(
+                slice(int(l - sl), int(h - sl))
+                for l, h, sl in zip(lo, hi, sel_lo)
+            )
+            out[dst] = data[src]
+            filled[dst] = True
+        if not filled.all():
+            raise ValueError(
+                f"Selection {start}+{count} of {name!r} not fully covered "
+                "by written blocks"
+            )
+        return out
+
+    def _read_block(self, block: dict, dtype, shape) -> np.ndarray:
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+        with open(os.path.join(self.path, block["file"]), "rb") as f:
+            f.seek(block["offset"])
+            buf = f.read(nbytes)
+        if len(buf) != nbytes:
+            raise IOError(
+                f"Short read in {block['file']} at {block['offset']}"
+            )
+        arr = np.frombuffer(buf, dtype=dtype)
+        return arr.reshape(shape) if shape else arr[0]
+
+    def close(self) -> None:
+        self._current = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,67 @@
+"""Checkpoint / restart — implemented for real.
+
+The reference *declares* checkpoint/restart settings (``Structs.jl:15-19``)
+but never uses them: the driver hardcodes ``restart_step = 0``
+(``src/GrayScott.jl:77-78``) and no checkpoint is ever written (SURVEY
+defect #4). Here they work: every ``checkpoint_freq`` steps the driver
+writes (u, v, step) to ``checkpoint_output`` as a BP-lite store, and
+``restart = true`` resumes from ``restart_input`` — reproducing the exact
+trajectory, because the noise key is folded per absolute step
+(``models/grayscott.py``).
+
+Checkpoints append as new steps in one store; restart loads the latest.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..config.settings import Settings
+from .bplite import BpReader, BpWriter
+
+
+class CheckpointWriter:
+    def __init__(self, settings: Settings, dtype):
+        L = settings.L
+        # On restart, append: truncating would destroy the very store the
+        # run just resumed from when checkpoint_output == restart_input.
+        self.writer = BpWriter(
+            settings.checkpoint_output, append=settings.restart
+        )
+        self.writer.define_attribute("L", settings.L)
+        self.writer.define_attribute("precision", settings.precision)
+        self.writer.define_variable("step", np.int32)
+        self.writer.define_variable("u", np.dtype(dtype).name, (L, L, L))
+        self.writer.define_variable("v", np.dtype(dtype).name, (L, L, L))
+
+    def save(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
+        w = self.writer
+        w.begin_step()
+        w.put("step", np.int32(step))
+        w.put("u", u)
+        w.put("v", v)
+        w.end_step()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+def load_checkpoint(path: str, settings: Settings) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Latest (u, v, step) from a checkpoint store; validates L."""
+    r = BpReader(path)
+    n = r.num_steps()
+    if n == 0:
+        raise ValueError(f"Checkpoint store {path} contains no steps")
+    attrs = r.attributes()
+    if int(attrs.get("L", settings.L)) != settings.L:
+        raise ValueError(
+            f"Checkpoint L={attrs['L']} does not match config L={settings.L}"
+        )
+    last = n - 1
+    step = int(r.get("step", step=last))
+    u = r.get("u", step=last)
+    v = r.get("v", step=last)
+    r.close()
+    return u, v, step

@@ -1,0 +1,117 @@
+"""Direct VTK ImageData (.vti) output for ParaView.
+
+The reference embeds a VTK ImageData XML schema as an ADIOS2 attribute so
+ParaView's ADIOS reader can interpret the BP file (``IO.jl:123-163``).
+Without the ADIOS2 C++ library in this environment, BP-lite stores that
+same schema for parity — and this module additionally writes real ``.vti``
+files (plus a ``.pvd`` time-series index), so the simulation remains
+directly ParaView-visualizable end-to-end.
+
+Axis convention: our fields are C-order ``[x, y, z]``; VTK flat ordering is
+x-fastest, so blocks are transposed before writing.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import xml.sax.saxutils as saxutils
+
+import numpy as np
+
+_VTK_TYPES = {
+    "float32": "Float32",
+    "float64": "Float64",
+    "int32": "Int32",
+    "int64": "Int64",
+}
+
+
+def write_vti(path: str, L: int, step: int, u: np.ndarray, v: np.ndarray) -> None:
+    """One .vti file with U and V as CellData (appended raw encoding).
+
+    Dtypes VTK has no type name for (e.g. bfloat16) are widened to float32.
+    """
+    if u.dtype.name not in _VTK_TYPES:
+        u = u.astype(np.float32)
+        v = v.astype(np.float32)
+    vtk_type = _VTK_TYPES[u.dtype.name]
+    extent = f"0 {L} 0 {L} 0 {L}"
+    payloads = []
+    offsets = []
+    off = 0
+    for arr in (u, v):
+        raw = np.ascontiguousarray(arr.transpose(2, 1, 0)).tobytes()
+        payloads.append(struct.pack("<Q", len(raw)) + raw)
+        offsets.append(off)
+        off += len(payloads[-1])
+
+    header = (
+        '<?xml version="1.0"?>\n'
+        '<VTKFile type="ImageData" version="1.0" byte_order="LittleEndian" '
+        'header_type="UInt64">\n'
+        f'  <ImageData WholeExtent="{extent}" Origin="0 0 0" '
+        'Spacing="1 1 1">\n'
+        f'    <Piece Extent="{extent}">\n'
+        '      <CellData Scalars="U">\n'
+        f'        <DataArray type="{vtk_type}" Name="U" format="appended" '
+        f'offset="{offsets[0]}"/>\n'
+        f'        <DataArray type="{vtk_type}" Name="V" format="appended" '
+        f'offset="{offsets[1]}"/>\n'
+        '      </CellData>\n'
+        '    </Piece>\n'
+        '  </ImageData>\n'
+        '  <AppendedData encoding="raw">_'
+    )
+    footer = "</AppendedData>\n</VTKFile>\n"
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header.encode())
+        for p in payloads:
+            f.write(p)
+        f.write(footer.encode())
+    os.replace(tmp, path)
+
+
+class VtiSeriesWriter:
+    """Time series of .vti files with a .pvd collection index."""
+
+    def __init__(self, output_name: str, L: int, *, append: bool = False):
+        base = output_name[:-3] if output_name.endswith(".bp") else output_name
+        self.dir = base + ".vtk"
+        self.L = L
+        os.makedirs(self.dir, exist_ok=True)
+        self._entries = []
+        if append:
+            # restart: keep pre-restart frames in the series index
+            for name in sorted(os.listdir(self.dir)):
+                if name.startswith("step_") and name.endswith(".vti"):
+                    self._entries.append((int(name[5:-4]), name))
+        self._pvd_path = os.path.join(self.dir, "series.pvd")
+
+    def write(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
+        name = f"step_{step:07d}.vti"
+        write_vti(os.path.join(self.dir, name), self.L, step, u, v)
+        self._entries.append((step, name))
+        self._flush_pvd()
+
+    def _flush_pvd(self) -> None:
+        lines = [
+            '<?xml version="1.0"?>',
+            '<VTKFile type="Collection" version="0.1" '
+            'byte_order="LittleEndian">',
+            "  <Collection>",
+        ]
+        for step, name in self._entries:
+            lines.append(
+                f'    <DataSet timestep="{step}" part="0" '
+                f'file="{saxutils.escape(name)}"/>'
+            )
+        lines += ["  </Collection>", "</VTKFile>", ""]
+        tmp = self._pvd_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines))
+        os.replace(tmp, self._pvd_path)
+
+    def close(self) -> None:
+        self._flush_pvd()

@@ -1,0 +1,132 @@
+"""The Gray-Scott reaction-diffusion model — the framework's flagship model.
+
+System (reference ``README.md:8-11``):
+
+    u_t = Du * lap(u) - u*v^2 + F*(1-u) + noise*U(-1,1)
+    v_t = Dv * lap(v) + u*v^2 - (F+k)*v
+
+integrated with explicit Euler on a cubic grid of side ``L`` with a 1-cell
+frozen ghost shell (u=1, v=0) as the boundary condition.
+
+Design differences from the reference (idiomatic JAX):
+
+* Fields are interior-shaped ``(L, L, L)`` immutable arrays; the ghost shell
+  is materialized functionally at compute time (single device: constant pad;
+  distributed: halo exchange in ``parallel/halo.py``). The reference instead
+  carries mutable ghost-padded arrays plus explicit double buffers
+  (``Structs.jl:82-93``); in JAX the "swap" is just returning new arrays
+  (``public.jl:67-68`` made free).
+* Noise uses JAX's counter-based PRNG: the step key is ``fold_in(base, step)``
+  so a restart reproduces the same stream — the reference's global-RNG
+  ``rand(Distributions.Uniform(-1,1))`` (``Simulation_CPU.jl:101-103``) is
+  not reproducible across thread schedules.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.settings import Settings
+from ..ops import stencil
+
+#: Half-width of the seeded center cube (reference ``Simulation_CPU.jl:31``).
+SEED_HALF_WIDTH = 6
+SEED_U = 0.25
+SEED_V = 0.33
+
+
+class Params(NamedTuple):
+    """Gray-Scott parameters as dtype-typed scalars (a JAX pytree).
+
+    Passing these as traced values means changing F/k/Du/Dv/dt does not
+    trigger recompilation.
+    """
+
+    Du: jnp.ndarray
+    Dv: jnp.ndarray
+    F: jnp.ndarray
+    k: jnp.ndarray
+    dt: jnp.ndarray
+    noise: jnp.ndarray
+
+    @classmethod
+    def from_settings(cls, settings: Settings, dtype) -> "Params":
+        return cls(
+            Du=jnp.asarray(settings.Du, dtype),
+            Dv=jnp.asarray(settings.Dv, dtype),
+            F=jnp.asarray(settings.F, dtype),
+            k=jnp.asarray(settings.k, dtype),
+            dt=jnp.asarray(settings.dt, dtype),
+            noise=jnp.asarray(settings.noise, dtype),
+        )
+
+
+def seed_bounds(L: int) -> Tuple[int, int]:
+    """Global index range (inclusive) of the seeded center cube.
+
+    Reference: ``minL = Int64(L/2 - d); maxL = Int64(L/2 + d)`` with d=6
+    (``Simulation_CPU.jl:31-35``) over 0-based global coordinates. The
+    reference throws ``InexactError`` for odd L; we require even L with a
+    clear error.
+    """
+    if L % 2 != 0:
+        raise ValueError(
+            f"L must be even (reference requires Int(L/2)); got L={L}"
+        )
+    return L // 2 - SEED_HALF_WIDTH, L // 2 + SEED_HALF_WIDTH
+
+
+def init_fields(
+    L: int,
+    dtype,
+    *,
+    offsets: Tuple[int, int, int] = (0, 0, 0),
+    sizes: Optional[Tuple[int, int, int]] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Initialize (u, v) for a local block of the global ``L^3`` grid.
+
+    u = 1 everywhere, v = 0, except a seeded cube
+    ``[L/2-6, L/2+6]^3`` (inclusive) where u=0.25, v=0.33
+    (reference ``Simulation_CPU.jl:23-57``). ``offsets``/``sizes`` select the
+    block owned by this shard in global 0-based coordinates (whole grid by
+    default); the seed region is intersected with the block, mirroring the
+    reference's ``is_inside`` guard (``Common.jl:34-47``).
+
+    Returns interior-shaped arrays (no ghost cells).
+    """
+    if sizes is None:
+        sizes = (L, L, L)
+    lo, hi = seed_bounds(L)
+
+    u = jnp.full(sizes, stencil.U_BOUNDARY, dtype=dtype)
+    v = jnp.full(sizes, stencil.V_BOUNDARY, dtype=dtype)
+
+    # Intersect [lo, hi] (global, inclusive) with [off, off+size) per axis.
+    slices = []
+    empty = False
+    for off, size in zip(offsets, sizes):
+        a = max(lo - off, 0)
+        b = min(hi + 1 - off, size)
+        if a >= b:
+            empty = True
+            break
+        slices.append(slice(a, b))
+    if not empty:
+        u = u.at[tuple(slices)].set(jnp.asarray(SEED_U, dtype))
+        v = v.at[tuple(slices)].set(jnp.asarray(SEED_V, dtype))
+    return u, v
+
+
+def noise_field(key, shape, dtype, noise: jnp.ndarray) -> jnp.ndarray:
+    """Pre-scaled noise term ``noise * U(-1, 1)`` per cell.
+
+    Counter-based replacement for the reference's per-cell
+    ``rand(Distributions.Uniform(-1,1))`` (``Simulation_CPU.jl:101-103``).
+    """
+    unit = jax.random.uniform(key, shape, dtype=dtype, minval=-1.0, maxval=1.0)
+    return noise * unit
+
+

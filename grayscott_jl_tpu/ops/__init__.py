@@ -1,0 +1,26 @@
+"""Compute kernels for the stencil update.
+
+Two kernel languages (the reference's Plain/KernelAbstractions pair,
+``Inputs.jl:110-120``, re-imagined for TPU):
+
+* ``"xla"``    — jnp/lax ops, fused by the XLA compiler (default; legacy
+  config values "Plain" and "KernelAbstractions" alias here).
+* ``"pallas"`` — hand-fused Pallas TPU kernel (``kernel_language = "Pallas"``).
+
+Both share the signature ``kernel(u_pad, v_pad, noise_u, params) -> (u, v)``
+with ghost-padded inputs and interior-shaped outputs.
+"""
+
+from __future__ import annotations
+
+from . import stencil
+
+
+def get_kernel(lang: str):
+    if lang == "xla":
+        return stencil.reaction_update
+    if lang == "pallas":
+        from . import pallas_stencil
+
+        return pallas_stencil.reaction_update
+    raise ValueError(f"Unknown kernel language: {lang!r}")

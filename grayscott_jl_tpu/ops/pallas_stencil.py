@@ -1,0 +1,140 @@
+"""Hand-fused Pallas TPU kernel for the Gray-Scott update.
+
+``kernel_language = "Pallas"`` — the TPU-native re-design of the
+reference's hand-written GPU kernels (``ext/CUDAExt.jl:127-187``,
+``Simulation_KA.jl:160-236``): where those launch a 2D (k,j) thread grid
+with a serial i loop per thread, this kernel walks the outermost (x) axis
+as a sequential TPU grid, processing one full (y, z) plane per program with
+both fields' diffusion + reaction fused into a single VMEM-resident pass.
+
+Layout: fields are C-order ``[x, y, z]`` so z is the 128-lane dimension and
+y the sublane dimension; in-plane shifts are vector ops, and the x-axis
+neighbor planes arrive as separate blocks (``x-1``, ``x``, ``x+1``) of the
+same ghost-padded operand. HBM traffic per step: 3 reads + 1 write per
+field per cell (vs the XLA path's materialized pad + 6 shifted-slice
+reads), plus the optional noise field.
+
+Numerics are identical to ``ops/stencil.reaction_update`` (same op order,
+same dtype); the noise field is generated *outside* the kernel with the
+same ``jax.random`` stream, so XLA- and Pallas-kernel runs are bit-
+comparable (asserted by ``tests/unit/test_pallas.py``).
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests); the
+Float64 + TPU combination falls back to the XLA kernel (Mosaic has no f64
+vector path — the reference has the same asymmetry: its AMDGPU backend
+disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import stencil
+
+
+def _plane_kernel(p_ref, um, uc, up, vm, vc, vp, nz, u_out, v_out):
+    """One (y, z) plane of the fused update.
+
+    ``um/uc/up`` are the x-1/x/x+1 ghost-padded planes of u, shape
+    (1, ny+2, nz+2); ``nz`` is the pre-scaled noise plane (1, ny, nz) or
+    None; outputs are interior planes (1, ny, nz).
+    """
+    dtype = uc.dtype
+    six = jnp.asarray(6.0, dtype)
+    one = jnp.asarray(1.0, dtype)
+    Du, Dv, F, K, dt = (p_ref[i] for i in range(5))
+
+    # 7-point Laplacian on the plane interior (Common.jl:13-18): x-axis
+    # neighbors come from the um/up planes, y/z neighbors from in-plane
+    # shifts of the center plane.
+    u_c = uc[0]
+    v_c = vc[0]
+    lap_u = (
+        um[0, 1:-1, 1:-1]
+        + up[0, 1:-1, 1:-1]
+        + u_c[:-2, 1:-1]
+        + u_c[2:, 1:-1]
+        + u_c[1:-1, :-2]
+        + u_c[1:-1, 2:]
+        - six * u_c[1:-1, 1:-1]
+    ) / six
+    lap_v = (
+        vm[0, 1:-1, 1:-1]
+        + vp[0, 1:-1, 1:-1]
+        + v_c[:-2, 1:-1]
+        + v_c[2:, 1:-1]
+        + v_c[1:-1, :-2]
+        + v_c[1:-1, 2:]
+        - six * v_c[1:-1, 1:-1]
+    ) / six
+
+    u = u_c[1:-1, 1:-1]
+    v = v_c[1:-1, 1:-1]
+    uvv = u * v * v
+    du = Du * lap_u - uvv + F * (one - u) + (nz[0] if nz is not None else 0.0)
+    dv = Dv * lap_v + uvv - (F + K) * v
+    u_out[0] = u + du * dt
+    v_out[0] = v + dv * dt
+
+
+def _plane_kernel_nonoise(p_ref, um, uc, up, vm, vc, vp, u_out, v_out):
+    _plane_kernel(p_ref, um, uc, up, vm, vc, vp, None, u_out, v_out)
+
+
+@functools.partial(jax.jit, static_argnames=("use_noise",))
+def _call(u_pad, v_pad, noise_u, params_vec, *, use_noise: bool):
+    nxp, nyp, nzp = u_pad.shape
+    nx, ny, nz = nxp - 2, nyp - 2, nzp - 2
+    dtype = u_pad.dtype
+
+    plane = lambda off: pl.BlockSpec(  # noqa: E731 — x-1/x/x+1 planes
+        (1, nyp, nzp), lambda i, o=off: (i + o, 0, 0)
+    )
+    interior = pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0))
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # params vector
+        plane(0), plane(1), plane(2),  # u planes x-1, x, x+1
+        plane(0), plane(1), plane(2),  # v planes
+    ]
+    operands = [params_vec, u_pad, u_pad, u_pad, v_pad, v_pad, v_pad]
+    if use_noise:
+        in_specs.append(interior)
+        operands.append(noise_u)
+        kernel = _plane_kernel
+    else:
+        kernel = _plane_kernel_nonoise
+
+    out_shape = [
+        jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+        jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+    ]
+    return pl.pallas_call(
+        kernel,
+        grid=(nx,),
+        in_specs=in_specs,
+        out_specs=[interior, interior],
+        out_shape=out_shape,
+        interpret=jax.default_backend() != "tpu",
+    )(*operands)
+
+
+def reaction_update(u_pad, v_pad, noise_u, params):
+    """Drop-in replacement for ``stencil.reaction_update`` (same signature:
+    ghost-padded inputs, interior outputs)."""
+    dtype = u_pad.dtype
+    if dtype == jnp.float64 and jax.default_backend() == "tpu":
+        # Mosaic has no f64 path; keep Float64 configs correct via XLA.
+        return stencil.reaction_update(u_pad, v_pad, noise_u, params)
+    params_vec = jnp.stack(
+        [params.Du, params.Dv, params.F, params.k, params.dt]
+    ).astype(dtype)
+    use_noise = getattr(noise_u, "ndim", 0) > 0
+    if not use_noise:
+        noise_u = None
+    return _call(u_pad, v_pad, noise_u, params_vec, use_noise=use_noise)

@@ -1,0 +1,265 @@
+"""Public simulation API: settings -> devices -> fields -> step loop.
+
+This is the TPU-native analog of the reference's ``Simulation`` module
+(``src/simulation/public.jl`` + ``communication.jl:15-46``):
+
+* ``initialization(args)``  -> parse config, select devices, build the
+  domain decomposition, initialize fields (``communication.jl:15-33``).
+* ``Simulation.iterate(n)`` -> advance n steps (``public.jl:45-71``); halo
+  exchange + stencil update + "swap" all live inside one jitted
+  ``lax.fori_loop`` so XLA fuses and overlaps them — there is no per-step
+  host round-trip, unlike the reference which re-dispatches from strings
+  every step (``public.jl:47``, SURVEY defect #9).
+* ``Simulation.get_fields()`` -> host copies of u, v
+  (``Simulation_CPU.jl:125-133``; ghost stripping is a no-op here because
+  fields are stored interior-shaped).
+
+Distribution: with >1 device of the selected platform, fields are sharded
+``P('x','y','z')`` over a 3D ``jax.sharding.Mesh`` (the ``MPI.Cart_create``
+analog) and the step runs under ``shard_map`` with ``lax.ppermute`` halos
+(``parallel/halo.py``). With 1 device the ghost shell is a constant pad.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6 style
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from .config import settings as config
+from .config.settings import Settings
+from .models import grayscott
+from .ops import get_kernel, stencil
+from .parallel import halo
+from .parallel.domain import CartDomain
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def select_devices(platform: str):
+    """Devices of the requested platform (reference backend dispatch analog).
+
+    For CPU runs the platform list is pinned to "cpu" before the first
+    device query: initializing *all* registered backends would create the
+    TPU-tunnel client too, which blocks when no chip grant is available —
+    a CPU-only run must never depend on the accelerator being reachable.
+    """
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backends already initialized; keep current platforms
+    try:
+        return jax.devices(platform)
+    except RuntimeError as e:
+        raise RuntimeError(
+            f"Backend {platform!r} requested in config but no such JAX "
+            f"devices are available: {e}"
+        ) from e
+
+
+class Simulation:
+    """A running Gray-Scott simulation bound to a set of devices."""
+
+    def __init__(
+        self,
+        settings: Settings,
+        *,
+        n_devices: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.settings = settings
+        backend, self.kernel_language = config.load_backend_and_lang(settings)
+        # Resolve the kernel eagerly so an unavailable kernel language fails
+        # at construction, not at first iterate (the reference defers all
+        # dispatch errors to runtime fallbacks, public.jl:31-32, 77-78).
+        self._kernel = get_kernel(self.kernel_language)
+        self.dtype = config.resolve_precision(settings)
+
+        devices = select_devices(backend)
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"requested {n_devices} devices, only {len(devices)} "
+                    f"{backend} devices available"
+                )
+            devices = devices[:n_devices]
+
+        self.domain = CartDomain.create(len(devices), settings.L)
+        self.sharded = len(devices) > 1
+        self.params = grayscott.Params.from_settings(settings, self.dtype)
+        self.use_noise = settings.noise != 0.0
+        self.base_key = jax.random.PRNGKey(seed)
+        self.step = 0
+        self._runners: Dict[int, object] = {}
+
+        if self.sharded:
+            mesh_devices = np.array(devices).reshape(self.domain.dims)
+            self.mesh = Mesh(mesh_devices, AXIS_NAMES)
+            self.field_sharding = NamedSharding(self.mesh, P(*AXIS_NAMES))
+        else:
+            self.mesh = None
+            self.field_sharding = None
+            self.device = devices[0]
+
+        self.u, self.v = self._init_fields()
+
+    # ------------------------------------------------------------------ init
+
+    def _init_fields(self) -> Tuple[jax.Array, jax.Array]:
+        """Sharded field construction: each device shard is built locally
+        for its block (multi-host ready), mirroring the reference's
+        per-rank ``init_fields`` (``Simulation_CPU.jl:14-72``)."""
+        L, dtype = self.settings.L, self.dtype
+        if not self.sharded:
+            u, v = grayscott.init_fields(L, dtype)
+            return (
+                jax.device_put(u, self.device),
+                jax.device_put(v, self.device),
+            )
+
+        dom = self.domain
+        gshape = (L, L, L)
+
+        def make(field: str):
+            def cb(index):
+                offsets = tuple(s.start or 0 for s in index)
+                sizes = tuple(
+                    (s.stop or L) - (s.start or 0) for s in index
+                )
+                u, v = grayscott.init_fields(
+                    L, dtype, offsets=offsets, sizes=sizes
+                )
+                return u if field == "u" else v
+
+            return jax.make_array_from_callback(
+                gshape, self.field_sharding, cb
+            )
+
+        return make("u"), make("v")
+
+    # ---------------------------------------------------------------- runner
+
+    def _local_run(self, u, v, base_key, step0, params, *, nsteps: int):
+        """``nsteps`` fused steps on one (local) block. Called directly on a
+        single device, or per-shard under ``shard_map``."""
+        kernel = self._kernel
+        use_noise = self.use_noise
+        sharded = self.sharded
+        dims = self.domain.dims
+
+        if sharded and use_noise:
+            shard_key = jax.random.fold_in(
+                base_key, halo.linear_shard_index(AXIS_NAMES, dims)
+            )
+        else:
+            shard_key = base_key
+
+        def body(i, carry):
+            u, v = carry
+            if sharded:
+                u_pad, v_pad = halo.halo_pad(
+                    (u, v),
+                    (stencil.U_BOUNDARY, stencil.V_BOUNDARY),
+                    AXIS_NAMES,
+                    dims,
+                )
+            else:
+                u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
+                v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
+            if use_noise:
+                key = jax.random.fold_in(shard_key, step0 + i)
+                nz = grayscott.noise_field(key, u.shape, u.dtype, params.noise)
+            else:
+                nz = jnp.asarray(0.0, u.dtype)
+            return kernel(u_pad, v_pad, nz, params)
+
+        return lax.fori_loop(0, nsteps, body, (u, v))
+
+    def _runner(self, nsteps: int):
+        """Compiled ``nsteps``-step advance, cached per nsteps."""
+        fn = self._runners.get(nsteps)
+        if fn is not None:
+            return fn
+
+        local = partial(self._local_run, nsteps=nsteps)
+        if self.sharded:
+            spec = P(*AXIS_NAMES)
+            rep = P()
+            fn = shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec, spec, rep, rep, rep),
+                out_specs=(spec, spec),
+                # pallas_call outputs carry no varying-mesh-axes metadata;
+                # skip the vma check (shardings are fully explicit here).
+                check_vma=False,
+            )
+        else:
+            fn = local
+        fn = jax.jit(fn, donate_argnums=(0, 1))
+        self._runners[nsteps] = fn
+        return fn
+
+    # ---------------------------------------------------------------- public
+
+    def iterate(self, nsteps: int = 1) -> None:
+        """Advance the simulation ``nsteps`` steps (``public.jl:45-71``)."""
+        if nsteps <= 0:
+            return
+        runner = self._runner(nsteps)
+        self.u, self.v = runner(
+            self.u, self.v, self.base_key, jnp.int32(self.step), self.params
+        )
+        self.step += nsteps
+
+    def restore(self, u: np.ndarray, v: np.ndarray, step: int) -> None:
+        """Restore state from a checkpoint (fixes the reference's hardcoded
+        ``restart_step = 0``, ``src/GrayScott.jl:77-78``)."""
+        u = jnp.asarray(u, self.dtype)
+        v = jnp.asarray(v, self.dtype)
+        expected = (self.settings.L,) * 3
+        if u.shape != expected or v.shape != expected:
+            raise ValueError(
+                f"Checkpoint shapes u={u.shape}, v={v.shape} do not match "
+                f"L={self.settings.L}"
+            )
+        target = self.field_sharding if self.sharded else self.device
+        self.u = jax.device_put(u, target)
+        self.v = jax.device_put(v, target)
+        self.step = int(step)
+
+    def get_fields(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copies of (u, v) — the ghost-strip + D->H analog
+        (``Simulation_CPU.jl:125-133``, ``CUDAExt.jl:199-209``)."""
+        jax.block_until_ready((self.u, self.v))
+        return np.asarray(self.u), np.asarray(self.v)
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready((self.u, self.v))
+
+
+def initialization(
+    args, *, n_devices: Optional[int] = None, seed: int = 0
+) -> Tuple[Settings, CartDomain, Simulation]:
+    """Parse config and build a ready-to-run simulation
+    (reference ``Simulation.initialization``, ``communication.jl:15-33``)."""
+    settings = config.get_settings(list(args))
+    sim = Simulation(settings, n_devices=n_devices, seed=seed)
+    return settings, sim.domain, sim
+
+
+def finalize() -> None:
+    """Reference-parity no-op (``communication.jl:40-46``): JAX needs no
+    explicit teardown; kept so driver code mirrors the reference flow."""

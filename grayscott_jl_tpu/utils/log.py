@@ -1,0 +1,32 @@
+"""Minimal structured logging for the driver.
+
+The reference gates rank-0 ``println`` on ``settings.verbose``
+(``src/GrayScott.jl:88-91``); here only JAX process 0 logs, so multi-host
+runs keep single-writer output.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def _is_primary() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # pragma: no cover — before/without jax init
+        return True
+
+
+class Logger:
+    def __init__(self, verbose: bool = False, stream=None):
+        self.verbose = verbose
+        self.stream = stream or sys.stdout
+        self._t0 = time.perf_counter()
+
+    def info(self, msg: str) -> None:
+        if self.verbose and _is_primary():
+            dt = time.perf_counter() - self._t0
+            print(f"[gray-scott +{dt:9.3f}s] {msg}", file=self.stream, flush=True)

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Distributed paths (3D mesh, shard_map halo exchange) are exercised without
+TPU hardware via XLA's host-platform device-count flag — the test analog of
+the reference's oversubscribed ``mpirun -n 4`` on one CI node
+(reference ``test/runtests.jl``, ``.github/workflows/ci.yml:24-27``).
+
+Note: the host environment registers the TPU ("axon") PJRT plugin from a
+``sitecustomize`` hook that imports JAX at interpreter startup, so setting
+``JAX_PLATFORMS`` here is too late — we must go through ``jax.config``.
+``XLA_FLAGS`` is still read lazily at first backend init, which has not
+happened yet when conftest runs.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,199 @@
+"""Out-of-process functional tests (reference ``functional-GrayScott.jl``).
+
+The reference runs the real binary under ``mpirun -n 4`` and asserts exit
+code 0 only (``functional-GrayScott.jl:4-11``); here we run the real CLI on
+the 8-device virtual CPU mesh and additionally assert on the written
+output — steps, shapes, attributes, visualization files — which the
+reference acknowledges it cannot (``runtests.jl:23-25``).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.io.bplite import BpReader
+
+REPO = Path(__file__).resolve().parents[2]
+
+CONFIG = """\
+L = 32
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = 10
+steps = 40
+noise = {noise}
+output = "{output}"
+checkpoint = {checkpoint}
+checkpoint_freq = {checkpoint_freq}
+checkpoint_output = "{checkpoint_output}"
+restart = {restart}
+restart_input = "{restart_input}"
+mesh_type = "{mesh_type}"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "{kernel_language}"
+verbose = true
+"""
+
+
+def write_config(tmp_path, name="config.toml", **kw):
+    defaults = dict(
+        noise=0.0,
+        output="gs.bp",
+        checkpoint="false",
+        checkpoint_freq=20,
+        checkpoint_output="ckpt.bp",
+        restart="false",
+        restart_input="ckpt.bp",
+        mesh_type="image",
+        kernel_language="Plain",
+    )
+    defaults.update(kw)
+    p = tmp_path / name
+    p.write_text(CONFIG.format(**defaults))
+    return p
+
+
+def run_cli(tmp_path, config, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, str(REPO / "gray-scott.py"), str(config)],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+def test_cli_end_to_end(tmp_path):
+    cfg = write_config(tmp_path, noise=0.1)
+    res = run_cli(tmp_path, cfg)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "writing output step" in res.stdout  # verbose driver log
+
+    r = BpReader(str(tmp_path / "gs.bp"))
+    # steps=40, plotgap=10 -> 4 output steps
+    assert r.num_steps() == 4
+    attrs = r.attributes()
+    assert attrs["F"] == 0.02 and attrs["k"] == 0.048
+    assert attrs["Fides_Data_Model"] == "uniform"
+    assert "vtk.xml" in attrs and "ImageData" in attrs["vtk.xml"]
+    info = r.inquire_variable("U")
+    assert info.shape == (32, 32, 32) and info.dtype == np.float32
+    steps_seen = [int(r.get("step", step=i)) for i in range(4)]
+    assert steps_seen == [10, 20, 30, 40]
+    u = r.get("U", step=3)
+    assert np.isfinite(u).all() and u.min() < 1.0  # evolved pattern
+
+    # VTK series written alongside (mesh_type = "image")
+    vtk_dir = tmp_path / "gs.vtk"
+    assert (vtk_dir / "series.pvd").exists()
+    assert (vtk_dir / "step_0000010.vti").exists()
+
+
+def test_cli_rejects_bad_config(tmp_path):
+    bad = tmp_path / "config.json"
+    bad.write_text("{}")
+    res = run_cli(tmp_path, bad)
+    assert res.returncode == 1
+    assert "TOML" in res.stderr
+
+
+def test_checkpoint_and_restart_reproduce_trajectory(tmp_path):
+    """Resume from a checkpoint == uninterrupted run (bit-exact, incl. noise
+    — the step key is folded per absolute step)."""
+    # uninterrupted 40-step run
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    cfg = write_config(full_dir, noise=0.1, output="full.bp")
+    assert run_cli(full_dir, cfg).returncode == 0
+
+    # run to step 40, checkpointing at 20; then a second process restarts
+    part_dir = tmp_path / "part"
+    part_dir.mkdir()
+    cfg1 = write_config(
+        part_dir, "phase1.toml", noise=0.1, output="p1.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(part_dir, cfg1).returncode == 0
+
+    ck = BpReader(str(part_dir / "ckpt.bp"))
+    assert ck.num_steps() == 2  # steps 20 and 40
+
+    cfg2 = write_config(
+        part_dir, "phase2.toml", noise=0.1, output="p2.bp",
+        restart="true", restart_input="ckpt.bp",
+    )
+    # restart from the step-20 checkpoint: rewrite ckpt store to first entry?
+    # No — restart loads the *latest* checkpoint (step 40) and the run ends
+    # immediately at steps=40. Use a fresh store truncated at step 20 instead.
+    import json, shutil
+
+    trunc = part_dir / "ckpt20.bp"
+    shutil.copytree(part_dir / "ckpt.bp", trunc)
+    md = json.loads((trunc / "md.json").read_text())
+    md["steps"] = md["steps"][:1]
+    (trunc / "md.json").write_text(json.dumps(md))
+    cfg2 = write_config(
+        part_dir, "phase2.toml", noise=0.1, output="p2.bp",
+        restart="true", restart_input="ckpt20.bp",
+    )
+    res = run_cli(part_dir, cfg2)
+    assert res.returncode == 0, res.stderr
+    assert "Restarted from ckpt20.bp at step 20" in res.stdout
+
+    full = BpReader(str(full_dir / "full.bp"))
+    resumed = BpReader(str(part_dir / "p2.bp"))
+    # resumed run wrote steps 30, 40; compare step 40 against full run
+    nf, nr = full.num_steps(), resumed.num_steps()
+    assert nr == 2
+    uf = full.get("U", step=nf - 1)
+    ur = resumed.get("U", step=nr - 1)
+    np.testing.assert_array_equal(uf, ur)
+    vf = full.get("V", step=nf - 1)
+    vr = resumed.get("V", step=nr - 1)
+    np.testing.assert_array_equal(vf, vr)
+
+
+def test_restart_appends_to_checkpoint_store(tmp_path):
+    """Restarting with checkpointing into the same store must append, not
+    truncate the checkpoint being resumed from."""
+    cfg1 = write_config(
+        tmp_path, "p1.toml", output="p1.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(tmp_path, cfg1).returncode == 0  # ckpts at 20, 40
+
+    # extend the run to step 80, resuming from the latest (40)
+    cfg2 = write_config(
+        tmp_path, "p2.toml", output="p2.bp",
+        checkpoint="true", checkpoint_freq=20,
+        restart="true", restart_input="ckpt.bp",
+    )
+    p2 = (tmp_path / "p2.toml").read_text().replace("steps = 40", "steps = 80")
+    (tmp_path / "p2.toml").write_text(p2)
+    res = run_cli(tmp_path, cfg2)
+    assert res.returncode == 0, res.stderr
+    ck = BpReader(str(tmp_path / "ckpt.bp"))
+    steps = [int(ck.get("step", step=i)) for i in range(ck.num_steps())]
+    assert steps == [20, 40, 60, 80]
+
+
+def test_restart_with_missing_checkpoint_fails_cleanly(tmp_path):
+    cfg = write_config(tmp_path, restart="true", restart_input="absent.bp")
+    res = run_cli(tmp_path, cfg)
+    assert res.returncode == 1
+    assert "absent.bp" in res.stderr

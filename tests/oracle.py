@@ -1,0 +1,61 @@
+"""Independent NumPy oracle for the Gray-Scott update.
+
+A direct, loop-free transcription of the reference semantics
+(``src/simulation/Common.jl:13-18``, ``Simulation_CPU.jl:14-112``): mutable
+ghost-padded arrays, frozen ghost values (u=1, v=0), Laplacian evaluated in
+float64 (Julia's ``6.0`` literal promotes Float32 inputs), result cast back
+to the storage dtype. Used as the correctness oracle the reference lacks
+(its tests never assert on ``iterate!`` results — SURVEY §4).
+"""
+
+import numpy as np
+
+SEED_D = 6
+
+
+def oracle_init(L: int, dtype):
+    """Ghost-padded (L+2)^3 fields with the seeded center cube."""
+    u = np.ones((L + 2,) * 3, dtype=dtype)
+    v = np.zeros((L + 2,) * 3, dtype=dtype)
+    lo, hi = L // 2 - SEED_D, L // 2 + SEED_D
+    # global 0-based cell g lives at padded index g+1
+    sl = slice(lo + 1, hi + 2)
+    u[sl, sl, sl] = 0.25
+    v[sl, sl, sl] = 0.33
+    return u, v
+
+
+def _lap64(a: np.ndarray) -> np.ndarray:
+    a = a.astype(np.float64)
+    return (
+        a[:-2, 1:-1, 1:-1]
+        + a[2:, 1:-1, 1:-1]
+        + a[1:-1, :-2, 1:-1]
+        + a[1:-1, 2:, 1:-1]
+        + a[1:-1, 1:-1, :-2]
+        + a[1:-1, 1:-1, 2:]
+        - 6.0 * a[1:-1, 1:-1, 1:-1]
+    ) / 6.0
+
+
+def oracle_step(u, v, Du, Dv, F, k, dt, noise_u=0.0):
+    """One explicit-Euler step; returns new ghost-padded arrays."""
+    dtype = u.dtype
+    ui = u[1:-1, 1:-1, 1:-1].astype(np.float64)
+    vi = v[1:-1, 1:-1, 1:-1].astype(np.float64)
+    uvv = ui * vi * vi
+    du = Du * _lap64(u) - uvv + F * (1.0 - ui) + noise_u
+    dv = Dv * _lap64(v) + uvv - (F + k) * vi
+    un, vn = u.copy(), v.copy()
+    un[1:-1, 1:-1, 1:-1] = (ui + du * dt).astype(dtype)
+    vn[1:-1, 1:-1, 1:-1] = (vi + dv * dt).astype(dtype)
+    return un, vn
+
+
+def oracle_run(L, dtype, nsteps, Du, Dv, F, k, dt):
+    """nsteps noiseless steps from the seeded initial condition; returns
+    interior (u, v)."""
+    u, v = oracle_init(L, dtype)
+    for _ in range(nsteps):
+        u, v = oracle_step(u, v, Du, Dv, F, k, dt)
+    return u[1:-1, 1:-1, 1:-1], v[1:-1, 1:-1, 1:-1]

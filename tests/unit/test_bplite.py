@@ -1,0 +1,177 @@
+"""BP-lite store tests: write/read round trips, selections, streaming.
+
+The reference's IO tests are stale and disabled (``unit-IO.jl``,
+``runtests.jl:16`` — SURVEY defect #10); these cover what they meant to and
+the streaming semantics pdfcalc needs.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.io.bplite import BpReader, BpWriter, StepStatus
+
+
+def _store(tmp_path, name="out.bp"):
+    return str(tmp_path / name)
+
+
+def test_roundtrip_attributes_and_steps(tmp_path):
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_attribute("F", 0.02)
+    w.define_attribute("name", "gray-scott")
+    w.define_attribute("Fides_Origin", [0.0, 0.0, 0.0])
+    w.define_attribute("flag", True)
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (4, 4, 4))
+    for s in range(3):
+        w.begin_step()
+        w.put("step", np.int32(s * 10))
+        w.put("U", np.full((4, 4, 4), s, np.float32))
+        w.end_step()
+    w.close()
+
+    r = BpReader(path)
+    assert r.num_steps() == 3
+    assert r.attributes()["F"] == 0.02
+    assert r.attributes()["name"] == "gray-scott"
+    assert r.attributes()["Fides_Origin"] == [0.0, 0.0, 0.0]
+    assert r.attributes()["flag"] is True
+    info = r.inquire_variable("U")
+    assert info.dtype == np.float32 and info.shape == (4, 4, 4)
+    assert r.inquire_variable("nope") is None
+    for s in range(3):
+        assert r.begin_step(timeout=0) == StepStatus.OK
+        assert int(r.get("step")) == s * 10
+        np.testing.assert_array_equal(
+            r.get("U"), np.full((4, 4, 4), s, np.float32)
+        )
+        r.end_step()
+    assert r.begin_step(timeout=0) == StepStatus.END_OF_STREAM
+
+
+def test_selection_reads(tmp_path):
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("U", np.float64, (8, 8, 8))
+    data = np.arange(512, dtype=np.float64).reshape(8, 8, 8)
+    w.begin_step()
+    w.put("U", data)
+    w.end_step()
+    w.close()
+
+    r = BpReader(path)
+    r.begin_step(timeout=0)
+    r.set_selection("U", (2, 0, 4), (3, 8, 4))
+    np.testing.assert_array_equal(r.get("U"), data[2:5, :, 4:8])
+
+
+def test_multiblock_assembly(tmp_path):
+    # two writer blocks covering halves of the global array
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("U", np.float32, (4, 4, 4))
+    data = np.random.default_rng(0).random((4, 4, 4)).astype(np.float32)
+    w.begin_step()
+    w.put("U", data[:2], start=(0, 0, 0), count=(2, 4, 4))
+    w.put("U", data[2:], start=(2, 0, 0), count=(2, 4, 4))
+    w.end_step()
+    w.close()
+
+    r = BpReader(path)
+    r.begin_step(timeout=0)
+    np.testing.assert_array_equal(r.get("U"), data)
+    # selection crossing the block seam
+    r.set_selection("U", (1, 1, 1), (2, 2, 2))
+    np.testing.assert_array_equal(r.get("U"), data[1:3, 1:3, 1:3])
+
+
+def test_uncovered_selection_raises(tmp_path):
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("U", np.float32, (4, 4))
+    w.begin_step()
+    w.put("U", np.zeros((2, 4), np.float32), start=(0, 0), count=(2, 4))
+    w.end_step()
+    w.close()
+    r = BpReader(path)
+    r.begin_step(timeout=0)
+    with pytest.raises(ValueError, match="not fully covered"):
+        r.get("U")
+
+
+def test_streaming_reader_follows_live_writer(tmp_path):
+    """The pdfcalc coupling pattern: reader polls while writer appends."""
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("x", np.float32, (4,))
+    w.begin_step()
+    w.put("x", np.zeros(4, np.float32))
+    w.end_step()
+
+    r = BpReader(path)
+    assert r.begin_step(timeout=0) == StepStatus.OK
+    r.end_step()
+    # no second step yet, writer still open
+    assert r.begin_step(timeout=0.05) == StepStatus.NOT_READY
+
+    def later():
+        time.sleep(0.3)
+        w.begin_step()
+        w.put("x", np.ones(4, np.float32))
+        w.end_step()
+        w.close()
+
+    t = threading.Thread(target=later)
+    t.start()
+    assert r.begin_step(timeout=10) == StepStatus.OK
+    np.testing.assert_array_equal(r.get("x"), np.ones(4, np.float32))
+    r.end_step()
+    t.join()
+    assert r.begin_step(timeout=0) == StepStatus.END_OF_STREAM
+
+
+def test_writer_misuse_raises(tmp_path):
+    w = BpWriter(_store(tmp_path))
+    w.define_variable("x", np.float32, (2,))
+    with pytest.raises(RuntimeError, match="outside"):
+        w.put("x", np.zeros(2, np.float32))
+    w.begin_step()
+    with pytest.raises(RuntimeError, match="inside"):
+        w.begin_step()
+    with pytest.raises(KeyError):
+        w.put("y", np.zeros(2, np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        w.put("x", np.zeros(3, np.float32))
+    with pytest.raises(RuntimeError, match="inside"):
+        w.close()
+    w.end_step()
+    w.close()
+
+
+def test_append_mode(tmp_path):
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(1))
+    w.end_step()
+    w.close()
+
+    w2 = BpWriter(path, append=True)
+    w2.begin_step()
+    w2.put("step", np.int32(2))
+    w2.end_step()
+    w2.close()
+
+    r = BpReader(path)
+    assert r.num_steps() == 2
+    assert int(r.get("step", step=1)) == 2
+
+
+def test_missing_store_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        BpReader(str(tmp_path / "absent.bp"))

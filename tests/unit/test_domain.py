@@ -1,0 +1,51 @@
+"""Domain-decomposition tests (reference ``unit-Simulation.jl`` init tests,
+strengthened: the reference never checks offsets/sizes)."""
+
+import pytest
+
+from grayscott_jl_tpu.parallel.domain import (
+    CartDomain,
+    block_size_offset,
+    dims_create,
+)
+
+
+def test_dims_create_matches_mpi_semantics():
+    # MPI_Dims_create: balanced, non-increasing factorization
+    assert dims_create(1) == (1, 1, 1)
+    assert dims_create(2) == (2, 1, 1)
+    assert dims_create(4) == (2, 2, 1)
+    assert dims_create(6) == (3, 2, 1)
+    assert dims_create(8) == (2, 2, 2)
+    assert dims_create(12) == (3, 2, 2)
+    assert dims_create(64) == (4, 4, 4)
+    assert dims_create(256) == (8, 8, 4)
+
+
+def test_block_sizes_cover_domain():
+    # integer remainder spread (fixes reference InexactError, defect #7)
+    for L, n in [(64, 4), (65, 4), (7, 3), (128, 8)]:
+        sizes = [block_size_offset(L, n, c)[0] for c in range(n)]
+        offsets = [block_size_offset(L, n, c)[1] for c in range(n)]
+        assert sum(sizes) == L
+        assert offsets[0] == 0
+        for c in range(1, n):
+            assert offsets[c] == offsets[c - 1] + sizes[c - 1]
+
+
+def test_cart_domain_coords_rank_roundtrip():
+    dom = CartDomain(L=64, dims=(2, 2, 2))
+    seen = set()
+    for r in range(8):
+        c = dom.coords(r)
+        assert all(0 <= ci < di for ci, di in zip(c, dom.dims))
+        seen.add(c)
+    assert len(seen) == 8
+
+
+def test_cart_domain_divisibility_enforced():
+    with pytest.raises(ValueError, match="divisible"):
+        CartDomain.create(8, 65)
+    dom = CartDomain.create(8, 64)
+    assert dom.dims == (2, 2, 2)
+    assert dom.local_shape == (32, 32, 32)

@@ -1,0 +1,126 @@
+"""Config-layer tests (reference ``test/unit/simulation/unit-Inputs.jl``,
+strengthened per SURVEY §4)."""
+
+import dataclasses
+
+import pytest
+
+from grayscott_jl_tpu.config.settings import (
+    Settings,
+    get_settings,
+    load_backend_and_lang,
+    parse_settings_toml,
+)
+
+REFERENCE_EXAMPLE = """\
+L = 64
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = 10
+steps = 1000
+noise = 0.1
+output = "gs-1MPI-1GPU-64L-F32.bp"
+checkpoint = false
+checkpoint_freq = 700
+checkpoint_output = "ckpt.bp"
+restart = false
+restart_input = "ckpt.bp"
+mesh_type = "image"
+precision = "Float32"
+backend = "TPU"
+"""
+
+
+def test_defaults_match_reference():
+    # Reference Structs.jl:4-28 (Base.@kwdef Settings)
+    s = Settings()
+    assert s.L == 128
+    assert s.steps == 20000
+    assert s.plotgap == 200
+    assert s.F == 0.04
+    assert s.k == 0.0
+    assert s.dt == 0.2
+    assert s.Du == 0.05
+    assert s.Dv == 0.1
+    assert s.noise == 0.0
+    assert s.output == "foo.bp"
+    assert s.checkpoint is False
+    assert s.checkpoint_freq == 2000
+    assert s.checkpoint_output == "ckpt.bp"
+    assert s.restart is False
+    assert s.restart_input == "ckpt.bp"
+    assert s.mesh_type == "image"
+    assert s.precision == "Float64"
+    assert s.backend == "CPU"
+    assert s.kernel_language == "Plain"
+    assert s.verbose is False
+
+
+def test_parse_reference_example():
+    s = parse_settings_toml(REFERENCE_EXAMPLE)
+    assert s.L == 64
+    assert s.Du == 0.2
+    assert s.F == 0.02
+    assert s.k == 0.048
+    assert s.dt == 1.0
+    assert s.steps == 1000
+    assert s.plotgap == 10
+    assert s.noise == 0.1
+    assert s.precision == "Float32"
+    assert s.backend == "TPU"
+    assert isinstance(s.dt, float)  # TOML int coerced to float field
+
+
+def test_unknown_keys_silently_ignored():
+    # Inputs.jl:88-94 incl. legacy adios_* keys (Structs.jl:20-22)
+    s = parse_settings_toml(
+        'L = 32\nadios_config = "adios2.yaml"\nadios_span = false\n'
+        'adios_memory_selection = false\ntotally_unknown = 1\n'
+    )
+    assert s.L == 32
+    assert not hasattr(s, "adios_config")
+
+
+def test_non_toml_extension_rejected(tmp_path):
+    # Inputs.jl:25-28
+    p = tmp_path / "settings.json"
+    p.write_text("{}")
+    with pytest.raises(ValueError, match="TOML"):
+        get_settings([str(p)])
+
+
+def test_get_settings_roundtrip(tmp_path):
+    p = tmp_path / "settings.toml"
+    p.write_text(REFERENCE_EXAMPLE)
+    s = get_settings([str(p)])
+    assert s.L == 64 and s.backend == "TPU"
+
+
+def test_backend_lang_lowering():
+    # Inputs.jl:110-120, with legacy aliases onto the XLA path
+    s = Settings(backend="TPU", kernel_language="Plain")
+    assert load_backend_and_lang(s) == ("tpu", "xla")
+    s = Settings(backend="CPU", kernel_language="KernelAbstractions")
+    assert load_backend_and_lang(s) == ("cpu", "xla")
+    s = Settings(backend="tpu", kernel_language="Pallas")
+    assert load_backend_and_lang(s) == ("tpu", "pallas")
+    s = Settings(backend="CUDA")
+    assert load_backend_and_lang(s)[0] == "gpu"
+
+
+def test_bad_backend_and_lang_raise():
+    with pytest.raises(ValueError, match="backend"):
+        load_backend_and_lang(Settings(backend="quantum"))
+    with pytest.raises(ValueError, match="kernel_language"):
+        load_backend_and_lang(Settings(kernel_language="fortran"))
+
+
+def test_settings_keys_cover_all_fields():
+    from grayscott_jl_tpu.config.settings import SETTINGS_KEYS
+
+    assert SETTINGS_KEYS == frozenset(
+        f.name for f in dataclasses.fields(Settings)
+    )
