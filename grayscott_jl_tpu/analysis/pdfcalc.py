@@ -1,0 +1,211 @@
+"""Streaming PDF analysis of simulation output — completed for real.
+
+The reference's companion analysis (``src/analysis/pdfcalc.jl``) is
+unfinished: the read loop stops at a ``# Calculate`` comment
+(``pdfcalc.jl:147``), ``_compute_pdf`` never zero-initializes its histogram
+and has no return on the main path (``pdfcalc.jl:15-48``), and the ADIOS2
+import is commented out (SURVEY defect #5). This module implements the
+intended workflow end to end:
+
+* open the simulation output as a *streaming* reader —
+  ``begin_step(timeout=10)``, sleep-and-retry on NOT_READY, stop otherwise
+  (``pdfcalc.jl:112-123``) — so it can run concurrently with a live
+  simulation (in-situ coupling) or over a finished store;
+* per step, for each x-slice of U and V, compute an ``nbins``-bin histogram
+  of the slice's values between its min and max (``pdfcalc.jl:14-49``,
+  with the counting bug fixed: zero-initialized, returned, and vectorized
+  with numpy instead of a triple loop);
+* split slices across workers along the slowest dimension with the
+  remainder to the last worker (``pdfcalc.jl:132-139``);
+* write ``U/pdf``, ``U/bins``, ``V/pdf``, ``V/bins`` (+ optionally the
+  original U/V) to an output store per step.
+
+CLI (``python -m grayscott_jl_tpu.analysis.pdfcalc``) mirrors the
+reference's arguments: input, output, nbins (default 1000),
+output_inputdata (default False) (``pdfcalc.jl:51-84``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import open_writer
+from ..io.bplite import BpReader, StepStatus
+
+_EPS = 1.0e-20  # reference ``_epsilon`` threshold (pdfcalc.jl:5-7)
+
+
+def compute_pdf(
+    data: np.ndarray, nbins: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-slice histograms of a (nslices, ny, nz) block.
+
+    Returns ``(pdf, bins)`` with shapes ``(nslices, nbins)`` and
+    ``(nbins,)``: counts of values in ``nbins`` equal bins spanning
+    [min, max] of the whole block, lower-edge bin convention with the top
+    value folded into the last bin (``pdfcalc.jl:41-44``). Degenerate
+    windows (single bin, or max-min below epsilon) fill ``slice_size``
+    per bin, matching the reference's special case (``pdfcalc.jl:24-27``).
+    """
+    nslices = data.shape[0]
+    slice_size = int(np.prod(data.shape[1:], dtype=np.int64))
+    lo = float(data.min())
+    hi = float(data.max())
+    bin_width = (hi - lo) / nbins
+    bins = (lo + np.arange(nbins) * bin_width).astype(data.dtype)
+
+    if nbins == 1 or (hi - lo) < _EPS or bin_width < _EPS:
+        pdf = np.full((nslices, nbins), slice_size, dtype=data.dtype)
+        return pdf, bins
+
+    idx = np.floor((data.reshape(nslices, -1) - lo) / bin_width).astype(np.int64)
+    np.clip(idx, 0, nbins - 1, out=idx)
+    pdf = np.zeros((nslices, nbins), dtype=np.int64)
+    rows = np.repeat(np.arange(nslices), slice_size)
+    np.add.at(pdf, (rows, idx.ravel()), 1)
+    return pdf.astype(data.dtype), bins
+
+
+def split_slowest_dim(n: int, size: int, rank: int) -> Tuple[int, int]:
+    """(start, count) of worker ``rank``'s share of ``n`` slices: floor
+    division with the remainder going to the last worker
+    (``pdfcalc.jl:132-139``)."""
+    count = n // size
+    start = count * rank
+    if rank == size - 1:
+        count = n - count * (size - 1)
+    return start, count
+
+
+def parse_arguments(args: List[str]) -> argparse.Namespace:
+    """Reference CLI contract (``pdfcalc.jl:51-84``)."""
+    p = argparse.ArgumentParser(
+        prog="pdfcalc",
+        description="gray-scott workflow pdf generator, TPU-native version",
+    )
+    p.add_argument("input", help="Name of the input file handle for reading data")
+    p.add_argument(
+        "output", help="Name of the output file to which data must be written"
+    )
+    p.add_argument(
+        "N",
+        nargs="?",
+        type=int,
+        default=1000,
+        help="Number of bins for the PDF calculation, default = 1000",
+    )
+    p.add_argument(
+        "output_inputdata",
+        nargs="?",
+        type=lambda s: s.lower() in ("yes", "true", "1"),
+        default=False,
+        help="YES will write the original variables besides the analysis results",
+    )
+    return p.parse_args(args)
+
+
+def read_data_write_pdf(
+    in_filename: str,
+    out_filename: str,
+    nbins: int = 1000,
+    write_inputvars: bool = False,
+    *,
+    rank: int = 0,
+    size: int = 1,
+    timeout: float = 10.0,
+    max_not_ready: Optional[int] = None,
+    verbose: bool = False,
+) -> int:
+    """Streaming read -> per-slice PDF -> write loop. Returns steps processed.
+
+    ``rank``/``size`` split the slowest (x) dimension across workers; with
+    one worker the whole volume is processed. ``max_not_ready`` bounds the
+    NOT_READY retries (None = retry forever, the reference behavior).
+    """
+    reader = BpReader(in_filename)
+    writer = open_writer(out_filename, writer_id=rank)
+
+    defined = False
+    not_ready = 0
+    steps_done = 0
+    while True:
+        status = reader.begin_step(timeout=timeout)
+        if status == StepStatus.NOT_READY:
+            not_ready += 1
+            if max_not_ready is not None and not_ready > max_not_ready:
+                break
+            time.sleep(1.0)  # pdfcalc.jl:117-118
+            continue
+        if status != StepStatus.OK:
+            break
+        not_ready = 0
+
+        var_u = reader.inquire_variable("U")
+        shape = var_u.shape
+        start_x, count_x = split_slowest_dim(shape[0], size, rank)
+        sel_start = (start_x, 0, 0)
+        sel_count = (count_x, shape[1], shape[2])
+        reader.set_selection("U", sel_start, sel_count)
+        reader.set_selection("V", sel_start, sel_count)
+
+        u = reader.get("U")
+        v = reader.get("V")
+        sim_step = int(reader.get("step"))
+        reader.end_step()
+
+        if not defined:
+            dt = var_u.dtype.name
+            writer.define_attribute("nbins", nbins)
+            writer.define_attribute("input", in_filename)
+            writer.define_variable("step", np.int32)
+            writer.define_variable("U/pdf", dt, (shape[0], nbins))
+            writer.define_variable("U/bins", dt, (nbins,))
+            writer.define_variable("V/pdf", dt, (shape[0], nbins))
+            writer.define_variable("V/bins", dt, (nbins,))
+            if write_inputvars:
+                writer.define_variable("U", dt, shape)
+                writer.define_variable("V", dt, shape)
+            defined = True
+
+        u_pdf, u_bins = compute_pdf(u, nbins)
+        v_pdf, v_bins = compute_pdf(v, nbins)
+
+        writer.begin_step()
+        writer.put("step", np.int32(sim_step))
+        writer.put(
+            "U/pdf", u_pdf, start=(start_x, 0), count=(count_x, nbins)
+        )
+        writer.put("U/bins", u_bins)
+        writer.put(
+            "V/pdf", v_pdf, start=(start_x, 0), count=(count_x, nbins)
+        )
+        writer.put("V/bins", v_bins)
+        if write_inputvars:
+            writer.put("U", u, start=sel_start, count=sel_count)
+            writer.put("V", v, start=sel_start, count=sel_count)
+        writer.end_step()
+        steps_done += 1
+        if verbose:
+            print(f"pdfcalc: processed sim step {sim_step}", flush=True)
+
+    writer.close()
+    reader.close()
+    return steps_done
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+
+    ns = parse_arguments(sys.argv[1:] if argv is None else argv)
+    read_data_write_pdf(
+        ns.input, ns.output, ns.N, ns.output_inputdata, verbose=True
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,30 @@
+"""I/O subsystem: BP-lite streaming stores, VTK output, checkpointing.
+
+Two interchangeable writer engines for the same on-disk format (the
+reference's single engine is the ADIOS2 C++ library, ``IO.jl``):
+
+* native (``csrc/libbplite.so`` via ``io/native.py``) — C++, async step
+  pipeline with background write/fsync/publish; default when built;
+* pure Python (``io/bplite.py``) — reference implementation and format
+  spec; always available.
+
+``GS_TPU_NATIVE_IO=0`` forces the Python engine.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def open_writer(path: str, *, writer_id: int = 0, append: bool = False):
+    """Open a BP-lite writer with the best available engine."""
+    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
+        from . import native
+
+        if native.available():
+            return native.NativeBpWriter(
+                path, writer_id=writer_id, append=append
+            )
+    from .bplite import BpWriter
+
+    return BpWriter(path, writer_id=writer_id, append=append)

@@ -19,7 +19,8 @@ from typing import Tuple
 import numpy as np
 
 from ..config.settings import Settings
-from .bplite import BpReader, BpWriter
+from . import open_writer
+from .bplite import BpReader
 
 
 class CheckpointWriter:
@@ -27,7 +28,7 @@ class CheckpointWriter:
         L = settings.L
         # On restart, append: truncating would destroy the very store the
         # run just resumed from when checkpoint_output == restart_input.
-        self.writer = BpWriter(
+        self.writer = open_writer(
             settings.checkpoint_output, append=settings.restart
         )
         self.writer.define_attribute("L", settings.L)

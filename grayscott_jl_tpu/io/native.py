@@ -1,0 +1,219 @@
+"""ctypes binding for the native C++ BP-lite writer engine (csrc/bplite.cpp).
+
+Drop-in replacement for the pure-Python ``BpWriter`` with the same on-disk
+format, plus an asynchronous step pipeline: ``end_step`` returns as soon as
+the step's payload is staged, and a background C++ I/O thread performs
+write + fsync + atomic metadata publication while the simulation computes
+— the ADIOS2 deferred-put analog. ``drain()``/``close()`` block until
+everything queued is durable.
+
+Engine selection lives in :func:`grayscott_jl_tpu.io.open_writer`: native
+when ``csrc/libbplite.so`` is built (``make -C csrc``), pure Python
+otherwise, overridable with ``GS_TPU_NATIVE_IO=0``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from . import bplite as _py
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+    "libbplite.so",
+)
+
+_lib = None
+
+
+def load_library(path: str = _LIB_PATH):
+    """The loaded libbplite, or None if not built/loadable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.bpw_open.restype = ctypes.c_void_p
+    lib.bpw_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bpw_define_attribute_json.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.bpw_define_variable.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+    ]
+    lib.bpw_set_prior_steps_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bpw_publish.argtypes = [ctypes.c_void_p]
+    lib.bpw_begin_step.argtypes = [ctypes.c_void_p]
+    lib.bpw_begin_step.restype = ctypes.c_int
+    lib.bpw_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
+    lib.bpw_put.restype = ctypes.c_int64
+    lib.bpw_end_step.argtypes = [ctypes.c_void_p]
+    lib.bpw_end_step.restype = ctypes.c_int
+    lib.bpw_drain.argtypes = [ctypes.c_void_p]
+    lib.bpw_drain.restype = ctypes.c_int
+    lib.bpw_close.argtypes = [ctypes.c_void_p]
+    lib.bpw_close.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def _i64(seq: Sequence[int]):
+    return (ctypes.c_int64 * len(seq))(*[int(s) for s in seq])
+
+
+class NativeBpWriter:
+    """Same interface as :class:`grayscott_jl_tpu.io.bplite.BpWriter`."""
+
+    def __init__(self, path: str, *, writer_id: int = 0, append: bool = False):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError(
+                "libbplite.so not built — run `make -C csrc` or use the "
+                "Python engine"
+            )
+        self._lib = lib
+        self.path = path
+        self.writer_id = writer_id
+        # variable registry mirrored host-side for dtype coercion/validation
+        self._vars = {}
+        prior = None
+        if append and os.path.exists(os.path.join(path, "md.json")):
+            with open(os.path.join(path, "md.json"), "r", encoding="utf-8") as f:
+                prior = json.load(f)
+            for name, v in prior.get("variables", {}).items():
+                self._vars[name] = (v["dtype"], tuple(v["shape"]))
+        self._h = lib.bpw_open(path.encode(), writer_id, 1 if append else 0)
+        if not self._h:
+            raise IOError(f"Cannot open BP-lite store at {path}")
+        if prior is not None:
+            # Forward ALL prior state (steps, variables, attributes) before
+            # the single publish — a streaming reader must never observe
+            # steps without their variables/attributes.
+            steps_json = ", ".join(
+                json.dumps(s) for s in prior.get("steps", [])
+            )
+            lib.bpw_set_prior_steps_json(self._h, steps_json.encode())
+            for name, (dtype, shape) in self._vars.items():
+                lib.bpw_define_variable(
+                    self._h, name.encode(), dtype.encode(),
+                    _i64(shape), len(shape),
+                )
+            for name, val in prior.get("attributes", {}).items():
+                lib.bpw_define_attribute_json(
+                    self._h, name.encode(), json.dumps(val).encode()
+                )
+            lib.bpw_publish(self._h)
+        self._in_step = False
+
+    def _handle(self):
+        if not self._h:
+            raise RuntimeError("writer is closed")
+        return self._h
+
+    def define_attribute(self, name: str, value: Any) -> None:
+        self._handle()
+        # reuse the Python engine's attribute typing rules
+        probe = _py.BpWriter.__new__(_py.BpWriter)
+        probe._md = {"attributes": {}}
+        _py.BpWriter.define_attribute(probe, name, value)
+        encoded = json.dumps(probe._md["attributes"][name])
+        self._lib.bpw_define_attribute_json(
+            self._h, name.encode(), encoded.encode()
+        )
+
+    def define_variable(self, name: str, dtype, shape: Sequence[int] = ()) -> None:
+        self._handle()
+        dtype_name = np.dtype(dtype).name
+        self._vars[name] = (dtype_name, tuple(int(s) for s in shape))
+        self._lib.bpw_define_variable(
+            self._h, name.encode(), dtype_name.encode(), _i64(shape), len(shape)
+        )
+
+    def begin_step(self) -> None:
+        if self._lib.bpw_begin_step(self._handle()) != 0:
+            raise RuntimeError("begin_step called inside an open step")
+        self._in_step = True
+
+    def put(
+        self,
+        name: str,
+        value,
+        *,
+        start: Optional[Sequence[int]] = None,
+        count: Optional[Sequence[int]] = None,
+    ) -> None:
+        if not self._in_step:
+            raise RuntimeError("put called outside begin_step/end_step")
+        if name not in self._vars:
+            raise KeyError(f"Variable {name!r} not defined")
+        dtype_name, shape = self._vars[name]
+        arr = np.asarray(value, dtype=dtype_name)
+        arr = arr.reshape(()) if not shape else np.ascontiguousarray(arr)
+        if start is None:
+            start = [0] * len(shape)
+        if count is None:
+            count = list(shape)
+        if list(arr.shape) != [int(c) for c in count]:
+            raise ValueError(
+                f"{name!r}: data shape {arr.shape} != count {tuple(count)}"
+            )
+        rc = self._lib.bpw_put(
+            self._handle(),
+            name.encode(),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            arr.nbytes,
+            _i64(start),
+            _i64(count),
+            len(count),
+        )
+        if rc < 0:
+            raise RuntimeError(f"native put failed for {name!r}")
+
+    def end_step(self) -> None:
+        if self._lib.bpw_end_step(self._handle()) != 0:
+            raise RuntimeError("end_step called outside a step")
+        self._in_step = False
+
+    def drain(self) -> None:
+        """Block until all queued steps are durable on disk."""
+        if self._lib.bpw_drain(self._handle()) != 0:
+            raise IOError(
+                f"native BP-lite writer failed writing {self.path} "
+                "(disk full or I/O error); failed steps were not published"
+            )
+
+    def close(self) -> None:
+        if self._in_step:
+            raise RuntimeError("close called inside an open step")
+        if self._h:
+            h, self._h = self._h, None
+            if self._lib.bpw_close(h) != 0:
+                raise IOError(
+                    f"native BP-lite writer failed writing {self.path} "
+                    "(disk full or I/O error); failed steps were not published"
+                )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

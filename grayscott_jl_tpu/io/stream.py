@@ -19,7 +19,7 @@ import numpy as np
 
 from ..config.settings import Settings
 from ..parallel.domain import CartDomain
-from .bplite import BpWriter
+from . import open_writer
 
 
 def fides_vtk_schemas(L: int) -> dict:
@@ -73,7 +73,7 @@ class SimStream:
 
         # On restart, append: a resumed run must not truncate the output
         # steps written before the checkpoint it resumed from.
-        self.writer = BpWriter(settings.output, append=settings.restart)
+        self.writer = open_writer(settings.output, append=settings.restart)
         # Provenance attributes (IO.jl:48-53)
         self.writer.define_attribute("F", settings.F)
         self.writer.define_attribute("k", settings.k)

@@ -1,0 +1,137 @@
+"""Native C++ BP-lite engine tests: format compatibility with the Python
+engine, async pipeline durability, append mode, and the engine factory."""
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.io import open_writer
+from grayscott_jl_tpu.io.bplite import BpReader, BpWriter
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _ensure_built():
+    lib = REPO / "csrc" / "libbplite.so"
+    if not lib.exists():
+        subprocess.run(
+            ["make", "-C", str(REPO / "csrc")], capture_output=True, check=False
+        )
+    return lib.exists()
+
+
+native = pytest.importorskip("grayscott_jl_tpu.io.native")
+pytestmark = pytest.mark.skipif(
+    not _ensure_built() or not native.available(),
+    reason="libbplite.so not built",
+)
+
+
+def _write(writer, nsteps=3, L=4):
+    writer.define_attribute("F", 0.02)
+    writer.define_attribute("name", 'gray "scott"\nnative')  # escaping probe
+    writer.define_attribute("Fides_Origin", [0.0, 0.0, 0.0])
+    writer.define_variable("step", np.int32)
+    writer.define_variable("U", np.float32, (L, L, L))
+    for s in range(nsteps):
+        writer.begin_step()
+        writer.put("step", np.int32(s * 10))
+        writer.put("U", np.full((L, L, L), s, np.float32))
+        writer.end_step()
+    writer.close()
+
+
+def test_native_store_readable_by_python_reader(tmp_path):
+    path = str(tmp_path / "n.bp")
+    _write(native.NativeBpWriter(path))
+    r = BpReader(path)
+    assert r.num_steps() == 3
+    assert r.attributes()["F"] == 0.02
+    assert r.attributes()["name"] == 'gray "scott"\nnative'
+    assert r.attributes()["Fides_Origin"] == [0.0, 0.0, 0.0]
+    for s in range(3):
+        np.testing.assert_array_equal(
+            r.get("U", step=s), np.full((4, 4, 4), s, np.float32)
+        )
+        assert int(r.get("step", step=s)) == s * 10
+
+
+def test_native_and_python_engines_produce_equivalent_metadata(tmp_path):
+    pa, pb = str(tmp_path / "a.bp"), str(tmp_path / "b.bp")
+    _write(native.NativeBpWriter(pa))
+    _write(BpWriter(pb))
+    ma = json.loads((tmp_path / "a.bp" / "md.json").read_text())
+    mb = json.loads((tmp_path / "b.bp" / "md.json").read_text())
+    assert ma == mb
+    assert (tmp_path / "a.bp" / "data.0").read_bytes() == (
+        tmp_path / "b.bp" / "data.0"
+    ).read_bytes()
+
+
+def test_native_append_mode(tmp_path):
+    path = str(tmp_path / "n.bp")
+    w = native.NativeBpWriter(path)
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(1))
+    w.end_step()
+    w.close()
+
+    w2 = native.NativeBpWriter(path, append=True)
+    w2.begin_step()
+    w2.put("step", np.int32(2))
+    w2.end_step()
+    w2.close()
+
+    r = BpReader(path)
+    assert r.num_steps() == 2
+    assert int(r.get("step", step=0)) == 1
+    assert int(r.get("step", step=1)) == 2
+
+
+def test_native_async_pipeline_many_steps(tmp_path):
+    """Steps queued faster than disk can drain must all land, in order."""
+    path = str(tmp_path / "n.bp")
+    w = native.NativeBpWriter(path)
+    w.define_variable("x", np.float64, (64, 64))
+    rng = np.random.default_rng(0)
+    frames = [rng.random((64, 64)) for _ in range(20)]
+    for f in frames:
+        w.begin_step()
+        w.put("x", f)
+        w.end_step()
+    w.drain()
+    w.close()
+    r = BpReader(path)
+    assert r.num_steps() == 20
+    for i, f in enumerate(frames):
+        np.testing.assert_array_equal(r.get("x", step=i), f)
+
+
+def test_native_misuse_raises(tmp_path):
+    w = native.NativeBpWriter(str(tmp_path / "n.bp"))
+    w.define_variable("x", np.float32, (2,))
+    with pytest.raises(RuntimeError, match="outside"):
+        w.put("x", np.zeros(2, np.float32))
+    w.begin_step()
+    with pytest.raises(KeyError):
+        w.put("y", np.zeros(2, np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        w.put("x", np.zeros(3, np.float32))
+    w.end_step()
+    w.close()
+
+
+def test_factory_selects_native_and_env_override(tmp_path, monkeypatch):
+    w = open_writer(str(tmp_path / "a.bp"))
+    assert isinstance(w, native.NativeBpWriter)
+    w.define_variable("x", np.int32)
+    w.close()
+    monkeypatch.setenv("GS_TPU_NATIVE_IO", "0")
+    w = open_writer(str(tmp_path / "b.bp"))
+    assert isinstance(w, BpWriter)
+    w.close()

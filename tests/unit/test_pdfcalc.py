@@ -1,0 +1,146 @@
+"""PDF-analysis workflow tests.
+
+The reference's pdfcalc tests cover argument parsing only
+(``unit-pdfcalc.jl:6-18``) because the compute path was never finished;
+these assert on the histogram math (vs numpy), the worker split, the
+streaming coupling against a live writer, and the CLI contract.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from grayscott_jl_tpu.analysis.pdfcalc import (
+    compute_pdf,
+    parse_arguments,
+    read_data_write_pdf,
+    split_slowest_dim,
+)
+from grayscott_jl_tpu.io.bplite import BpReader, BpWriter
+
+
+def test_parse_arguments_defaults():
+    # reference pdfcalc.jl:51-84 contract
+    ns = parse_arguments(["in.bp", "out.bp"])
+    assert ns.input == "in.bp" and ns.output == "out.bp"
+    assert ns.N == 1000 and ns.output_inputdata is False
+    ns = parse_arguments(["a", "b", "50", "YES"])
+    assert ns.N == 50 and ns.output_inputdata is True
+
+
+def test_compute_pdf_matches_numpy_histogram():
+    rng = np.random.default_rng(1)
+    data = rng.random((4, 8, 8)).astype(np.float32)
+    nbins = 16
+    pdf, bins = compute_pdf(data, nbins)
+    assert pdf.shape == (4, nbins) and bins.shape == (nbins,)
+    lo, hi = float(data.min()), float(data.max())
+    for s in range(4):
+        ref, _ = np.histogram(data[s], bins=nbins, range=(lo, hi))
+        np.testing.assert_array_equal(pdf[s].astype(np.int64), ref)
+    # counts preserved
+    assert int(pdf.sum()) == data.size
+
+
+def test_compute_pdf_degenerate_window():
+    data = np.full((3, 4, 4), 7.0, np.float32)
+    pdf, bins = compute_pdf(data, 10)
+    # reference special case: fill slice_size (pdfcalc.jl:24-27)
+    assert (pdf == 16).all()
+
+
+def test_split_slowest_dim():
+    # remainder to the last worker (pdfcalc.jl:132-139)
+    assert split_slowest_dim(10, 3, 0) == (0, 3)
+    assert split_slowest_dim(10, 3, 1) == (3, 3)
+    assert split_slowest_dim(10, 3, 2) == (6, 4)
+    assert split_slowest_dim(8, 1, 0) == (0, 8)
+
+
+def _write_sim_store(path, L=8, nsteps=3):
+    w = BpWriter(str(path))
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (L, L, L))
+    w.define_variable("V", np.float32, (L, L, L))
+    rng = np.random.default_rng(0)
+    for s in range(nsteps):
+        w.begin_step()
+        w.put("step", np.int32((s + 1) * 10))
+        w.put("U", rng.random((L, L, L)).astype(np.float32))
+        w.put("V", rng.random((L, L, L)).astype(np.float32))
+        w.end_step()
+    return w
+
+
+def test_pdfcalc_over_finished_store(tmp_path):
+    w = _write_sim_store(tmp_path / "sim.bp")
+    w.close()
+    n = read_data_write_pdf(
+        str(tmp_path / "sim.bp"), str(tmp_path / "pdf.bp"), nbins=32
+    )
+    assert n == 3
+    r = BpReader(str(tmp_path / "pdf.bp"))
+    assert r.num_steps() == 3
+    assert r.attributes()["nbins"] == 32
+    pdf = r.get("U/pdf", step=0)
+    assert pdf.shape == (8, 32)
+    assert int(pdf.sum()) == 8 * 8 * 8
+    assert int(r.get("step", step=2)) == 30
+
+
+def test_pdfcalc_streams_from_live_writer(tmp_path):
+    """In-situ coupling: analysis starts before the simulation finishes."""
+    w = _write_sim_store(tmp_path / "sim.bp", nsteps=1)
+
+    def finish():
+        time.sleep(0.5)
+        rng = np.random.default_rng(9)
+        w.begin_step()
+        w.put("step", np.int32(20))
+        w.put("U", rng.random((8, 8, 8)).astype(np.float32))
+        w.put("V", rng.random((8, 8, 8)).astype(np.float32))
+        w.end_step()
+        w.close()
+
+    t = threading.Thread(target=finish)
+    t.start()
+    n = read_data_write_pdf(
+        str(tmp_path / "sim.bp"), str(tmp_path / "pdf.bp"), nbins=8,
+        timeout=5.0,
+    )
+    t.join()
+    assert n == 2
+
+
+def test_pdfcalc_worker_split_covers_volume(tmp_path):
+    w = _write_sim_store(tmp_path / "sim.bp", nsteps=1)
+    w.close()
+    # two workers write disjoint x-ranges into separate stores' blocks
+    for rank in range(2):
+        read_data_write_pdf(
+            str(tmp_path / "sim.bp"),
+            str(tmp_path / f"pdf{rank}.bp"),
+            nbins=8,
+            rank=rank,
+            size=2,
+        )
+    r0 = BpReader(str(tmp_path / "pdf0.bp"))
+    r0.begin_step(timeout=0)
+    r0.set_selection("U/pdf", (0, 0), (4, 8))
+    top = r0.get("U/pdf")
+    assert int(top.sum()) == 4 * 8 * 8
+
+
+def test_write_inputdata_passthrough(tmp_path):
+    w = _write_sim_store(tmp_path / "sim.bp", nsteps=1)
+    w.close()
+    read_data_write_pdf(
+        str(tmp_path / "sim.bp"), str(tmp_path / "pdf.bp"), nbins=8,
+        write_inputvars=True,
+    )
+    r = BpReader(str(tmp_path / "pdf.bp"))
+    src = BpReader(str(tmp_path / "sim.bp"))
+    np.testing.assert_array_equal(
+        r.get("U", step=0), src.get("U", step=0)
+    )
