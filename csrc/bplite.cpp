@@ -281,7 +281,7 @@ class Writer {
   void publish_md_locked(std::unique_lock<std::mutex> lk) {
     std::string md = "{\"format\": \"bplite-1\", \"complete\": ";
     md += complete_ ? "true" : "false";
-    md += ", \"attributes\": {";
+    md += ", \"nwriters\": 1, \"attributes\": {";  // native engine is single-writer
     bool first = true;
     for (const auto &kv : attributes_) {
       if (!first) md += ", ";

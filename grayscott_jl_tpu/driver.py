@@ -29,25 +29,65 @@ def _next_boundary(step: int, period: int, limit: int) -> int:
     return min(limit, (step // period + 1) * period)
 
 
+def maybe_initialize_distributed() -> None:
+    """Multi-host bring-up (replaces the reference's ``MPI.Init``,
+    ``communication.jl:20``).
+
+    Activated by ``GS_TPU_COORDINATOR`` (host:port) +
+    ``GS_TPU_NUM_PROCESSES`` + ``GS_TPU_PROCESS_ID`` for explicit launch
+    (works on CPU for testing), or ``GS_TPU_DISTRIBUTED=auto`` for
+    TPU-pod autodetection via ``jax.distributed.initialize()``.
+    """
+    import os
+
+    import jax
+
+    coord = os.environ.get("GS_TPU_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["GS_TPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["GS_TPU_PROCESS_ID"]),
+        )
+    elif os.environ.get("GS_TPU_DISTRIBUTED") == "auto":
+        jax.distributed.initialize()
+
+
 def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     """Run a full simulation from CLI args (reference ``GrayScott.main``)."""
     settings = get_settings(list(args))
+    maybe_initialize_distributed()
+
+    import jax
+
     sim = Simulation(settings, n_devices=n_devices, seed=seed)
     log = Logger(verbose=settings.verbose)
+    proc, nprocs = jax.process_index(), jax.process_count()
 
     restart_step = 0
     if settings.restart:
-        from .io.checkpoint import load_checkpoint
+        from .io.checkpoint import open_checkpoint
 
-        u, v, restart_step = load_checkpoint(settings.restart_input, settings)
-        sim.restore(u, v, restart_step)
+        reader, last, restart_step = open_checkpoint(
+            settings.restart_input, settings
+        )
+        sim.restore_from_reader(reader, last, restart_step)
+        reader.close()
         log.info(f"Restarted from {settings.restart_input} at step {restart_step}")
 
     from .io.checkpoint import CheckpointWriter
     from .io.stream import SimStream
 
-    stream = SimStream(settings, sim.domain, sim.dtype)
-    ckpt = CheckpointWriter(settings, sim.dtype) if settings.checkpoint else None
+    stream = SimStream(
+        settings, sim.domain, sim.dtype, writer_id=proc, nwriters=nprocs
+    )
+    ckpt = (
+        CheckpointWriter(
+            settings, sim.dtype, writer_id=proc, nwriters=nprocs
+        )
+        if settings.checkpoint
+        else None
+    )
 
     step = restart_step
     t0 = time.perf_counter()
@@ -63,21 +103,22 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
         sim.iterate(boundary - step)
         step = boundary
 
-        if settings.plotgap > 0 and step % settings.plotgap == 0:
+        at_plot = settings.plotgap > 0 and step % settings.plotgap == 0
+        at_ckpt = (
+            ckpt is not None
+            and settings.checkpoint_freq > 0
+            and step % settings.checkpoint_freq == 0
+        )
+        if at_plot or at_ckpt:
+            blocks = sim.local_blocks()
+        if at_plot:
             log.info(
                 f"Simulation at step {step} writing output step "
                 f"{step // settings.plotgap}"
             )
-            u, v = sim.get_fields()
-            stream.write_step(step, u, v)
-
-        if (
-            ckpt is not None
-            and settings.checkpoint_freq > 0
-            and step % settings.checkpoint_freq == 0
-        ):
-            u, v = sim.get_fields()
-            ckpt.save(step, u, v)
+            stream.write_step(step, blocks)
+        if at_ckpt:
+            ckpt.save(step, blocks)
             log.info(f"Checkpoint written at step {step}")
 
     sim.block_until_ready()

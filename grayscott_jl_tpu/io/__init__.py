@@ -16,9 +16,20 @@ from __future__ import annotations
 import os
 
 
-def open_writer(path: str, *, writer_id: int = 0, append: bool = False):
-    """Open a BP-lite writer with the best available engine."""
-    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
+def open_writer(
+    path: str,
+    *,
+    writer_id: int = 0,
+    nwriters: int = 1,
+    append: bool = False,
+):
+    """Open a BP-lite writer with the best available engine.
+
+    Multi-writer stores (``nwriters > 1``, one writer per JAX process) use
+    the Python engine; the native engine currently implements the
+    single-writer layout.
+    """
+    if nwriters == 1 and os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native
 
         if native.available():
@@ -27,4 +38,6 @@ def open_writer(path: str, *, writer_id: int = 0, append: bool = False):
             )
     from .bplite import BpWriter
 
-    return BpWriter(path, writer_id=writer_id, append=append)
+    return BpWriter(
+        path, writer_id=writer_id, nwriters=nwriters, append=append
+    )

@@ -68,15 +68,40 @@ def _md_path(path: str) -> str:
 
 
 class BpWriter:
-    """Step-based writer engine (``ADIOS2.open(io, name, mode_write)``)."""
+    """Step-based writer engine (``ADIOS2.open(io, name, mode_write)``).
 
-    def __init__(self, path: str, *, writer_id: int = 0, append: bool = False):
+    Multi-writer stores (the ADIOS2 MPI-aggregated-I/O analog for JAX
+    multi-host runs): each process opens the same store with its own
+    ``writer_id`` and ``nwriters`` set; every writer owns its private
+    ``data.<w>`` payload and metadata file (``md.json`` for writer 0 —
+    which also carries the attribute/variable definitions and the writer
+    count — ``md.<w>.json`` for the rest), so NO cross-process
+    coordination is needed. The reader merges per-step blocks and
+    publishes a step only once every writer has committed it.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        writer_id: int = 0,
+        nwriters: int = 1,
+        append: bool = False,
+    ):
         self.path = path
         self.writer_id = writer_id
+        self.nwriters = nwriters
+        if not 0 <= writer_id < nwriters:
+            raise ValueError(f"writer_id {writer_id} not in [0, {nwriters})")
         os.makedirs(path, exist_ok=True)
+        self._md_path = (
+            _md_path(path)
+            if writer_id == 0
+            else os.path.join(path, f"md.{writer_id}.json")
+        )
         self._data_path = os.path.join(path, f"data.{writer_id}")
-        if append and os.path.exists(_md_path(path)):
-            with open(_md_path(path), "r", encoding="utf-8") as f:
+        if append and os.path.exists(self._md_path):
+            with open(self._md_path, "r", encoding="utf-8") as f:
                 self._md = json.load(f)
             self._md["complete"] = False
             self._offset = (
@@ -88,6 +113,7 @@ class BpWriter:
             self._md = {
                 "format": FORMAT_NAME,
                 "complete": False,
+                "nwriters": nwriters,
                 "attributes": {},
                 "variables": {},
                 "steps": [],
@@ -204,10 +230,10 @@ class BpWriter:
         self._data.close()
 
     def _flush_md(self) -> None:
-        tmp = _md_path(self.path) + f".tmp.{self.writer_id}"
+        tmp = self._md_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(self._md, f)
-        os.replace(tmp, _md_path(self.path))
+        os.replace(tmp, self._md_path)
 
     def __enter__(self):
         return self
@@ -246,16 +272,53 @@ class BpReader:
         self._load_md()
 
     def _load_md(self) -> None:
-        # The writer replaces md.json atomically; retry briefly on the
-        # window where a JSON read could race a slow filesystem.
+        # Writers replace their metadata files atomically; retry briefly on
+        # the window where a JSON read could race a slow filesystem.
+        md0 = self._load_one(_md_path(self.path), required=True)
+        nwriters = int(md0.get("nwriters", 1))
+        if nwriters == 1:
+            self._md = md0
+            return
+        # Multi-writer store: merge. A step is visible only once EVERY
+        # writer has committed it; the stream is complete when all writers
+        # closed and no unmerged steps remain.
+        mds = [md0]
+        for w in range(1, nwriters):
+            md_w = self._load_one(
+                os.path.join(self.path, f"md.{w}.json"), required=False
+            )
+            if md_w is None:  # writer not started yet: nothing visible
+                md_w = {"complete": False, "steps": []}
+            mds.append(md_w)
+        n_steps = min(len(m["steps"]) for m in mds)
+        steps = []
+        for i in range(n_steps):
+            merged: dict = {}
+            for m in mds:
+                for var, blocks in m["steps"][i].items():
+                    merged.setdefault(var, []).extend(blocks)
+            steps.append(merged)
+        self._md = {
+            "format": md0.get("format", FORMAT_NAME),
+            "complete": all(m.get("complete") for m in mds),
+            "nwriters": nwriters,
+            "attributes": md0.get("attributes", {}),
+            "variables": md0.get("variables", {}),
+            "steps": steps,
+        }
+
+    def _load_one(self, path: str, *, required: bool):
         for _ in range(50):
             try:
-                with open(_md_path(self.path), "r", encoding="utf-8") as f:
-                    self._md = json.load(f)
-                return
-            except (json.JSONDecodeError, FileNotFoundError):
+                with open(path, "r", encoding="utf-8") as f:
+                    return json.load(f)
+            except FileNotFoundError:
+                if not required:
+                    return None
                 time.sleep(0.01)
-        raise RuntimeError(f"Unreadable BP-lite metadata at {self.path}")
+            except json.JSONDecodeError:
+                time.sleep(0.01)
+        raise RuntimeError(f"Unreadable BP-lite metadata at {path}")
 
     # -- step streaming ----------------------------------------------------
 
@@ -313,9 +376,17 @@ class BpReader:
 
     # -- data --------------------------------------------------------------
 
-    def get(self, name: str, *, step: Optional[int] = None) -> np.ndarray:
+    def get(
+        self,
+        name: str,
+        *,
+        step: Optional[int] = None,
+        start: Optional[Sequence[int]] = None,
+        count: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
         """Read variable ``name`` at the current (or given) step, honoring
-        any selection. Assembles the box from the step's blocks."""
+        any selection (``start``/``count`` here override a stored
+        ``set_selection``). Assembles the box from the step's blocks."""
         if step is None:
             if self._current is None:
                 raise RuntimeError("get outside begin_step/end_step "
@@ -332,12 +403,16 @@ class BpReader:
         if not info.shape:  # scalar
             return self._read_block(blocks[0], info.dtype, ())
 
-        sel = self._selections.get(name)
-        if sel is None:
-            start = [0] * len(info.shape)
-            count = list(info.shape)
+        if start is None:
+            sel = self._selections.get(name)
+            if sel is None:
+                start = [0] * len(info.shape)
+                count = list(info.shape)
+            else:
+                start, count = sel
         else:
-            start, count = sel
+            start = [int(s) for s in start]
+            count = [int(c) for c in count]
         out = np.empty(count, dtype=info.dtype)
         filled = np.zeros(count, dtype=bool)
         sel_lo = np.array(start)

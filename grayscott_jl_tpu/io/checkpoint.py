@@ -24,33 +24,52 @@ from .bplite import BpReader
 
 
 class CheckpointWriter:
-    def __init__(self, settings: Settings, dtype):
+    def __init__(
+        self,
+        settings: Settings,
+        dtype,
+        *,
+        writer_id: int = 0,
+        nwriters: int = 1,
+    ):
         L = settings.L
         # On restart, append: truncating would destroy the very store the
         # run just resumed from when checkpoint_output == restart_input.
         self.writer = open_writer(
-            settings.checkpoint_output, append=settings.restart
+            settings.checkpoint_output,
+            writer_id=writer_id,
+            nwriters=nwriters,
+            append=settings.restart,
         )
-        self.writer.define_attribute("L", settings.L)
-        self.writer.define_attribute("precision", settings.precision)
+        if writer_id == 0:
+            self.writer.define_attribute("L", settings.L)
+            self.writer.define_attribute("precision", settings.precision)
         self.writer.define_variable("step", np.int32)
         self.writer.define_variable("u", np.dtype(dtype).name, (L, L, L))
         self.writer.define_variable("v", np.dtype(dtype).name, (L, L, L))
 
-    def save(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
+    def save(self, step: int, blocks) -> None:
+        """``blocks``: iterable of (offsets, sizes, u_block, v_block) —
+        this process's shards (``Simulation.local_blocks``)."""
         w = self.writer
         w.begin_step()
         w.put("step", np.int32(step))
-        w.put("u", u)
-        w.put("v", v)
+        for offsets, sizes, ub, vb in blocks:
+            w.put("u", ub, start=offsets, count=sizes)
+            w.put("v", vb, start=offsets, count=sizes)
         w.end_step()
 
     def close(self) -> None:
         self.writer.close()
 
 
-def load_checkpoint(path: str, settings: Settings) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Latest (u, v, step) from a checkpoint store; validates L."""
+def open_checkpoint(path: str, settings: Settings) -> Tuple[BpReader, int, int]:
+    """Open a checkpoint store and locate the latest entry.
+
+    Returns ``(reader, step_index, sim_step)``; the caller restores state
+    via per-shard selection reads (``Simulation.restore_from_reader``) so
+    no process ever materializes the full global arrays.
+    """
     r = BpReader(path)
     n = r.num_steps()
     if n == 0:
@@ -61,7 +80,16 @@ def load_checkpoint(path: str, settings: Settings) -> Tuple[np.ndarray, np.ndarr
             f"Checkpoint L={attrs['L']} does not match config L={settings.L}"
         )
     last = n - 1
-    step = int(r.get("step", step=last))
+    sim_step = int(r.get("step", step=last))
+    return r, last, sim_step
+
+
+def load_checkpoint(
+    path: str, settings: Settings
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Latest full (u, v, step) from a checkpoint store (single-host
+    convenience wrapper around :func:`open_checkpoint`)."""
+    r, last, step = open_checkpoint(path, settings)
     u = r.get("u", step=last)
     v = r.get("v", step=last)
     r.close()

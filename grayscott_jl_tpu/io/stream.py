@@ -65,6 +65,8 @@ class SimStream:
         dtype,
         *,
         io_name: str = "SimulationOutput",
+        writer_id: int = 0,
+        nwriters: int = 1,
     ):
         self.settings = settings
         self.domain = domain
@@ -73,39 +75,66 @@ class SimStream:
 
         # On restart, append: a resumed run must not truncate the output
         # steps written before the checkpoint it resumed from.
-        self.writer = open_writer(settings.output, append=settings.restart)
-        # Provenance attributes (IO.jl:48-53)
-        self.writer.define_attribute("F", settings.F)
-        self.writer.define_attribute("k", settings.k)
-        self.writer.define_attribute("dt", settings.dt)
-        self.writer.define_attribute("Du", settings.Du)
-        self.writer.define_attribute("Dv", settings.Dv)
-        self.writer.define_attribute("noise", settings.noise)
-        # Visualization schemas (IO.jl:123-163)
-        for name, value in fides_vtk_schemas(L).items():
-            self.writer.define_attribute(name, value)
+        self.writer = open_writer(
+            settings.output,
+            writer_id=writer_id,
+            nwriters=nwriters,
+            append=settings.restart,
+        )
+        if writer_id == 0:
+            # Provenance attributes (IO.jl:48-53)
+            self.writer.define_attribute("F", settings.F)
+            self.writer.define_attribute("k", settings.k)
+            self.writer.define_attribute("dt", settings.dt)
+            self.writer.define_attribute("Du", settings.Du)
+            self.writer.define_attribute("Dv", settings.Dv)
+            self.writer.define_attribute("noise", settings.noise)
+            # Visualization schemas (IO.jl:123-163)
+            for name, value in fides_vtk_schemas(L).items():
+                self.writer.define_attribute(name, value)
 
         self.writer.define_variable("step", np.int32)
         self.writer.define_variable("U", np.dtype(dtype).name, (L, L, L))
         self.writer.define_variable("V", np.dtype(dtype).name, (L, L, L))
 
         self._vtk = None
-        if settings.mesh_type.lower() == "image":
+        if settings.mesh_type.lower() == "image" and nwriters == 1:
+            # .vti needs the whole grid; multi-host runs rely on the BP
+            # store (ParaView-side assembly) instead.
             from .vtk import VtiSeriesWriter
 
             self._vtk = VtiSeriesWriter(
                 settings.output, L, append=settings.restart
             )
 
-    def write_step(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
-        """Write one output step (``IO.write_step!``, ``IO.jl:82-96``)."""
+    def write_step(self, step: int, blocks) -> None:
+        """Write one output step (``IO.write_step!``, ``IO.jl:82-96``).
+
+        ``blocks`` is an iterable of ``(offsets, sizes, u_block, v_block)``
+        — this process's shards of the global fields
+        (``Simulation.local_blocks``).
+        """
         w = self.writer
         w.begin_step()
         w.put("step", np.int32(step))
-        w.put("U", u)
-        w.put("V", v)
+        blocks = list(blocks)
+        for offsets, sizes, ub, vb in blocks:
+            w.put("U", ub, start=offsets, count=sizes)
+            w.put("V", vb, start=offsets, count=sizes)
         w.end_step()
         if self._vtk is not None:
+            L = self.settings.L
+            if len(blocks) == 1 and blocks[0][1] == (L, L, L):
+                u, v = blocks[0][2], blocks[0][3]
+            else:
+                u = np.empty((L, L, L), blocks[0][2].dtype)
+                v = np.empty_like(u)
+                for offsets, sizes, ub, vb in blocks:
+                    sl = tuple(
+                        slice(o, o + s) for o, s in zip(offsets, sizes)
+                    )
+                    u[sl] = ub
+                    v[sl] = vb
             self._vtk.write(step, u, v)
 
     def close(self) -> None:
