@@ -89,45 +89,61 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
         else None
     )
 
+    from .utils.profiler import RunStats, trace
+
+    stats = RunStats(settings.L)
     step = restart_step
     t0 = time.perf_counter()
-    while step < settings.steps:
-        boundary = min(
-            _next_boundary(step, settings.plotgap, settings.steps),
-            _next_boundary(
-                step,
-                settings.checkpoint_freq if ckpt is not None else 0,
-                settings.steps,
-            ),
-        )
-        sim.iterate(boundary - step)
-        step = boundary
-
-        at_plot = settings.plotgap > 0 and step % settings.plotgap == 0
-        at_ckpt = (
-            ckpt is not None
-            and settings.checkpoint_freq > 0
-            and step % settings.checkpoint_freq == 0
-        )
-        if at_plot or at_ckpt:
-            blocks = sim.local_blocks()
-        if at_plot:
-            log.info(
-                f"Simulation at step {step} writing output step "
-                f"{step // settings.plotgap}"
+    with trace():
+        while step < settings.steps:
+            boundary = min(
+                _next_boundary(step, settings.plotgap, settings.steps),
+                _next_boundary(
+                    step,
+                    settings.checkpoint_freq if ckpt is not None else 0,
+                    settings.steps,
+                ),
             )
-            stream.write_step(step, blocks)
-        if at_ckpt:
-            ckpt.save(step, blocks)
-            log.info(f"Checkpoint written at step {step}")
+            with stats.phase("compute"):
+                sim.iterate(boundary - step)
+            stats.count("steps", boundary - step)
+            step = boundary
 
-    sim.block_until_ready()
+            at_plot = settings.plotgap > 0 and step % settings.plotgap == 0
+            at_ckpt = (
+                ckpt is not None
+                and settings.checkpoint_freq > 0
+                and step % settings.checkpoint_freq == 0
+            )
+            if at_plot or at_ckpt:
+                with stats.phase("device_to_host"):
+                    blocks = sim.local_blocks()
+            if at_plot:
+                log.info(
+                    f"Simulation at step {step} writing output step "
+                    f"{step // settings.plotgap}"
+                )
+                with stats.phase("output"):
+                    stream.write_step(step, blocks)
+                stats.count("output_steps")
+            if at_ckpt:
+                with stats.phase("checkpoint"):
+                    ckpt.save(step, blocks)
+                stats.count("checkpoints")
+                log.info(f"Checkpoint written at step {step}")
+
+        with stats.phase("compute"):
+            sim.block_until_ready()
+
     elapsed = time.perf_counter() - t0
     cells = settings.L**3 * (settings.steps - restart_step)
     log.info(
         f"Completed {settings.steps - restart_step} steps in {elapsed:.3f}s "
         f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
     )
+    stats.maybe_write()
+    if settings.verbose:
+        log.info(f"run stats: {stats.summary()}")
 
     stream.close()
     if ckpt is not None:

@@ -1,0 +1,92 @@
+"""Tracing, timing, and run metrics.
+
+The reference's entire observability story is one ``@time`` around the run
+and commented-out PProf hooks (``gray-scott.jl:3-14``, SURVEY §5). Here:
+
+* :class:`RunStats` — per-phase wall-clock accumulation (compute, output,
+  checkpoint) with a structured JSON summary: cell-updates/s, per-phase
+  totals, step counts. Written to ``GS_TPU_STATS`` (file path) and logged
+  at verbose runs.
+* :class:`trace` — ``jax.profiler`` device tracing, enabled with
+  ``GS_TPU_PROFILE=<output-dir>``; view with TensorBoard/XProf or
+  ``jax.profiler`` tooling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class RunStats:
+    """Accumulates per-phase timings and counters for one simulation run.
+
+    Phases are host-side wall clock: JAX dispatch is asynchronous, so
+    device compute launched in a "compute" phase may overlap and complete
+    inside the next blocking phase (device_to_host / end-of-run sync).
+    Total wall time and cell-updates/s are exact; use ``GS_TPU_PROFILE``
+    device traces for per-op attribution.
+    """
+
+    def __init__(self, L: int):
+        self.L = L
+        self.phases: Dict[str, float] = {}
+        self.counters: Dict[str, int] = {}
+        self._t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t
+            )
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def summary(self) -> dict:
+        total = time.perf_counter() - self._t0
+        steps = self.counters.get("steps", 0)
+        compute = self.phases.get("compute", total)
+        return {
+            "L": self.L,
+            "steps": steps,
+            "wall_s": round(total, 6),
+            "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
+            "counters": dict(self.counters),
+            "cell_updates_per_s": (
+                round(self.L**3 * steps / compute, 3) if compute > 0 else None
+            ),
+        }
+
+    def maybe_write(self) -> Optional[str]:
+        """Write the summary where ``GS_TPU_STATS`` points (if set)."""
+        path = os.environ.get("GS_TPU_STATS")
+        if not path:
+            return None
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.summary(), f)
+            f.write("\n")
+        return path
+
+
+@contextlib.contextmanager
+def trace():
+    """``jax.profiler`` trace of the run when ``GS_TPU_PROFILE`` is set."""
+    out = os.environ.get("GS_TPU_PROFILE")
+    if not out:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(out)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Run an N-process distributed simulation on one machine (CPU devices) —
+# the development/testing analog of the reference's oversubscribed
+# `mpirun -n 4` (test/runtests.jl). Each process gets DEVICES_PER_PROC
+# virtual CPU devices; the global mesh spans all of them.
+#
+# Usage: ./scripts/run_local_multiproc.sh <nprocs> <config.toml> [devices_per_proc]
+
+set -euo pipefail
+
+NPROCS="${1:?nprocs}"
+CONFIG="${2:?config.toml}"
+DEV="${3:-4}"
+PORT="${PORT:-$(( (RANDOM % 20000) + 20000 ))}"
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+pids=()
+for ((i = 0; i < NPROCS; i++)); do
+  GS_TPU_COORDINATOR="127.0.0.1:${PORT}" \
+  GS_TPU_NUM_PROCESSES="${NPROCS}" \
+  GS_TPU_PROCESS_ID="${i}" \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=${DEV}" \
+  PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+  python3 "${REPO}/gray-scott.py" "${CONFIG}" &
+  pids+=($!)
+done
+
+rc=0
+for p in "${pids[@]}"; do
+  wait "$p" || rc=$?
+done
+exit "$rc"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Launch a multi-host simulation on a Cloud TPU pod slice.
+#
+# The reference ships per-machine HPC launch scripts (Summit/Crusher/
+# Perlmutter jsrun/srun wrappers, scripts/*.sh); the TPU-native analog is
+# one command fanned out to every pod worker — JAX discovers the pod
+# topology itself (GS_TPU_DISTRIBUTED=auto -> jax.distributed.initialize).
+#
+# Usage:
+#   ./scripts/run_tpu_pod.sh <tpu-name> <zone> <config.toml>
+#
+# Requires: gcloud configured, the repo present at the same path on every
+# worker (or use --worker=all scp first).
+
+set -euo pipefail
+
+TPU_NAME="${1:?tpu name}"
+ZONE="${2:?zone}"
+CONFIG="${3:?config.toml}"
+REPO_DIR="${REPO_DIR:-$(pwd)}"
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
+  --command "cd ${REPO_DIR} && GS_TPU_DISTRIBUTED=auto python3 gray-scott.py ${CONFIG}"
