@@ -4,17 +4,11 @@
     python gray-scott.py <config.toml>
 
 Wall-clock for the whole run is printed on success, like the reference's
-``@time julia_main()``.
+``@time julia_main()``. Same entry as the installed ``gray-scott``
+console script.
 """
 
-import sys
-import time
-
-from grayscott_jl_tpu import julia_main
+from grayscott_jl_tpu import cli_main
 
 if __name__ == "__main__":
-    t0 = time.perf_counter()
-    rc = julia_main(sys.argv[1:])
-    if rc == 0:
-        print(f"{time.perf_counter() - t0:.6f} seconds", file=sys.stderr)
-    sys.exit(rc)
+    cli_main()

@@ -22,7 +22,7 @@ from .config.settings import (  # noqa: F401
 )
 from .simulation import Simulation, finalize, initialization  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def main(args):
@@ -44,3 +44,17 @@ def julia_main(args=None) -> int:
         traceback.print_exc()
         return 1
     return 0
+
+
+def cli_main() -> None:
+    """``gray-scott`` console-script entry point (installed via
+    pyproject; the repo-root ``gray-scott.py`` launcher wraps the same
+    path for uninstalled use)."""
+    import sys
+    import time
+
+    t0 = time.perf_counter()
+    rc = julia_main(sys.argv[1:])
+    if rc == 0:
+        print(f"{time.perf_counter() - t0:.6f} seconds", file=sys.stderr)
+    sys.exit(rc)

@@ -106,6 +106,9 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
             )
             with stats.phase("compute"):
                 sim.iterate(boundary - step)
+                # iterate() only dispatches; block so the phase measures
+                # device execution, not async enqueue time.
+                sim.block_until_ready()
             stats.count("steps", boundary - step)
             step = boundary
 
@@ -131,9 +134,6 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
                     ckpt.save(step, blocks)
                 stats.count("checkpoints")
                 log.info(f"Checkpoint written at step {step}")
-
-        with stats.phase("compute"):
-            sim.block_until_ready()
 
     elapsed = time.perf_counter() - t0
     cells = settings.L**3 * (settings.steps - restart_step)

@@ -7,20 +7,28 @@ Two kernel languages (the reference's Plain/KernelAbstractions pair,
   config values "Plain" and "KernelAbstractions" alias here).
 * ``"pallas"`` — hand-fused Pallas TPU kernel (``kernel_language = "Pallas"``).
 
-Both share the signature ``kernel(u_pad, v_pad, noise_u, params) -> (u, v)``
-with ghost-padded inputs and interior-shaped outputs.
+The two languages have *different* call contracts (deliberately — the
+Pallas kernel's whole advantage is consuming interior arrays + halo faces
+with in-kernel RNG, while the XLA kernel consumes ghost-padded arrays +
+a pre-generated noise field), so there is no uniform kernel callable:
+``Simulation._local_run`` branches on the language explicitly.
+``validate_kernel_language`` front-loads the import/availability check so
+a bad config fails at construction, not at first ``iterate`` (the
+reference defers dispatch errors to runtime fallbacks,
+``public.jl:31-32, 77-78``).
 """
 
 from __future__ import annotations
 
-from . import stencil
+from . import stencil  # noqa: F401 — re-exported compute core
 
 
-def get_kernel(lang: str):
+def validate_kernel_language(lang: str) -> None:
+    """Raise if ``lang`` is unknown or its kernel module cannot load."""
     if lang == "xla":
-        return stencil.reaction_update
+        return
     if lang == "pallas":
-        from . import pallas_stencil
+        from . import pallas_stencil  # noqa: F401 — import is the check
 
-        return pallas_stencil.reaction_update
+        return
     raise ValueError(f"Unknown kernel language: {lang!r}")

@@ -2,27 +2,48 @@
 
 ``kernel_language = "Pallas"`` — the TPU-native re-design of the
 reference's hand-written GPU kernels (``ext/CUDAExt.jl:127-187``,
-``Simulation_KA.jl:160-236``): where those launch a 2D (k,j) thread grid
-with a serial i loop per thread, this kernel walks the outermost (x) axis
-as a sequential TPU grid, processing one full (y, z) plane per program with
-both fields' diffusion + reaction fused into a single VMEM-resident pass.
+``Simulation_KA.jl:160-236``). Where those launch a 2D (k,j) thread grid
+with a serial i loop per thread, this kernel is a single program that
+walks the outermost (x) axis in ``BX``-plane slabs with a manually
+double-buffered HBM->VMEM DMA pipeline, computing both fields' diffusion +
+reaction + noise in one fused VMEM-resident pass per slab.
 
-Layout: fields are C-order ``[x, y, z]`` so z is the 128-lane dimension and
-y the sublane dimension; in-plane shifts are vector ops, and the x-axis
-neighbor planes arrive as separate blocks (``x-1``, ``x``, ``x+1``) of the
-same ghost-padded operand. HBM traffic per step: 3 reads + 1 write per
-field per cell (vs the XLA path's materialized pad + 6 shifted-slice
-reads), plus the optional noise field.
+The stencil is memory-bound (~30 flops vs 16 bytes minimum traffic per
+cell), so the kernel is designed around HBM traffic:
 
-Numerics are identical to ``ops/stencil.reaction_update`` (same op order,
-same dtype); the noise field is generated *outside* the kernel with the
-same ``jax.random`` stream, so XLA- and Pallas-kernel runs are bit-
-comparable (asserted by ``tests/unit/test_pallas.py``).
+* operands are the **interior-shaped** ``(L, L, L)`` fields — no
+  materialized ghost pad (a blocked-``pallas_call`` or XLA version spends
+  a full extra read+write per field on ``jnp.pad``, and the padded
+  ``L+2`` lane dimension rounds up to the next 128-lane tile, wasting up
+  to ~50% of the vector work at L=256);
+* x-neighbor planes come from overlapping slab DMAs — ``(BX+2)/BX``
+  reads per cell instead of 3 reads with the three-plane-operand trick;
+* y/z neighbors are in-VMEM shifts (``pltpu.roll``) with the wrapped
+  boundary row/column repaired by a masked select — ghost cells never
+  exist in memory. On the global edge the mask substitutes the frozen
+  boundary value (u=1, v=0 — the reference's ``MPI.PROC_NULL`` ghost
+  semantics, ``Simulation_CPU.jl:23-24``); on an interior shard edge it
+  substitutes the neighbor face delivered by the ``ppermute`` halo
+  exchange (``parallel/halo.exchange_faces``);
+* per-cell uniform noise is generated *inside* the kernel with the TPU
+  hardware PRNG (``pltpu.prng_random_bits``) — the XLA path's separate
+  counter-based ``threefry`` pass (generate + write + re-read)
+  disappears. The stream is seeded from (base key, step, slab), so
+  restarts reproduce it exactly; it is a *different* stream from the XLA
+  kernel's, just as the reference's CPU (``Distributions.Uniform``,
+  ``Simulation_CPU.jl:101-103``) and CUDA (in-kernel ``rand``,
+  ``CUDAExt.jl:149-151``) backends draw from unrelated streams.
+  ``tests/unit/test_pallas.py`` checks the noiseless paths agree exactly
+  and the noisy path statistically.
 
-On non-TPU backends the kernel runs in Pallas interpret mode (tests); the
-Float64 + TPU combination falls back to the XLA kernel (Mosaic has no f64
-vector path — the reference has the same asymmetry: its AMDGPU backend
-disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
+Net HBM traffic per cell per step: ~(1 + 2/BX) reads + 1 write per field
+(f32: ~18 bytes at BX=8) vs ~60 bytes for the pad + three-plane + noise
+pipeline it replaces.
+
+The Float64 + TPU combination falls back to the XLA kernel (Mosaic has no
+f64 vector path — the reference has the same asymmetry: its AMDGPU
+backend disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
+On non-TPU backends the kernel runs in Pallas interpret mode (tests).
 """
 
 from __future__ import annotations
@@ -31,110 +52,368 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
 
-
-def _plane_kernel(p_ref, um, uc, up, vm, vc, vp, nz, u_out, v_out):
-    """One (y, z) plane of the fused update.
-
-    ``um/uc/up`` are the x-1/x/x+1 ghost-padded planes of u, shape
-    (1, ny+2, nz+2); ``nz`` is the pre-scaled noise plane (1, ny, nz) or
-    None; outputs are interior planes (1, ny, nz).
-    """
-    dtype = uc.dtype
-    six = jnp.asarray(6.0, dtype)
-    one = jnp.asarray(1.0, dtype)
-    Du, Dv, F, K, dt = (p_ref[i] for i in range(5))
-
-    # 7-point Laplacian on the plane interior (Common.jl:13-18): x-axis
-    # neighbors come from the um/up planes, y/z neighbors from in-plane
-    # shifts of the center plane.
-    u_c = uc[0]
-    v_c = vc[0]
-    lap_u = (
-        um[0, 1:-1, 1:-1]
-        + up[0, 1:-1, 1:-1]
-        + u_c[:-2, 1:-1]
-        + u_c[2:, 1:-1]
-        + u_c[1:-1, :-2]
-        + u_c[1:-1, 2:]
-        - six * u_c[1:-1, 1:-1]
-    ) / six
-    lap_v = (
-        vm[0, 1:-1, 1:-1]
-        + vp[0, 1:-1, 1:-1]
-        + v_c[:-2, 1:-1]
-        + v_c[2:, 1:-1]
-        + v_c[1:-1, :-2]
-        + v_c[1:-1, 2:]
-        - six * v_c[1:-1, 1:-1]
-    ) / six
-
-    u = u_c[1:-1, 1:-1]
-    v = v_c[1:-1, 1:-1]
-    uvv = u * v * v
-    du = Du * lap_u - uvv + F * (one - u) + (nz[0] if nz is not None else 0.0)
-    dv = Dv * lap_v + uvv - (F + K) * v
-    u_out[0] = u + du * dt
-    v_out[0] = v + dv * dt
+#: VMEM scratch budget for slab buffers. Per-core VMEM is 64-128 MiB on
+#: v4/v5 hardware; stay well under to leave the compiler headroom.
+_VMEM_BUDGET = 48 * 1024 * 1024
 
 
-def _plane_kernel_nonoise(p_ref, um, uc, up, vm, vc, vp, u_out, v_out):
-    _plane_kernel(p_ref, um, uc, up, vm, vc, vp, None, u_out, v_out)
+def pick_block_planes(nx: int, ny: int, nz: int, itemsize: int) -> int:
+    """Largest slab depth BX (dividing nx) whose double-buffered u/v
+    in/out scratch fits the VMEM budget; 0 if even BX=1 does not fit."""
+    for bx in (16, 8, 4, 2, 1):
+        if nx % bx:
+            continue
+        in_bytes = 2 * 2 * (bx + 2) * ny * nz * itemsize
+        out_bytes = 2 * 2 * bx * ny * nz * itemsize
+        if in_bytes + out_bytes <= _VMEM_BUDGET:
+            return bx
+    return 0
 
 
-@functools.partial(jax.jit, static_argnames=("use_noise",))
-def _call(u_pad, v_pad, noise_u, params_vec, *, use_noise: bool):
-    nxp, nyp, nzp = u_pad.shape
-    nx, ny, nz = nxp - 2, nyp - 2, nzp - 2
-    dtype = u_pad.dtype
-
-    plane = lambda off: pl.BlockSpec(  # noqa: E731 — x-1/x/x+1 planes
-        (1, nyp, nzp), lambda i, o=off: (i + o, 0, 0)
+def _uniform_pm1(shape, dtype):
+    """Uniform in [-1, 1) from the seeded TPU PRNG: keep 23 random
+    mantissa bits over exponent 0 -> float in [1, 2), then affine-map."""
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    f12 = pltpu.bitcast(
+        jnp.uint32(0x3F800000) | (bits >> jnp.uint32(9)), jnp.float32
     )
-    interior = pl.BlockSpec((1, ny, nz), lambda i: (i, 0, 0))
+    return (f12 * 2.0 - 3.0).astype(dtype)
 
-    in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),  # params vector
-        plane(0), plane(1), plane(2),  # u planes x-1, x, x+1
-        plane(0), plane(1), plane(2),  # v planes
-    ]
-    operands = [params_vec, u_pad, u_pad, u_pad, v_pad, v_pad, v_pad]
-    if use_noise:
-        in_specs.append(interior)
-        operands.append(noise_u)
-        kernel = _plane_kernel
-    else:
-        kernel = _plane_kernel_nonoise
 
-    out_shape = [
-        jax.ShapeDtypeStruct((nx, ny, nz), dtype),
-        jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+def _shifted(block, axis, shift, edge_value):
+    """Neighbor values along a VMEM-resident axis: circular shift with the
+    wrapped boundary row/column replaced by ``edge_value`` (a scalar
+    boundary constant or a broadcastable face slab)."""
+    n = block.shape[axis]
+    # roll(x, s)[i] = x[i - s]; a backward (-1) shift is circularly n-1.
+    rolled = pltpu.roll(block, shift if shift > 0 else n - 1, axis)
+    idx = lax.broadcasted_iota(jnp.int32, block.shape, axis)
+    edge = idx == (0 if shift == 1 else n - 1)
+    return jnp.where(edge, edge_value, rolled)
+
+
+def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
+    """Build the fused single-program kernel body; see module docstring.
+
+    Ref order (faces present only when ``with_faces``):
+      params(SMEM f32[6]), seeds(SMEM i32[3]),
+      u, v (ANY/HBM, (nx, ny, nz)),
+      [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (1, ny, nz)),
+       u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
+       u_zlo, u_zhi, v_zlo, v_zhi (VMEM, (nx, ny, 1))],
+      u_out, v_out (ANY/HBM),
+      scratch: in_u, in_v (VMEM (2, bx+2, ny, nz)),
+               out_u, out_v (VMEM (2, bx, ny, nz)),
+               in_sems (DMA (2, 2)), out_sems (DMA (2, 2)),
+               [face_sems (DMA (2, 2, 2))]
+    """
+
+    def kernel(params, seeds, u, v, *rest):
+        if with_faces:
+            (u_xlo, u_xhi, v_xlo, v_xhi,
+             u_ylo, u_yhi, v_ylo, v_yhi,
+             u_zlo, u_zhi, v_zlo, v_zhi,
+             u_out, v_out,
+             in_u, in_v, out_u, out_v,
+             in_sems, out_sems, face_sems) = rest
+            x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
+        else:
+            (u_out, v_out,
+             in_u, in_v, out_u, out_v,
+             in_sems, out_sems) = rest
+            x_faces = None
+
+        u_bv = jnp.asarray(stencil.U_BOUNDARY, dtype)
+        v_bv = jnp.asarray(stencil.V_BOUNDARY, dtype)
+        fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
+
+        def slab_io(slot, b, start):
+            """Start (or wait for) all input DMAs of slab ``b``.
+
+            An interior slab reads planes [b*bx-1, b*bx+bx+1); the first
+            and last slabs read one plane fewer (the missing plane is a
+            ghost filled from the boundary constant or the x halo face).
+            Descriptors are constructed lazily inside their branch — an
+            unused descriptor is an error.
+            """
+
+            def go(make):
+                d = make()
+                (d.start if start else d.wait)()
+
+            for field_ref, scr, tag, bv in fields:
+                sem = in_sems.at[slot, tag]
+                if nblocks == 1:
+                    go(lambda: pltpu.make_async_copy(
+                        field_ref, scr.at[slot, pl.ds(1, bx)], sem))
+                else:
+                    lo, hi = b == 0, b == nblocks - 1
+
+                    @pl.when(lo)
+                    def _():
+                        go(lambda: pltpu.make_async_copy(
+                            field_ref.at[pl.ds(0, bx + 1)],
+                            scr.at[slot, pl.ds(1, bx + 1)], sem))
+
+                    @pl.when(hi)
+                    def _():
+                        go(lambda: pltpu.make_async_copy(
+                            field_ref.at[pl.ds(b * bx - 1, bx + 1)],
+                            scr.at[slot, pl.ds(0, bx + 1)], sem))
+
+                    @pl.when(jnp.logical_not(lo | hi))
+                    def _():
+                        go(lambda: pltpu.make_async_copy(
+                            field_ref.at[pl.ds(b * bx - 1, bx + 2)],
+                            scr.at[slot], sem))
+
+                # Ghost x-planes on the slab's outer side(s).
+                for which, cond in ((0, b == 0), (1, b == nblocks - 1)):
+                    plane = 0 if which == 0 else bx + 1
+                    if with_faces:
+                        xref = x_faces[tag][which]
+
+                        @pl.when(cond)
+                        def _():
+                            go(lambda: pltpu.make_async_copy(
+                                xref,
+                                scr.at[slot, pl.ds(plane, 1)],
+                                face_sems.at[slot, tag, which]))
+                    elif start:
+
+                        @pl.when(cond)
+                        def _():
+                            scr[slot, plane] = jnp.full((ny, nz), bv, dtype)
+
+        def out_dma(ref, scr, slot, b, tag):
+            return pltpu.make_async_copy(
+                scr.at[slot],
+                ref.at[pl.ds(b * bx, bx)],
+                out_sems.at[slot, tag],
+            )
+
+        def compute(slot, b):
+            u_win = in_u[slot]
+            v_win = in_v[slot]
+            u_c = u_win[1:bx + 1]
+            v_c = v_win[1:bx + 1]
+
+            if with_faces:
+                rows = lambda f: f[pl.ds(b * bx, bx)]  # noqa: E731
+                u_edges = (rows(u_ylo), rows(u_yhi), rows(u_zlo), rows(u_zhi))
+                v_edges = (rows(v_ylo), rows(v_yhi), rows(v_zlo), rows(v_zhi))
+            else:
+                u_edges = (u_bv,) * 4
+                v_edges = (v_bv,) * 4
+
+            six = jnp.asarray(6.0, dtype)
+            one = jnp.asarray(1.0, dtype)
+
+            def lap(win, c, edges):
+                ylo, yhi, zlo, zhi = edges
+                return (
+                    win[0:bx] + win[2:bx + 2]
+                    + _shifted(c, 1, 1, ylo)
+                    + _shifted(c, 1, -1, yhi)
+                    + _shifted(c, 2, 1, zlo)
+                    + _shifted(c, 2, -1, zhi)
+                    - six * c
+                ) / six
+
+            lap_u = lap(u_win, u_c, u_edges)
+            lap_v = lap(v_win, v_c, v_edges)
+
+            Du, Dv, F, K, dt, noise = (params[j] for j in range(6))
+            uvv = u_c * v_c * v_c
+            du = Du * lap_u - uvv + F * (one - u_c)
+            if use_noise:
+                pltpu.prng_seed(seeds[0], seeds[1], seeds[2], b)
+                du = du + noise * _uniform_pm1(u_c.shape, dtype)
+            dv = Dv * lap_v + uvv - (F + K) * v_c
+            out_u[slot] = u_c + du * dt
+            out_v[slot] = v_c + dv * dt
+
+        # ---- pipeline: prologue, steady-state loop, epilogue ----
+        slab_io(0, jnp.int32(0), start=True)
+
+        def body(b, _):
+            slot = lax.rem(b, 2)
+            nxt = lax.rem(b + 1, 2)
+
+            @pl.when(b + 1 < nblocks)
+            def _():
+                slab_io(nxt, b + 1, start=True)
+
+            slab_io(slot, b, start=False)
+
+            @pl.when(b >= 2)
+            def _():
+                out_dma(u_out, out_u, slot, b - 2, 0).wait()
+                out_dma(v_out, out_v, slot, b - 2, 1).wait()
+
+            compute(slot, b)
+            out_dma(u_out, out_u, slot, b, 0).start()
+            out_dma(v_out, out_v, slot, b, 1).start()
+            return 0
+
+        lax.fori_loop(0, nblocks, body, 0)
+
+        for tail_b in (nblocks - 2, nblocks - 1):
+            if tail_b >= 0:
+                slot = tail_b % 2
+                b = jnp.int32(tail_b)
+                out_dma(u_out, out_u, slot, b, 0).wait()
+                out_dma(v_out, out_v, slot, b, 1).wait()
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("bx", "use_noise", "interpret"))
+def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
+    nx, ny, nz = u.shape
+    dtype = u.dtype
+    nblocks = nx // bx
+    with_faces = faces is not None
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    vmem_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    in_specs = [smem_spec, smem_spec, any_spec, any_spec]
+    operands = [params_vec, seeds, u, v]
+    if with_faces:
+        # x faces ride DMA from HBM (ANY); y/z faces are small -> VMEM.
+        in_specs += [any_spec] * 4 + [vmem_spec] * 8
+        operands += list(faces)
+
+    scratch_shapes = [
+        pltpu.VMEM((2, bx + 2, ny, nz), dtype),
+        pltpu.VMEM((2, bx + 2, ny, nz), dtype),
+        pltpu.VMEM((2, bx, ny, nz), dtype),
+        pltpu.VMEM((2, bx, ny, nz), dtype),
+        pltpu.SemaphoreType.DMA((2, 2)),
+        pltpu.SemaphoreType.DMA((2, 2)),
     ]
+    if with_faces:
+        scratch_shapes.append(pltpu.SemaphoreType.DMA((2, 2, 2)))
+
     return pl.pallas_call(
-        kernel,
-        grid=(nx,),
+        _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces),
         in_specs=in_specs,
-        out_specs=[interior, interior],
-        out_shape=out_shape,
-        interpret=jax.default_backend() != "tpu",
+        out_specs=[any_spec, any_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+            jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+        ],
+        scratch_shapes=scratch_shapes,
+        # The TPU-semantics interpreter (not the generic HLO one) models
+        # SMEM/semaphores/DMA and the TPU PRNG on CPU for tests.
+        interpret=pltpu.InterpretParams(dma_execution_mode="eager")
+        if interpret
+        else False,
     )(*operands)
 
 
-def reaction_update(u_pad, v_pad, noise_u, params):
-    """Drop-in replacement for ``stencil.reaction_update`` (same signature:
-    ghost-padded inputs, interior outputs)."""
-    dtype = u_pad.dtype
-    if dtype == jnp.float64 and jax.default_backend() == "tpu":
-        # Mosaic has no f64 path; keep Float64 configs correct via XLA.
-        return stencil.reaction_update(u_pad, v_pad, noise_u, params)
+def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
+               allow_interpret=True):
+    """One fused Gray-Scott step on interior-shaped fields.
+
+    ``seeds`` is an int32[3] vector (PRNG key data lo/hi, step index)
+    feeding the in-kernel PRNG; ``faces`` (optional) is the 12-tuple of
+    resolved halo faces for a sharded block, in the order
+    ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi,
+    u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped (1, ny, nz),
+    y faces (nx, 1, nz), z faces (nx, ny, 1).
+
+    Returns (u', v'). Falls back to the XLA kernel when Mosaic cannot
+    serve the dtype (f64 on TPU), the shape would overflow VMEM, or —
+    off TPU with ``allow_interpret=False`` — when the caller is inside
+    ``shard_map``: the interpret-mode TPU model keeps *global* semaphore
+    state, and concurrent per-shard interpreter instances deadlock each
+    other (reproduced at nblocks >= 2 on an 8-device CPU mesh). The
+    sharded kernel path is instead covered by the single-device
+    with-faces interpret test plus the TPU hardware tests.
+    """
+    nx, ny, nz = u.shape
+    dtype = u.dtype
+    on_tpu = jax.default_backend() == "tpu"
+    bx = pick_block_planes(nx, ny, nz, u.dtype.itemsize)
+    if (
+        (dtype == jnp.float64 and on_tpu)
+        or bx == 0
+        or (not on_tpu and not allow_interpret)
+    ):
+        return _xla_fallback(u, v, params, seeds, faces, use_noise=use_noise)
     params_vec = jnp.stack(
-        [params.Du, params.Dv, params.F, params.k, params.dt]
+        [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
     ).astype(dtype)
-    use_noise = getattr(noise_u, "ndim", 0) > 0
-    if not use_noise:
-        noise_u = None
-    return _call(u_pad, v_pad, noise_u, params_vec, use_noise=use_noise)
+    # The interpret-mode TPU PRNG is a deterministic zeros stub, so off
+    # TPU the noise is added outside the kernel from the threefry stream
+    # (u' = u + (du + n)*dt  ==  fused u' + n*dt). The in-kernel PRNG
+    # statistics are validated on hardware (tests/unit/test_tpu_hardware.py).
+    seeds = jnp.asarray(seeds, jnp.int32)
+    u2, v2 = _fused_call(
+        u, v, params_vec, seeds,
+        tuple(faces) if faces is not None else None,
+        bx=bx, use_noise=use_noise and on_tpu, interpret=not on_tpu,
+    )
+    if use_noise and not on_tpu:
+        from ..models import grayscott
+
+        key = _threefry_key(seeds)
+        nz_field = grayscott.noise_field(key, u.shape, dtype, params.noise)
+        u2 = u2 + nz_field * params.dt
+    return u2, v2
+
+
+def _threefry_key(seeds):
+    return jax.random.fold_in(
+        jax.random.wrap_key_data(
+            lax.bitcast_convert_type(seeds[:2], jnp.uint32)
+        ),
+        lax.bitcast_convert_type(seeds[2], jnp.uint32),
+    )
+
+
+def _xla_fallback(u, v, params, seeds, faces, *, use_noise):
+    """XLA-path step with the same call contract as ``fused_step``.
+
+    Noise here comes from the counter-based threefry stream keyed on
+    ``seeds`` — a different (still reproducible) stream from the TPU
+    hardware PRNG, mirroring how the reference's backends each own their
+    RNG (``Simulation_CPU.jl:101-103`` vs ``CUDAExt.jl:149-151``).
+    """
+    from ..models import grayscott
+
+    if faces is None:
+        u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
+        v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
+    else:
+        u_pad = _pad_from_faces(u, faces[0], faces[1], faces[4], faces[5],
+                                faces[8], faces[9])
+        v_pad = _pad_from_faces(v, faces[2], faces[3], faces[6], faces[7],
+                                faces[10], faces[11])
+    if use_noise:
+        key = _threefry_key(jnp.asarray(seeds, jnp.int32))
+        nz_field = grayscott.noise_field(key, u.shape, u.dtype, params.noise)
+    else:
+        nz_field = jnp.asarray(0.0, u.dtype)
+    return stencil.reaction_update(u_pad, v_pad, nz_field, params)
+
+
+def _pad_from_faces(x, xlo, xhi, ylo, yhi, zlo, zhi):
+    """Ghost-pad an interior block using resolved halo faces (corner and
+    edge ghosts get zeros — the 7-point stencil never reads them)."""
+    x = jnp.concatenate([xlo, x, xhi], axis=0)
+    ylo = jnp.pad(ylo, ((1, 1), (0, 0), (0, 0)))
+    yhi = jnp.pad(yhi, ((1, 1), (0, 0), (0, 0)))
+    x = jnp.concatenate([ylo, x, yhi], axis=1)
+    zlo = jnp.pad(zlo, ((1, 1), (1, 1), (0, 0)))
+    zhi = jnp.pad(zhi, ((1, 1), (1, 1), (0, 0)))
+    return jnp.concatenate([zlo, x, zhi], axis=2)

@@ -22,7 +22,7 @@ All functions here must be called *inside* ``shard_map``.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,53 @@ def _face(x: jnp.ndarray, dim: int, index: int) -> jnp.ndarray:
     return x[tuple(idx)]
 
 
+def _exchange_dim(
+    arrays: List[jnp.ndarray],
+    boundary_values: Sequence[float],
+    dim: int,
+    ax: str,
+    n: int,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Resolved (lo, hi) ghost faces along one mesh axis for each array.
+
+    One ``ppermute`` per direction carries all arrays (stacked along the
+    transfer axis); global-edge shards get the frozen boundary value.
+    ``n == 1`` (single shard on the axis) short-circuits to constants.
+    """
+    if n == 1:
+        out = []
+        for a, bv in zip(arrays, boundary_values):
+            shape = list(a.shape)
+            shape[dim] = 1
+            f = jnp.full(shape, bv, a.dtype)
+            out.append((f, f))
+        return out
+
+    n_arr = len(arrays)
+    idx = lax.axis_index(ax)
+
+    # Stack the last faces of all arrays -> send "up" (coord+1);
+    # stack the first faces -> send "down" (coord-1).
+    send_up = jnp.concatenate([_face(a, dim, -1) for a in arrays], dim)
+    send_dn = jnp.concatenate([_face(a, dim, 0) for a in arrays], dim)
+
+    up_perm = [(i, i + 1) for i in range(n - 1)]
+    dn_perm = [(i + 1, i) for i in range(n - 1)]
+    recv_from_lo = lax.ppermute(send_up, ax, up_perm)  # lower nbr's top
+    recv_from_hi = lax.ppermute(send_dn, ax, dn_perm)  # upper nbr's bottom
+
+    lo_faces = jnp.split(recv_from_lo, n_arr, axis=dim)
+    hi_faces = jnp.split(recv_from_hi, n_arr, axis=dim)
+
+    out = []
+    for i, (a, bv) in enumerate(zip(arrays, boundary_values)):
+        bvt = jnp.asarray(bv, a.dtype)
+        lo = jnp.where(idx > 0, lo_faces[i], bvt)
+        hi = jnp.where(idx < n - 1, hi_faces[i], bvt)
+        out.append((lo, hi))
+    return out
+
+
 def halo_pad(
     arrays: Sequence[jnp.ndarray],
     boundary_values: Sequence[float],
@@ -46,11 +93,10 @@ def halo_pad(
 
     ``arrays`` are interior-shaped local blocks (same shape); ghosts come
     from the adjacent shard along each mesh axis, or stay at the frozen
-    ``boundary_values`` on the global edge. One ``ppermute`` per
-    (axis, direction) carries all arrays (stacked along the transfer axis).
+    ``boundary_values`` on the global edge. This is the XLA-kernel form;
+    the Pallas kernel consumes :func:`exchange_faces` instead.
     """
     arrays = list(arrays)
-    n_arr = len(arrays)
     padded = [
         jnp.pad(a, 1, mode="constant", constant_values=bv)
         for a, bv in zip(arrays, boundary_values)
@@ -59,25 +105,8 @@ def halo_pad(
     for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
         if n == 1:
             continue  # single shard on this axis: ghosts stay frozen
-        idx = lax.axis_index(ax)
-
-        # Stack the last faces of all arrays -> send "up" (coord+1);
-        # stack the first faces -> send "down" (coord-1).
-        send_up = jnp.concatenate([_face(a, dim, -1) for a in arrays], dim)
-        send_dn = jnp.concatenate([_face(a, dim, 0) for a in arrays], dim)
-
-        up_perm = [(i, i + 1) for i in range(n - 1)]
-        dn_perm = [(i + 1, i) for i in range(n - 1)]
-        recv_from_lo = lax.ppermute(send_up, ax, up_perm)  # lower nbr's top
-        recv_from_hi = lax.ppermute(send_dn, ax, dn_perm)  # upper nbr's bottom
-
-        lo_faces = jnp.split(recv_from_lo, n_arr, axis=dim)
-        hi_faces = jnp.split(recv_from_hi, n_arr, axis=dim)
-
-        for i, (a, bv) in enumerate(zip(arrays, boundary_values)):
-            bvt = jnp.asarray(bv, a.dtype)
-            lo = jnp.where(idx > 0, lo_faces[i], bvt)
-            hi = jnp.where(idx < n - 1, hi_faces[i], bvt)
+        faces = _exchange_dim(arrays, boundary_values, dim, ax, n)
+        for i, (lo, hi) in enumerate(faces):
             # Write interior-sized faces into the padded array; corners and
             # edges keep the boundary constant (never read by the stencil).
             start_lo = [1] * 3
@@ -88,6 +117,34 @@ def halo_pad(
             padded[i] = lax.dynamic_update_slice(padded[i], hi, start_hi)
 
     return tuple(padded)
+
+
+def exchange_faces(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    axis_names: Tuple[str, str, str],
+    axis_sizes: Tuple[int, int, int],
+) -> Tuple[jnp.ndarray, ...]:
+    """Resolved halo faces for each array, without building padded blocks.
+
+    Same communication pattern as :func:`halo_pad`, but the result is the
+    1-thick face slabs themselves — the form the fused Pallas kernel
+    consumes (``ops/pallas_stencil.fused_step``), which repairs its
+    boundary rows/columns in-register instead of reading ghost cells from
+    memory.
+
+    Returns, for axes x, y, z in order and per array, ``(lo, hi)`` faces:
+    for 2 arrays (u, v) that is
+    ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, ..., v_zhi)``. On a global
+    edge (or an axis with a single shard) the face is the frozen
+    boundary constant.
+    """
+    arrays = list(arrays)
+    flat = []
+    for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
+        for lo_hi in _exchange_dim(arrays, boundary_values, dim, ax, n):
+            flat.extend(lo_hi)
+    return tuple(flat)
 
 
 def linear_shard_index(

@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover
 from .config import settings as config
 from .config.settings import Settings
 from .models import grayscott
-from .ops import get_kernel, stencil
+from .ops import stencil, validate_kernel_language
 from .parallel import halo
 from .parallel.domain import CartDomain
 
@@ -81,10 +81,10 @@ class Simulation:
     ):
         self.settings = settings
         backend, self.kernel_language = config.load_backend_and_lang(settings)
-        # Resolve the kernel eagerly so an unavailable kernel language fails
-        # at construction, not at first iterate (the reference defers all
+        # Validate eagerly so an unavailable kernel language fails at
+        # construction, not at first iterate (the reference defers all
         # dispatch errors to runtime fallbacks, public.jl:31-32, 77-78).
-        self._kernel = get_kernel(self.kernel_language)
+        validate_kernel_language(self.kernel_language)
         self.dtype = config.resolve_precision(settings)
 
         devices = select_devices(backend)
@@ -154,10 +154,10 @@ class Simulation:
     def _local_run(self, u, v, base_key, step0, params, *, nsteps: int):
         """``nsteps`` fused steps on one (local) block. Called directly on a
         single device, or per-shard under ``shard_map``."""
-        kernel = self._kernel
         use_noise = self.use_noise
         sharded = self.sharded
         dims = self.domain.dims
+        boundaries = (stencil.U_BOUNDARY, stencil.V_BOUNDARY)
 
         if sharded and use_noise:
             shard_key = jax.random.fold_in(
@@ -166,14 +166,37 @@ class Simulation:
         else:
             shard_key = base_key
 
+        if self.kernel_language == "pallas":
+            from .ops import pallas_stencil
+
+            key_i32 = lax.bitcast_convert_type(shard_key, jnp.int32)
+            # Concurrent interpret-mode kernels deadlock under shard_map
+            # (global interpreter state) — sharded CPU runs take the XLA
+            # fallback inside fused_step; real TPU runs the fused kernel.
+            allow_interpret = not sharded
+
+            def body(i, carry):
+                u, v = carry
+                faces = (
+                    halo.exchange_faces((u, v), boundaries, AXIS_NAMES, dims)
+                    if sharded
+                    else None
+                )
+                seeds = jnp.stack(
+                    [key_i32[0], key_i32[1], (step0 + i).astype(jnp.int32)]
+                )
+                return pallas_stencil.fused_step(
+                    u, v, params, seeds, faces, use_noise=use_noise,
+                    allow_interpret=allow_interpret,
+                )
+
+            return lax.fori_loop(0, nsteps, body, (u, v))
+
         def body(i, carry):
             u, v = carry
             if sharded:
                 u_pad, v_pad = halo.halo_pad(
-                    (u, v),
-                    (stencil.U_BOUNDARY, stencil.V_BOUNDARY),
-                    AXIS_NAMES,
-                    dims,
+                    (u, v), boundaries, AXIS_NAMES, dims
                 )
             else:
                 u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
@@ -183,7 +206,7 @@ class Simulation:
                 nz = grayscott.noise_field(key, u.shape, u.dtype, params.noise)
             else:
                 nz = jnp.asarray(0.0, u.dtype)
-            return kernel(u_pad, v_pad, nz, params)
+            return stencil.reaction_update(u_pad, v_pad, nz, params)
 
         return lax.fori_loop(0, nsteps, body, (u, v))
 

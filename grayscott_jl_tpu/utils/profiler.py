@@ -66,10 +66,19 @@ class RunStats:
         }
 
     def maybe_write(self) -> Optional[str]:
-        """Write the summary where ``GS_TPU_STATS`` points (if set)."""
+        """Write the summary where ``GS_TPU_STATS`` points (if set).
+
+        In a multi-process run each rank records its own local timings;
+        the path gets a ``.rank<N>`` suffix so ranks don't clobber each
+        other's file.
+        """
         path = os.environ.get("GS_TPU_STATS")
         if not path:
             return None
+        import jax
+
+        if jax.process_count() > 1:
+            path = f"{path}.rank{jax.process_index()}"
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.summary(), f)
             f.write("\n")

@@ -15,6 +15,15 @@ PORT="${PORT:-$(( (RANDOM % 20000) + 20000 ))}"
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 
 pids=()
+cleanup() {
+  # If any rank dies, survivors hang in collectives waiting for it —
+  # kill the whole group so the script exits instead of wedging.
+  for p in "${pids[@]}"; do
+    kill "$p" 2>/dev/null || true
+  done
+}
+trap cleanup EXIT
+
 for ((i = 0; i < NPROCS; i++)); do
   GS_TPU_COORDINATOR="127.0.0.1:${PORT}" \
   GS_TPU_NUM_PROCESSES="${NPROCS}" \
@@ -27,7 +36,12 @@ for ((i = 0; i < NPROCS; i++)); do
 done
 
 rc=0
-for p in "${pids[@]}"; do
-  wait "$p" || rc=$?
+# wait -n returns as each rank finishes; first failure kills the rest.
+for ((i = 0; i < NPROCS; i++)); do
+  if ! wait -n; then
+    rc=1
+    cleanup
+  fi
 done
+trap - EXIT
 exit "$rc"

@@ -20,4 +20,4 @@ CONFIG="${3:?config.toml}"
 REPO_DIR="${REPO_DIR:-$(pwd)}"
 
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
-  --command "cd ${REPO_DIR} && GS_TPU_DISTRIBUTED=auto python3 gray-scott.py ${CONFIG}"
+  --command "cd $(printf %q "${REPO_DIR}") && GS_TPU_DISTRIBUTED=auto python3 gray-scott.py $(printf %q "${CONFIG}")"

@@ -1,15 +1,23 @@
 """Pallas-kernel equivalence tests (interpret mode on CPU).
 
-The two kernel languages must agree bit-for-bit: same op order, same
-dtype, same externally-generated noise stream — the strengthened version
-of the reference's cross-backend oracle pattern
-(``unit-Simulation_CUDA.jl:10-32``).
+Noiseless runs of the two kernel languages must agree to float tolerance
+(same math, same op order) — the strengthened version of the reference's
+cross-backend oracle pattern (``unit-Simulation_CUDA.jl:10-32``). The
+noisy paths draw from *different* reproducible streams (in-kernel TPU
+PRNG vs counter-based threefry), just as the reference's CPU and CUDA
+backends each own their RNG — so noise is checked statistically and for
+reproducibility, not bitwise.
 """
 
 import numpy as np
 import pytest
 
+import jax
+import jax.numpy as jnp
+
 from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.models import grayscott
+from grayscott_jl_tpu.ops import pallas_stencil
 from grayscott_jl_tpu.simulation import Simulation
 
 PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
@@ -24,10 +32,12 @@ def _settings(lang, L=16, noise=0.0, **kw):
     return Settings(**base)
 
 
-@pytest.mark.parametrize("noise", [0.0, 0.1])
-def test_pallas_matches_xla_single_device(noise):
-    a = Simulation(_settings("XLA", noise=noise), n_devices=1, seed=5)
-    b = Simulation(_settings("Pallas", noise=noise), n_devices=1, seed=5)
+# L=16 -> BX=16 (single-slab path); L=32 -> 2 slabs; L=48 -> 3 slabs
+# (pipelined steady state with both buffer slots cycling).
+@pytest.mark.parametrize("L", [16, 32, 48])
+def test_pallas_matches_xla_noiseless(L):
+    a = Simulation(_settings("XLA", L=L), n_devices=1, seed=5)
+    b = Simulation(_settings("Pallas", L=L), n_devices=1, seed=5)
     a.iterate(10)
     b.iterate(10)
     ua, va = a.get_fields()
@@ -46,15 +56,120 @@ def test_pallas_float64_interpret():
     )
 
 
-def test_pallas_sharded():
-    import jax
+def test_pallas_noise_statistics_and_reproducibility():
+    """One noisy step vs the noiseless step isolates dt*noise*U(-1,1)."""
+    L, noise = 32, 0.5
+    settings = _settings("Pallas", L=L, noise=noise)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(settings, dtype)
+    params0 = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.0), dtype
+    )
+    u, v = grayscott.init_fields(L, dtype)
+    seeds = jnp.asarray([123, 456, 7], jnp.int32)
 
+    u1, v1 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    u0, v0 = pallas_stencil.fused_step(u, v, params0, seeds, use_noise=False)
+
+    # v never receives noise (Simulation_CPU.jl:101-112).
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), rtol=1e-6)
+
+    unit = (np.asarray(u1) - np.asarray(u0)) / (noise * float(params.dt))
+    assert np.all(unit >= -1.0 - 1e-5) and np.all(unit <= 1.0 + 1e-5)
+    n = unit.size
+    assert abs(unit.mean()) < 4.0 / np.sqrt(n)  # mean 0
+    assert abs(unit.std() - 1 / np.sqrt(3)) < 0.01  # std of U(-1,1)
+
+    # Same seeds -> identical draw; different step seed -> different draw.
+    u1b, _ = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    np.testing.assert_array_equal(np.asarray(u1), np.asarray(u1b))
+    seeds2 = seeds.at[2].set(8)
+    u2, _ = pallas_stencil.fused_step(u, v, params, seeds2, use_noise=True)
+    assert not np.array_equal(np.asarray(u1), np.asarray(u2))
+
+
+def test_pallas_faces_kernel_matches_padded_oracle():
+    """The with-faces kernel path (face DMAs + in-register edge repair),
+    exercised single-device in interpret mode against the XLA
+    pad-from-faces oracle — sharded CPU runs take the XLA fallback (the
+    interpreter's global state deadlocks under concurrent shard_map
+    calls), so this is the off-hardware coverage for that code."""
+    L = 32  # bx=16 -> 2 slabs: both x-face DMAs + steady-state pipeline
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(_settings("Pallas", L=L), dtype)
+    key = jax.random.PRNGKey(3)
+    keys = jax.random.split(key, 14)
+    u = jax.random.uniform(keys[0], (L, L, L), dtype)
+    v = jax.random.uniform(keys[1], (L, L, L), dtype)
+    shapes = [(1, L, L)] * 4 + [(L, 1, L)] * 4 + [(L, L, 1)] * 4
+    faces = tuple(
+        jax.random.uniform(k, s, dtype) for k, s in zip(keys[2:], shapes)
+    )
+    seeds = jnp.asarray([1, 2, 3], jnp.int32)
+
+    got_u, got_v = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=False
+    )
+    want_u, want_v = pallas_stencil._xla_fallback(
+        u, v, params, seeds, faces, use_noise=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_u), np.asarray(want_u), rtol=1e-6, atol=5e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_v), np.asarray(want_v), rtol=1e-6, atol=5e-7
+    )
+
+
+def test_pallas_sharded_multislab():
+    """32^3 shards -> bx=16 -> 2 slabs each; CPU takes the XLA fallback
+    (kernel-path equivalent is covered by the faces oracle test above)."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual CPU devices")
-    ref = Simulation(_settings("XLA"), n_devices=8)
-    pal = Simulation(_settings("Pallas"), n_devices=8)
-    ref.iterate(10)
-    pal.iterate(10)
+    ref = Simulation(_settings("XLA", L=64), n_devices=8)
+    pal = Simulation(_settings("Pallas", L=64), n_devices=8)
+    ref.iterate(5)
+    pal.iterate(5)
     np.testing.assert_allclose(
         ref.get_fields()[0], pal.get_fields()[0], rtol=1e-6, atol=1e-7
+    )
+
+
+@pytest.mark.parametrize("noise", [0.0, 0.1])
+def test_pallas_sharded(noise):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    ref = Simulation(_settings("XLA", L=16, noise=noise), n_devices=8)
+    pal = Simulation(_settings("Pallas", L=16, noise=noise), n_devices=8)
+    ref.iterate(10)
+    pal.iterate(10)
+    if noise == 0.0:
+        np.testing.assert_allclose(
+            ref.get_fields()[0], pal.get_fields()[0], rtol=1e-6, atol=1e-7
+        )
+    else:
+        # Different noise streams: fields stay bounded and close in
+        # distribution, and the run is reproducible.
+        u_ref, _ = ref.get_fields()
+        u_pal, _ = pal.get_fields()
+        assert np.isfinite(u_pal).all()
+        assert abs(u_ref.mean() - u_pal.mean()) < 0.05
+        pal2 = Simulation(_settings("Pallas", L=16, noise=noise), n_devices=8)
+        pal2.iterate(10)
+        np.testing.assert_array_equal(u_pal, pal2.get_fields()[0])
+
+
+def test_pallas_sharded_matches_single_device():
+    """Sharded Pallas (halo faces) vs single-device Pallas oracle."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    one = Simulation(_settings("Pallas", L=16), n_devices=1)
+    eight = Simulation(_settings("Pallas", L=16), n_devices=8)
+    one.iterate(10)
+    eight.iterate(10)
+    np.testing.assert_allclose(
+        one.get_fields()[0], eight.get_fields()[0], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        one.get_fields()[1], eight.get_fields()[1], rtol=1e-5, atol=1e-6
     )

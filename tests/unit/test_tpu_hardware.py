@@ -1,0 +1,72 @@
+"""Tests that require real TPU hardware (Mosaic-compiled kernels).
+
+Skipped everywhere else — the analog of the reference's
+``if CUDA.functional()`` hardware gate (``unit-Simulation_CUDA.jl:25``).
+Run with the axon tunnel up: ``JAX_PLATFORMS=axon pytest tests/unit/
+test_tpu_hardware.py`` (the default test conftest pins CPU, so these use
+their own fixture to re-enable the TPU platform when present).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs real TPU hardware"
+)
+
+
+@requires_tpu
+def test_in_kernel_prng_statistics():
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    L, noise = 64, 0.5
+    s = Settings(L=L, noise=noise, precision="Float32", backend="TPU",
+                 kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
+                 dt=1.0)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(s, dtype)
+    u, v = grayscott.init_fields(L, dtype)
+    seeds = jnp.asarray([123, 456, 7], jnp.int32)
+
+    u1, v1 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    u0, v0 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=False)
+
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), rtol=1e-6)
+    unit = (np.asarray(u1) - np.asarray(u0)) / (noise * float(params.dt))
+    assert np.all(unit >= -1.0 - 1e-5) and np.all(unit <= 1.0 + 1e-5)
+    n = unit.size
+    assert abs(unit.mean()) < 4.0 / np.sqrt(n)
+    assert abs(unit.std() - 1 / np.sqrt(3)) < 0.01
+    # Per-slab seeding must not repeat the stream across slabs.
+    bx = pallas_stencil.pick_block_planes(L, L, L, 4)
+    if bx < L:
+        assert not np.array_equal(unit[:bx], unit[bx:2 * bx])
+
+    # Reproducibility: same seeds -> identical draw.
+    u1b, _ = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    np.testing.assert_array_equal(np.asarray(u1), np.asarray(u1b))
+
+
+@requires_tpu
+def test_pallas_matches_xla_on_tpu():
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    common = dict(L=64, noise=0.0, precision="Float32", backend="TPU",
+                  Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+    a = Simulation(Settings(kernel_language="Plain", **common), n_devices=1)
+    b = Simulation(Settings(kernel_language="Pallas", **common), n_devices=1)
+    a.iterate(10)
+    b.iterate(10)
+    np.testing.assert_allclose(
+        a.get_fields()[0], b.get_fields()[0], rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        a.get_fields()[1], b.get_fields()[1], rtol=1e-5, atol=1e-6
+    )
