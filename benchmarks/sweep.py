@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Benchmark sweep: cell-updates/s across grid sizes, dtypes and kernels.
+
+Produces the measured table for BASELINE.md (the reference publishes no
+numbers — SURVEY.md section 6 — so this build measures its own):
+
+    python benchmarks/sweep.py [--out results.json] [--quick]
+
+Each configuration reports the best-of-N round throughput on whatever
+the default JAX backend is (the one real TPU chip under the axon tunnel,
+or CPU with ``--cpu``). One JSON object per line, plus a summary table.
+
+The roofline anchor (BASELINE.md): the update moves >= 16 bytes per cell
+per step (2 fields x read + write x 4 bytes, f32), so
+HBM-BW / 16 bounds cell-updates/s — ~5.1e10 on v5e (819 GB/s),
+~1.75e11 on v5p (2.8 TB/s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Runnable as a plain script: put the repo root on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grayscott_jl_tpu.utils.benchmark import bench_one  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write JSONL here too")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes / fewer rounds (CI smoke)")
+    ap.add_argument("--cpu", action="store_true", help="pin CPU platform")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.quick:
+        cases = [
+            (32, "Float32", "Plain", 0.1),
+            (32, "Float32", "Pallas", 0.1),
+            (32, "Float64", "Plain", 0.1),
+        ]
+        steps, rounds = 20, 2
+    else:
+        cases = [
+            (128, "Float32", "Plain", 0.1),
+            (128, "Float32", "Pallas", 0.1),
+            (256, "Float32", "Plain", 0.1),
+            (256, "Float32", "Pallas", 0.1),
+            (256, "Float32", "Pallas", 0.0),
+            (512, "Float32", "Plain", 0.1),
+            (512, "Float32", "Pallas", 0.1),
+            (128, "Float64", "Plain", 0.1),
+            (256, "Float64", "Plain", 0.1),
+        ]
+        steps, rounds = 100, 3
+
+    results = []
+    for L, prec, lang, noise in cases:
+        try:
+            r = bench_one(L, prec, lang, noise=noise, steps=steps,
+                          rounds=rounds)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            r = {"L": L, "precision": prec, "kernel": lang, "noise": noise,
+                 "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+
+    ok = [r for r in results if "error" not in r]
+    if ok:
+        print("\n| L | precision | kernel | noise | µs/step | cell-updates/s |",
+              file=sys.stderr)
+        print("|---|---|---|---|---|---|", file=sys.stderr)
+        for r in ok:
+            print(
+                f"| {r['L']} | {r['precision']} | {r['kernel']} | "
+                f"{r['noise']} | {r['us_per_step']} | "
+                f"{r['cell_updates_per_s']:.3e} |",
+                file=sys.stderr,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Shared single-chip measurement harness for bench.py and benchmarks/.
+
+One implementation of the warmup + best-of-N timing loop and of the
+axon-tunnel completion workaround, so the repo's reported numbers cannot
+drift apart between entry points.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+def bench_one(
+    L: int,
+    precision: str,
+    lang: str,
+    *,
+    noise: float = 0.1,
+    steps: int = 100,
+    rounds: int = 3,
+) -> Dict[str, object]:
+    """Best-of-``rounds`` throughput of ``steps`` fused simulation steps
+    at grid side ``L`` on the default JAX backend (single device)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config.settings import Settings
+    from ..simulation import Simulation
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+    settings = Settings(
+        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=noise,
+        precision=precision, backend=backend, kernel_language=lang,
+    )
+    sim = Simulation(settings, n_devices=1)
+
+    def sync() -> float:
+        # block_until_ready does not reliably block under the axon TPU
+        # tunnel; a dependent scalar readback forces real completion.
+        return float(jnp.sum(sim.u[:1, :1, :4]))
+
+    sim.iterate(steps)  # warmup: trigger compile
+    sync()
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        sim.iterate(steps)
+        sync()
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "L": L,
+        "precision": precision,
+        "kernel": lang,
+        "noise": noise,
+        "platform": platform,
+        "us_per_step": round(best / steps * 1e6, 1),
+        "cell_updates_per_s": round(L**3 * steps / best, 1),
+    }

@@ -1,0 +1,58 @@
+"""Smoke tests for the benchmark entry points (CPU, tiny sizes) so the
+driver-run ``bench.py`` contract (one JSON line) cannot rot unnoticed."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@pytest.mark.slow
+def test_sweep_quick_cpu(tmp_path):
+    out = tmp_path / "sweep.jsonl"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "sweep.py"),
+         "--cpu", "--quick", "--out", str(out)],
+        env=_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 3
+    ok = [row for row in rows if "error" not in row]
+    assert ok, rows
+    for row in ok:
+        assert row["cell_updates_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_bench_contract_cpu():
+    """bench.py must print exactly one JSON line with the driver's keys.
+
+    L=256 on CPU is slow; GS_BENCH_L shrinks the workload for the test.
+    """
+    env = _env()
+    env["GS_BENCH_L"] = "32"
+    env["GS_BENCH_STEPS"] = "10"
+    env["GS_BENCH_ROUNDS"] = "1"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+    assert payload["value"] > 0

@@ -78,8 +78,12 @@ def run_cli(tmp_path, config, extra_env=None):
     )
 
 
-def test_cli_end_to_end(tmp_path):
-    cfg = write_config(tmp_path, noise=0.1)
+# Both kernel languages through the real CLI — the analog of the
+# reference's four functional config TOMLs (cpu/cuda x plain/ka,
+# test/functional/), with the GPU axis replaced by the kernel axis.
+@pytest.mark.parametrize("kernel_language", ["Plain", "Pallas"])
+def test_cli_end_to_end(tmp_path, kernel_language):
+    cfg = write_config(tmp_path, noise=0.1, kernel_language=kernel_language)
     res = run_cli(tmp_path, cfg)
     assert res.returncode == 0, res.stderr + res.stdout
     assert "writing output step" in res.stdout  # verbose driver log
