@@ -63,6 +63,10 @@ def main() -> None:
                 "value": r["cell_updates_per_s"],
                 "unit": "cell-updates/s",
                 "vs_baseline": r["cell_updates_per_s"] / BASELINE_CELL_UPDATES,
+                # Which kernel actually produced the number — a Pallas
+                # regression falling back to Plain must be visible in the
+                # recorded payload, not only on stderr.
+                "kernel": r["kernel"],
             }
         )
     )

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Halo-exchange microbenchmark (BASELINE.json metric: halo-exchange µs).
+
+Times ``nsteps`` fused simulation steps with and without the 6-face
+``ppermute`` halo exchange at identical *local* volume, attributing the
+difference to the exchange:
+
+* sharded: global L^g over an ``n``-device mesh (local block L^g/n)
+* single:  one device at the same local block size, no collectives
+
+    python benchmarks/halo_bench.py [--devices 8] [--local 64] [--cpu]
+
+On CPU the mesh is virtual (``--xla_force_host_platform_device_count``);
+on a TPU slice the same code measures real ICI hops. One JSON line per
+configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--local", type=int, default=64,
+                    help="per-device block side at full device count")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--kernel", default="Plain")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.parallel.domain import dims_create
+    from grayscott_jl_tpu.simulation import Simulation
+    from grayscott_jl_tpu.utils.benchmark import time_sim
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+    dims = dims_create(args.devices)
+    # Global grid with the requested local block on every axis.
+    L_global = args.local * max(dims)
+    base = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.0,
+                precision="Float32", backend=backend,
+                kernel_language=args.kernel)
+
+    sharded = Simulation(
+        Settings(L=L_global, **base), n_devices=args.devices
+    )
+    # Same local volume, no halo: block side = global/dims per axis; use
+    # the largest local block side for a conservative single-device ref.
+    local_side = L_global // min(dims)
+    single = Simulation(Settings(L=local_side, **base), n_devices=1)
+
+    t_sharded = time_sim(sharded, args.steps, args.rounds)
+    t_single = time_sim(single, args.steps, args.rounds)
+    halo_us = (t_sharded - t_single) * 1e6
+
+    print(json.dumps({
+        "platform": platform,
+        "devices": args.devices,
+        "mesh": list(sharded.domain.dims),
+        "L_global": L_global,
+        "local_block": [
+            L_global // d for d in sharded.domain.dims
+        ],
+        "kernel": args.kernel,
+        "us_per_step_sharded": round(t_sharded * 1e6, 1),
+        "us_per_step_single_equivalent": round(t_single * 1e6, 1),
+        "halo_exchange_us_per_step": round(halo_us, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,9 @@ def main() -> int:
                 f.write(json.dumps(r) + "\n")
 
     ok = [r for r in results if "error" not in r]
+    if not ok:
+        print("sweep: every configuration errored", file=sys.stderr)
+        return 1
     if ok:
         print("\n| L | precision | kernel | noise | µs/step | cell-updates/s |",
               file=sys.stderr)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Weak-scaling efficiency harness (BASELINE.json: >=90% at v5p-256).
+
+Fixed per-device volume, growing device count: efficiency(n) =
+throughput(n) / (n * throughput(1)).
+
+    python benchmarks/weak_scaling.py [--max-devices 8] [--local 32] [--cpu]
+
+On CPU the mesh is virtual, so the absolute numbers measure the
+framework's sharding/collective overhead (not ICI); on a TPU slice the
+same harness produces the real weak-scaling curve. One JSON line per
+device count, plus a final summary line with the efficiency curve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-devices", type=int, default=8)
+    ap.add_argument("--local", type=int, default=32,
+                    help="per-device block volume ~ local^3")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--kernel", default="Plain")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.max_devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.parallel.domain import dims_create
+    from grayscott_jl_tpu.simulation import Simulation
+    from grayscott_jl_tpu.utils.benchmark import time_sim
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+
+    # Perfect-cube device counts keep every device at exactly local^3
+    # cells (cubic global grid, cubic mesh) so efficiency needs no
+    # volume normalization — the k^3 shape a pod-slice sweep uses too.
+    counts = [n**3 for n in (1, 2, 3, 4) if n**3 <= args.max_devices]
+    results = []
+    for n in counts:
+        dims = dims_create(n)
+        L = args.local * round(n ** (1 / 3))
+        settings = Settings(
+            L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.0,
+            precision="Float32", backend=backend,
+            kernel_language=args.kernel,
+        )
+        sim = Simulation(settings, n_devices=n)
+        thr = L**3 / time_sim(sim, args.steps, args.rounds)
+        row = {
+            "platform": platform,
+            "devices": n,
+            "mesh": list(dims),
+            "L": L,
+            "cells_per_device": L**3 // n,
+            "cell_updates_per_s": round(thr, 1),
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    per_dev_1 = results[0]["cell_updates_per_s"]
+    curve = {
+        r["devices"]: round(
+            r["cell_updates_per_s"] / (r["devices"] * per_dev_1), 3
+        )
+        for r in results
+    }
+    print(json.dumps({"weak_scaling_efficiency": curve}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,7 @@ On non-TPU backends the kernel runs in Pallas interpret mode (tests).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -313,8 +314,13 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
         ],
         scratch_shapes=scratch_shapes,
         # The TPU-semantics interpreter (not the generic HLO one) models
-        # SMEM/semaphores/DMA and the TPU PRNG on CPU for tests.
-        interpret=pltpu.InterpretParams(dma_execution_mode="eager")
+        # SMEM/semaphores/DMA on CPU for tests. GS_PALLAS_DETECT_RACES=1
+        # additionally runs its DMA/compute race detector (read at trace
+        # time — use a fresh shape to defeat the jit cache when toggling).
+        interpret=pltpu.InterpretParams(
+            dma_execution_mode="eager",
+            detect_races=os.environ.get("GS_PALLAS_DETECT_RACES") == "1",
+        )
         if interpret
         else False,
     )(*operands)

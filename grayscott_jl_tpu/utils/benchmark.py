@@ -11,30 +11,15 @@ import time
 from typing import Dict
 
 
-def bench_one(
-    L: int,
-    precision: str,
-    lang: str,
-    *,
-    noise: float = 0.1,
-    steps: int = 100,
-    rounds: int = 3,
-) -> Dict[str, object]:
-    """Best-of-``rounds`` throughput of ``steps`` fused simulation steps
-    at grid side ``L`` on the default JAX backend (single device)."""
-    import jax
+def time_sim(sim, steps: int, rounds: int) -> float:
+    """Best-of-``rounds`` seconds-per-step of ``steps`` fused simulation
+    steps (after a compile-triggering warmup chunk).
+
+    The ONLY timing loop in the repo — bench.py, benchmarks/sweep.py,
+    halo_bench.py and weak_scaling.py all go through here so the
+    completion workaround below cannot drift between entry points.
+    """
     import jax.numpy as jnp
-
-    from ..config.settings import Settings
-    from ..simulation import Simulation
-
-    platform = jax.devices()[0].platform
-    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
-    settings = Settings(
-        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=noise,
-        precision=precision, backend=backend, kernel_language=lang,
-    )
-    sim = Simulation(settings, n_devices=1)
 
     def sync() -> float:
         # block_until_ready does not reliably block under the axon TPU
@@ -49,12 +34,39 @@ def bench_one(
         sim.iterate(steps)
         sync()
         best = min(best, time.perf_counter() - t0)
+    return best / steps
+
+
+def bench_one(
+    L: int,
+    precision: str,
+    lang: str,
+    *,
+    noise: float = 0.1,
+    steps: int = 100,
+    rounds: int = 3,
+) -> Dict[str, object]:
+    """Best-of-``rounds`` throughput of ``steps`` fused simulation steps
+    at grid side ``L`` on the default JAX backend (single device)."""
+    import jax
+
+    from ..config.settings import Settings
+    from ..simulation import Simulation
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+    settings = Settings(
+        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=noise,
+        precision=precision, backend=backend, kernel_language=lang,
+    )
+    sim = Simulation(settings, n_devices=1)
+    per_step = time_sim(sim, steps, rounds)
     return {
         "L": L,
         "precision": precision,
         "kernel": lang,
         "noise": noise,
         "platform": platform,
-        "us_per_step": round(best / steps * 1e6, 1),
-        "cell_updates_per_s": round(L**3 * steps / best, 1),
+        "us_per_step": round(per_step * 1e6, 1),
+        "cell_updates_per_s": round(L**3 / per_step, 1),
     }

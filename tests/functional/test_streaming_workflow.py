@@ -60,9 +60,14 @@ def test_pdfcalc_streams_live_simulation(tmp_path):
             str(out), str(tmp_path / "pdf.bp"), nbins=64,
             timeout=0.2, max_not_ready=150,
         )
-    finally:
         rc = sim.wait(timeout=300)
-    assert rc == 0, sim.stderr.read() if sim.stderr else ""
+    finally:
+        # Never leak the child or let a hung wait mask the assertion;
+        # communicate() also drains the PIPEs (a full pipe blocks the
+        # child).
+        sim.kill()
+        _, err = sim.communicate()
+    assert rc == 0, err
     assert steps == 4  # steps=40, plotgap=10 -> outputs at 10,20,30,40
 
     r = BpReader(str(tmp_path / "pdf.bp"))
