@@ -5,8 +5,11 @@ Times ``nsteps`` fused simulation steps with and without the 6-face
 ``ppermute`` halo exchange at identical *local* volume, attributing the
 difference to the exchange:
 
-* sharded: global L^g over an ``n``-device mesh (local block L^g/n)
-* single:  one device at the same local block size, no collectives
+* sharded: global (local*k)^3 over a k^3-device cubic mesh
+* single:  one device at local^3 — the same per-device volume
+
+Device count must be a perfect cube so the per-device volume matches
+exactly (non-cube meshes would compare different workloads).
 
     python benchmarks/halo_bench.py [--devices 8] [--local 64] [--cpu]
 
@@ -36,28 +39,23 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
-    if args.cpu:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{args.devices}"
-            ).strip()
-        import jax
+    kside = round(args.devices ** (1 / 3))
+    if kside**3 != args.devices:
+        ap.error(
+            f"--devices must be a perfect cube (got {args.devices}); "
+            "non-cube meshes give unequal per-device volumes and a "
+            "meaningless halo metric"
+        )
 
-        jax.config.update("jax_platforms", "cpu")
-    import jax
+    from grayscott_jl_tpu.utils.benchmark import setup_platform, time_sim
+
+    backend = setup_platform(args.cpu, args.devices)
 
     from grayscott_jl_tpu.config.settings import Settings
-    from grayscott_jl_tpu.parallel.domain import dims_create
     from grayscott_jl_tpu.simulation import Simulation
-    from grayscott_jl_tpu.utils.benchmark import time_sim
 
-    platform = jax.devices()[0].platform
-    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
-    dims = dims_create(args.devices)
     # Global grid with the requested local block on every axis.
-    L_global = args.local * max(dims)
+    L_global = args.local * kside
     base = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.0,
                 precision="Float32", backend=backend,
                 kernel_language=args.kernel)
@@ -65,17 +63,15 @@ def main() -> int:
     sharded = Simulation(
         Settings(L=L_global, **base), n_devices=args.devices
     )
-    # Same local volume, no halo: block side = global/dims per axis; use
-    # the largest local block side for a conservative single-device ref.
-    local_side = L_global // min(dims)
-    single = Simulation(Settings(L=local_side, **base), n_devices=1)
+    # Same per-device volume, no halo exchange.
+    single = Simulation(Settings(L=args.local, **base), n_devices=1)
 
     t_sharded = time_sim(sharded, args.steps, args.rounds)
     t_single = time_sim(single, args.steps, args.rounds)
     halo_us = (t_sharded - t_single) * 1e6
 
     print(json.dumps({
-        "platform": platform,
+        "platform": backend.lower(),
         "devices": args.devices,
         "mesh": list(sharded.domain.dims),
         "L_global": L_global,

@@ -83,17 +83,16 @@ def main() -> int:
     if not ok:
         print("sweep: every configuration errored", file=sys.stderr)
         return 1
-    if ok:
-        print("\n| L | precision | kernel | noise | µs/step | cell-updates/s |",
-              file=sys.stderr)
-        print("|---|---|---|---|---|---|", file=sys.stderr)
-        for r in ok:
-            print(
-                f"| {r['L']} | {r['precision']} | {r['kernel']} | "
-                f"{r['noise']} | {r['us_per_step']} | "
-                f"{r['cell_updates_per_s']:.3e} |",
-                file=sys.stderr,
-            )
+    print("\n| L | precision | kernel | noise | µs/step | cell-updates/s |",
+          file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
+    for r in ok:
+        print(
+            f"| {r['L']} | {r['precision']} | {r['kernel']} | "
+            f"{r['noise']} | {r['us_per_step']} | "
+            f"{r['cell_updates_per_s']:.3e} |",
+            file=sys.stderr,
+        )
     return 0
 
 

@@ -33,30 +33,30 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
-    if args.cpu:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{args.max_devices}"
-            ).strip()
-        import jax
+    if args.max_devices < 1:
+        ap.error("--max-devices must be >= 1")
 
-        jax.config.update("jax_platforms", "cpu")
-    import jax
+    from grayscott_jl_tpu.utils.benchmark import setup_platform, time_sim
+
+    backend = setup_platform(args.cpu, args.max_devices)
 
     from grayscott_jl_tpu.config.settings import Settings
     from grayscott_jl_tpu.parallel.domain import dims_create
     from grayscott_jl_tpu.simulation import Simulation
-    from grayscott_jl_tpu.utils.benchmark import time_sim
-
-    platform = jax.devices()[0].platform
-    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
 
     # Perfect-cube device counts keep every device at exactly local^3
     # cells (cubic global grid, cubic mesh) so efficiency needs no
     # volume normalization — the k^3 shape a pod-slice sweep uses too.
-    counts = [n**3 for n in (1, 2, 3, 4) if n**3 <= args.max_devices]
+    counts, side = [], 1
+    while side**3 <= args.max_devices:
+        counts.append(side**3)
+        side += 1
+    if counts[-1] < args.max_devices:
+        print(
+            f"weak_scaling: largest cube <= {args.max_devices} is "
+            f"{counts[-1]} devices; non-cube counts are skipped",
+            file=sys.stderr,
+        )
     results = []
     for n in counts:
         dims = dims_create(n)
@@ -69,7 +69,7 @@ def main() -> int:
         sim = Simulation(settings, n_devices=n)
         thr = L**3 / time_sim(sim, args.steps, args.rounds)
         row = {
-            "platform": platform,
+            "platform": backend.lower(),
             "devices": n,
             "mesh": list(dims),
             "L": L,

@@ -9,15 +9,16 @@ double-buffered HBM->VMEM DMA pipeline, computing both fields' diffusion +
 reaction + noise in one fused VMEM-resident pass per slab.
 
 The stencil is memory-bound (~30 flops vs 16 bytes minimum traffic per
-cell), so the kernel is designed around HBM traffic:
+cell per step), so the kernel is designed around HBM traffic:
 
 * operands are the **interior-shaped** ``(L, L, L)`` fields — no
   materialized ghost pad (a blocked-``pallas_call`` or XLA version spends
   a full extra read+write per field on ``jnp.pad``, and the padded
   ``L+2`` lane dimension rounds up to the next 128-lane tile, wasting up
   to ~50% of the vector work at L=256);
-* x-neighbor planes come from overlapping slab DMAs — ``(BX+2)/BX``
-  reads per cell instead of 3 reads with the three-plane-operand trick;
+* x-neighbor planes come from overlapping slab DMAs — ``(BX+2h)/BX``
+  reads per cell (h = halo width) instead of 3 reads with the
+  three-plane-operand trick;
 * y/z neighbors are in-VMEM shifts (``pltpu.roll``) with the wrapped
   boundary row/column repaired by a masked select — ghost cells never
   exist in memory. On the global edge the mask substitutes the frozen
@@ -25,25 +26,29 @@ cell), so the kernel is designed around HBM traffic:
   semantics, ``Simulation_CPU.jl:23-24``); on an interior shard edge it
   substitutes the neighbor face delivered by the ``ppermute`` halo
   exchange (``parallel/halo.exchange_faces``);
+* **temporal blocking** (``fuse=2``, single-block runs): each slab pass
+  advances TWO timesteps — stage A computes step n+1 on a (BX+2)-plane
+  window (recomputing one overlap plane per side), stage B computes step
+  n+2 on the BX output planes — so HBM traffic per *step* drops to
+  ~((BX+4)/BX + 1)/2 passes (~10 bytes/cell at BX=8, f32), below the
+  1-read-1-write "roofline" of any single-step schedule;
 * per-cell uniform noise is generated *inside* the kernel with the TPU
-  hardware PRNG (``pltpu.prng_random_bits``) — the XLA path's separate
-  counter-based ``threefry`` pass (generate + write + re-read)
-  disappears. The stream is seeded from (base key, step, slab), so
-  restarts reproduce it exactly; it is a *different* stream from the XLA
-  kernel's, just as the reference's CPU (``Distributions.Uniform``,
+  hardware PRNG (``pltpu.prng_random_bits``), seeded per
+  ``(key, absolute step, absolute x-plane)`` — so the stream is
+  invariant under restarts, step chunking, slab size, and temporal
+  fusion (slab-overlap recomputation reproduces identical noise). It is
+  a *different* stream from the XLA kernel's counter-based threefry,
+  just as the reference's CPU (``Distributions.Uniform``,
   ``Simulation_CPU.jl:101-103``) and CUDA (in-kernel ``rand``,
   ``CUDAExt.jl:149-151``) backends draw from unrelated streams.
-  ``tests/unit/test_pallas.py`` checks the noiseless paths agree exactly
-  and the noisy path statistically.
-
-Net HBM traffic per cell per step: ~(1 + 2/BX) reads + 1 write per field
-(f32: ~18 bytes at BX=8) vs ~60 bytes for the pad + three-plane + noise
-pipeline it replaces.
 
 The Float64 + TPU combination falls back to the XLA kernel (Mosaic has no
 f64 vector path — the reference has the same asymmetry: its AMDGPU
 backend disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
-On non-TPU backends the kernel runs in Pallas interpret mode (tests).
+On non-TPU backends the kernel runs in the TPU-semantics interpreter
+(tests); its PRNG is a zeros stub, so noise is then injected outside the
+kernel from the threefry stream (forcing ``fuse=1``, since post-hoc
+injection is only valid for a single step).
 """
 
 from __future__ import annotations
@@ -64,15 +69,19 @@ from . import stencil
 _VMEM_BUDGET = 48 * 1024 * 1024
 
 
-def pick_block_planes(nx: int, ny: int, nz: int, itemsize: int) -> int:
+def pick_block_planes(
+    nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1
+) -> int:
     """Largest slab depth BX (dividing nx) whose double-buffered u/v
-    in/out scratch fits the VMEM budget; 0 if even BX=1 does not fit."""
+    in/mid/out scratch fits the VMEM budget; 0 if even BX=1 does not
+    fit. ``fuse`` is the temporal-blocking depth (input halo width)."""
     for bx in (16, 8, 4, 2, 1):
         if nx % bx:
             continue
-        in_bytes = 2 * 2 * (bx + 2) * ny * nz * itemsize
+        in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
+        mid_bytes = 2 * (bx + 2) * ny * nz * itemsize if fuse == 2 else 0
         out_bytes = 2 * 2 * bx * ny * nz * itemsize
-        if in_bytes + out_bytes <= _VMEM_BUDGET:
+        if in_bytes + mid_bytes + out_bytes <= _VMEM_BUDGET:
             return bx
     return 0
 
@@ -99,21 +108,26 @@ def _shifted(block, axis, shift, edge_value):
     return jnp.where(edge, edge_value, rolled)
 
 
-def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
+def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
+                 fuse):
     """Build the fused single-program kernel body; see module docstring.
 
-    Ref order (faces present only when ``with_faces``):
-      params(SMEM f32[6]), seeds(SMEM i32[3]),
+    Ref order (faces present only when ``with_faces``, which requires
+    ``fuse == 1``; mid scratch present only when ``fuse == 2``):
+      params(SMEM f32[6]), seeds(SMEM i32[3] = key lo, key hi, step),
       u, v (ANY/HBM, (nx, ny, nz)),
       [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (1, ny, nz)),
        u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
        u_zlo, u_zhi, v_zlo, v_zhi (VMEM, (nx, ny, 1))],
       u_out, v_out (ANY/HBM),
-      scratch: in_u, in_v (VMEM (2, bx+2, ny, nz)),
+      scratch: in_u, in_v (VMEM (2, bx+2*fuse, ny, nz)),
+               [mid_u, mid_v (VMEM (bx+2, ny, nz))],
                out_u, out_v (VMEM (2, bx, ny, nz)),
                in_sems (DMA (2, 2)), out_sems (DMA (2, 2)),
                [face_sems (DMA (2, 2, 2))]
     """
+    halo = fuse
+    win_n = bx + 2 * halo
 
     def kernel(params, seeds, u, v, *rest):
         if with_faces:
@@ -124,6 +138,11 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
              in_u, in_v, out_u, out_v,
              in_sems, out_sems, face_sems) = rest
             x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
+        elif fuse == 2:
+            (u_out, v_out,
+             in_u, in_v, mid_u, mid_v, out_u, out_v,
+             in_sems, out_sems) = rest
+            x_faces = None
         else:
             (u_out, v_out,
              in_u, in_v, out_u, out_v,
@@ -133,15 +152,21 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
         u_bv = jnp.asarray(stencil.U_BOUNDARY, dtype)
         v_bv = jnp.asarray(stencil.V_BOUNDARY, dtype)
         fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
+        Du, Dv, F, K, dt, noise = (params[j] for j in range(6))
+        six = jnp.asarray(6.0, dtype)
+        one = jnp.asarray(1.0, dtype)
 
         def slab_io(slot, b, start):
             """Start (or wait for) all input DMAs of slab ``b``.
 
-            An interior slab reads planes [b*bx-1, b*bx+bx+1); the first
-            and last slabs read one plane fewer (the missing plane is a
-            ghost filled from the boundary constant or the x halo face).
-            Descriptors are constructed lazily inside their branch — an
-            unused descriptor is an error.
+            An interior slab reads planes [b*bx-halo, b*bx+bx+halo); the
+            first and last slabs read ``halo`` planes fewer (the missing
+            ghost plane is filled from the boundary constant or the x
+            halo face; for fuse=2 the outermost missing plane is filled
+            with the boundary too — its value is masked out of stage A,
+            the fill just keeps scratch deterministic). Descriptors are
+            constructed lazily inside their branch — an unused
+            descriptor is an error.
             """
 
             def go(make):
@@ -152,33 +177,33 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
                 sem = in_sems.at[slot, tag]
                 if nblocks == 1:
                     go(lambda: pltpu.make_async_copy(
-                        field_ref, scr.at[slot, pl.ds(1, bx)], sem))
+                        field_ref, scr.at[slot, pl.ds(halo, bx)], sem))
                 else:
                     lo, hi = b == 0, b == nblocks - 1
 
                     @pl.when(lo)
                     def _():
                         go(lambda: pltpu.make_async_copy(
-                            field_ref.at[pl.ds(0, bx + 1)],
-                            scr.at[slot, pl.ds(1, bx + 1)], sem))
+                            field_ref.at[pl.ds(0, bx + halo)],
+                            scr.at[slot, pl.ds(halo, bx + halo)], sem))
 
                     @pl.when(hi)
                     def _():
                         go(lambda: pltpu.make_async_copy(
-                            field_ref.at[pl.ds(b * bx - 1, bx + 1)],
-                            scr.at[slot, pl.ds(0, bx + 1)], sem))
+                            field_ref.at[pl.ds(b * bx - halo, bx + halo)],
+                            scr.at[slot, pl.ds(0, bx + halo)], sem))
 
                     @pl.when(jnp.logical_not(lo | hi))
                     def _():
                         go(lambda: pltpu.make_async_copy(
-                            field_ref.at[pl.ds(b * bx - 1, bx + 2)],
+                            field_ref.at[pl.ds(b * bx - halo, win_n)],
                             scr.at[slot], sem))
 
                 # Ghost x-planes on the slab's outer side(s).
                 for which, cond in ((0, b == 0), (1, b == nblocks - 1)):
-                    plane = 0 if which == 0 else bx + 1
                     if with_faces:
                         xref = x_faces[tag][which]
+                        plane = 0 if which == 0 else bx + 1
 
                         @pl.when(cond)
                         def _():
@@ -187,10 +212,15 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
                                 scr.at[slot, pl.ds(plane, 1)],
                                 face_sems.at[slot, tag, which]))
                     elif start:
+                        planes = (
+                            range(halo) if which == 0
+                            else range(bx + halo, win_n)
+                        )
 
                         @pl.when(cond)
                         def _():
-                            scr[slot, plane] = jnp.full((ny, nz), bv, dtype)
+                            for p in planes:
+                                scr[slot, p] = jnp.full((ny, nz), bv, dtype)
 
         def out_dma(ref, scr, slot, b, tag):
             return pltpu.make_async_copy(
@@ -199,46 +229,88 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
                 out_sems.at[slot, tag],
             )
 
-        def compute(slot, b):
-            u_win = in_u[slot]
-            v_win = in_v[slot]
-            u_c = u_win[1:bx + 1]
-            v_c = v_win[1:bx + 1]
+        def lap(win, c, edges):
+            """7-point Laplacian over the window interior ``c``
+            (``Common.jl:13-18`` — keep the /6)."""
+            n = c.shape[0]
+            ylo, yhi, zlo, zhi = edges
+            return (
+                win[0:n] + win[2:n + 2]
+                + _shifted(c, 1, 1, ylo)
+                + _shifted(c, 1, -1, yhi)
+                + _shifted(c, 2, 1, zlo)
+                + _shifted(c, 2, -1, zhi)
+                - six * c
+            ) / six
 
-            if with_faces:
-                rows = lambda f: f[pl.ds(b * bx, bx)]  # noqa: E731
-                u_edges = (rows(u_ylo), rows(u_yhi), rows(u_zlo), rows(u_zhi))
-                v_edges = (rows(v_ylo), rows(v_yhi), rows(v_zlo), rows(v_zhi))
-            else:
-                u_edges = (u_bv,) * 4
-                v_edges = (v_bv,) * 4
-
-            six = jnp.asarray(6.0, dtype)
-            one = jnp.asarray(1.0, dtype)
-
-            def lap(win, c, edges):
-                ylo, yhi, zlo, zhi = edges
-                return (
-                    win[0:bx] + win[2:bx + 2]
-                    + _shifted(c, 1, 1, ylo)
-                    + _shifted(c, 1, -1, yhi)
-                    + _shifted(c, 2, 1, zlo)
-                    + _shifted(c, 2, -1, zhi)
-                    - six * c
-                ) / six
-
+        def euler(u_win, v_win, u_edges, v_edges):
+            """One noiseless explicit-Euler update of the window
+            interior; noise is added per-plane by the caller."""
+            n = u_win.shape[0] - 2
+            u_c = u_win[1:n + 1]
+            v_c = v_win[1:n + 1]
             lap_u = lap(u_win, u_c, u_edges)
             lap_v = lap(v_win, v_c, v_edges)
-
-            Du, Dv, F, K, dt, noise = (params[j] for j in range(6))
             uvv = u_c * v_c * v_c
             du = Du * lap_u - uvv + F * (one - u_c)
-            if use_noise:
-                pltpu.prng_seed(seeds[0], seeds[1], seeds[2], b)
-                du = du + noise * _uniform_pm1(u_c.shape, dtype)
             dv = Dv * lap_v + uvv - (F + K) * v_c
-            out_u[slot] = u_c + du * dt
-            out_v[slot] = v_c + dv * dt
+            return u_c + du * dt, v_c + dv * dt
+
+        def noise_plane(step_idx, g):
+            """Pre-scaled noise*dt plane for absolute step/x-plane."""
+            pltpu.prng_seed(seeds[0], seeds[1], step_idx, g)
+            return (noise * dt) * _uniform_pm1((ny, nz), dtype)
+
+        const_edges_u = (u_bv,) * 4
+        const_edges_v = (v_bv,) * 4
+
+        def compute1(slot, b):
+            u_win = in_u[slot]
+            v_win = in_v[slot]
+            if with_faces:
+                rows = lambda f: f[pl.ds(b * bx, bx)]  # noqa: E731
+                u_edges = (rows(u_ylo), rows(u_yhi),
+                           rows(u_zlo), rows(u_zhi))
+                v_edges = (rows(v_ylo), rows(v_yhi),
+                           rows(v_zlo), rows(v_zhi))
+            else:
+                u_edges, v_edges = const_edges_u, const_edges_v
+            u_next, v_next = euler(u_win, v_win, u_edges, v_edges)
+            if use_noise:
+                for j in range(bx):
+                    out_u[slot, j] = u_next[j] + noise_plane(
+                        seeds[2], b * bx + j
+                    )
+            else:
+                out_u[slot] = u_next
+            out_v[slot] = v_next
+
+        def compute2(slot, b):
+            # Stage A: step n+1 on the (bx+2)-plane window
+            # [b*bx-1, b*bx+bx+1); global-edge ghost planes stay frozen.
+            u_win = in_u[slot]
+            v_win = in_v[slot]
+            uA, vA = euler(u_win, v_win, const_edges_u, const_edges_v)
+            for j in range(bx + 2):
+                g = b * bx - 1 + j
+                valid = (g >= 0) & (g < nx)
+                plane_u = uA[j]
+                if use_noise:
+                    plane_u = plane_u + noise_plane(seeds[2], g)
+                mid_u[j] = jnp.where(valid, plane_u, u_bv)
+                mid_v[j] = jnp.where(valid, vA[j], v_bv)
+            # Stage B: step n+2 on the bx output planes.
+            uB, vB = euler(mid_u[:], mid_v[:], const_edges_u, const_edges_v)
+            if use_noise:
+                for j in range(bx):
+                    out_u[slot, j] = uB[j] + noise_plane(
+                        seeds[2] + 1, b * bx + j
+                    )
+            else:
+                out_u[slot] = uB
+            out_v[slot] = vB
+
+        compute = compute2 if fuse == 2 else compute1
 
         # ---- pipeline: prologue, steady-state loop, epilogue ----
         slab_io(0, jnp.int32(0), start=True)
@@ -275,8 +347,11 @@ def _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("bx", "use_noise", "interpret"))
-def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
+@functools.partial(
+    jax.jit, static_argnames=("bx", "use_noise", "interpret", "fuse")
+)
+def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
+                interpret, fuse):
     nx, ny, nz = u.shape
     dtype = u.dtype
     nblocks = nx // bx
@@ -294,8 +369,15 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
         operands += list(faces)
 
     scratch_shapes = [
-        pltpu.VMEM((2, bx + 2, ny, nz), dtype),
-        pltpu.VMEM((2, bx + 2, ny, nz), dtype),
+        pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
+        pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
+    ]
+    if fuse == 2:
+        scratch_shapes += [
+            pltpu.VMEM((bx + 2, ny, nz), dtype),
+            pltpu.VMEM((bx + 2, ny, nz), dtype),
+        ]
+    scratch_shapes += [
         pltpu.VMEM((2, bx, ny, nz), dtype),
         pltpu.VMEM((2, bx, ny, nz), dtype),
         pltpu.SemaphoreType.DMA((2, 2)),
@@ -305,7 +387,9 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
         scratch_shapes.append(pltpu.SemaphoreType.DMA((2, 2, 2)))
 
     return pl.pallas_call(
-        _make_kernel(nblocks, bx, ny, nz, dtype, use_noise, with_faces),
+        _make_kernel(
+            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse
+        ),
         in_specs=in_specs,
         out_specs=[any_spec, any_spec],
         out_shape=[
@@ -327,15 +411,16 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise, interpret):
 
 
 def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
-               allow_interpret=True):
-    """One fused Gray-Scott step on interior-shaped fields.
+               allow_interpret=True, fuse=1):
+    """``fuse`` fused Gray-Scott steps on interior-shaped fields.
 
-    ``seeds`` is an int32[3] vector (PRNG key data lo/hi, step index)
-    feeding the in-kernel PRNG; ``faces`` (optional) is the 12-tuple of
-    resolved halo faces for a sharded block, in the order
-    ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi,
+    ``seeds`` is an int32[3] vector (PRNG key data lo/hi, absolute step
+    index) feeding the in-kernel PRNG; ``faces`` (optional, fuse=1 only)
+    is the 12-tuple of resolved halo faces for a sharded block, in the
+    order ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi,
     u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped (1, ny, nz),
-    y faces (nx, 1, nz), z faces (nx, ny, 1).
+    y faces (nx, 1, nz), z faces (nx, ny, 1). ``fuse=2`` temporal
+    blocking advances two steps per HBM pass (single-block runs only).
 
     Returns (u', v'). Falls back to the XLA kernel when Mosaic cannot
     serve the dtype (f64 on TPU), the shape would overflow VMEM, or —
@@ -346,28 +431,42 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     sharded kernel path is instead covered by the single-device
     with-faces interpret test plus the TPU hardware tests.
     """
+    if fuse == 2 and faces is not None:
+        raise ValueError("temporal blocking requires a single block")
     nx, ny, nz = u.shape
     dtype = u.dtype
     on_tpu = jax.default_backend() == "tpu"
-    bx = pick_block_planes(nx, ny, nz, u.dtype.itemsize)
-    if (
-        (dtype == jnp.float64 and on_tpu)
-        or bx == 0
-        or (not on_tpu and not allow_interpret)
+    seeds = jnp.asarray(seeds, jnp.int32)
+
+    def single(u, v, seeds):
+        return fused_step(
+            u, v, params, seeds, faces, use_noise=use_noise,
+            allow_interpret=allow_interpret, fuse=1,
+        )
+
+    if fuse == 2 and use_noise and not on_tpu:
+        # Off-TPU noise is injected outside the kernel (interpreter PRNG
+        # is a stub), which is only valid for one step at a time.
+        u, v = single(u, v, seeds)
+        return single(u, v, seeds.at[2].add(1))
+
+    bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
+    if (dtype == jnp.float64 and on_tpu) or bx == 0 or (
+        not on_tpu and not allow_interpret
     ):
+        if fuse == 2:
+            u, v = single(u, v, seeds)
+            return single(u, v, seeds.at[2].add(1))
         return _xla_fallback(u, v, params, seeds, faces, use_noise=use_noise)
+
     params_vec = jnp.stack(
         [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
     ).astype(dtype)
-    # The interpret-mode TPU PRNG is a deterministic zeros stub, so off
-    # TPU the noise is added outside the kernel from the threefry stream
-    # (u' = u + (du + n)*dt  ==  fused u' + n*dt). The in-kernel PRNG
-    # statistics are validated on hardware (tests/unit/test_tpu_hardware.py).
-    seeds = jnp.asarray(seeds, jnp.int32)
     u2, v2 = _fused_call(
         u, v, params_vec, seeds,
         tuple(faces) if faces is not None else None,
         bx=bx, use_noise=use_noise and on_tpu, interpret=not on_tpu,
+        fuse=fuse,
     )
     if use_noise and not on_tpu:
         from ..models import grayscott

@@ -174,6 +174,15 @@ class Simulation:
             # (global interpreter state) — sharded CPU runs take the XLA
             # fallback inside fused_step; real TPU runs the fused kernel.
             allow_interpret = not sharded
+            # Temporal blocking (2 steps per HBM pass) on single-block
+            # runs; the noise stream is keyed on absolute (step, plane),
+            # so fusion/chunking does not change the trajectory.
+            fuse = 2 if (not sharded and nsteps >= 2) else 1
+
+            def step_seeds(step_idx):
+                return jnp.stack(
+                    [key_i32[0], key_i32[1], step_idx.astype(jnp.int32)]
+                )
 
             def body(i, carry):
                 u, v = carry
@@ -182,15 +191,21 @@ class Simulation:
                     if sharded
                     else None
                 )
-                seeds = jnp.stack(
-                    [key_i32[0], key_i32[1], (step0 + i).astype(jnp.int32)]
-                )
                 return pallas_stencil.fused_step(
-                    u, v, params, seeds, faces, use_noise=use_noise,
-                    allow_interpret=allow_interpret,
+                    u, v, params, step_seeds(step0 + fuse * i), faces,
+                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    fuse=fuse,
                 )
 
-            return lax.fori_loop(0, nsteps, body, (u, v))
+            pairs, rem = divmod(nsteps, fuse)
+            u, v = lax.fori_loop(0, pairs, body, (u, v))
+            if rem:
+                u, v = pallas_stencil.fused_step(
+                    u, v, params, step_seeds(step0 + fuse * pairs), None,
+                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    fuse=1,
+                )
+            return u, v
 
         def body(i, carry):
             u, v = carry

@@ -11,6 +11,31 @@ import time
 from typing import Dict
 
 
+def setup_platform(cpu: bool, devices: int = 1) -> str:
+    """Benchmark-script platform bring-up, shared by ``benchmarks/``.
+
+    With ``cpu``: inject the virtual-device XLA flag (before any backend
+    init) and pin the CPU platform via jax.config (the axon sitecustomize
+    hook re-pins platforms after import, so the env var alone is not
+    enough). Returns the Settings ``backend`` string for the platform.
+    """
+    import os
+
+    if cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    platform = jax.devices()[0].platform
+    return {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+
+
 def time_sim(sim, steps: int, rounds: int) -> float:
     """Best-of-``rounds`` seconds-per-step of ``steps`` fused simulation
     steps (after a compile-triggering warmup chunk).
