@@ -46,15 +46,18 @@ The Float64 + TPU combination falls back to the XLA kernel (Mosaic has no
 f64 vector path — the reference has the same asymmetry: its AMDGPU
 backend disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
 On non-TPU backends the kernel runs in the TPU-semantics interpreter
-(tests); its PRNG is a zeros stub, so noise is then injected outside the
-kernel from the threefry stream (forcing ``fuse=1``, since post-hoc
-injection is only valid for a single step).
+(tests); the interpreter's hardware PRNG is a zeros stub, so the kernel is
+built with a deterministic counter-hash noise source instead
+(:func:`_uniform_pm1_stub`) keyed on the **same** ``(key, step, plane)``
+seeding contract — a different stream from the hardware PRNG, but one
+that exercises the identical seeding logic (per-plane keys, stage-A/B
+step offsets, masked ghost-plane noise), so stream-invariance properties
+of the TPU code path are assertable off hardware.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -90,10 +93,51 @@ def _uniform_pm1(shape, dtype):
     """Uniform in [-1, 1) from the seeded TPU PRNG: keep 23 random
     mantissa bits over exponent 0 -> float in [1, 2), then affine-map."""
     bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return _bits_to_pm1(bits, dtype)
+
+
+def _bits_to_pm1(bits, dtype):
     f12 = pltpu.bitcast(
         jnp.uint32(0x3F800000) | (bits >> jnp.uint32(9)), jnp.float32
     )
     return (f12 * 2.0 - 3.0).astype(dtype)
+
+
+def _hash32(x):
+    """lowbias32 integer finalizer (32-bit avalanche hash); uint32
+    arithmetic wraps modulo 2**32 by construction."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _uniform_pm1_stub(s0, s1, step_idx, g, shape, dtype):
+    """Interpret-mode replacement for the hardware PRNG stream.
+
+    The TPU-semantics interpreter models ``prng_random_bits`` as zeros, so
+    off-hardware kernel builds draw from this counter-based hash instead:
+    the same ``(key lo, key hi, step, plane)`` seeding contract as
+    ``pltpu.prng_seed`` plus a per-cell counter, producing a deterministic
+    stream with the same invariances (chunking, slab size, temporal
+    fusion) — which is exactly what the off-hardware tests assert.
+    """
+    seed = _hash32(
+        _hash32(
+            _hash32(jnp.asarray(s0).astype(jnp.uint32))
+            ^ jnp.asarray(s1).astype(jnp.uint32)
+        )
+        ^ _hash32(
+            _hash32(jnp.asarray(step_idx).astype(jnp.uint32))
+            ^ jnp.asarray(g).astype(jnp.uint32)
+        )
+    )
+    iy = lax.broadcasted_iota(jnp.uint32, shape, 0)
+    iz = lax.broadcasted_iota(jnp.uint32, shape, 1)
+    cell = iy * jnp.uint32(shape[1]) + iz
+    bits = _hash32(_hash32(cell + seed) ^ seed)
+    return _bits_to_pm1(bits, dtype)
 
 
 def _shifted(block, axis, shift, edge_value):
@@ -109,12 +153,17 @@ def _shifted(block, axis, shift, edge_value):
 
 
 def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
-                 fuse):
+                 fuse, stub_noise):
     """Build the fused single-program kernel body; see module docstring.
+
+    ``stub_noise`` selects the interpret-mode counter-hash noise source in
+    place of the hardware PRNG (same seeding contract, different stream).
 
     Ref order (faces present only when ``with_faces``, which requires
     ``fuse == 1``; mid scratch present only when ``fuse == 2``):
-      params(SMEM f32[6]), seeds(SMEM i32[3] = key lo, key hi, step),
+      params(SMEM f32[6]; f64 for f64 fields — never bf16, Mosaic SMEM
+      support for bf16 scalars is shaky),
+      seeds(SMEM i32[3] = key lo, key hi, step),
       u, v (ANY/HBM, (nx, ny, nz)),
       [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (1, ny, nz)),
        u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
@@ -152,7 +201,11 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
         u_bv = jnp.asarray(stencil.U_BOUNDARY, dtype)
         v_bv = jnp.asarray(stencil.V_BOUNDARY, dtype)
         fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
-        Du, Dv, F, K, dt, noise = (params[j] for j in range(6))
+        # Params land in SMEM at >= f32 (see ref order above); cast the
+        # six scalars to the field dtype at the point of use.
+        Du, Dv, F, K, dt, noise = (
+            params[j].astype(dtype) for j in range(6)
+        )
         six = jnp.asarray(6.0, dtype)
         one = jnp.asarray(1.0, dtype)
 
@@ -258,6 +311,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
         def noise_plane(step_idx, g):
             """Pre-scaled noise*dt plane for absolute step/x-plane."""
+            if stub_noise:
+                return (noise * dt) * _uniform_pm1_stub(
+                    seeds[0], seeds[1], step_idx, g, (ny, nz), dtype
+                )
             pltpu.prng_seed(seeds[0], seeds[1], step_idx, g)
             return (noise * dt) * _uniform_pm1((ny, nz), dtype)
 
@@ -348,10 +405,11 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bx", "use_noise", "interpret", "fuse")
+    jax.jit,
+    static_argnames=("bx", "use_noise", "interpret", "fuse", "detect_races"),
 )
 def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
-                interpret, fuse):
+                interpret, fuse, detect_races=False):
     nx, ny, nz = u.shape
     dtype = u.dtype
     nblocks = nx // bx
@@ -388,7 +446,8 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
 
     return pl.pallas_call(
         _make_kernel(
-            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse
+            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse,
+            stub_noise=interpret,
         ),
         in_specs=in_specs,
         out_specs=[any_spec, any_spec],
@@ -398,12 +457,12 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
         ],
         scratch_shapes=scratch_shapes,
         # The TPU-semantics interpreter (not the generic HLO one) models
-        # SMEM/semaphores/DMA on CPU for tests. GS_PALLAS_DETECT_RACES=1
-        # additionally runs its DMA/compute race detector (read at trace
-        # time — use a fresh shape to defeat the jit cache when toggling).
+        # SMEM/semaphores/DMA on CPU for tests. ``detect_races`` is a
+        # static jit argument so toggling it cannot be swallowed by the
+        # jit cache (it is part of the cache key).
         interpret=pltpu.InterpretParams(
             dma_execution_mode="eager",
-            detect_races=os.environ.get("GS_PALLAS_DETECT_RACES") == "1",
+            detect_races=detect_races,
         )
         if interpret
         else False,
@@ -411,7 +470,7 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
 
 
 def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
-               allow_interpret=True, fuse=1):
+               allow_interpret=True, fuse=1, detect_races=False):
     """``fuse`` fused Gray-Scott steps on interior-shaped fields.
 
     ``seeds`` is an int32[3] vector (PRNG key data lo/hi, absolute step
@@ -421,6 +480,14 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped (1, ny, nz),
     y faces (nx, 1, nz), z faces (nx, ny, 1). ``fuse=2`` temporal
     blocking advances two steps per HBM pass (single-block runs only).
+    ``detect_races`` (interpret mode only) runs the TPU interpreter's
+    DMA/compute race detector; it is a static jit argument, so toggling
+    it recompiles rather than reusing a stale cache entry.
+
+    Noise always comes from *inside* the kernel: the hardware PRNG on
+    TPU, the counter-hash stub (same seeding contract) in interpret mode
+    — so the seeding logic that runs on hardware is the one tested off
+    hardware.
 
     Returns (u', v'). Falls back to the XLA kernel when Mosaic cannot
     serve the dtype (f64 on TPU), the shape would overflow VMEM, or —
@@ -438,43 +505,34 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     on_tpu = jax.default_backend() == "tpu"
     seeds = jnp.asarray(seeds, jnp.int32)
 
-    def single(u, v, seeds):
-        return fused_step(
-            u, v, params, seeds, faces, use_noise=use_noise,
-            allow_interpret=allow_interpret, fuse=1,
-        )
-
-    if fuse == 2 and use_noise and not on_tpu:
-        # Off-TPU noise is injected outside the kernel (interpreter PRNG
-        # is a stub), which is only valid for one step at a time.
-        u, v = single(u, v, seeds)
-        return single(u, v, seeds.at[2].add(1))
-
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
     if (dtype == jnp.float64 and on_tpu) or bx == 0 or (
         not on_tpu and not allow_interpret
     ):
         if fuse == 2:
-            u, v = single(u, v, seeds)
-            return single(u, v, seeds.at[2].add(1))
+            u, v = fused_step(
+                u, v, params, seeds, faces, use_noise=use_noise,
+                allow_interpret=allow_interpret, fuse=1,
+            )
+            return fused_step(
+                u, v, params, seeds.at[2].add(1), faces,
+                use_noise=use_noise, allow_interpret=allow_interpret,
+                fuse=1,
+            )
         return _xla_fallback(u, v, params, seeds, faces, use_noise=use_noise)
 
+    # SMEM scalars stay >= f32 (bf16 scalars in SMEM are a shaky Mosaic
+    # combination); the kernel casts them to the field dtype at use.
+    smem_dtype = jnp.promote_types(dtype, jnp.float32)
     params_vec = jnp.stack(
         [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
-    ).astype(dtype)
-    u2, v2 = _fused_call(
+    ).astype(smem_dtype)
+    return _fused_call(
         u, v, params_vec, seeds,
         tuple(faces) if faces is not None else None,
-        bx=bx, use_noise=use_noise and on_tpu, interpret=not on_tpu,
-        fuse=fuse,
+        bx=bx, use_noise=use_noise, interpret=not on_tpu,
+        fuse=fuse, detect_races=detect_races and not on_tpu,
     )
-    if use_noise and not on_tpu:
-        from ..models import grayscott
-
-        key = _threefry_key(seeds)
-        nz_field = grayscott.noise_field(key, u.shape, dtype, params.noise)
-        u2 = u2 + nz_field * params.dt
-    return u2, v2
 
 
 def _threefry_key(seeds):

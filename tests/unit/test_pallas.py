@@ -88,6 +88,71 @@ def test_pallas_noise_statistics_and_reproducibility():
     assert not np.array_equal(np.asarray(u1), np.asarray(u2))
 
 
+def test_temporal_blocking_with_noise_matches_two_single_steps():
+    """fuse=2 WITH in-kernel noise must equal two fuse=1 steps with step
+    seeds ``s`` and ``s+1`` — asserting the kernel's own noise seeding
+    (stage A at seeds[2], stage B at seeds[2]+1, masked ghost-plane
+    noise), not post-hoc injection. Off TPU the kernel draws from the
+    counter-hash stub, which obeys the identical seeding contract."""
+    L = 32
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.25), dtype
+    )
+    key = jax.random.PRNGKey(21)
+    u = jax.random.uniform(key, (L, L, L), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
+    seeds = jnp.asarray([17, 29, 4], jnp.int32)
+
+    u2, v2 = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=2
+    )
+    ua, va = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    ub, vb = pallas_stencil.fused_step(
+        ua, va, params, seeds.at[2].add(1), use_noise=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(u2), np.asarray(ub), rtol=1e-6, atol=5e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(v2), np.asarray(vb), rtol=1e-6, atol=5e-7
+    )
+
+
+def test_noise_stream_is_position_keyed_not_layout_keyed():
+    """The in-kernel noise is keyed on (key, step, global plane), so the
+    noise field must be identical between the with-faces (sharded-block)
+    and no-faces (single-block) kernel builds."""
+    L = 32
+    dtype = jnp.float32
+    noisy = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.5), dtype
+    )
+    quiet = grayscott.Params.from_settings(_settings("Pallas", L=L), dtype)
+    key = jax.random.PRNGKey(13)
+    keys = jax.random.split(key, 14)
+    u = jax.random.uniform(keys[0], (L, L, L), dtype)
+    v = jax.random.uniform(keys[1], (L, L, L), dtype)
+    shapes = [(1, L, L)] * 4 + [(L, 1, L)] * 4 + [(L, L, 1)] * 4
+    faces = tuple(
+        jax.random.uniform(k, s, dtype) for k, s in zip(keys[2:], shapes)
+    )
+    seeds = jnp.asarray([3, 1, 9], jnp.int32)
+
+    def noise_delta(faces_arg):
+        un, _ = pallas_stencil.fused_step(
+            u, v, noisy, seeds, faces_arg, use_noise=True
+        )
+        u0, _ = pallas_stencil.fused_step(
+            u, v, quiet, seeds, faces_arg, use_noise=False
+        )
+        return np.asarray(un) - np.asarray(u0)
+
+    np.testing.assert_allclose(
+        noise_delta(faces), noise_delta(None), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_temporal_blocking_matches_two_single_steps():
     """fuse=2 (two timesteps per HBM pass, with slab-overlap
     recomputation) must reproduce two fuse=1 steps exactly — the
