@@ -16,10 +16,13 @@ Design differences from the reference (idiomatic JAX):
   carries mutable ghost-padded arrays plus explicit double buffers
   (``Structs.jl:82-93``); in JAX the "swap" is just returning new arrays
   (``public.jl:67-68`` made free).
-* Noise uses JAX's counter-based PRNG: the step key is ``fold_in(base, step)``
-  so a restart reproduces the same stream — the reference's global-RNG
-  ``rand(Distributions.Uniform(-1,1))`` (``Simulation_CPU.jl:101-103``) is
-  not reproducible across thread schedules.
+* Noise comes from the framework's position-keyed counter-hash stream
+  (``ops/noise.py``): each draw is a function of (key, absolute step,
+  global cell coordinate), so restarts, step chunking, shard layout, and
+  temporal fusion all reproduce the same trajectory — the reference's
+  global-RNG ``rand(Distributions.Uniform(-1,1))``
+  (``Simulation_CPU.jl:101-103``) is not reproducible across thread
+  schedules.
 """
 
 from __future__ import annotations
@@ -120,13 +123,23 @@ def init_fields(
     return u, v
 
 
-def noise_field(key, shape, dtype, noise: jnp.ndarray) -> jnp.ndarray:
-    """Pre-scaled noise term ``noise * U(-1, 1)`` per cell.
-
-    Counter-based replacement for the reference's per-cell
+def noise_field(key_i32, step, shape, dtype, noise: jnp.ndarray,
+                offsets=(0, 0, 0), row=None) -> jnp.ndarray:
+    """Pre-scaled noise term ``noise * U(-1, 1)`` per cell from the
+    position-keyed stream (``ops/noise.py``) — the reproducible
+    replacement for the reference's per-cell global-RNG
     ``rand(Distributions.Uniform(-1,1))`` (``Simulation_CPU.jl:101-103``).
+
+    ``key_i32`` is int32[2] raw key data, ``step`` the absolute step
+    index, ``offsets``/``row`` the block's global origin and the global
+    grid side (for sharded blocks).
     """
-    unit = jax.random.uniform(key, shape, dtype=dtype, minval=-1.0, maxval=1.0)
+    from ..ops.noise import uniform_pm1_block
+
+    unit = uniform_pm1_block(
+        key_i32, step, offsets, shape,
+        shape[2] if row is None else row, dtype,
+    )
     return noise * unit
 
 

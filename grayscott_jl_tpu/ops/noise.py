@@ -1,0 +1,105 @@
+"""Position-keyed noise stream shared by every kernel and layout.
+
+One stream for the whole framework: each global cell's draw at each step
+is a pure function of ``(key, step, global x, global y, global z)``,
+computed with a counter-based integer hash (lowbias32). Consequences,
+all load-bearing for correctness tests:
+
+* **chunk invariance** — iterating 10 steps in one jitted chunk equals
+  two chunks of 5 (the step index is absolute);
+* **layout invariance** — a sharded run draws the same noise as a
+  single-device run for every global cell (the key is shared, the
+  coordinates are global), so sharded == single-device holds bitwise
+  even with noise on;
+* **fusion invariance** — temporal blocking recomputes neighbor-owned
+  ring cells locally; position-keyed draws make the recomputed values
+  identical to what the owner computed, so ``fuse=2`` equals two single
+  steps exactly;
+* **kernel-language agreement** — the XLA path (:func:`uniform_pm1_block`)
+  and the Pallas kernel (same hash on 2D planes,
+  ``ops/pallas_stencil.py``) produce identical bits, so the
+  cross-kernel-language oracle tests are exact for noisy runs too —
+  strictly stronger than the reference, whose CPU and CUDA backends draw
+  from unrelated streams (``Simulation_CPU.jl:101-103`` vs
+  ``CUDAExt.jl:149-151``).
+
+The reference's noise is ``rand(Distributions.Uniform(-1,1))`` from a
+global RNG — not reproducible across thread schedules, let alone across
+backends. This design trades its statistical pedigree (threefry) for a
+fast avalanche hash; the noise term is a forcing perturbation, not a
+Monte-Carlo estimator, and the uniformity/independence the tests assert
+(mean, variance, step-to-step decorrelation) hold.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def hash32(x):
+    """lowbias32 integer finalizer (32-bit avalanche hash); uint32
+    arithmetic wraps modulo 2**32 by construction."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _u32(x):
+    """Reinterpret an int32 scalar/array as uint32 (no value checks —
+    negative step offsets at global edges wrap, which is fine: the wrap
+    is deterministic and those draws land on masked ghost cells)."""
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def plane_seed(k0, k1, step, gx):
+    """Per-(key, step, global x-plane) scalar seed — the contract shared
+    with the Pallas kernel's ``noise_plane``. ``gx`` may be an array
+    (hash32 is elementwise), which is how the 3D block form reuses this."""
+    return hash32(
+        hash32(hash32(_u32(k0)) ^ _u32(k1))
+        ^ hash32(hash32(_u32(step)) ^ _u32(gx))
+    )
+
+
+def _cell_bits(seed, cell):
+    """Final per-cell mix. ONE definition — the XLA block form and the
+    Pallas per-plane form must produce identical bits."""
+    return hash32(hash32(cell + seed) ^ seed)
+
+
+def plane_bits(seed, y_off, z_off, row, shape):
+    """uint32 noise bits for one (ny, nz) plane at global offsets
+    ``(y_off, z_off)``; ``row`` is the global row length (grid side L),
+    making the per-cell counter a global coordinate."""
+    iy = lax.broadcasted_iota(jnp.uint32, shape, 0) + _u32(y_off)
+    iz = lax.broadcasted_iota(jnp.uint32, shape, 1) + _u32(z_off)
+    return _cell_bits(seed, iy * _u32(row) + iz)
+
+
+def bits_to_pm1(bits, dtype):
+    """Map uint32 bits to uniform [-1, 1): 23 mantissa bits over exponent
+    0 -> float in [1, 2), then affine-map."""
+    f12 = lax.bitcast_convert_type(
+        jnp.uint32(0x3F800000) | (bits >> jnp.uint32(9)), jnp.float32
+    )
+    return (f12 * 2.0 - 3.0).astype(dtype)
+
+
+def uniform_pm1_block(key_i32, step, offsets, shape, row, dtype):
+    """Uniform [-1, 1) noise for a 3D block at global ``offsets``.
+
+    ``key_i32`` is the int32[2] raw key data (bitcast of a PRNG key),
+    ``step`` the absolute step index, ``offsets`` the block's global
+    (x, y, z) origin (python ints or traced scalars), ``row`` the global
+    grid side L. Identical values to the Pallas kernel's per-plane draws
+    for the same global cells.
+    """
+    gx = lax.broadcasted_iota(jnp.uint32, shape, 0) + _u32(offsets[0])
+    seed = plane_seed(key_i32[0], key_i32[1], step, gx)
+    iy = lax.broadcasted_iota(jnp.uint32, shape, 1) + _u32(offsets[1])
+    iz = lax.broadcasted_iota(jnp.uint32, shape, 2) + _u32(offsets[2])
+    bits = _cell_bits(seed, iy * _u32(row) + iz)
+    return bits_to_pm1(bits, dtype)

@@ -32,27 +32,22 @@ cell per step), so the kernel is designed around HBM traffic:
   n+2 on the BX output planes — so HBM traffic per *step* drops to
   ~((BX+4)/BX + 1)/2 passes (~10 bytes/cell at BX=8, f32), below the
   1-read-1-write "roofline" of any single-step schedule;
-* per-cell uniform noise is generated *inside* the kernel with the TPU
-  hardware PRNG (``pltpu.prng_random_bits``), seeded per
-  ``(key, absolute step, absolute x-plane)`` — so the stream is
-  invariant under restarts, step chunking, slab size, and temporal
-  fusion (slab-overlap recomputation reproduces identical noise). It is
-  a *different* stream from the XLA kernel's counter-based threefry,
-  just as the reference's CPU (``Distributions.Uniform``,
-  ``Simulation_CPU.jl:101-103``) and CUDA (in-kernel ``rand``,
-  ``CUDAExt.jl:149-151``) backends draw from unrelated streams.
+* per-cell uniform noise is generated *inside* the kernel from the
+  framework's position-keyed counter-hash stream (``ops/noise.py``),
+  keyed on ``(key, absolute step, global cell coordinates)`` — so the
+  stream is invariant under restarts, step chunking, slab size, shard
+  layout, and temporal fusion (slab-overlap recomputation reproduces
+  identical noise), and it is the *same* stream the XLA kernel draws
+  from, making the cross-kernel-language oracle exact for noisy runs.
+  The hash is pure vector integer ALU (xor/shift/mul) — essentially
+  free in a memory-bound kernel — and, unlike the TPU hardware PRNG
+  (``pltpu.prng_random_bits``), it is modeled faithfully by the
+  interpret-mode tests (the interpreter stubs the hardware PRNG to
+  zeros) and needs no per-shard seeding machinery.
 
 The Float64 + TPU combination falls back to the XLA kernel (Mosaic has no
 f64 vector path — the reference has the same asymmetry: its AMDGPU
 backend disables noise rather than supporting it, ``AMDGPUExt.jl:195-201``).
-On non-TPU backends the kernel runs in the TPU-semantics interpreter
-(tests); the interpreter's hardware PRNG is a zeros stub, so the kernel is
-built with a deterministic counter-hash noise source instead
-(:func:`_uniform_pm1_stub`) keyed on the **same** ``(key, step, plane)``
-seeding contract — a different stream from the hardware PRNG, but one
-that exercises the identical seeding logic (per-plane keys, stage-A/B
-step offsets, masked ghost-plane noise), so stream-invariance properties
-of the TPU code path are assertable off hardware.
 """
 
 from __future__ import annotations
@@ -66,6 +61,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
+from .noise import plane_bits, plane_seed, uniform_pm1_block
 
 #: VMEM scratch budget for slab buffers. Per-core VMEM is 64-128 MiB on
 #: v4/v5 hardware; stay well under to leave the compiler headroom.
@@ -89,55 +85,13 @@ def pick_block_planes(
     return 0
 
 
-def _uniform_pm1(shape, dtype):
-    """Uniform in [-1, 1) from the seeded TPU PRNG: keep 23 random
-    mantissa bits over exponent 0 -> float in [1, 2), then affine-map."""
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    return _bits_to_pm1(bits, dtype)
-
-
-def _bits_to_pm1(bits, dtype):
+def _kernel_pm1(bits, dtype):
+    """uint32 bits -> uniform [-1, 1), Mosaic form of
+    ``noise.bits_to_pm1`` (``pltpu.bitcast`` instead of lax bitcast)."""
     f12 = pltpu.bitcast(
         jnp.uint32(0x3F800000) | (bits >> jnp.uint32(9)), jnp.float32
     )
     return (f12 * 2.0 - 3.0).astype(dtype)
-
-
-def _hash32(x):
-    """lowbias32 integer finalizer (32-bit avalanche hash); uint32
-    arithmetic wraps modulo 2**32 by construction."""
-    x = x ^ (x >> jnp.uint32(16))
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> jnp.uint32(15))
-    x = x * jnp.uint32(0x846CA68B)
-    return x ^ (x >> jnp.uint32(16))
-
-
-def _uniform_pm1_stub(s0, s1, step_idx, g, shape, dtype):
-    """Interpret-mode replacement for the hardware PRNG stream.
-
-    The TPU-semantics interpreter models ``prng_random_bits`` as zeros, so
-    off-hardware kernel builds draw from this counter-based hash instead:
-    the same ``(key lo, key hi, step, plane)`` seeding contract as
-    ``pltpu.prng_seed`` plus a per-cell counter, producing a deterministic
-    stream with the same invariances (chunking, slab size, temporal
-    fusion) — which is exactly what the off-hardware tests assert.
-    """
-    seed = _hash32(
-        _hash32(
-            _hash32(jnp.asarray(s0).astype(jnp.uint32))
-            ^ jnp.asarray(s1).astype(jnp.uint32)
-        )
-        ^ _hash32(
-            _hash32(jnp.asarray(step_idx).astype(jnp.uint32))
-            ^ jnp.asarray(g).astype(jnp.uint32)
-        )
-    )
-    iy = lax.broadcasted_iota(jnp.uint32, shape, 0)
-    iz = lax.broadcasted_iota(jnp.uint32, shape, 1)
-    cell = iy * jnp.uint32(shape[1]) + iz
-    bits = _hash32(_hash32(cell + seed) ^ seed)
-    return _bits_to_pm1(bits, dtype)
 
 
 def _shifted(block, axis, shift, edge_value):
@@ -153,17 +107,15 @@ def _shifted(block, axis, shift, edge_value):
 
 
 def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
-                 fuse, stub_noise):
+                 fuse):
     """Build the fused single-program kernel body; see module docstring.
-
-    ``stub_noise`` selects the interpret-mode counter-hash noise source in
-    place of the hardware PRNG (same seeding contract, different stream).
 
     Ref order (faces present only when ``with_faces``, which requires
     ``fuse == 1``; mid scratch present only when ``fuse == 2``):
       params(SMEM f32[6]; f64 for f64 fields — never bf16, Mosaic SMEM
       support for bf16 scalars is shaky),
-      seeds(SMEM i32[3] = key lo, key hi, step),
+      seeds(SMEM i32[7] = key lo, key hi, step, x/y/z global offset,
+      global row length L — the position-keyed noise coordinates),
       u, v (ANY/HBM, (nx, ny, nz)),
       [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (1, ny, nz)),
        u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
@@ -310,13 +262,11 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             return u_c + du * dt, v_c + dv * dt
 
         def noise_plane(step_idx, g):
-            """Pre-scaled noise*dt plane for absolute step/x-plane."""
-            if stub_noise:
-                return (noise * dt) * _uniform_pm1_stub(
-                    seeds[0], seeds[1], step_idx, g, (ny, nz), dtype
-                )
-            pltpu.prng_seed(seeds[0], seeds[1], step_idx, g)
-            return (noise * dt) * _uniform_pm1((ny, nz), dtype)
+            """Pre-scaled noise*dt plane for absolute step / local
+            x-plane ``g``; global coordinates come from seeds[3:7]."""
+            seed = plane_seed(seeds[0], seeds[1], step_idx, seeds[3] + g)
+            bits = plane_bits(seed, seeds[4], seeds[5], seeds[6], (ny, nz))
+            return (noise * dt) * _kernel_pm1(bits, dtype)
 
         const_edges_u = (u_bv,) * 4
         const_edges_v = (v_bv,) * 4
@@ -446,8 +396,7 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
 
     return pl.pallas_call(
         _make_kernel(
-            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse,
-            stub_noise=interpret,
+            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse
         ),
         in_specs=in_specs,
         out_specs=[any_spec, any_spec],
@@ -470,24 +419,29 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
 
 
 def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
-               allow_interpret=True, fuse=1, detect_races=False):
+               allow_interpret=True, fuse=1, detect_races=False,
+               offsets=None, row=None):
     """``fuse`` fused Gray-Scott steps on interior-shaped fields.
 
     ``seeds`` is an int32[3] vector (PRNG key data lo/hi, absolute step
-    index) feeding the in-kernel PRNG; ``faces`` (optional, fuse=1 only)
-    is the 12-tuple of resolved halo faces for a sharded block, in the
-    order ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi,
-    u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped (1, ny, nz),
-    y faces (nx, 1, nz), z faces (nx, ny, 1). ``fuse=2`` temporal
-    blocking advances two steps per HBM pass (single-block runs only).
-    ``detect_races`` (interpret mode only) runs the TPU interpreter's
-    DMA/compute race detector; it is a static jit argument, so toggling
-    it recompiles rather than reusing a stale cache entry.
+    index) keying the in-kernel noise stream; ``offsets`` (optional,
+    int32[3]) is the block's global origin and ``row`` the global grid
+    side L — together they make the noise position-keyed across shard
+    layouts (defaults: zero origin, row = local nz — the single-block
+    case). ``faces`` (optional, fuse=1 only) is the 12-tuple of resolved
+    halo faces for a sharded block, in the order ``(u_xlo, u_xhi,
+    v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi, u_zlo, u_zhi, v_zlo,
+    v_zhi)`` with x faces shaped (1, ny, nz), y faces (nx, 1, nz),
+    z faces (nx, ny, 1). ``fuse=2`` temporal blocking advances two steps
+    per HBM pass (single-block runs only). ``detect_races`` (interpret
+    mode only) runs the TPU interpreter's DMA/compute race detector; it
+    is a static jit argument, so toggling it recompiles rather than
+    reusing a stale cache entry.
 
-    Noise always comes from *inside* the kernel: the hardware PRNG on
-    TPU, the counter-hash stub (same seeding contract) in interpret mode
-    — so the seeding logic that runs on hardware is the one tested off
-    hardware.
+    Noise comes from *inside* the kernel, drawn from the shared
+    position-keyed stream (``ops/noise.py``) — the same code path and
+    the same values on hardware and under the interpreter, and the same
+    stream as the XLA kernel.
 
     Returns (u', v'). Falls back to the XLA kernel when Mosaic cannot
     serve the dtype (f64 on TPU), the shape would overflow VMEM, or —
@@ -504,6 +458,10 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     dtype = u.dtype
     on_tpu = jax.default_backend() == "tpu"
     seeds = jnp.asarray(seeds, jnp.int32)
+    if offsets is None:
+        offsets = jnp.zeros((3,), jnp.int32)
+    offsets = jnp.asarray(offsets, jnp.int32)
+    row = jnp.asarray(nz if row is None else row, jnp.int32)
 
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
     if (dtype == jnp.float64 and on_tpu) or bx == 0 or (
@@ -513,13 +471,17 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
             u, v = fused_step(
                 u, v, params, seeds, faces, use_noise=use_noise,
                 allow_interpret=allow_interpret, fuse=1,
+                offsets=offsets, row=row,
             )
             return fused_step(
                 u, v, params, seeds.at[2].add(1), faces,
                 use_noise=use_noise, allow_interpret=allow_interpret,
-                fuse=1,
+                fuse=1, offsets=offsets, row=row,
             )
-        return _xla_fallback(u, v, params, seeds, faces, use_noise=use_noise)
+        return _xla_fallback(
+            u, v, params, seeds, faces, use_noise=use_noise,
+            offsets=offsets, row=row,
+        )
 
     # SMEM scalars stay >= f32 (bf16 scalars in SMEM are a shaky Mosaic
     # combination); the kernel casts them to the field dtype at use.
@@ -527,33 +489,19 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     params_vec = jnp.stack(
         [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
     ).astype(smem_dtype)
+    seeds7 = jnp.concatenate([seeds, offsets, row[None]])
     return _fused_call(
-        u, v, params_vec, seeds,
+        u, v, params_vec, seeds7,
         tuple(faces) if faces is not None else None,
         bx=bx, use_noise=use_noise, interpret=not on_tpu,
         fuse=fuse, detect_races=detect_races and not on_tpu,
     )
 
 
-def _threefry_key(seeds):
-    return jax.random.fold_in(
-        jax.random.wrap_key_data(
-            lax.bitcast_convert_type(seeds[:2], jnp.uint32)
-        ),
-        lax.bitcast_convert_type(seeds[2], jnp.uint32),
-    )
-
-
-def _xla_fallback(u, v, params, seeds, faces, *, use_noise):
-    """XLA-path step with the same call contract as ``fused_step``.
-
-    Noise here comes from the counter-based threefry stream keyed on
-    ``seeds`` — a different (still reproducible) stream from the TPU
-    hardware PRNG, mirroring how the reference's backends each own their
-    RNG (``Simulation_CPU.jl:101-103`` vs ``CUDAExt.jl:149-151``).
-    """
-    from ..models import grayscott
-
+def _xla_fallback(u, v, params, seeds, faces, *, use_noise, offsets=None,
+                  row=None):
+    """XLA-path step with the same call contract as ``fused_step``,
+    drawing from the same position-keyed noise stream."""
     if faces is None:
         u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
         v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
@@ -563,8 +511,14 @@ def _xla_fallback(u, v, params, seeds, faces, *, use_noise):
         v_pad = _pad_from_faces(v, faces[2], faces[3], faces[6], faces[7],
                                 faces[10], faces[11])
     if use_noise:
-        key = _threefry_key(jnp.asarray(seeds, jnp.int32))
-        nz_field = grayscott.noise_field(key, u.shape, u.dtype, params.noise)
+        seeds = jnp.asarray(seeds, jnp.int32)
+        if offsets is None:
+            offsets = jnp.zeros((3,), jnp.int32)
+        unit = uniform_pm1_block(
+            seeds[:2], seeds[2], offsets, u.shape,
+            u.shape[2] if row is None else row, u.dtype,
+        )
+        nz_field = params.noise * unit
     else:
         nz_field = jnp.asarray(0.0, u.dtype)
     return stencil.reaction_update(u_pad, v_pad, nz_field, params)

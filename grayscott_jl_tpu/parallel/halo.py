@@ -119,6 +119,69 @@ def halo_pad(
     return tuple(padded)
 
 
+def halo_pad_wide(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    axis_names: Tuple[str, str, str],
+    axis_sizes: Tuple[int, int, int],
+    width: int,
+) -> Tuple[jnp.ndarray, ...]:
+    """Ghost-pad each local block with a ``width``-deep halo, **including
+    edge/corner ghosts**.
+
+    Deep halos feed temporal blocking: a ``width=2`` halo lets a shard
+    recompute step n+1 on a +1-cell-extended window locally, so two
+    steps need one exchange (the reference's per-step ``exchange!``,
+    ``communication.jl:138-199``, amortized). Unlike the 7-point
+    single-step stencil, the extended-window computation reads edge and
+    corner ghosts, so exchanges are *sequential by axis* and each slab
+    spans the full padded extent of the axes exchanged before it — the
+    classic corner-propagation ordering (the reference's xy/xz/yz
+    sequence has the same structure).
+    """
+    arrays = list(arrays)
+    w = width
+    padded = [
+        jnp.pad(a, w, mode="constant", constant_values=bv)
+        for a, bv in zip(arrays, boundary_values)
+    ]
+    n_arr = len(arrays)
+
+    for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
+        if n == 1:
+            continue  # single shard on this axis: ghosts stay frozen
+        idx = lax.axis_index(ax)
+        m = padded[0].shape[dim]
+
+        def slab(x, start):
+            i = [slice(None)] * x.ndim
+            i[dim] = slice(start, start + w)
+            return x[tuple(i)]
+
+        # Interior boundary slabs (full padded extent on other axes, so
+        # previously-filled ghosts ride along -> corners propagate).
+        send_up = jnp.concatenate([slab(p, m - 2 * w) for p in padded], dim)
+        send_dn = jnp.concatenate([slab(p, w) for p in padded], dim)
+        up_perm = [(i, i + 1) for i in range(n - 1)]
+        dn_perm = [(i + 1, i) for i in range(n - 1)]
+        recv_lo = lax.ppermute(send_up, ax, up_perm)
+        recv_dn = lax.ppermute(send_dn, ax, dn_perm)
+
+        lo_slabs = jnp.split(recv_lo, n_arr, axis=dim)
+        hi_slabs = jnp.split(recv_dn, n_arr, axis=dim)
+        for i, bv in enumerate(boundary_values):
+            bvt = jnp.asarray(bv, padded[i].dtype)
+            lo = jnp.where(idx > 0, lo_slabs[i], bvt)
+            hi = jnp.where(idx < n - 1, hi_slabs[i], bvt)
+            start_lo = [0] * 3
+            start_hi = [0] * 3
+            start_hi[dim] = m - w
+            p = lax.dynamic_update_slice(padded[i], lo, start_lo)
+            padded[i] = lax.dynamic_update_slice(p, hi, start_hi)
+
+    return tuple(padded)
+
+
 def exchange_faces(
     arrays: Sequence[jnp.ndarray],
     boundary_values: Sequence[float],
@@ -147,12 +210,3 @@ def exchange_faces(
     return tuple(flat)
 
 
-def linear_shard_index(
-    axis_names: Tuple[str, str, str], axis_sizes: Tuple[int, int, int]
-) -> jnp.ndarray:
-    """Row-major linear index of this shard in the 3D mesh (traced scalar)."""
-    _, dy, dz = axis_sizes
-    cx = lax.axis_index(axis_names[0])
-    cy = lax.axis_index(axis_names[1])
-    cz = lax.axis_index(axis_names[2])
-    return (cx * dy + cy) * dz + cz

@@ -40,6 +40,7 @@ except ImportError:  # pragma: no cover
 from .config import settings as config
 from .config.settings import Settings
 from .models import grayscott
+from .ops import noise as noise_ops
 from .ops import stencil, validate_kernel_language
 from .parallel import halo
 from .parallel.domain import CartDomain
@@ -153,36 +154,50 @@ class Simulation:
 
     def _local_run(self, u, v, base_key, step0, params, *, nsteps: int):
         """``nsteps`` fused steps on one (local) block. Called directly on a
-        single device, or per-shard under ``shard_map``."""
+        single device, or per-shard under ``shard_map``.
+
+        Noise everywhere comes from the position-keyed stream
+        (``ops/noise.py``): one shared key, absolute step index, global
+        cell coordinates — so the trajectory is invariant under step
+        chunking, shard layout, and temporal fusion.
+        """
         use_noise = self.use_noise
         sharded = self.sharded
         dims = self.domain.dims
+        L = self.settings.L
         boundaries = (stencil.U_BOUNDARY, stencil.V_BOUNDARY)
+        key_i32 = lax.bitcast_convert_type(base_key, jnp.int32)
 
-        if sharded and use_noise:
-            shard_key = jax.random.fold_in(
-                base_key, halo.linear_shard_index(AXIS_NAMES, dims)
+        if sharded:
+            block = self.domain.local_shape
+            offs = jnp.stack(
+                [
+                    lax.axis_index(ax) * jnp.int32(b)
+                    for ax, b in zip(AXIS_NAMES, block)
+                ]
             )
         else:
-            shard_key = base_key
+            offs = jnp.zeros((3,), jnp.int32)
 
         if self.kernel_language == "pallas":
             from .ops import pallas_stencil
 
-            key_i32 = lax.bitcast_convert_type(shard_key, jnp.int32)
+            def step_seeds(step_idx):
+                return jnp.stack(
+                    [key_i32[0], key_i32[1], jnp.asarray(step_idx, jnp.int32)]
+                )
+
             # Concurrent interpret-mode kernels deadlock under shard_map
             # (global interpreter state) — sharded CPU runs take the XLA
             # fallback inside fused_step; real TPU runs the fused kernel.
             allow_interpret = not sharded
             # Temporal blocking (2 steps per HBM pass) on single-block
-            # runs; the noise stream is keyed on absolute (step, plane),
-            # so fusion/chunking does not change the trajectory.
+            # runs; the noise stream is keyed on absolute (step, cell),
+            # so fusion/chunking does not change the trajectory. Sharded
+            # runs exchange faces per step (fuse=1): the in-kernel
+            # wide-halo fuse is a recorded future lever, pending hardware
+            # evidence that sharded runs are exchange-bound.
             fuse = 2 if (not sharded and nsteps >= 2) else 1
-
-            def step_seeds(step_idx):
-                return jnp.stack(
-                    [key_i32[0], key_i32[1], step_idx.astype(jnp.int32)]
-                )
 
             def body(i, carry):
                 u, v = carry
@@ -194,20 +209,36 @@ class Simulation:
                 return pallas_stencil.fused_step(
                     u, v, params, step_seeds(step0 + fuse * i), faces,
                     use_noise=use_noise, allow_interpret=allow_interpret,
-                    fuse=fuse,
+                    fuse=fuse, offsets=offs, row=L,
                 )
 
             pairs, rem = divmod(nsteps, fuse)
             u, v = lax.fori_loop(0, pairs, body, (u, v))
             if rem:
+                # The remainder step needs its own halo exchange when
+                # sharded — never assume rem>0 implies unsharded (the
+                # implicit chain rem>0 => fuse==2 => not sharded would
+                # silently drop the exchange if fuse rules change).
+                faces = (
+                    halo.exchange_faces((u, v), boundaries, AXIS_NAMES, dims)
+                    if sharded
+                    else None
+                )
                 u, v = pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step0 + fuse * pairs), None,
+                    u, v, params, step_seeds(step0 + fuse * pairs), faces,
                     use_noise=use_noise, allow_interpret=allow_interpret,
-                    fuse=1,
+                    fuse=1, offsets=offs, row=L,
                 )
             return u, v
 
-        def body(i, carry):
+        # ---- XLA kernel path ----
+
+        def unit_noise(step_idx, offsets, shape):
+            return noise_ops.uniform_pm1_block(
+                key_i32, step_idx, offsets, shape, L, u.dtype
+            )
+
+        def single_step(i, carry):
             u, v = carry
             if sharded:
                 u_pad, v_pad = halo.halo_pad(
@@ -217,13 +248,60 @@ class Simulation:
                 u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
                 v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
             if use_noise:
-                key = jax.random.fold_in(shard_key, step0 + i)
-                nz = grayscott.noise_field(key, u.shape, u.dtype, params.noise)
+                nz = params.noise * unit_noise(step0 + i, offs, u.shape)
             else:
                 nz = jnp.asarray(0.0, u.dtype)
             return stencil.reaction_update(u_pad, v_pad, nz, params)
 
-        return lax.fori_loop(0, nsteps, body, (u, v))
+        if not sharded or nsteps < 2:
+            return lax.fori_loop(0, nsteps, single_step, (u, v))
+
+        # Sharded temporal blocking: one width-2 halo exchange feeds TWO
+        # steps — stage A recomputes step n+1 on a +1-cell-extended
+        # window (neighbor-owned ring cells reproduce the owner's values
+        # bitwise: same inputs via the corner-propagated halo, same
+        # position-keyed noise), stage B computes step n+2 on the
+        # interior with the stage-A ring as its ghost shell. Halves the
+        # exchange count per step (the cost ``communication.jl:138-199``
+        # pays every step).
+        ext = tuple(s + 2 for s in u.shape)
+
+        def freeze_out_of_domain(arr, bv):
+            """Ring positions outside the global domain stay at the
+            frozen boundary value (MPI.PROC_NULL ghost semantics)."""
+            out = arr
+            for dim, (ax, n) in enumerate(zip(AXIS_NAMES, dims)):
+                idx = lax.axis_index(ax)
+                pos = lax.broadcasted_iota(jnp.int32, out.shape, dim)
+                lo = (pos == 0) & (idx == 0)
+                hi = (pos == out.shape[dim] - 1) & (idx == n - 1)
+                out = jnp.where(lo | hi, jnp.asarray(bv, out.dtype), out)
+            return out
+
+        def pair_step(i, carry):
+            u, v = carry
+            step = step0 + 2 * i
+            u_p2, v_p2 = halo.halo_pad_wide(
+                (u, v), boundaries, AXIS_NAMES, dims, 2
+            )
+            if use_noise:
+                nz_a = params.noise * unit_noise(step, offs - 1, ext)
+            else:
+                nz_a = jnp.asarray(0.0, u.dtype)
+            u_a, v_a = stencil.reaction_update(u_p2, v_p2, nz_a, params)
+            u_a = freeze_out_of_domain(u_a, stencil.U_BOUNDARY)
+            v_a = freeze_out_of_domain(v_a, stencil.V_BOUNDARY)
+            if use_noise:
+                nz_b = params.noise * unit_noise(step + 1, offs, u.shape)
+            else:
+                nz_b = jnp.asarray(0.0, u.dtype)
+            return stencil.reaction_update(u_a, v_a, nz_b, params)
+
+        pairs, rem = divmod(nsteps, 2)
+        u, v = lax.fori_loop(0, pairs, pair_step, (u, v))
+        if rem:
+            u, v = single_step(nsteps - 1, (u, v))
+        return u, v
 
     def _runner(self, nsteps: int):
         """Compiled ``nsteps``-step advance, cached per nsteps."""

@@ -30,10 +30,15 @@ requires8 = pytest.mark.skipif(
 
 @requires8
 @pytest.mark.parametrize("n_devices", [2, 4, 8])
-def test_sharded_matches_single_device(n_devices):
+@pytest.mark.parametrize("noise", [0.0, 0.1])
+def test_sharded_matches_single_device(n_devices, noise):
+    """Layout invariance — including with noise on: the position-keyed
+    stream draws identical values for every global cell regardless of
+    shard layout, a property the reference cannot state (its noise comes
+    from per-process global RNGs)."""
     L, nsteps = 16, 10
-    ref = Simulation(_settings(L=L), n_devices=1)
-    sh = Simulation(_settings(L=L), n_devices=n_devices)
+    ref = Simulation(_settings(L=L, noise=noise), n_devices=1)
+    sh = Simulation(_settings(L=L, noise=noise), n_devices=n_devices)
     assert sh.sharded and sh.domain.n_blocks == n_devices
     ref.iterate(nsteps)
     sh.iterate(nsteps)
@@ -42,6 +47,28 @@ def test_sharded_matches_single_device(n_devices):
     # identical elementwise ops per cell -> agreement to f32 roundoff
     np.testing.assert_allclose(us, ur, rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(vs, vr, rtol=1e-6, atol=1e-7)
+
+
+@requires8
+@pytest.mark.parametrize("noise", [0.0, 0.1])
+@pytest.mark.parametrize("nsteps", [2, 4, 5])
+def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps):
+    """Sharded runs fuse two steps per width-2 halo exchange (stage A on
+    the +1-extended window, stage B interior). The fused trajectory must
+    equal the step-at-a-time trajectory exactly — including with noise
+    (position-keyed draws make ring recomputation reproduce the
+    neighbor's values), and for odd counts (fuse pairs + one remainder
+    step with its own exchange)."""
+    L = 16
+    fused = Simulation(_settings(L=L, noise=noise), n_devices=8, seed=7)
+    stepwise = Simulation(_settings(L=L, noise=noise), n_devices=8, seed=7)
+    fused.iterate(nsteps)
+    for _ in range(nsteps):
+        stepwise.iterate(1)
+    uf, vf = fused.get_fields()
+    us, vs = stepwise.get_fields()
+    np.testing.assert_allclose(uf, us, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(vf, vs, rtol=1e-6, atol=1e-7)
 
 
 @requires8

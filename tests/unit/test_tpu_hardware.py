@@ -18,7 +18,7 @@ requires_tpu = pytest.mark.skipif(
 
 
 @requires_tpu
-def test_in_kernel_prng_statistics():
+def test_in_kernel_noise_statistics():
     import jax.numpy as jnp
 
     from grayscott_jl_tpu.config.settings import Settings
@@ -43,7 +43,7 @@ def test_in_kernel_prng_statistics():
     n = unit.size
     assert abs(unit.mean()) < 4.0 / np.sqrt(n)
     assert abs(unit.std() - 1 / np.sqrt(3)) < 0.01
-    # Per-slab seeding must not repeat the stream across slabs.
+    # Position keying must not repeat the stream across slabs.
     bx = pallas_stencil.pick_block_planes(L, L, L, 4)
     if bx < L:
         assert not np.array_equal(unit[:bx], unit[bx:2 * bx])
@@ -54,11 +54,73 @@ def test_in_kernel_prng_statistics():
 
 
 @requires_tpu
-def test_pallas_matches_xla_on_tpu():
+def test_mosaic_noise_matches_xla_stream():
+    """The Mosaic-compiled hash noise must reproduce the XLA stream
+    bit-for-bit on hardware — the property that makes every off-hardware
+    noise test representative of the TPU path."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    L = 64
+    s = Settings(L=L, noise=0.5, precision="Float32", backend="TPU",
+                 kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
+                 dt=1.0)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(s, dtype)
+    u, v = grayscott.init_fields(L, dtype)
+    seeds = jnp.asarray([11, 22, 33], jnp.int32)
+
+    got_u, got_v = pallas_stencil.fused_step(u, v, params, seeds,
+                                             use_noise=True)
+    want_u, want_v = pallas_stencil._xla_fallback(u, v, params, seeds, None,
+                                                  use_noise=True)
+    np.testing.assert_allclose(np.asarray(got_u), np.asarray(want_u),
+                               rtol=1e-6, atol=5e-7)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=1e-6, atol=5e-7)
+
+
+@requires_tpu
+def test_temporal_blocking_with_noise_on_hardware():
+    """fuse=2 with in-kernel noise vs two fuse=1 steps, Mosaic-compiled —
+    the stage-A/B seeding the off-hardware interpret tests cover must
+    hold on the real kernel too."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    L = 64
+    s = Settings(L=L, noise=0.25, precision="Float32", backend="TPU",
+                 kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
+                 dt=1.0)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(s, dtype)
+    u, v = grayscott.init_fields(L, dtype)
+    seeds = jnp.asarray([5, 6, 0], jnp.int32)
+
+    u2, v2 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True,
+                                       fuse=2)
+    ua, va = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    ub, vb = pallas_stencil.fused_step(ua, va, params, seeds.at[2].add(1),
+                                       use_noise=True)
+    np.testing.assert_allclose(np.asarray(u2), np.asarray(ub),
+                               rtol=1e-6, atol=5e-7)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(vb),
+                               rtol=1e-6, atol=5e-7)
+
+
+@requires_tpu
+@pytest.mark.parametrize("noise", [0.0, 0.1])
+def test_pallas_matches_xla_on_tpu(noise):
     from grayscott_jl_tpu.config.settings import Settings
     from grayscott_jl_tpu.simulation import Simulation
 
-    common = dict(L=64, noise=0.0, precision="Float32", backend="TPU",
+    common = dict(L=64, noise=noise, precision="Float32", backend="TPU",
                   Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
     a = Simulation(Settings(kernel_language="Plain", **common), n_devices=1)
     b = Simulation(Settings(kernel_language="Pallas", **common), n_devices=1)
