@@ -113,6 +113,10 @@ def _measure_subprocess(platform: str, kernel: str):
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+    else:
+        # This parent just probed the backend; don't pay for (or wedge
+        # on) a second in-child probe in Simulation construction.
+        env.setdefault("GS_TPU_PROBE_TIMEOUT", "0")
     rc, out, err, timed_out = _run_bounded(
         [sys.executable, os.path.abspath(__file__), "--worker", platform,
          kernel],

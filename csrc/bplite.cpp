@@ -81,10 +81,17 @@ struct Variable {
 
 class Writer {
  public:
-  Writer(std::string path, int writer_id, bool append)
-      : path_(std::move(path)), writer_id_(writer_id) {
+  Writer(std::string path, int writer_id, int nwriters, bool append)
+      : path_(std::move(path)), writer_id_(writer_id), nwriters_(nwriters) {
     ::mkdir(path_.c_str(), 0755);
     data_name_ = "data." + std::to_string(writer_id_);
+    // Multi-writer layout (bplite.py spec): writer 0 owns md.json (and
+    // the attribute/variable definitions + writer count); every other
+    // writer publishes its private md.<w>.json. No cross-writer
+    // coordination — the reader merges.
+    md_name_ = writer_id_ == 0
+                   ? std::string("md.json")
+                   : "md." + std::to_string(writer_id_) + ".json";
     const std::string data_path = path_ + "/" + data_name_;
     // Append mode keeps the existing payload; the Python side re-declares
     // attributes/variables and passes the prior step index via
@@ -281,7 +288,7 @@ class Writer {
   void publish_md_locked(std::unique_lock<std::mutex> lk) {
     std::string md = "{\"format\": \"bplite-1\", \"complete\": ";
     md += complete_ ? "true" : "false";
-    md += ", \"nwriters\": 1, \"attributes\": {";  // native engine is single-writer
+    md += ", \"nwriters\": " + std::to_string(nwriters_) + ", \"attributes\": {";
     bool first = true;
     for (const auto &kv : attributes_) {
       if (!first) md += ", ";
@@ -311,9 +318,8 @@ class Writer {
     lk.unlock();
 
     std::unique_lock<std::mutex> plk(publish_mu_);
-    const std::string tmp =
-        path_ + "/md.json.tmp." + std::to_string(writer_id_);
-    const std::string final_path = path_ + "/md.json";
+    const std::string tmp = path_ + "/" + md_name_ + ".tmp";
+    const std::string final_path = path_ + "/" + md_name_;
     FILE *f = std::fopen(tmp.c_str(), "w");
     if (!f) return;
     std::fwrite(md.data(), 1, md.size(), f);
@@ -325,7 +331,9 @@ class Writer {
 
   std::string path_;
   int writer_id_;
+  int nwriters_;
   std::string data_name_;
+  std::string md_name_;
   int fd_ = -1;
   int64_t offset_ = 0;        // durable bytes in data file at open
   int64_t staged_offset_ = 0; // includes staged-but-unwritten payloads
@@ -355,8 +363,14 @@ class Writer {
 
 extern "C" {
 
-void *bpw_open(const char *path, int writer_id, int append) {
-  auto *w = new Writer(path, writer_id, append != 0);
+// Bumped on any C-ABI change (argument lists, semantics). The Python
+// binding refuses to load a library reporting a different version — a
+// stale build must fall back to the Python engine, not silently misread
+// arguments.
+int bpw_abi_version() { return 2; }
+
+void *bpw_open(const char *path, int writer_id, int nwriters, int append) {
+  auto *w = new Writer(path, writer_id, nwriters, append != 0);
   if (!w->ok()) {
     delete w;
     return nullptr;

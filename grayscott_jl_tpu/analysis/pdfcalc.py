@@ -127,7 +127,9 @@ def read_data_write_pdf(
     NOT_READY retries (None = retry forever, the reference behavior).
     """
     reader = BpReader(in_filename)
-    writer = open_writer(out_filename, writer_id=rank)
+    # All workers cooperate on ONE output store (the reference's
+    # MPI-parallel pdfcalc writes a single output.bp the same way).
+    writer = open_writer(out_filename, writer_id=rank, nwriters=size)
 
     defined = False
     not_ready = 0

@@ -47,6 +47,10 @@ class Settings:
     checkpoint_output: str = "ckpt.bp"
     restart: bool = False
     restart_input: str = "ckpt.bp"
+    #: Extension beyond the reference (whose restart settings are dead
+    #: config, ``Structs.jl:15-19``): simulation step to restart from;
+    #: -1 = the latest checkpoint in the store.
+    restart_step: int = -1
     mesh_type: str = "image"
     precision: str = "Float64"
     backend: str = "CPU"

@@ -69,7 +69,7 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
         from .io.checkpoint import open_checkpoint
 
         reader, last, restart_step = open_checkpoint(
-            settings.restart_input, settings
+            settings.restart_input, settings, settings.restart_step
         )
         sim.restore_from_reader(reader, last, restart_step)
         reader.close()
@@ -79,11 +79,13 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     from .io.stream import SimStream
 
     stream = SimStream(
-        settings, sim.domain, sim.dtype, writer_id=proc, nwriters=nprocs
+        settings, sim.domain, sim.dtype, writer_id=proc, nwriters=nprocs,
+        resume_step=restart_step if settings.restart else None,
     )
     ckpt = (
         CheckpointWriter(
-            settings, sim.dtype, writer_id=proc, nwriters=nprocs
+            settings, sim.dtype, writer_id=proc, nwriters=nprocs,
+            resume_step=restart_step if settings.restart else None,
         )
         if settings.checkpoint
         else None

@@ -16,28 +16,55 @@ from __future__ import annotations
 import os
 
 
+def count_steps_upto(path: str, sim_step: int):
+    """Number of leading step entries in a store whose recorded ``step``
+    scalar is <= ``sim_step`` (None when the store does not exist).
+
+    The rollback helper: a run resuming from ``restart_step`` keeps this
+    many entries of its output/checkpoint stores and drops the abandoned
+    trajectory's tail (pass the result as ``keep_steps``).
+    """
+    if not os.path.isdir(path):
+        return None
+    from .bplite import BpReader
+
+    r = BpReader(path)
+    k = 0
+    for i in range(r.num_steps()):
+        if int(r.get("step", step=i)) <= sim_step:
+            k = i + 1
+        else:
+            break
+    r.close()
+    return k
+
+
 def open_writer(
     path: str,
     *,
     writer_id: int = 0,
     nwriters: int = 1,
     append: bool = False,
+    keep_steps=None,
 ):
     """Open a BP-lite writer with the best available engine.
 
-    Multi-writer stores (``nwriters > 1``, one writer per JAX process) use
-    the Python engine; the native engine currently implements the
-    single-writer layout.
+    Both engines implement the full multi-writer layout (``nwriters > 1``,
+    one writer per JAX process, private ``data.<w>`` payload +
+    per-writer metadata, reader-side merge) — pod-scale runs get the
+    async native engine too.
     """
-    if nwriters == 1 and os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
+    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native
 
         if native.available():
             return native.NativeBpWriter(
-                path, writer_id=writer_id, append=append
+                path, writer_id=writer_id, nwriters=nwriters, append=append,
+                keep_steps=keep_steps,
             )
     from .bplite import BpWriter
 
     return BpWriter(
-        path, writer_id=writer_id, nwriters=nwriters, append=append
+        path, writer_id=writer_id, nwriters=nwriters, append=append,
+        keep_steps=keep_steps,
     )

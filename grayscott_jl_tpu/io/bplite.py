@@ -87,7 +87,13 @@ class BpWriter:
         writer_id: int = 0,
         nwriters: int = 1,
         append: bool = False,
+        keep_steps: Optional[int] = None,
     ):
+        """``keep_steps`` (append mode): keep only the first N existing
+        step entries — the rollback path, dropping the abandoned
+        trajectory's steps past a ``restart_step`` so the resumed run
+        does not append duplicates after them. Orphaned payload bytes
+        stay in the data file (harmless; offsets are absolute)."""
         self.path = path
         self.writer_id = writer_id
         self.nwriters = nwriters
@@ -104,6 +110,8 @@ class BpWriter:
             with open(self._md_path, "r", encoding="utf-8") as f:
                 self._md = json.load(f)
             self._md["complete"] = False
+            if keep_steps is not None:
+                self._md["steps"] = self._md["steps"][:keep_steps]
             self._offset = (
                 os.path.getsize(self._data_path)
                 if os.path.exists(self._data_path)

@@ -14,7 +14,7 @@ Checkpoints append as new steps in one store; restart loads the latest.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -31,15 +31,24 @@ class CheckpointWriter:
         *,
         writer_id: int = 0,
         nwriters: int = 1,
+        resume_step: Optional[int] = None,
     ):
         L = settings.L
         # On restart, append: truncating would destroy the very store the
         # run just resumed from when checkpoint_output == restart_input.
+        # But entries past the resume point (rollback) are dropped so a
+        # later restart never sees two trajectories for the same step.
+        keep = None
+        if settings.restart and resume_step is not None:
+            from . import count_steps_upto
+
+            keep = count_steps_upto(settings.checkpoint_output, resume_step)
         self.writer = open_writer(
             settings.checkpoint_output,
             writer_id=writer_id,
             nwriters=nwriters,
             append=settings.restart,
+            keep_steps=keep,
         )
         if writer_id == 0:
             self.writer.define_attribute("L", settings.L)
@@ -63,8 +72,15 @@ class CheckpointWriter:
         self.writer.close()
 
 
-def open_checkpoint(path: str, settings: Settings) -> Tuple[BpReader, int, int]:
-    """Open a checkpoint store and locate the latest entry.
+def open_checkpoint(
+    path: str, settings: Settings, restart_step: int = -1
+) -> Tuple[BpReader, int, int]:
+    """Open a checkpoint store and locate the entry to restart from.
+
+    ``restart_step`` selects the checkpoint whose recorded simulation
+    step matches (the ``restart_step`` config knob); ``-1`` means the
+    latest entry. Selecting an earlier checkpoint is how an operator
+    rolls a run back without hand-editing store metadata.
 
     Returns ``(reader, step_index, sim_step)``; the caller restores state
     via per-shard selection reads (``Simulation.restore_from_reader``) so
@@ -79,18 +95,31 @@ def open_checkpoint(path: str, settings: Settings) -> Tuple[BpReader, int, int]:
         raise ValueError(
             f"Checkpoint L={attrs['L']} does not match config L={settings.L}"
         )
-    last = n - 1
-    sim_step = int(r.get("step", step=last))
-    return r, last, sim_step
+    if restart_step < 0:
+        idx = n - 1
+        sim_step = int(r.get("step", step=idx))
+    else:
+        available = [int(r.get("step", step=i)) for i in range(n)]
+        if restart_step not in available:
+            raise ValueError(
+                f"Checkpoint store {path} has no entry for simulation "
+                f"step {restart_step}; available steps: {available}"
+            )
+        # Last match: after a rollback-and-resume the store can hold two
+        # entries for the same sim step (pre- and post-rollback
+        # trajectories); the latest is the live one.
+        idx = n - 1 - available[::-1].index(restart_step)
+        sim_step = restart_step
+    return r, idx, sim_step
 
 
 def load_checkpoint(
-    path: str, settings: Settings
+    path: str, settings: Settings, restart_step: int = -1
 ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Latest full (u, v, step) from a checkpoint store (single-host
-    convenience wrapper around :func:`open_checkpoint`)."""
-    r, last, step = open_checkpoint(path, settings)
-    u = r.get("u", step=last)
-    v = r.get("v", step=last)
+    """Full (u, v, step) of one checkpoint entry (single-host convenience
+    wrapper around :func:`open_checkpoint`)."""
+    r, idx, step = open_checkpoint(path, settings, restart_step)
+    u = r.get("u", step=idx)
+    v = r.get("v", step=idx)
     r.close()
     return u, v, step

@@ -43,8 +43,20 @@ def load_library(path: str = _LIB_PATH):
         lib = ctypes.CDLL(path)
     except OSError:
         return None
+    # A stale build would silently misread the current argument lists
+    # (e.g. nwriters landing in the old append slot -> every store opens
+    # in append mode). Refuse anything but the expected ABI and fall
+    # back to the Python engine.
+    try:
+        lib.bpw_abi_version.restype = ctypes.c_int
+        if lib.bpw_abi_version() != 2:
+            return None
+    except AttributeError:
+        return None
     lib.bpw_open.restype = ctypes.c_void_p
-    lib.bpw_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bpw_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
     lib.bpw_define_attribute_json.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
     ]
@@ -83,7 +95,15 @@ def _i64(seq: Sequence[int]):
 class NativeBpWriter:
     """Same interface as :class:`grayscott_jl_tpu.io.bplite.BpWriter`."""
 
-    def __init__(self, path: str, *, writer_id: int = 0, append: bool = False):
+    def __init__(
+        self,
+        path: str,
+        *,
+        writer_id: int = 0,
+        nwriters: int = 1,
+        append: bool = False,
+        keep_steps: Optional[int] = None,
+    ):
         lib = load_library()
         if lib is None:
             raise RuntimeError(
@@ -93,24 +113,32 @@ class NativeBpWriter:
         self._lib = lib
         self.path = path
         self.writer_id = writer_id
+        self.nwriters = nwriters
+        if not 0 <= writer_id < nwriters:
+            raise ValueError(f"writer_id {writer_id} not in [0, {nwriters})")
+        md_name = "md.json" if writer_id == 0 else f"md.{writer_id}.json"
         # variable registry mirrored host-side for dtype coercion/validation
         self._vars = {}
         prior = None
-        if append and os.path.exists(os.path.join(path, "md.json")):
-            with open(os.path.join(path, "md.json"), "r", encoding="utf-8") as f:
+        if append and os.path.exists(os.path.join(path, md_name)):
+            with open(os.path.join(path, md_name), "r", encoding="utf-8") as f:
                 prior = json.load(f)
             for name, v in prior.get("variables", {}).items():
                 self._vars[name] = (v["dtype"], tuple(v["shape"]))
-        self._h = lib.bpw_open(path.encode(), writer_id, 1 if append else 0)
+        self._h = lib.bpw_open(
+            path.encode(), writer_id, nwriters, 1 if append else 0
+        )
         if not self._h:
             raise IOError(f"Cannot open BP-lite store at {path}")
         if prior is not None:
             # Forward ALL prior state (steps, variables, attributes) before
             # the single publish — a streaming reader must never observe
-            # steps without their variables/attributes.
-            steps_json = ", ".join(
-                json.dumps(s) for s in prior.get("steps", [])
-            )
+            # steps without their variables/attributes. keep_steps drops
+            # rolled-back trajectory steps (see BpWriter docstring).
+            prior_steps = prior.get("steps", [])
+            if keep_steps is not None:
+                prior_steps = prior_steps[:keep_steps]
+            steps_json = ", ".join(json.dumps(s) for s in prior_steps)
             lib.bpw_set_prior_steps_json(self._h, steps_json.encode())
             for name, (dtype, shape) in self._vars.items():
                 lib.bpw_define_variable(

@@ -67,19 +67,29 @@ class SimStream:
         io_name: str = "SimulationOutput",
         writer_id: int = 0,
         nwriters: int = 1,
+        resume_step: Optional[int] = None,
     ):
         self.settings = settings
         self.domain = domain
         self.io_name = io_name
         L = settings.L
 
-        # On restart, append: a resumed run must not truncate the output
-        # steps written before the checkpoint it resumed from.
+        # On restart, append — a resumed run must not truncate the output
+        # steps written before the checkpoint it resumed from — but DO
+        # drop entries past the resume point: after a rollback
+        # (restart_step earlier than the last run's end) the abandoned
+        # trajectory's steps would otherwise precede duplicates.
+        keep = None
+        if settings.restart and resume_step is not None:
+            from . import count_steps_upto
+
+            keep = count_steps_upto(settings.output, resume_step)
         self.writer = open_writer(
             settings.output,
             writer_id=writer_id,
             nwriters=nwriters,
             append=settings.restart,
+            keep_steps=keep,
         )
         if writer_id == 0:
             # Provenance attributes (IO.jl:48-53)
@@ -98,14 +108,25 @@ class SimStream:
         self.writer.define_variable("V", np.dtype(dtype).name, (L, L, L))
 
         self._vtk = None
-        if settings.mesh_type.lower() == "image" and nwriters == 1:
-            # .vti needs the whole grid; multi-host runs rely on the BP
-            # store (ParaView-side assembly) instead.
-            from .vtk import VtiSeriesWriter
+        self._pvti = None
+        if settings.mesh_type.lower() == "image":
+            if nwriters == 1:
+                from .vtk import VtiSeriesWriter
 
-            self._vtk = VtiSeriesWriter(
-                settings.output, L, append=settings.restart
-            )
+                self._vtk = VtiSeriesWriter(
+                    settings.output, L, append=settings.restart,
+                    max_step=resume_step,
+                )
+            else:
+                # Multi-host: per-block .vti pieces + .pvti index — the
+                # run stays ParaView-openable without any gather.
+                from .vtk import PvtiSeriesWriter
+
+                self._pvti = PvtiSeriesWriter(
+                    settings.output, domain, dtype,
+                    writer_id=writer_id, append=settings.restart,
+                    max_step=resume_step,
+                )
 
     def write_step(self, step: int, blocks) -> None:
         """Write one output step (``IO.write_step!``, ``IO.jl:82-96``).
@@ -122,6 +143,8 @@ class SimStream:
             w.put("U", ub, start=offsets, count=sizes)
             w.put("V", vb, start=offsets, count=sizes)
         w.end_step()
+        if self._pvti is not None:
+            self._pvti.write(step, blocks)
         if self._vtk is not None:
             L = self.settings.L
             if len(blocks) == 1 and blocks[0][1] == (L, L, L):
@@ -141,3 +164,5 @@ class SimStream:
         self.writer.close()
         if self._vtk is not None:
             self._vtk.close()
+        if self._pvti is not None:
+            self._pvti.close()

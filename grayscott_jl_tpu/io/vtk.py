@@ -27,16 +27,34 @@ _VTK_TYPES = {
 }
 
 
-def write_vti(path: str, L: int, step: int, u: np.ndarray, v: np.ndarray) -> None:
+def _extent_str(extent) -> str:
+    return " ".join(f"{lo} {hi}" for lo, hi in extent)
+
+
+def write_vti(
+    path: str,
+    L: int,
+    step: int,
+    u: np.ndarray,
+    v: np.ndarray,
+    *,
+    extent=None,
+) -> None:
     """One .vti file with U and V as CellData (appended raw encoding).
 
-    Dtypes VTK has no type name for (e.g. bfloat16) are widened to float32.
+    ``extent`` is the block's cell-space box in *global* coordinates as
+    ``((x0, x1), (y0, y1), (z0, z1))`` — a piece of a larger grid, the
+    form ``.pvti`` indexes reference; default is the whole ``[0, L]^3``
+    grid. Dtypes VTK has no type name for (e.g. bfloat16) are widened to
+    float32.
     """
     if u.dtype.name not in _VTK_TYPES:
         u = u.astype(np.float32)
         v = v.astype(np.float32)
     vtk_type = _VTK_TYPES[u.dtype.name]
-    extent = f"0 {L} 0 {L} 0 {L}"
+    if extent is None:
+        extent = ((0, L),) * 3
+    ext = _extent_str(extent)
     payloads = []
     offsets = []
     off = 0
@@ -50,9 +68,9 @@ def write_vti(path: str, L: int, step: int, u: np.ndarray, v: np.ndarray) -> Non
         '<?xml version="1.0"?>\n'
         '<VTKFile type="ImageData" version="1.0" byte_order="LittleEndian" '
         'header_type="UInt64">\n'
-        f'  <ImageData WholeExtent="{extent}" Origin="0 0 0" '
+        f'  <ImageData WholeExtent="{ext}" Origin="0 0 0" '
         'Spacing="1 1 1">\n'
-        f'    <Piece Extent="{extent}">\n'
+        f'    <Piece Extent="{ext}">\n'
         '      <CellData Scalars="U">\n'
         f'        <DataArray type="{vtk_type}" Name="U" format="appended" '
         f'offset="{offsets[0]}"/>\n'
@@ -73,20 +91,183 @@ def write_vti(path: str, L: int, step: int, u: np.ndarray, v: np.ndarray) -> Non
     os.replace(tmp, path)
 
 
+_NP_TYPES = {v: k for k, v in _VTK_TYPES.items()}
+
+
+def read_vti(path: str):
+    """Read back a :func:`write_vti` file -> ``(extent, {"U": a, "V": a})``.
+
+    Parses exactly the subset this module writes (appended raw encoding,
+    UInt64 headers) — used by tests and the analysis tools to round-trip
+    visualization output without a VTK dependency.
+    """
+    import re
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    marker = blob.index(b'<AppendedData encoding="raw">_') + len(
+        b'<AppendedData encoding="raw">_'
+    )
+    header = blob[:marker].decode()
+    m = re.search(r'<Piece Extent="([^"]+)"', header)
+    nums = [int(x) for x in m.group(1).split()]
+    extent = tuple((nums[i], nums[i + 1]) for i in (0, 2, 4))
+    shape = tuple(hi - lo for lo, hi in extent)
+    out = {}
+    for am in re.finditer(
+        r'<DataArray type="(\w+)" Name="(\w+)" format="appended" '
+        r'offset="(\d+)"/>', header
+    ):
+        vtk_type, name, off = am.group(1), am.group(2), int(am.group(3))
+        dtype = np.dtype(_NP_TYPES[vtk_type])
+        (nbytes,) = struct.unpack_from("<Q", blob, marker + off)
+        arr = np.frombuffer(
+            blob, dtype=dtype, count=nbytes // dtype.itemsize,
+            offset=marker + off + 8,
+        )
+        # stored x-fastest (VTK flat order); back to C-order [x, y, z]
+        out[name] = arr.reshape(shape[::-1]).transpose(2, 1, 0)
+    return extent, out
+
+
+class PvtiSeriesWriter:
+    """Parallel time series: per-block ``.vti`` pieces + a ``.pvti``
+    index per step + a ``.pvd`` collection — ParaView opens the ``.pvd``
+    and assembles pieces itself.
+
+    The multi-host output path: every process writes only the pieces it
+    owns (no gather, no cross-process coordination); writer 0 also
+    writes the ``.pvti``/``.pvd`` indexes, which it can do without
+    communication because the block decomposition is global static data
+    (``CartDomain``). This restores the reference's "ParaView reads the
+    output" property (``IO.jl:123-163``) for pod runs.
+
+    Known window: writer 0 publishes a step's ``.pvti`` after writing
+    its *own* pieces; a peer still flushing (or crashed mid-step) leaves
+    the index referencing not-yet-present piece files until it catches
+    up. Same semantics as the BP store's per-writer metadata — readers
+    that need all-writers-committed steps should follow the BP store
+    (whose merge enforces exactly that) and use the ``.pvti`` for
+    visualization.
+    """
+
+    def __init__(
+        self,
+        output_name: str,
+        domain,
+        dtype,
+        *,
+        writer_id: int = 0,
+        append: bool = False,
+        max_step=None,
+    ):
+        base = output_name[:-3] if output_name.endswith(".bp") else output_name
+        self.dir = base + ".vtk"
+        self.domain = domain
+        self.L = domain.L
+        self.writer_id = writer_id
+        dtype = np.dtype(dtype)
+        if dtype.name not in _VTK_TYPES:
+            dtype = np.dtype(np.float32)  # bf16 pieces are widened
+        self._vtk_type = _VTK_TYPES[dtype.name]
+        os.makedirs(self.dir, exist_ok=True)
+        self._entries = _scan_series(
+            self.dir, ".pvti", max_step
+        ) if append and writer_id == 0 else []
+        self._pvd_path = os.path.join(self.dir, "series.pvd")
+
+    @staticmethod
+    def _piece_name(step: int, offsets) -> str:
+        return f"step_{step:07d}_b{'_'.join(str(o) for o in offsets)}.vti"
+
+    def write(self, step: int, blocks) -> None:
+        """Write this process's ``(offsets, sizes, u, v)`` blocks as
+        pieces; writer 0 also publishes the step's ``.pvti`` index."""
+        for offsets, sizes, ub, vb in blocks:
+            extent = tuple(
+                (o, o + s) for o, s in zip(offsets, sizes)
+            )
+            write_vti(
+                os.path.join(self.dir, self._piece_name(step, offsets)),
+                self.L, step, ub, vb, extent=extent,
+            )
+        if self.writer_id == 0:
+            self._write_pvti(step)
+
+    def _write_pvti(self, step: int) -> None:
+        whole = _extent_str(((0, self.L),) * 3)
+        lines = [
+            '<?xml version="1.0"?>',
+            '<VTKFile type="PImageData" version="0.1" '
+            'byte_order="LittleEndian">',
+            f'  <PImageData WholeExtent="{whole}" GhostLevel="0" '
+            'Origin="0 0 0" Spacing="1 1 1">',
+            '    <PCellData Scalars="U">',
+            f'      <PDataArray type="{self._vtk_type}" Name="U"/>',
+            f'      <PDataArray type="{self._vtk_type}" Name="V"/>',
+            "    </PCellData>",
+        ]
+        # Every block of the global decomposition, regardless of which
+        # process writes it — the decomposition is global static data.
+        for rank in range(self.domain.n_blocks):
+            coords = self.domain.coords(rank)
+            offsets = self.domain.proc_offsets(coords)
+            sizes = self.domain.proc_sizes(coords)
+            ext = _extent_str(
+                tuple((o, o + s) for o, s in zip(offsets, sizes))
+            )
+            name = self._piece_name(step, offsets)
+            lines.append(
+                f'    <Piece Extent="{ext}" '
+                f'Source="{saxutils.escape(name)}"/>'
+            )
+        lines += ["  </PImageData>", "</VTKFile>", ""]
+        name = f"step_{step:07d}.pvti"
+        tmp = os.path.join(self.dir, name + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines))
+        os.replace(tmp, os.path.join(self.dir, name))
+        self._entries.append((step, name))
+        self._flush_pvd()
+
+    def _flush_pvd(self) -> None:
+        _write_pvd(self._pvd_path, self._entries)
+
+    def close(self) -> None:
+        if self.writer_id == 0:
+            self._flush_pvd()
+
+
+def _scan_series(directory: str, suffix: str, max_step) -> list:
+    """(step, name) entries of existing ``step_<n><suffix>`` files,
+    skipping anything that is not a plain series frame (e.g. ``.pvti``
+    indexes and ``_b*``-suffixed piece files share the directory), and —
+    after a rollback — anything past ``max_step``."""
+    entries = []
+    for name in sorted(os.listdir(directory)):
+        stem = name[5:-len(suffix)]
+        if not (name.startswith("step_") and name.endswith(suffix)
+                and stem.isdigit()):
+            continue
+        if max_step is not None and int(stem) > max_step:
+            continue
+        entries.append((int(stem), name))
+    return entries
+
+
 class VtiSeriesWriter:
     """Time series of .vti files with a .pvd collection index."""
 
-    def __init__(self, output_name: str, L: int, *, append: bool = False):
+    def __init__(
+        self, output_name: str, L: int, *, append: bool = False,
+        max_step=None,
+    ):
         base = output_name[:-3] if output_name.endswith(".bp") else output_name
         self.dir = base + ".vtk"
         self.L = L
         os.makedirs(self.dir, exist_ok=True)
-        self._entries = []
-        if append:
-            # restart: keep pre-restart frames in the series index
-            for name in sorted(os.listdir(self.dir)):
-                if name.startswith("step_") and name.endswith(".vti"):
-                    self._entries.append((int(name[5:-4]), name))
+        # restart: keep pre-restart frames in the series index
+        self._entries = _scan_series(self.dir, ".vti", max_step) if append else []
         self._pvd_path = os.path.join(self.dir, "series.pvd")
 
     def write(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
@@ -96,22 +277,27 @@ class VtiSeriesWriter:
         self._flush_pvd()
 
     def _flush_pvd(self) -> None:
-        lines = [
-            '<?xml version="1.0"?>',
-            '<VTKFile type="Collection" version="0.1" '
-            'byte_order="LittleEndian">',
-            "  <Collection>",
-        ]
-        for step, name in self._entries:
-            lines.append(
-                f'    <DataSet timestep="{step}" part="0" '
-                f'file="{saxutils.escape(name)}"/>'
-            )
-        lines += ["  </Collection>", "</VTKFile>", ""]
-        tmp = self._pvd_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write("\n".join(lines))
-        os.replace(tmp, self._pvd_path)
+        _write_pvd(self._pvd_path, self._entries)
 
     def close(self) -> None:
         self._flush_pvd()
+
+
+def _write_pvd(pvd_path: str, entries) -> None:
+    """Atomic ``.pvd`` collection index over (step, file) entries."""
+    lines = [
+        '<?xml version="1.0"?>',
+        '<VTKFile type="Collection" version="0.1" '
+        'byte_order="LittleEndian">',
+        "  <Collection>",
+    ]
+    for step, name in entries:
+        lines.append(
+            f'    <DataSet timestep="{step}" part="0" '
+            f'file="{saxutils.escape(name)}"/>'
+        )
+    lines += ["  </Collection>", "</VTKFile>", ""]
+    tmp = pvd_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines))
+    os.replace(tmp, pvd_path)

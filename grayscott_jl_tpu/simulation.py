@@ -48,6 +48,49 @@ from .parallel.domain import CartDomain
 AXIS_NAMES = ("x", "y", "z")
 
 
+#: Platforms this process has already reached successfully — skips the
+#: bounded subprocess probe on subsequent Simulation constructions.
+_reached_platforms: set = set()
+
+
+def _bounded_tpu_probe(timeout: float) -> Optional[str]:
+    """Probe TPU reachability in a subprocess with a hard wall-clock
+    bound; returns an error string, or None when the chip answered.
+
+    Initializing a remote-tunnel PJRT client ("axon"-style platforms)
+    blocks *indefinitely* when no chip grant is available; probing
+    out-of-process keeps this process un-wedged and able to report a
+    clear error. SIGTERM before SIGKILL — a SIGKILLed PJRT client can
+    wedge the grant server-side.
+    """
+    import subprocess
+    import sys
+
+    src = (
+        "import jax, jax.numpy as jnp;"
+        "jax.devices('tpu');"
+        "print('GSPROBE-OK', float(jnp.ones((8, 8)).sum()))"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", src],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return f"TPU probe timed out after {timeout:.0f}s (tunnel wedged?)"
+    if "GSPROBE-OK" in out:
+        return None
+    tail = err.strip().splitlines()[-1] if err.strip() else "no output"
+    return f"TPU probe failed (rc={proc.returncode}): {tail}"
+
+
 def select_devices(platform: str):
     """Devices of the requested platform (reference backend dispatch analog).
 
@@ -55,19 +98,48 @@ def select_devices(platform: str):
     device query: initializing *all* registered backends would create the
     TPU-tunnel client too, which blocks when no chip grant is available —
     a CPU-only run must never depend on the accelerator being reachable.
+
+    For TPU runs an unreachable chip must fail in seconds with a clear
+    error, not hang ``Simulation.__init__`` forever: the first TPU
+    construction in a process runs a bounded out-of-process probe
+    (``GS_TPU_PROBE_TIMEOUT`` seconds, default 60; ``0`` disables, e.g.
+    when a parent process already probed).
     """
-    if platform == "cpu":
+    import os
+
+    if platform == "cpu" and "cpu" not in _reached_platforms:
         try:
             jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass  # backends already initialized; keep current platforms
+        except RuntimeError as e:
+            # Backends are already initialized, so the pin is a no-op.
+            # Only safe to continue if CPU devices are in fact reachable —
+            # the jax.devices() below verifies exactly that; say why.
+            import sys
+
+            print(
+                f"gray-scott: note: platform pin to cpu was too late ({e}); "
+                "continuing with already-initialized backends",
+                file=sys.stderr,
+            )
+    elif platform == "tpu" and platform not in _reached_platforms:
+        timeout = float(os.environ.get("GS_TPU_PROBE_TIMEOUT", "60"))
+        if timeout > 0:
+            probe_err = _bounded_tpu_probe(timeout)
+            if probe_err is not None:
+                raise RuntimeError(
+                    f"Backend 'TPU' requested but the chip is not "
+                    f"reachable: {probe_err}. Retry later, or set "
+                    "GS_TPU_PROBE_TIMEOUT=0 to dial without the guard."
+                )
     try:
-        return jax.devices(platform)
+        devices = jax.devices(platform)
     except RuntimeError as e:
         raise RuntimeError(
             f"Backend {platform!r} requested in config but no such JAX "
             f"devices are available: {e}"
         ) from e
+    _reached_platforms.add(platform)
+    return devices
 
 
 class Simulation:

@@ -35,6 +35,7 @@ checkpoint_freq = {checkpoint_freq}
 checkpoint_output = "{checkpoint_output}"
 restart = {restart}
 restart_input = "{restart_input}"
+restart_step = {restart_step}
 mesh_type = "{mesh_type}"
 precision = "Float32"
 backend = "CPU"
@@ -52,6 +53,7 @@ def write_config(tmp_path, name="config.toml", **kw):
         checkpoint_output="ckpt.bp",
         restart="false",
         restart_input="ckpt.bp",
+        restart_step=-1,
         mesh_type="image",
         kernel_language="Plain",
     )
@@ -137,27 +139,15 @@ def test_checkpoint_and_restart_reproduce_trajectory(tmp_path):
     ck = BpReader(str(part_dir / "ckpt.bp"))
     assert ck.num_steps() == 2  # steps 20 and 40
 
+    # Restart from the step-20 checkpoint (not the latest, step 40) via
+    # the restart_step knob — the operator-facing way to roll a run back.
     cfg2 = write_config(
         part_dir, "phase2.toml", noise=0.1, output="p2.bp",
-        restart="true", restart_input="ckpt.bp",
-    )
-    # restart from the step-20 checkpoint: rewrite ckpt store to first entry?
-    # No — restart loads the *latest* checkpoint (step 40) and the run ends
-    # immediately at steps=40. Use a fresh store truncated at step 20 instead.
-    import json, shutil
-
-    trunc = part_dir / "ckpt20.bp"
-    shutil.copytree(part_dir / "ckpt.bp", trunc)
-    md = json.loads((trunc / "md.json").read_text())
-    md["steps"] = md["steps"][:1]
-    (trunc / "md.json").write_text(json.dumps(md))
-    cfg2 = write_config(
-        part_dir, "phase2.toml", noise=0.1, output="p2.bp",
-        restart="true", restart_input="ckpt20.bp",
+        restart="true", restart_input="ckpt.bp", restart_step=20,
     )
     res = run_cli(part_dir, cfg2)
     assert res.returncode == 0, res.stderr
-    assert "Restarted from ckpt20.bp at step 20" in res.stdout
+    assert "Restarted from ckpt.bp at step 20" in res.stdout
 
     full = BpReader(str(full_dir / "full.bp"))
     resumed = BpReader(str(part_dir / "p2.bp"))
@@ -170,6 +160,48 @@ def test_checkpoint_and_restart_reproduce_trajectory(tmp_path):
     vf = full.get("V", step=nf - 1)
     vr = resumed.get("V", step=nr - 1)
     np.testing.assert_array_equal(vf, vr)
+
+
+def test_rollback_restart_truncates_stale_trajectory(tmp_path):
+    """Rolling back (restart_step earlier than the last run's end) while
+    reusing the SAME output and checkpoint stores must drop the
+    abandoned trajectory's later entries — no duplicate steps, and the
+    resumed trajectory bit-matches an uninterrupted run."""
+    cfg1 = write_config(
+        tmp_path, "p1.toml", noise=0.1, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(tmp_path, cfg1).returncode == 0
+
+    cfg2 = write_config(
+        tmp_path, "p2.toml", noise=0.1, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+        restart="true", restart_input="ckpt.bp", restart_step=20,
+    )
+    res = run_cli(tmp_path, cfg2)
+    assert res.returncode == 0, res.stderr
+
+    r = BpReader(str(tmp_path / "gs.bp"))
+    steps_seen = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+    assert steps_seen == [10, 20, 30, 40]  # no stale 30/40 duplicates
+    ck = BpReader(str(tmp_path / "ckpt.bp"))
+    ck_steps = [int(ck.get("step", step=i)) for i in range(ck.num_steps())]
+    assert ck_steps == [20, 40]
+
+    # VTK series index also rolled back + re-extended without duplicates
+    pvd = (tmp_path / "gs.vtk" / "series.pvd").read_text()
+    assert pvd.count('file="step_0000040.vti"') == 1
+
+    # bit-match against an uninterrupted run
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    cfg = write_config(full_dir, noise=0.1, output="full.bp")
+    assert run_cli(full_dir, cfg).returncode == 0
+    rf = BpReader(str(full_dir / "full.bp"))
+    np.testing.assert_array_equal(
+        rf.get("U", step=rf.num_steps() - 1),
+        r.get("U", step=r.num_steps() - 1),
+    )
 
 
 def test_restart_appends_to_checkpoint_store(tmp_path):

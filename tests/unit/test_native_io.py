@@ -135,3 +135,69 @@ def test_factory_selects_native_and_env_override(tmp_path, monkeypatch):
     w = open_writer(str(tmp_path / "b.bp"))
     assert isinstance(w, BpWriter)
     w.close()
+
+
+def test_native_multiwriter_store(tmp_path):
+    """Two native writers, private payloads + per-writer metadata; the
+    reader merges blocks per step and sees completion only when all
+    writers closed — the pod-scale output layout on the async engine."""
+    path = str(tmp_path / "mw.bp")
+    L = 8
+    w0 = native.NativeBpWriter(path, writer_id=0, nwriters=2)
+    w1 = native.NativeBpWriter(path, writer_id=1, nwriters=2)
+    for w in (w0, w1):
+        w.define_variable("step", np.int32)
+        w.define_variable("U", np.float32, (L, L, L))
+    w0.define_attribute("F", 0.02)
+
+    rng = np.random.default_rng(1)
+    full = [rng.random((L, L, L)).astype(np.float32) for _ in range(3)]
+    for s, f in enumerate(full):
+        for w, lo in ((w0, 0), (w1, L // 2)):
+            w.begin_step()
+            w.put("step", np.int32(s))
+            w.put(
+                "U", f[lo:lo + L // 2],
+                start=(lo, 0, 0), count=(L // 2, L, L),
+            )
+            w.end_step()
+    w0.drain()
+    w1.drain()
+
+    # both metadata files exist (no shared-file contention)
+    assert (tmp_path / "mw.bp" / "md.json").exists()
+    assert (tmp_path / "mw.bp" / "md.1.json").exists()
+
+    r = BpReader(path)
+    assert r.num_steps() == 3
+    for s, f in enumerate(full):
+        np.testing.assert_array_equal(r.get("U", step=s), f)
+    assert r.attributes()["F"] == 0.02
+
+    # stream completes only once every writer closed
+    assert not r._md["complete"]
+    w0.close()
+    w1.close()
+    r2 = BpReader(path)
+    assert r2._md["complete"]
+
+
+def test_native_multiwriter_interops_with_python_engine(tmp_path):
+    """Mixed engines on one store (native writer 0, Python writer 1) —
+    the format contract, not the engine, defines the layout."""
+    path = str(tmp_path / "mixed.bp")
+    w0 = native.NativeBpWriter(path, writer_id=0, nwriters=2)
+    w1 = BpWriter(path, writer_id=1, nwriters=2)
+    for w in (w0, w1):
+        w.define_variable("x", np.float32, (4,))
+    for w, lo in ((w0, 0), (w1, 2)):
+        w.begin_step()
+        w.put("x", np.arange(lo, lo + 2, dtype=np.float32),
+              start=(lo,), count=(2,))
+        w.end_step()
+    w0.close()
+    w1.close()
+    r = BpReader(path)
+    np.testing.assert_array_equal(
+        r.get("x", step=0), np.arange(4, dtype=np.float32)
+    )

@@ -116,20 +116,21 @@ def test_pdfcalc_streams_from_live_writer(tmp_path):
 def test_pdfcalc_worker_split_covers_volume(tmp_path):
     w = _write_sim_store(tmp_path / "sim.bp", nsteps=1)
     w.close()
-    # two workers write disjoint x-ranges into separate stores' blocks
+    # two workers write disjoint x-ranges into ONE shared multi-writer
+    # store (the reference's MPI-parallel pdfcalc output layout)
     for rank in range(2):
         read_data_write_pdf(
             str(tmp_path / "sim.bp"),
-            str(tmp_path / f"pdf{rank}.bp"),
+            str(tmp_path / "pdf.bp"),
             nbins=8,
             rank=rank,
             size=2,
         )
-    r0 = BpReader(str(tmp_path / "pdf0.bp"))
-    r0.begin_step(timeout=0)
-    r0.set_selection("U/pdf", (0, 0), (4, 8))
-    top = r0.get("U/pdf")
-    assert int(top.sum()) == 4 * 8 * 8
+    r = BpReader(str(tmp_path / "pdf.bp"))
+    r.begin_step(timeout=0)
+    full = r.get("U/pdf")  # merged across both workers' blocks
+    assert full.shape == (8, 8)
+    assert int(full.sum()) == 8 * 8 * 8  # every cell counted exactly once
 
 
 def test_write_inputdata_passthrough(tmp_path):

@@ -1,0 +1,60 @@
+"""Device-selection guard rails.
+
+A wedged accelerator tunnel must fail ``Simulation.__init__`` in seconds
+with a clear error instead of hanging the process (the round-1 failure
+mode that cost both driver gates their results).
+"""
+
+import pytest
+
+from grayscott_jl_tpu import simulation
+from grayscott_jl_tpu.config.settings import Settings
+
+
+def _settings(backend):
+    return Settings(
+        L=16, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.0,
+        precision="Float32", backend=backend,
+    )
+
+
+def test_missing_platform_is_a_clear_error():
+    with pytest.raises(RuntimeError, match="no such JAX devices"):
+        simulation.Simulation(_settings("CUDA"), n_devices=1)
+
+
+def test_unreachable_tpu_fails_fast_with_probe_error(monkeypatch):
+    monkeypatch.setattr(
+        simulation, "_bounded_tpu_probe",
+        lambda timeout: "TPU probe timed out after 60s (tunnel wedged?)",
+    )
+    monkeypatch.setattr(simulation, "_reached_platforms", set())
+    monkeypatch.delenv("GS_TPU_PROBE_TIMEOUT", raising=False)
+    with pytest.raises(RuntimeError, match="not reachable.*timed out"):
+        simulation.Simulation(_settings("TPU"), n_devices=1)
+
+
+def test_probe_can_be_disabled(monkeypatch):
+    """GS_TPU_PROBE_TIMEOUT=0 skips the guard (parent already probed);
+    the direct device query then reports the missing platform."""
+    def boom(timeout):  # pragma: no cover - must not be called
+        raise AssertionError("probe ran despite GS_TPU_PROBE_TIMEOUT=0")
+
+    monkeypatch.setattr(simulation, "_bounded_tpu_probe", boom)
+    monkeypatch.setattr(simulation, "_reached_platforms", set())
+    monkeypatch.setenv("GS_TPU_PROBE_TIMEOUT", "0")
+    with pytest.raises(RuntimeError, match="no such JAX devices"):
+        simulation.Simulation(_settings("TPU"), n_devices=1)
+
+
+def test_reached_platform_skips_probe(monkeypatch):
+    """A platform that already answered once is not re-probed."""
+    calls = []
+    monkeypatch.setattr(
+        simulation, "_bounded_tpu_probe",
+        lambda timeout: calls.append(timeout) or None,
+    )
+    monkeypatch.setattr(simulation, "_reached_platforms", {"cpu"})
+    sim = simulation.Simulation(_settings("CPU"), n_devices=1)
+    sim.iterate(1)
+    assert calls == []
