@@ -248,9 +248,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 - six * c
             ) / six
 
-        def euler(u_win, v_win, u_edges, v_edges):
-            """One noiseless explicit-Euler update of the window
-            interior; noise is added per-plane by the caller."""
+        def euler_terms(u_win, v_win, u_edges, v_edges):
+            """Rate terms (u_c, du, v_c, dv) of the window interior —
+            noise joins ``du`` *before* the dt multiply, per-plane in the
+            caller, in exactly the XLA kernel's operation order
+            (``stencil.reaction_update``) so the two kernel languages
+            agree to float roundoff even with noise on."""
             n = u_win.shape[0] - 2
             u_c = u_win[1:n + 1]
             v_c = v_win[1:n + 1]
@@ -259,14 +262,14 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             uvv = u_c * v_c * v_c
             du = Du * lap_u - uvv + F * (one - u_c)
             dv = Dv * lap_v + uvv - (F + K) * v_c
-            return u_c + du * dt, v_c + dv * dt
+            return u_c, du, v_c, dv
 
         def noise_plane(step_idx, g):
-            """Pre-scaled noise*dt plane for absolute step / local
-            x-plane ``g``; global coordinates come from seeds[3:7]."""
+            """Pre-scaled ``noise * U(-1,1)`` plane for absolute step /
+            local x-plane ``g``; global coordinates from seeds[3:7]."""
             seed = plane_seed(seeds[0], seeds[1], step_idx, seeds[3] + g)
             bits = plane_bits(seed, seeds[4], seeds[5], seeds[6], (ny, nz))
-            return (noise * dt) * _kernel_pm1(bits, dtype)
+            return noise * _kernel_pm1(bits, dtype)
 
         const_edges_u = (u_bv,) * 4
         const_edges_v = (v_bv,) * 4
@@ -282,40 +285,44 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                            rows(v_zlo), rows(v_zhi))
             else:
                 u_edges, v_edges = const_edges_u, const_edges_v
-            u_next, v_next = euler(u_win, v_win, u_edges, v_edges)
+            u_c, du, v_c, dv = euler_terms(u_win, v_win, u_edges, v_edges)
             if use_noise:
                 for j in range(bx):
-                    out_u[slot, j] = u_next[j] + noise_plane(
-                        seeds[2], b * bx + j
-                    )
+                    out_u[slot, j] = u_c[j] + (
+                        du[j] + noise_plane(seeds[2], b * bx + j)
+                    ) * dt
             else:
-                out_u[slot] = u_next
-            out_v[slot] = v_next
+                out_u[slot] = u_c + du * dt
+            out_v[slot] = v_c + dv * dt
 
         def compute2(slot, b):
             # Stage A: step n+1 on the (bx+2)-plane window
             # [b*bx-1, b*bx+bx+1); global-edge ghost planes stay frozen.
             u_win = in_u[slot]
             v_win = in_v[slot]
-            uA, vA = euler(u_win, v_win, const_edges_u, const_edges_v)
+            u_c, du, v_c, dv = euler_terms(
+                u_win, v_win, const_edges_u, const_edges_v
+            )
             for j in range(bx + 2):
                 g = b * bx - 1 + j
                 valid = (g >= 0) & (g < nx)
-                plane_u = uA[j]
+                du_j = du[j]
                 if use_noise:
-                    plane_u = plane_u + noise_plane(seeds[2], g)
-                mid_u[j] = jnp.where(valid, plane_u, u_bv)
-                mid_v[j] = jnp.where(valid, vA[j], v_bv)
+                    du_j = du_j + noise_plane(seeds[2], g)
+                mid_u[j] = jnp.where(valid, u_c[j] + du_j * dt, u_bv)
+                mid_v[j] = jnp.where(valid, v_c[j] + dv[j] * dt, v_bv)
             # Stage B: step n+2 on the bx output planes.
-            uB, vB = euler(mid_u[:], mid_v[:], const_edges_u, const_edges_v)
+            u_c, du, v_c, dv = euler_terms(
+                mid_u[:], mid_v[:], const_edges_u, const_edges_v
+            )
             if use_noise:
                 for j in range(bx):
-                    out_u[slot, j] = uB[j] + noise_plane(
-                        seeds[2] + 1, b * bx + j
-                    )
+                    out_u[slot, j] = u_c[j] + (
+                        du[j] + noise_plane(seeds[2] + 1, b * bx + j)
+                    ) * dt
             else:
-                out_u[slot] = uB
-            out_v[slot] = vB
+                out_u[slot] = u_c + du * dt
+            out_v[slot] = v_c + dv * dt
 
         compute = compute2 if fuse == 2 else compute1
 

@@ -1,0 +1,266 @@
+"""Halo-amortized temporal pairing for the sharded Pallas path.
+
+The fused Pallas kernel (``ops/pallas_stencil.py``) reads interior-shaped
+blocks plus 1-thick resolved halo faces; a sharded run therefore pays one
+6-``ppermute`` exchange per step. This module halves that: ONE 2-deep
+ghost exchange feeds TWO kernel steps —
+
+1. :func:`exchange_wide_faces` delivers 2-deep ghost slabs (with the
+   edge/corner data deep stencils need, via the sequential
+   axis-by-axis corner-propagation ordering) **without materializing a
+   padded block** — slab-level concats only, so the kernel keeps its
+   no-ghost-pad HBM layout;
+2. step n+1 runs the kernel with the inner ghost planes as faces;
+3. :func:`ring_faces` recomputes, *locally and in XLA*, the 1-plane ring
+   of step-(n+1) values owned by each neighbor — O(n^2) work on slab
+   windows assembled from the wide ghosts. Position-keyed noise
+   (``ops/noise.py``) makes the recomputed values identical to what the
+   neighbor computed;
+4. step n+2 runs the kernel with that ring as its faces.
+
+Per two steps: one exchange + two kernel HBM passes + O(n^2) ring math,
+vs two exchanges + two passes for step-at-a-time — the amortization the
+reference pays for with ``exchange!`` every step
+(``communication.jl:138-199``). The XLA kernel language amortizes
+differently (extended-window recompute on a width-2 padded block,
+``simulation.py``); both reproduce the step-at-a-time trajectory.
+
+Ghost slab shapes for an (nx, ny, nz) block (2-deep, corner-propagated):
+x: (2, ny, nz); y: (nx+4, 2, nz) — x-extended; z: (nx+4, ny+4, 2) —
+x- and y-extended. Global-edge slabs hold the frozen boundary value.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def exchange_wide_faces(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    axis_names: Tuple[str, str, str],
+    axis_sizes: Tuple[int, int, int],
+):
+    """2-deep ghost slabs for each array; see module docstring.
+
+    Returns, per array, ``((x_lo, x_hi), (y_lo, y_hi), (z_lo, z_hi))``.
+    Must be called inside ``shard_map``.
+    """
+    arrays = list(arrays)
+    n_arr = len(arrays)
+    ghosts = [[] for _ in arrays]
+
+    def ext_slab(i, dim, lo_take):
+        """Width-2 boundary slab of array ``i`` along ``dim``, extended
+        with the already-received ghosts of axes < dim (that inclusion
+        is what propagates edge/corner data)."""
+
+        def slab(x):
+            sl = [slice(None)] * 3
+            sl[dim] = slice(0, 2) if lo_take else slice(-2, None)
+            return x[tuple(sl)]
+
+        core = slab(arrays[i])
+        for d2 in range(dim):
+            lo2, hi2 = ghosts[i][d2]
+            core = jnp.concatenate([slab(lo2), core, slab(hi2)], axis=d2)
+        return core
+
+    for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
+        sends_up = [ext_slab(i, dim, lo_take=False) for i in range(n_arr)]
+        sends_dn = [ext_slab(i, dim, lo_take=True) for i in range(n_arr)]
+        if n == 1:
+            for i, bv in enumerate(boundary_values):
+                bvt = jnp.asarray(bv, arrays[i].dtype)
+                shape = sends_up[i].shape
+                f = jnp.full(shape, bvt)
+                ghosts[i].append((f, f))
+            continue
+        idx = lax.axis_index(ax)
+        up_perm = [(r, r + 1) for r in range(n - 1)]
+        dn_perm = [(r + 1, r) for r in range(n - 1)]
+        recv_lo = lax.ppermute(
+            jnp.concatenate(sends_up, axis=dim), ax, up_perm
+        )
+        recv_hi = lax.ppermute(
+            jnp.concatenate(sends_dn, axis=dim), ax, dn_perm
+        )
+        lo_slabs = jnp.split(recv_lo, n_arr, axis=dim)
+        hi_slabs = jnp.split(recv_hi, n_arr, axis=dim)
+        for i, bv in enumerate(boundary_values):
+            bvt = jnp.asarray(bv, arrays[i].dtype)
+            lo = jnp.where(idx > 0, lo_slabs[i], bvt)
+            hi = jnp.where(idx < n - 1, hi_slabs[i], bvt)
+            ghosts[i].append((lo, hi))
+
+    return ghosts
+
+
+def inner_faces(gu, gv):
+    """The 1-thick resolved faces for the FIRST kernel step, sliced from
+    the wide ghosts — the plane adjacent to the block (x=-1 is index 1 of
+    the 2-deep lo slab; x=nx is index 0 of the hi slab). Order matches
+    ``ops/pallas_stencil.fused_step``."""
+    (uxl, uxh), (uyl, uyh), (uzl, uzh) = gu
+    (vxl, vxh), (vyl, vyh), (vzl, vzh) = gv
+    return (
+        uxl[1:2], uxh[0:1], vxl[1:2], vxh[0:1],
+        uyl[2:-2, 1:2, :], uyh[2:-2, 0:1, :],
+        vyl[2:-2, 1:2, :], vyh[2:-2, 0:1, :],
+        uzl[2:-2, 2:-2, 1:2], uzh[2:-2, 2:-2, 0:1],
+        vzl[2:-2, 2:-2, 1:2], vzh[2:-2, 2:-2, 0:1],
+    )
+
+
+def _windows(a, g, ny, nz, nx):
+    """Per-direction stencil windows around the block's six ghost ring
+    planes, assembled from block ``a`` and its wide ghosts ``g``.
+
+    Index maps (x-lo as the worked example; the rest are mirrors):
+    the ring plane x=-1 needs inputs x∈{-2,-1,0}, y∈[-1,ny+1),
+    z∈[-1,nz+1). x∈{-2,-1} comes from the x-lo slab, x=0 from the block;
+    the y borders at those x come from the y slabs (x-extended: global
+    x=-2 is index 0), the z borders from the z slabs (x- and
+    y-extended: global x=-2 index 0, global y=-1 index 1).
+    """
+    (x_lo, x_hi), (y_lo, y_hi), (z_lo, z_hi) = g
+    cat = jnp.concatenate
+
+    def xdir(core, xsl):
+        w = cat([y_lo[xsl, 1:2, :], core, y_hi[xsl, 0:1, :]], axis=1)
+        return cat(
+            [z_lo[xsl, 1:ny + 3, 1:2], w, z_hi[xsl, 1:ny + 3, 0:1]],
+            axis=2,
+        )
+
+    def ydir(core, ysl_lo, ysl_hi, xb_lo, xb_hi):
+        w = cat([xb_lo, core, xb_hi], axis=0)
+        return cat(
+            [z_lo[1:nx + 3, ysl_lo, 1:2], w, z_hi[1:nx + 3, ysl_hi, 0:1]],
+            axis=2,
+        )
+
+    return {
+        "x_lo": xdir(cat([x_lo, a[0:1]], axis=0), slice(0, 3)),
+        "x_hi": xdir(cat([a[-1:], x_hi], axis=0), slice(-3, None)),
+        "y_lo": ydir(
+            cat([y_lo[2:-2], a[:, 0:1]], axis=1),
+            slice(0, 3), slice(0, 3),
+            cat([y_lo[1:2], x_lo[1:2, 0:1, :]], axis=1),
+            cat([y_lo[-2:-1], x_hi[0:1, 0:1, :]], axis=1),
+        ),
+        "y_hi": ydir(
+            cat([a[:, -1:], y_hi[2:-2]], axis=1),
+            slice(-3, None), slice(-3, None),
+            cat([x_lo[1:2, -1:, :], y_hi[1:2]], axis=1),
+            cat([x_hi[0:1, -1:, :], y_hi[-2:-1]], axis=1),
+        ),
+        "z_lo": cat(
+            [
+                cat(
+                    [z_lo[1:nx + 3, 1:2, :],
+                     y_lo[1:nx + 3, 1:2, 0:1]], axis=2
+                ),
+                cat(
+                    [
+                        cat([z_lo[1:2, 2:-2, :],
+                             x_lo[1:2, :, 0:1]], axis=2),
+                        cat([z_lo[2:-2, 2:-2, :], a[:, :, 0:1]], axis=2),
+                        cat([z_lo[-2:-1, 2:-2, :],
+                             x_hi[0:1, :, 0:1]], axis=2),
+                    ],
+                    axis=0,
+                ),
+                cat(
+                    [z_lo[1:nx + 3, -2:-1, :],
+                     y_hi[1:nx + 3, 0:1, 0:1]], axis=2
+                ),
+            ],
+            axis=1,
+        ),
+        "z_hi": cat(
+            [
+                cat(
+                    [y_lo[1:nx + 3, 1:2, -1:],
+                     z_hi[1:nx + 3, 1:2, :]], axis=2
+                ),
+                cat(
+                    [
+                        cat([x_lo[1:2, :, -1:],
+                             z_hi[1:2, 2:-2, :]], axis=2),
+                        cat([a[:, :, -1:], z_hi[2:-2, 2:-2, :]], axis=2),
+                        cat([x_hi[0:1, :, -1:],
+                             z_hi[-2:-1, 2:-2, :]], axis=2),
+                    ],
+                    axis=0,
+                ),
+                cat(
+                    [y_hi[1:nx + 3, 0:1, -1:],
+                     z_hi[1:nx + 3, -2:-1, :]], axis=2
+                ),
+            ],
+            axis=1,
+        ),
+    }
+
+
+def ring_faces(
+    u, v, gu, gv, params, *, step, offs, L, use_noise, unit_noise,
+    axis_names, axis_sizes, boundaries,
+):
+    """Step-(n+1) values on the six neighbor-adjacent ring planes,
+    recomputed locally from the wide ghosts — the faces for the SECOND
+    kernel step. On a global edge the ring is the frozen boundary value.
+
+    ``unit_noise(step, offsets, shape)`` must draw from the same
+    position-keyed stream as the kernel; that is what makes the local
+    recomputation reproduce the neighbor's computation exactly.
+    """
+    from ..ops import stencil
+
+    nx, ny, nz = u.shape
+    wu = _windows(u, gu, ny, nz, nx)
+    wv = _windows(v, gv, ny, nz, nx)
+    u_bv, v_bv = boundaries
+
+    ring_offsets = {
+        "x_lo": (offs[0] - 1, offs[1], offs[2]),
+        "x_hi": (offs[0] + nx, offs[1], offs[2]),
+        "y_lo": (offs[0], offs[1] - 1, offs[2]),
+        "y_hi": (offs[0], offs[1] + ny, offs[2]),
+        "z_lo": (offs[0], offs[1], offs[2] - 1),
+        "z_hi": (offs[0], offs[1], offs[2] + nz),
+    }
+    has_nbr = {
+        "x_lo": lax.axis_index(axis_names[0]) > 0,
+        "x_hi": lax.axis_index(axis_names[0]) < axis_sizes[0] - 1,
+        "y_lo": lax.axis_index(axis_names[1]) > 0,
+        "y_hi": lax.axis_index(axis_names[1]) < axis_sizes[1] - 1,
+        "z_lo": lax.axis_index(axis_names[2]) > 0,
+        "z_hi": lax.axis_index(axis_names[2]) < axis_sizes[2] - 1,
+    }
+
+    rings = {}
+    for d in ("x_lo", "x_hi", "y_lo", "y_hi", "z_lo", "z_hi"):
+        shape = tuple(s - 2 for s in wu[d].shape)
+        if use_noise:
+            nz_ring = params.noise * unit_noise(step, ring_offsets[d], shape)
+        else:
+            nz_ring = jnp.asarray(0.0, u.dtype)
+        ru, rv = stencil.reaction_update(wu[d], wv[d], nz_ring, params)
+        rings[d] = (
+            jnp.where(has_nbr[d], ru, jnp.asarray(u_bv, u.dtype)),
+            jnp.where(has_nbr[d], rv, jnp.asarray(v_bv, v.dtype)),
+        )
+
+    return (
+        rings["x_lo"][0], rings["x_hi"][0],
+        rings["x_lo"][1], rings["x_hi"][1],
+        rings["y_lo"][0], rings["y_hi"][0],
+        rings["y_lo"][1], rings["y_hi"][1],
+        rings["z_lo"][0], rings["z_hi"][0],
+        rings["z_lo"][1], rings["z_hi"][1],
+    )

@@ -251,8 +251,14 @@ class Simulation:
         else:
             offs = jnp.zeros((3,), jnp.int32)
 
+        def unit_noise(step_idx, offsets, shape):
+            return noise_ops.uniform_pm1_block(
+                key_i32, step_idx, offsets, shape, L, u.dtype
+            )
+
         if self.kernel_language == "pallas":
             from .ops import pallas_stencil
+            from .parallel import temporal
 
             def step_seeds(step_idx):
                 return jnp.stack(
@@ -263,23 +269,55 @@ class Simulation:
             # (global interpreter state) — sharded CPU runs take the XLA
             # fallback inside fused_step; real TPU runs the fused kernel.
             allow_interpret = not sharded
-            # Temporal blocking (2 steps per HBM pass) on single-block
-            # runs; the noise stream is keyed on absolute (step, cell),
-            # so fusion/chunking does not change the trajectory. Sharded
-            # runs exchange faces per step (fuse=1): the in-kernel
-            # wide-halo fuse is a recorded future lever, pending hardware
-            # evidence that sharded runs are exchange-bound.
-            fuse = 2 if (not sharded and nsteps >= 2) else 1
+
+            def kernel_step(u, v, step_idx, faces):
+                return pallas_stencil.fused_step(
+                    u, v, params, step_seeds(step_idx), faces,
+                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    fuse=1, offsets=offs, row=L,
+                )
+
+            if sharded:
+                # Halo-amortized pairing: ONE 2-deep exchange feeds two
+                # kernel steps (step n+2's faces are step n+1 ring
+                # values recomputed locally from the wide ghosts) —
+                # exchange count halves vs step-at-a-time
+                # (``parallel/temporal.py``).
+                def pair_body(i, carry):
+                    u, v = carry
+                    step = step0 + 2 * i
+                    gu, gv = temporal.exchange_wide_faces(
+                        (u, v), boundaries, AXIS_NAMES, dims
+                    )
+                    u1, v1 = kernel_step(
+                        u, v, step, temporal.inner_faces(gu, gv)
+                    )
+                    faces2 = temporal.ring_faces(
+                        u, v, gu, gv, params, step=step, offs=offs, L=L,
+                        use_noise=use_noise, unit_noise=unit_noise,
+                        axis_names=AXIS_NAMES, axis_sizes=dims,
+                        boundaries=boundaries,
+                    )
+                    return kernel_step(u1, v1, step + 1, faces2)
+
+                pairs, rem = divmod(nsteps, 2) if nsteps >= 2 else (0, nsteps)
+                u, v = lax.fori_loop(0, pairs, pair_body, (u, v))
+                if rem:
+                    faces = halo.exchange_faces(
+                        (u, v), boundaries, AXIS_NAMES, dims
+                    )
+                    u, v = kernel_step(u, v, step0 + 2 * pairs, faces)
+                return u, v
+
+            # Single block: in-kernel temporal blocking (2 steps per HBM
+            # pass); the noise stream is keyed on absolute (step, cell),
+            # so fusion/chunking does not change the trajectory.
+            fuse = 2 if nsteps >= 2 else 1
 
             def body(i, carry):
                 u, v = carry
-                faces = (
-                    halo.exchange_faces((u, v), boundaries, AXIS_NAMES, dims)
-                    if sharded
-                    else None
-                )
                 return pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step0 + fuse * i), faces,
+                    u, v, params, step_seeds(step0 + fuse * i), None,
                     use_noise=use_noise, allow_interpret=allow_interpret,
                     fuse=fuse, offsets=offs, row=L,
                 )
@@ -287,28 +325,14 @@ class Simulation:
             pairs, rem = divmod(nsteps, fuse)
             u, v = lax.fori_loop(0, pairs, body, (u, v))
             if rem:
-                # The remainder step needs its own halo exchange when
-                # sharded — never assume rem>0 implies unsharded (the
-                # implicit chain rem>0 => fuse==2 => not sharded would
-                # silently drop the exchange if fuse rules change).
-                faces = (
-                    halo.exchange_faces((u, v), boundaries, AXIS_NAMES, dims)
-                    if sharded
-                    else None
-                )
                 u, v = pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step0 + fuse * pairs), faces,
+                    u, v, params, step_seeds(step0 + fuse * pairs), None,
                     use_noise=use_noise, allow_interpret=allow_interpret,
                     fuse=1, offsets=offs, row=L,
                 )
             return u, v
 
         # ---- XLA kernel path ----
-
-        def unit_noise(step_idx, offsets, shape):
-            return noise_ops.uniform_pm1_block(
-                key_i32, step_idx, offsets, shape, L, u.dtype
-            )
 
         def single_step(i, carry):
             u, v = carry

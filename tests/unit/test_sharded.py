@@ -52,16 +52,24 @@ def test_sharded_matches_single_device(n_devices, noise):
 @requires8
 @pytest.mark.parametrize("noise", [0.0, 0.1])
 @pytest.mark.parametrize("nsteps", [2, 4, 5])
-def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps):
-    """Sharded runs fuse two steps per width-2 halo exchange (stage A on
-    the +1-extended window, stage B interior). The fused trajectory must
-    equal the step-at-a-time trajectory exactly — including with noise
-    (position-keyed draws make ring recomputation reproduce the
-    neighbor's values), and for odd counts (fuse pairs + one remainder
-    step with its own exchange)."""
+@pytest.mark.parametrize("lang", ["XLA", "Pallas"])
+def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps, lang):
+    """Sharded runs fuse two steps per 2-deep halo exchange — the XLA
+    language via extended-window recompute, the Pallas language via
+    locally recomputed step-(n+1) ring faces (parallel/temporal.py). The
+    fused trajectory must equal the step-at-a-time trajectory exactly —
+    including with noise (position-keyed draws make ring recomputation
+    reproduce the neighbor's values), and for odd counts (pairs + one
+    remainder step with its own exchange)."""
     L = 16
-    fused = Simulation(_settings(L=L, noise=noise), n_devices=8, seed=7)
-    stepwise = Simulation(_settings(L=L, noise=noise), n_devices=8, seed=7)
+    fused = Simulation(
+        _settings(L=L, noise=noise, kernel_language=lang), n_devices=8,
+        seed=7,
+    )
+    stepwise = Simulation(
+        _settings(L=L, noise=noise, kernel_language=lang), n_devices=8,
+        seed=7,
+    )
     fused.iterate(nsteps)
     for _ in range(nsteps):
         stepwise.iterate(1)
