@@ -412,6 +412,12 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
             jax.ShapeDtypeStruct((nx, ny, nz), dtype),
         ],
         scratch_shapes=scratch_shapes,
+        # Mosaic's default scoped-VMEM cap is well below the slab budget;
+        # without an explicit limit L=256 f32 OOMs at kernel-stack
+        # allocation even though the scratch fits physical VMEM.
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET + 16 * 1024 * 1024,
+        ),
         # The TPU-semantics interpreter (not the generic HLO one) models
         # SMEM/semaphores/DMA on CPU for tests. ``detect_races`` is a
         # static jit argument so toggling it cannot be swallowed by the
@@ -471,7 +477,16 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     row = jnp.asarray(nz if row is None else row, jnp.int32)
 
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
+    # Mosaic tiles VMEM as (sublane, 128-lane) over the trailing two dims
+    # and rejects the kernel's sliced scratch views unless the lane dim is
+    # a whole number of tiles (measured on v5e: L=64 f32 fails "Slice
+    # shape along dimension 2 must be aligned to tiling (128)"; L=128
+    # compiles). Unaligned shapes take the XLA kernel, which handles any L.
+    sublane = 16 if dtype == jnp.bfloat16 else 8
+    aligned = nz % 128 == 0 and ny % sublane == 0
     if (dtype == jnp.float64 and on_tpu) or bx == 0 or (
+        on_tpu and not aligned
+    ) or (
         not on_tpu and not allow_interpret
     ):
         if fuse == 2:

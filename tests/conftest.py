@@ -14,13 +14,19 @@ happened yet when conftest runs.
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
+if os.environ.get("GS_TPU_TESTS") == "1":
+    # Explicit hardware-run request: leave the platform alone so the
+    # TPU-gated suite (tests/unit/test_tpu_hardware.py) sees the real
+    # backend. CPU-mesh tests will skip (they need 8 devices).
+    pass
+else:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

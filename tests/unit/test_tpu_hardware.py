@@ -25,7 +25,7 @@ def test_in_kernel_noise_statistics():
     from grayscott_jl_tpu.models import grayscott
     from grayscott_jl_tpu.ops import pallas_stencil
 
-    L, noise = 64, 0.5
+    L, noise = 128, 0.5
     s = Settings(L=L, noise=noise, precision="Float32", backend="TPU",
                  kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
                  dt=1.0)
@@ -64,7 +64,7 @@ def test_mosaic_noise_matches_xla_stream():
     from grayscott_jl_tpu.models import grayscott
     from grayscott_jl_tpu.ops import pallas_stencil
 
-    L = 64
+    L = 128
     s = Settings(L=L, noise=0.5, precision="Float32", backend="TPU",
                  kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
                  dt=1.0)
@@ -94,7 +94,7 @@ def test_temporal_blocking_with_noise_on_hardware():
     from grayscott_jl_tpu.models import grayscott
     from grayscott_jl_tpu.ops import pallas_stencil
 
-    L = 64
+    L = 128
     s = Settings(L=L, noise=0.25, precision="Float32", backend="TPU",
                  kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02, k=0.048,
                  dt=1.0)
@@ -120,7 +120,7 @@ def test_pallas_matches_xla_on_tpu(noise):
     from grayscott_jl_tpu.config.settings import Settings
     from grayscott_jl_tpu.simulation import Simulation
 
-    common = dict(L=64, noise=noise, precision="Float32", backend="TPU",
+    common = dict(L=128, noise=noise, precision="Float32", backend="TPU",
                   Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
     a = Simulation(Settings(kernel_language="Plain", **common), n_devices=1)
     b = Simulation(Settings(kernel_language="Pallas", **common), n_devices=1)
