@@ -26,12 +26,16 @@ cell per step), so the kernel is designed around HBM traffic:
   semantics, ``Simulation_CPU.jl:23-24``); on an interior shard edge it
   substitutes the neighbor face delivered by the ``ppermute`` halo
   exchange (``parallel/halo.exchange_faces``);
-* **temporal blocking** (``fuse=2``, single-block runs): each slab pass
-  advances TWO timesteps — stage A computes step n+1 on a (BX+2)-plane
-  window (recomputing one overlap plane per side), stage B computes step
-  n+2 on the BX output planes — so HBM traffic per *step* drops to
-  ~((BX+4)/BX + 1)/2 passes (~10 bytes/cell at BX=8, f32), below the
-  1-read-1-write "roofline" of any single-step schedule;
+* **temporal blocking** (``fuse=k``, single-block runs): each slab pass
+  advances k timesteps through a chain of shrinking windows — stage s
+  computes step n+1+s on a (BX+2(k-1-s))-plane window, recomputing one
+  overlap plane per side per stage — so HBM traffic per *step* drops to
+  ~((BX+2k)/BX + 1)/k passes (~5 bytes/cell at BX=16, k=4, f32), far
+  below the 1-read-1-write "roofline" of any single-step schedule.
+  Measured on the v5e, the slab DMA pipeline has a hard per-pass
+  envelope (~2 ms at L=256 f32) that is flat in compute content, so
+  per-step time scales ~1/k until the k-fold stage compute fills the
+  envelope (k≈4 at full clock);
 * per-cell uniform noise is generated *inside* the kernel from the
   framework's position-keyed counter-hash stream (``ops/noise.py``),
   keyed on ``(key, absolute step, global cell coordinates)`` — so the
@@ -63,9 +67,32 @@ from jax.experimental.pallas import tpu as pltpu
 from . import stencil
 from .noise import plane_bits, plane_seed, uniform_pm1_block
 
-#: VMEM scratch budget for slab buffers. Per-core VMEM is 64-128 MiB on
-#: v4/v5 hardware; stay well under to leave the compiler headroom.
-_VMEM_BUDGET = 48 * 1024 * 1024
+#: VMEM scratch budget for slab buffers, keyed on the device generation:
+#: v4/v5/v6 cores carry 128 MiB of VMEM — 96 lets fuse=4 keep bx=16
+#: (read amplification (bx+2k)/bx = 1.5 rather than 2 at bx=8) while
+#: leaving the compiler headroom; older/unknown parts get a conservative
+#: share of their 16 MiB. Resolved lazily (first backend touch).
+_VMEM_BUDGETS = {True: 96 * 1024 * 1024, False: 12 * 1024 * 1024}
+_VMEM_BUDGET = None
+
+
+def _vmem_budget() -> int:
+    global _VMEM_BUDGET
+    if _VMEM_BUDGET is None:
+        try:
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:
+            kind = ""
+        big = any(t in kind for t in ("v4", "v5", "v6", "cpu"))
+        _VMEM_BUDGET = _VMEM_BUDGETS[big]
+    return _VMEM_BUDGET
+
+
+def _mid_layout(bx: int, fuse: int):
+    """(buffer count, plane width) of the temporal-blocking mid scratch —
+    ONE definition shared by the VMEM estimate and the allocation."""
+    nbuf = 0 if fuse == 1 else (1 if fuse == 2 else 2)
+    return nbuf, bx + 2 * (fuse - 1)
 
 
 def pick_block_planes(
@@ -74,13 +101,20 @@ def pick_block_planes(
     """Largest slab depth BX (dividing nx) whose double-buffered u/v
     in/mid/out scratch fits the VMEM budget; 0 if even BX=1 does not
     fit. ``fuse`` is the temporal-blocking depth (input halo width)."""
+    budget = _vmem_budget()
     for bx in (16, 8, 4, 2, 1):
         if nx % bx:
             continue
+        if bx < nx and bx < fuse:
+            # Interior slabs read [b*bx - fuse, b*bx + bx + fuse); with
+            # bx < halo the slab next to the boundary would read out of
+            # bounds. (Single-block nx == bx has no interior slabs.)
+            continue
         in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
-        mid_bytes = 2 * (bx + 2) * ny * nz * itemsize if fuse == 2 else 0
+        nbuf, mid_planes = _mid_layout(bx, fuse)
+        mid_bytes = 2 * nbuf * mid_planes * ny * nz * itemsize
         out_bytes = 2 * 2 * bx * ny * nz * itemsize
-        if in_bytes + mid_bytes + out_bytes <= _VMEM_BUDGET:
+        if in_bytes + mid_bytes + out_bytes <= budget:
             return bx
     return 0
 
@@ -139,7 +173,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
              in_u, in_v, out_u, out_v,
              in_sems, out_sems, face_sems) = rest
             x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
-        elif fuse == 2:
+        elif fuse >= 2:
             (u_out, v_out,
              in_u, in_v, mid_u, mid_v, out_u, out_v,
              in_sems, out_sems) = rest
@@ -295,36 +329,54 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 out_u[slot] = u_c + du * dt
             out_v[slot] = v_c + dv * dt
 
-        def compute2(slot, b):
-            # Stage A: step n+1 on the (bx+2)-plane window
-            # [b*bx-1, b*bx+bx+1); global-edge ghost planes stay frozen.
-            u_win = in_u[slot]
-            v_win = in_v[slot]
-            u_c, du, v_c, dv = euler_terms(
-                u_win, v_win, const_edges_u, const_edges_v
-            )
-            for j in range(bx + 2):
-                g = b * bx - 1 + j
-                valid = (g >= 0) & (g < nx)
-                du_j = du[j]
-                if use_noise:
-                    du_j = du_j + noise_plane(seeds[2], g)
-                mid_u[j] = jnp.where(valid, u_c[j] + du_j * dt, u_bv)
-                mid_v[j] = jnp.where(valid, v_c[j] + dv[j] * dt, v_bv)
-            # Stage B: step n+2 on the bx output planes.
-            u_c, du, v_c, dv = euler_terms(
-                mid_u[:], mid_v[:], const_edges_u, const_edges_v
-            )
-            if use_noise:
-                for j in range(bx):
-                    out_u[slot, j] = u_c[j] + (
-                        du[j] + noise_plane(seeds[2] + 1, b * bx + j)
-                    ) * dt
-            else:
-                out_u[slot] = u_c + du * dt
-            out_v[slot] = v_c + dv * dt
+        def compute_k(slot, b):
+            """``fuse``-stage temporal blocking: stage s advances step
+            n+1+s on a window that shrinks by one plane per side per
+            stage — the outermost recomputed ring planes reproduce their
+            owner slab's values exactly (same inputs, position-keyed
+            noise), so the chain equals ``fuse`` single steps bitwise.
+            Stage 0 reads the (bx+2*fuse)-plane input slab; stages
+            0..fuse-2 write ping-pong mid buffers with out-of-domain
+            planes pinned to the frozen boundary value; the last stage
+            writes the bx output planes."""
+            k = fuse
+            for s in range(k):
+                w_out = bx + 2 * (k - 1 - s)
+                if s == 0:
+                    u_win, v_win = in_u[slot], in_v[slot]
+                else:
+                    buf = (s - 1) % 2 if k > 2 else 0
+                    u_win = mid_u[buf, pl.ds(0, w_out + 2)]
+                    v_win = mid_v[buf, pl.ds(0, w_out + 2)]
+                u_c, du, v_c, dv = euler_terms(
+                    u_win, v_win, const_edges_u, const_edges_v
+                )
+                step_s = seeds[2] + s
+                if s == k - 1:
+                    if use_noise:
+                        for j in range(bx):
+                            out_u[slot, j] = u_c[j] + (
+                                du[j] + noise_plane(step_s, b * bx + j)
+                            ) * dt
+                    else:
+                        out_u[slot] = u_c + du * dt
+                    out_v[slot] = v_c + dv * dt
+                else:
+                    buf = s % 2 if k > 2 else 0
+                    for j in range(w_out):
+                        g = b * bx - (k - 1 - s) + j
+                        valid = (g >= 0) & (g < nx)
+                        du_j = du[j]
+                        if use_noise:
+                            du_j = du_j + noise_plane(step_s, g)
+                        mid_u[buf, j] = jnp.where(
+                            valid, u_c[j] + du_j * dt, u_bv
+                        )
+                        mid_v[buf, j] = jnp.where(
+                            valid, v_c[j] + dv[j] * dt, v_bv
+                        )
 
-        compute = compute2 if fuse == 2 else compute1
+        compute = compute_k if fuse >= 2 else compute1
 
         # ---- pipeline: prologue, steady-state loop, epilogue ----
         slab_io(0, jnp.int32(0), start=True)
@@ -387,10 +439,11 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
         pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
         pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
     ]
-    if fuse == 2:
+    if fuse >= 2:
+        nbuf, mid_planes = _mid_layout(bx, fuse)
         scratch_shapes += [
-            pltpu.VMEM((bx + 2, ny, nz), dtype),
-            pltpu.VMEM((bx + 2, ny, nz), dtype),
+            pltpu.VMEM((nbuf, mid_planes, ny, nz), dtype),
+            pltpu.VMEM((nbuf, mid_planes, ny, nz), dtype),
         ]
     scratch_shapes += [
         pltpu.VMEM((2, bx, ny, nz), dtype),
@@ -416,7 +469,7 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
         # without an explicit limit L=256 f32 OOMs at kernel-stack
         # allocation even though the scratch fits physical VMEM.
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_BUDGET + 16 * 1024 * 1024,
+            vmem_limit_bytes=_vmem_budget() + 16 * 1024 * 1024,
         ),
         # The TPU-semantics interpreter (not the generic HLO one) models
         # SMEM/semaphores/DMA on CPU for tests. ``detect_races`` is a
@@ -445,7 +498,7 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     halo faces for a sharded block, in the order ``(u_xlo, u_xhi,
     v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi, u_zlo, u_zhi, v_zlo,
     v_zhi)`` with x faces shaped (1, ny, nz), y faces (nx, 1, nz),
-    z faces (nx, ny, 1). ``fuse=2`` temporal blocking advances two steps
+    z faces (nx, ny, 1). ``fuse=k`` temporal blocking advances k steps
     per HBM pass (single-block runs only). ``detect_races`` (interpret
     mode only) runs the TPU interpreter's DMA/compute race detector; it
     is a static jit argument, so toggling it recompiles rather than
@@ -465,7 +518,7 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     sharded kernel path is instead covered by the single-device
     with-faces interpret test plus the TPU hardware tests.
     """
-    if fuse == 2 and faces is not None:
+    if fuse > 1 and faces is not None:
         raise ValueError("temporal blocking requires a single block")
     nx, ny, nz = u.shape
     dtype = u.dtype
@@ -477,6 +530,27 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     row = jnp.asarray(nz if row is None else row, jnp.int32)
 
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
+    if bx == 0 and fuse > 1:
+        # The requested depth overflows VMEM for this shape, but a
+        # shallower chain may still fit — step down rather than losing
+        # the Pallas kernel entirely (large grids are exactly where the
+        # kernel matters most).
+        shallower = next(
+            (k for k in range(fuse - 1, 0, -1)
+             if pick_block_planes(nx, ny, nz, dtype.itemsize, k) > 0), 0,
+        )
+        if shallower:
+            done = 0
+            while done < fuse:
+                k = min(shallower, fuse - done)
+                u, v = fused_step(
+                    u, v, params,
+                    seeds.at[2].add(done) if done else seeds, faces,
+                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    fuse=k, offsets=offsets, row=row,
+                )
+                done += k
+            return u, v
     # Mosaic tiles VMEM as (sublane, 128-lane) over the trailing two dims
     # and rejects the kernel's sliced scratch views unless the lane dim is
     # a whole number of tiles (measured on v5e: L=64 f32 fails "Slice
@@ -489,21 +563,12 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     ) or (
         not on_tpu and not allow_interpret
     ):
-        if fuse == 2:
-            u, v = fused_step(
-                u, v, params, seeds, faces, use_noise=use_noise,
-                allow_interpret=allow_interpret, fuse=1,
-                offsets=offsets, row=row,
+        for s in range(fuse):
+            u, v = _xla_fallback(
+                u, v, params, seeds.at[2].add(s) if s else seeds, faces,
+                use_noise=use_noise, offsets=offsets, row=row,
             )
-            return fused_step(
-                u, v, params, seeds.at[2].add(1), faces,
-                use_noise=use_noise, allow_interpret=allow_interpret,
-                fuse=1, offsets=offsets, row=row,
-            )
-        return _xla_fallback(
-            u, v, params, seeds, faces, use_noise=use_noise,
-            offsets=offsets, row=row,
-        )
+        return u, v
 
     # SMEM scalars stay >= f32 (bf16 scalars in SMEM are a shaky Mosaic
     # combination); the kernel casts them to the field dtype at use.

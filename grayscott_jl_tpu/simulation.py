@@ -48,6 +48,23 @@ from .parallel.domain import CartDomain
 AXIS_NAMES = ("x", "y", "z")
 
 
+def default_fuse() -> int:
+    """Temporal-blocking depth for single-block Pallas runs.
+
+    The v5e slab pipeline is DMA-envelope-bound (measured: per-pass wall
+    is flat in compute content), so deeper fusion is a near-linear
+    per-step win until stage compute fills the envelope. ``GS_FUSE``
+    overrides; off-TPU the interpreter pays per-stage simulation cost,
+    so tests keep the historical depth 2.
+    """
+    import os
+
+    v = os.environ.get("GS_FUSE", "")
+    if v:
+        return max(1, int(v))
+    return 4 if jax.default_backend() == "tpu" else 2
+
+
 #: Platforms this process has already reached successfully — skips the
 #: bounded subprocess probe on subsequent Simulation constructions.
 _reached_platforms: set = set()
@@ -309,10 +326,12 @@ class Simulation:
                     u, v = kernel_step(u, v, step0 + 2 * pairs, faces)
                 return u, v
 
-            # Single block: in-kernel temporal blocking (2 steps per HBM
-            # pass); the noise stream is keyed on absolute (step, cell),
-            # so fusion/chunking does not change the trajectory.
-            fuse = 2 if nsteps >= 2 else 1
+            # Single block: in-kernel temporal blocking (``fuse`` steps
+            # per HBM pass — the slab pipeline is DMA-envelope-bound on
+            # the v5e, so per-step time scales ~1/fuse); the noise stream
+            # is keyed on absolute (step, cell), so fusion/chunking does
+            # not change the trajectory.
+            fuse = min(default_fuse(), max(nsteps, 1))
 
             def body(i, carry):
                 u, v = carry
@@ -328,7 +347,7 @@ class Simulation:
                 u, v = pallas_stencil.fused_step(
                     u, v, params, step_seeds(step0 + fuse * pairs), None,
                     use_noise=use_noise, allow_interpret=allow_interpret,
-                    fuse=1, offsets=offs, row=L,
+                    fuse=rem, offsets=offs, row=L,
                 )
             return u, v
 

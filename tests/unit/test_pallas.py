@@ -182,9 +182,76 @@ def test_temporal_blocking_matches_two_single_steps():
     )
 
 
+@pytest.mark.parametrize("fuse", [3, 4])
+@pytest.mark.parametrize("use_noise", [False, True])
+def test_deep_temporal_blocking_matches_single_steps(fuse, use_noise):
+    """fuse=k (k timesteps per HBM pass via the k-stage shrinking-window
+    chain) must reproduce k fuse=1 steps bitwise, noise included —
+    stage s draws at step seeds[2]+s on the same position-keyed
+    stream."""
+    L = 16
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.25 if use_noise else 0.0), dtype
+    )
+    key = jax.random.PRNGKey(31)
+    u = jax.random.uniform(key, (L, L, L), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
+    seeds = jnp.asarray([9, 17, 5], jnp.int32)
+
+    uk, vk = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=use_noise, fuse=fuse
+    )
+    us, vs = u, v
+    for s in range(fuse):
+        us, vs = pallas_stencil.fused_step(
+            us, vs, params, seeds.at[2].add(s), use_noise=use_noise,
+        )
+    np.testing.assert_array_equal(np.asarray(uk), np.asarray(us))
+    np.testing.assert_array_equal(np.asarray(vk), np.asarray(vs))
+
+
+def test_fuse_steps_down_when_vmem_overflows():
+    """When the requested fuse depth overflows the VMEM budget but a
+    shallower chain fits, fused_step must step down (keeping the Pallas
+    kernel) rather than fall back to XLA — and the trajectory must be
+    unchanged."""
+    L = 16
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.25), dtype
+    )
+    key = jax.random.PRNGKey(41)
+    u = jax.random.uniform(key, (L, L, L), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
+    seeds = jnp.asarray([2, 4, 8], jnp.int32)
+
+    want_u, want_v = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=4
+    )
+
+    item = 4
+    # Budget that admits fuse=2 at bx=2 but not fuse=4 (bx >= fuse, so
+    # fuse=4 needs bx=4 whose input slab alone overflows this budget).
+    plane = L * L * item
+    budget = (2 * 2 * 6 + 2 * 1 * 4 + 2 * 2 * 2) * plane
+    saved = pallas_stencil._VMEM_BUDGET
+    pallas_stencil._VMEM_BUDGET = budget
+    try:
+        assert pallas_stencil.pick_block_planes(L, L, L, item, 4) == 0
+        assert pallas_stencil.pick_block_planes(L, L, L, item, 2) > 0
+        got_u, got_v = pallas_stencil.fused_step(
+            u, v, params, seeds, use_noise=True, fuse=4
+        )
+    finally:
+        pallas_stencil._VMEM_BUDGET = saved
+    np.testing.assert_array_equal(np.asarray(got_u), np.asarray(want_u))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+
+
 @pytest.mark.parametrize("nsteps", [1, 3, 7])
 def test_pallas_odd_step_counts_match_xla(nsteps):
-    """Odd chunk sizes take the fuse=2 pairs + one fuse=1 remainder
+    """Odd chunk sizes take the fuse pairs + one fuse=rem remainder
     path; the result must not depend on the chunking."""
     a = Simulation(_settings("XLA"), n_devices=1)
     b = Simulation(_settings("Pallas"), n_devices=1)
