@@ -58,6 +58,9 @@ def main() -> int:
             (256, "Float32", "Pallas", 0.0),
             (512, "Float32", "Plain", 0.1),
             (512, "Float32", "Pallas", 0.1),
+            (256, "BFloat16", "Plain", 0.1),
+            (256, "BFloat16", "Pallas", 0.1),
+            (512, "BFloat16", "Pallas", 0.1),
             (128, "Float64", "Plain", 0.1),
             (256, "Float64", "Plain", 0.1),
         ]

@@ -95,6 +95,15 @@ def _mid_layout(bx: int, fuse: int):
     return nbuf, bx + 2 * (fuse - 1)
 
 
+def _compute_dtype(dtype):
+    """In-kernel compute dtype: bf16 fields are stored bf16 (the HBM
+    traffic win) but computed in f32 — Mosaic's rotate has no 16-bit
+    path, and f32 accumulate is the accuracy-correct choice anyway.
+    ONE definition shared by the kernel body, the mid-scratch
+    allocation, and the VMEM estimate."""
+    return jnp.float32 if dtype == jnp.bfloat16 else dtype
+
+
 def pick_block_planes(
     nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1
 ) -> int:
@@ -112,7 +121,9 @@ def pick_block_planes(
             continue
         in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
         nbuf, mid_planes = _mid_layout(bx, fuse)
-        mid_bytes = 2 * nbuf * mid_planes * ny * nz * itemsize
+        # Mid buffers hold the compute dtype — at least f32 for 16-bit
+        # fields (_compute_dtype), hence the 4-byte floor.
+        mid_bytes = 2 * nbuf * mid_planes * ny * nz * max(itemsize, 4)
         out_bytes = 2 * 2 * bx * ny * nz * itemsize
         if in_bytes + mid_bytes + out_bytes <= budget:
             return bx
@@ -184,16 +195,18 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
              in_sems, out_sems) = rest
             x_faces = None
 
-        u_bv = jnp.asarray(stencil.U_BOUNDARY, dtype)
-        v_bv = jnp.asarray(stencil.V_BOUNDARY, dtype)
+        # cdt == dtype except bf16, which computes in f32 (_compute_dtype).
+        cdt = _compute_dtype(dtype)
+        u_bv = jnp.asarray(stencil.U_BOUNDARY, cdt)
+        v_bv = jnp.asarray(stencil.V_BOUNDARY, cdt)
         fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
         # Params land in SMEM at >= f32 (see ref order above); cast the
-        # six scalars to the field dtype at the point of use.
+        # six scalars to the compute dtype at the point of use.
         Du, Dv, F, K, dt, noise = (
-            params[j].astype(dtype) for j in range(6)
+            params[j].astype(cdt) for j in range(6)
         )
-        six = jnp.asarray(6.0, dtype)
-        one = jnp.asarray(1.0, dtype)
+        six = jnp.asarray(6.0, cdt)
+        one = jnp.asarray(1.0, cdt)
 
         def slab_io(slot, b, start):
             """Start (or wait for) all input DMAs of slab ``b``.
@@ -303,16 +316,16 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             local x-plane ``g``; global coordinates from seeds[3:7]."""
             seed = plane_seed(seeds[0], seeds[1], step_idx, seeds[3] + g)
             bits = plane_bits(seed, seeds[4], seeds[5], seeds[6], (ny, nz))
-            return noise * _kernel_pm1(bits, dtype)
+            return noise * _kernel_pm1(bits, cdt)
 
         const_edges_u = (u_bv,) * 4
         const_edges_v = (v_bv,) * 4
 
         def compute1(slot, b):
-            u_win = in_u[slot]
-            v_win = in_v[slot]
+            u_win = in_u[slot].astype(cdt)
+            v_win = in_v[slot].astype(cdt)
             if with_faces:
-                rows = lambda f: f[pl.ds(b * bx, bx)]  # noqa: E731
+                rows = lambda f: f[pl.ds(b * bx, bx)].astype(cdt)  # noqa: E731
                 u_edges = (rows(u_ylo), rows(u_yhi),
                            rows(u_zlo), rows(u_zhi))
                 v_edges = (rows(v_ylo), rows(v_yhi),
@@ -322,12 +335,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             u_c, du, v_c, dv = euler_terms(u_win, v_win, u_edges, v_edges)
             if use_noise:
                 for j in range(bx):
-                    out_u[slot, j] = u_c[j] + (
+                    out_u[slot, j] = (u_c[j] + (
                         du[j] + noise_plane(seeds[2], b * bx + j)
-                    ) * dt
+                    ) * dt).astype(dtype)
             else:
-                out_u[slot] = u_c + du * dt
-            out_v[slot] = v_c + dv * dt
+                out_u[slot] = (u_c + du * dt).astype(dtype)
+            out_v[slot] = (v_c + dv * dt).astype(dtype)
 
         def compute_k(slot, b):
             """``fuse``-stage temporal blocking: stage s advances step
@@ -343,8 +356,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             for s in range(k):
                 w_out = bx + 2 * (k - 1 - s)
                 if s == 0:
-                    u_win, v_win = in_u[slot], in_v[slot]
+                    u_win = in_u[slot].astype(cdt)
+                    v_win = in_v[slot].astype(cdt)
                 else:
+                    # mid buffers are already cdt (f32 for bf16 fields).
                     buf = (s - 1) % 2 if k > 2 else 0
                     u_win = mid_u[buf, pl.ds(0, w_out + 2)]
                     v_win = mid_v[buf, pl.ds(0, w_out + 2)]
@@ -355,14 +370,22 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 if s == k - 1:
                     if use_noise:
                         for j in range(bx):
-                            out_u[slot, j] = u_c[j] + (
+                            out_u[slot, j] = (u_c[j] + (
                                 du[j] + noise_plane(step_s, b * bx + j)
-                            ) * dt
+                            ) * dt).astype(dtype)
                     else:
-                        out_u[slot] = u_c + du * dt
-                    out_v[slot] = v_c + dv * dt
+                        out_u[slot] = (u_c + du * dt).astype(dtype)
+                    out_v[slot] = (v_c + dv * dt).astype(dtype)
                 else:
                     buf = s % 2 if k > 2 else 0
+
+                    def _round(x):
+                        # Mid stages round through the FIELD dtype so
+                        # fuse=k stays bitwise equal to k single steps
+                        # (each of which stores the field); mids stay
+                        # cdt-typed for the 32-bit-only rotate.
+                        return x.astype(dtype).astype(cdt)
+
                     for j in range(w_out):
                         g = b * bx - (k - 1 - s) + j
                         valid = (g >= 0) & (g < nx)
@@ -370,10 +393,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                         if use_noise:
                             du_j = du_j + noise_plane(step_s, g)
                         mid_u[buf, j] = jnp.where(
-                            valid, u_c[j] + du_j * dt, u_bv
+                            valid, _round(u_c[j] + du_j * dt), u_bv
                         )
                         mid_v[buf, j] = jnp.where(
-                            valid, v_c[j] + dv[j] * dt, v_bv
+                            valid, _round(v_c[j] + dv[j] * dt), v_bv
                         )
 
         compute = compute_k if fuse >= 2 else compute1
@@ -441,9 +464,12 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
     ]
     if fuse >= 2:
         nbuf, mid_planes = _mid_layout(bx, fuse)
+        # Mid buffers hold stage outputs in the COMPUTE dtype (they are
+        # re-shifted by the next stage).
+        mid_dtype = _compute_dtype(dtype)
         scratch_shapes += [
-            pltpu.VMEM((nbuf, mid_planes, ny, nz), dtype),
-            pltpu.VMEM((nbuf, mid_planes, ny, nz), dtype),
+            pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
+            pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
         ]
     scratch_shapes += [
         pltpu.VMEM((2, bx, ny, nz), dtype),
@@ -547,7 +573,8 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
                     u, v, params,
                     seeds.at[2].add(done) if done else seeds, faces,
                     use_noise=use_noise, allow_interpret=allow_interpret,
-                    fuse=k, offsets=offsets, row=row,
+                    fuse=k, detect_races=detect_races,
+                    offsets=offsets, row=row,
                 )
                 done += k
             return u, v

@@ -61,7 +61,12 @@ def default_fuse() -> int:
 
     v = os.environ.get("GS_FUSE", "")
     if v:
-        return max(1, int(v))
+        try:
+            return max(1, int(v))
+        except ValueError as e:
+            raise ValueError(
+                f"GS_FUSE must be a positive integer, got {v!r}"
+            ) from e
     return 4 if jax.default_backend() == "tpu" else 2
 
 

@@ -84,9 +84,10 @@ def test_mosaic_noise_matches_xla_stream():
 
 
 @requires_tpu
-def test_temporal_blocking_with_noise_on_hardware():
-    """fuse=2 with in-kernel noise vs two fuse=1 steps, Mosaic-compiled —
-    the stage-A/B seeding the off-hardware interpret tests cover must
+@pytest.mark.parametrize("fuse", [2, 4])
+def test_temporal_blocking_with_noise_on_hardware(fuse):
+    """fuse=k with in-kernel noise vs k fuse=1 steps, Mosaic-compiled —
+    the per-stage seeding the off-hardware interpret tests cover must
     hold on the real kernel too."""
     import jax.numpy as jnp
 
@@ -103,15 +104,38 @@ def test_temporal_blocking_with_noise_on_hardware():
     u, v = grayscott.init_fields(L, dtype)
     seeds = jnp.asarray([5, 6, 0], jnp.int32)
 
-    u2, v2 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True,
-                                       fuse=2)
-    ua, va = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
-    ub, vb = pallas_stencil.fused_step(ua, va, params, seeds.at[2].add(1),
-                                       use_noise=True)
-    np.testing.assert_allclose(np.asarray(u2), np.asarray(ub),
+    uk, vk = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True,
+                                       fuse=fuse)
+    us, vs = u, v
+    for step in range(fuse):
+        us, vs = pallas_stencil.fused_step(
+            us, vs, params, seeds.at[2].add(step), use_noise=True)
+    np.testing.assert_allclose(np.asarray(uk), np.asarray(us),
                                rtol=1e-6, atol=5e-7)
-    np.testing.assert_allclose(np.asarray(v2), np.asarray(vb),
+    np.testing.assert_allclose(np.asarray(vk), np.asarray(vs),
                                rtol=1e-6, atol=5e-7)
+
+
+@requires_tpu
+def test_bfloat16_pallas_on_hardware():
+    """BFloat16 fields with f32 SMEM params must Mosaic-compile and track
+    the f32 trajectory to bf16 precision (the SMEM-dtype contract the
+    off-hardware tests can only exercise in interpret mode)."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    common = dict(L=128, noise=0.0, Du=0.2, Dv=0.1, F=0.02, k=0.048,
+                  dt=1.0, backend="TPU", kernel_language="Pallas")
+    a = Simulation(Settings(precision="Float32", **common), n_devices=1)
+    b = Simulation(Settings(precision="BFloat16", **common), n_devices=1)
+    a.iterate(10)
+    b.iterate(10)
+    ua = a.get_fields()[0]
+    ub = b.get_fields()[0].astype(np.float32)
+    assert np.isfinite(ub).all()
+    np.testing.assert_allclose(ua, ub, rtol=0.05, atol=0.05)
 
 
 @requires_tpu
