@@ -55,28 +55,23 @@ def _u32(x):
 
 
 def plane_seed(k0, k1, step, gx):
-    """Per-(key, step, global x-plane) scalar seed — the contract shared
-    with the Pallas kernel's ``noise_plane``. ``gx`` may be an array
-    (hash32 is elementwise), which is how the 3D block form reuses this."""
+    """Per-(key, step, global x-plane) seed — the contract shared with
+    the Pallas kernel's in-kernel ``noise_block``. ``gx`` may be an
+    array (hash32 is elementwise), which is how the 3D block forms
+    vectorize over planes."""
     return hash32(
         hash32(hash32(_u32(k0)) ^ _u32(k1))
         ^ hash32(hash32(_u32(step)) ^ _u32(gx))
     )
 
 
-def _cell_bits(seed, cell):
-    """Final per-cell mix. ONE definition — the XLA block form and the
-    Pallas per-plane form must produce identical bits."""
-    return hash32(hash32(cell + seed) ^ seed)
-
-
-def plane_bits(seed, y_off, z_off, row, shape):
-    """uint32 noise bits for one (ny, nz) plane at global offsets
-    ``(y_off, z_off)``; ``row`` is the global row length (grid side L),
-    making the per-cell counter a global coordinate."""
-    iy = lax.broadcasted_iota(jnp.uint32, shape, 0) + _u32(y_off)
-    iz = lax.broadcasted_iota(jnp.uint32, shape, 1) + _u32(z_off)
-    return _cell_bits(seed, iy * _u32(row) + iz)
+def block_bits(seed, iy, iz, row):
+    """uint32 noise bits for cells at broadcastable global y/z
+    coordinate arrays ``iy``/``iz`` (uint32); ``row`` is the global row
+    length (grid side L), making the per-cell counter a global
+    coordinate. ONE definition of the seed/counter mix — the XLA block
+    form and the Pallas in-kernel form must produce identical bits."""
+    return hash32(hash32(iy * _u32(row) + iz + seed) ^ seed)
 
 
 def bits_to_pm1(bits, dtype):
@@ -101,5 +96,5 @@ def uniform_pm1_block(key_i32, step, offsets, shape, row, dtype):
     seed = plane_seed(key_i32[0], key_i32[1], step, gx)
     iy = lax.broadcasted_iota(jnp.uint32, shape, 1) + _u32(offsets[1])
     iz = lax.broadcasted_iota(jnp.uint32, shape, 2) + _u32(offsets[2])
-    bits = _cell_bits(seed, iy * _u32(row) + iz)
+    bits = block_bits(seed, iy, iz, row)
     return bits_to_pm1(bits, dtype)

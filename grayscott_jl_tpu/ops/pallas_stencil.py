@@ -65,7 +65,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
-from .noise import plane_bits, plane_seed, uniform_pm1_block
+from .noise import _u32, block_bits, plane_seed, uniform_pm1_block
 
 #: VMEM scratch budget for slab buffers, keyed on the device generation:
 #: v4/v5/v6 cores carry 128 MiB of VMEM — 96 lets fuse=4 keep bx=16
@@ -139,15 +139,34 @@ def _kernel_pm1(bits, dtype):
     return (f12 * 2.0 - 3.0).astype(dtype)
 
 
-def _shifted(block, axis, shift, edge_value):
+def _edge_masks(ny, nz):
+    """The four wrapped-row/column boolean masks for a (n, ny, nz)
+    window, shaped to broadcast over any plane count n — computed once
+    per kernel invocation and shared across fields and stages (an
+    iota + compare per ``_shifted`` call is pure VPU overhead in a
+    stage-compute-bound pass)."""
+    iy = lax.broadcasted_iota(jnp.int32, (1, ny, 1), 1)
+    iz = lax.broadcasted_iota(jnp.int32, (1, 1, nz), 2)
+    return {
+        (1, 1): iy == 0,
+        (1, -1): iy == ny - 1,
+        (2, 1): iz == 0,
+        (2, -1): iz == nz - 1,
+    }
+
+
+def _shifted(block, axis, shift, edge_value, masks=None):
     """Neighbor values along a VMEM-resident axis: circular shift with the
     wrapped boundary row/column replaced by ``edge_value`` (a scalar
     boundary constant or a broadcastable face slab)."""
     n = block.shape[axis]
     # roll(x, s)[i] = x[i - s]; a backward (-1) shift is circularly n-1.
     rolled = pltpu.roll(block, shift if shift > 0 else n - 1, axis)
-    idx = lax.broadcasted_iota(jnp.int32, block.shape, axis)
-    edge = idx == (0 if shift == 1 else n - 1)
+    if masks is not None and axis in (1, 2):
+        edge = masks[(axis, shift)]
+    else:
+        idx = lax.broadcasted_iota(jnp.int32, block.shape, axis)
+        edge = idx == (0 if shift == 1 else n - 1)
     return jnp.where(edge, edge_value, rolled)
 
 
@@ -281,6 +300,8 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 out_sems.at[slot, tag],
             )
 
+        masks = _edge_masks(ny, nz)
+
         def lap(win, c, edges):
             """7-point Laplacian over the window interior ``c``
             (``Common.jl:13-18`` — keep the /6)."""
@@ -288,10 +309,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             ylo, yhi, zlo, zhi = edges
             return (
                 win[0:n] + win[2:n + 2]
-                + _shifted(c, 1, 1, ylo)
-                + _shifted(c, 1, -1, yhi)
-                + _shifted(c, 2, 1, zlo)
-                + _shifted(c, 2, -1, zhi)
+                + _shifted(c, 1, 1, ylo, masks)
+                + _shifted(c, 1, -1, yhi, masks)
+                + _shifted(c, 2, 1, zlo, masks)
+                + _shifted(c, 2, -1, zhi, masks)
                 - six * c
             ) / six
 
@@ -311,11 +332,20 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             dv = Dv * lap_v + uvv - (F + K) * v_c
             return u_c, du, v_c, dv
 
-        def noise_plane(step_idx, g):
-            """Pre-scaled ``noise * U(-1,1)`` plane for absolute step /
-            local x-plane ``g``; global coordinates from seeds[3:7]."""
-            seed = plane_seed(seeds[0], seeds[1], step_idx, seeds[3] + g)
-            bits = plane_bits(seed, seeds[4], seeds[5], seeds[6], (ny, nz))
+        def noise_block(step_idx, g0, w):
+            """Pre-scaled noise for ``w`` consecutive local x-planes
+            starting at ``g0`` — one 3D evaluation of the identical
+            per-plane stream (the (w,1,1) seed vector broadcasts into
+            the (1,ny,nz) cell counter exactly as the scalar per-plane
+            seed does), replacing w unrolled plane hashes + stores."""
+            gx = (seeds[3] + g0
+                  + lax.broadcasted_iota(jnp.int32, (w, 1, 1), 0))
+            seed = plane_seed(seeds[0], seeds[1], step_idx, gx)
+            iy = (lax.broadcasted_iota(jnp.uint32, (1, ny, 1), 1)
+                  + _u32(seeds[4]))
+            iz = (lax.broadcasted_iota(jnp.uint32, (1, 1, nz), 2)
+                  + _u32(seeds[5]))
+            bits = block_bits(seed, iy, iz, seeds[6])
             return noise * _kernel_pm1(bits, cdt)
 
         const_edges_u = (u_bv,) * 4
@@ -334,12 +364,8 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 u_edges, v_edges = const_edges_u, const_edges_v
             u_c, du, v_c, dv = euler_terms(u_win, v_win, u_edges, v_edges)
             if use_noise:
-                for j in range(bx):
-                    out_u[slot, j] = (u_c[j] + (
-                        du[j] + noise_plane(seeds[2], b * bx + j)
-                    ) * dt).astype(dtype)
-            else:
-                out_u[slot] = (u_c + du * dt).astype(dtype)
+                du = du + noise_block(seeds[2], b * bx, bx)
+            out_u[slot] = (u_c + du * dt).astype(dtype)
             out_v[slot] = (v_c + dv * dt).astype(dtype)
 
         def compute_k(slot, b):
@@ -369,15 +395,20 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 step_s = seeds[2] + s
                 if s == k - 1:
                     if use_noise:
-                        for j in range(bx):
-                            out_u[slot, j] = (u_c[j] + (
-                                du[j] + noise_plane(step_s, b * bx + j)
-                            ) * dt).astype(dtype)
-                    else:
-                        out_u[slot] = (u_c + du * dt).astype(dtype)
+                        du = du + noise_block(step_s, b * bx, bx)
+                    out_u[slot] = (u_c + du * dt).astype(dtype)
                     out_v[slot] = (v_c + dv * dt).astype(dtype)
                 else:
                     buf = s % 2 if k > 2 else 0
+                    g0 = b * bx - (k - 1 - s)
+                    if use_noise:
+                        du = du + noise_block(step_s, g0, w_out)
+                    # Ring planes outside the global domain stay at the
+                    # frozen boundary value.
+                    gx = g0 + lax.broadcasted_iota(
+                        jnp.int32, (w_out, 1, 1), 0
+                    )
+                    valid = (gx >= 0) & (gx < nx)
 
                     def _round(x):
                         # Mid stages round through the FIELD dtype so
@@ -386,18 +417,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                         # cdt-typed for the 32-bit-only rotate.
                         return x.astype(dtype).astype(cdt)
 
-                    for j in range(w_out):
-                        g = b * bx - (k - 1 - s) + j
-                        valid = (g >= 0) & (g < nx)
-                        du_j = du[j]
-                        if use_noise:
-                            du_j = du_j + noise_plane(step_s, g)
-                        mid_u[buf, j] = jnp.where(
-                            valid, _round(u_c[j] + du_j * dt), u_bv
-                        )
-                        mid_v[buf, j] = jnp.where(
-                            valid, _round(v_c[j] + dv[j] * dt), v_bv
-                        )
+                    mid_u[buf, pl.ds(0, w_out)] = jnp.where(
+                        valid, _round(u_c + du * dt), u_bv
+                    )
+                    mid_v[buf, pl.ds(0, w_out)] = jnp.where(
+                        valid, _round(v_c + dv * dt), v_bv
+                    )
 
         compute = compute_k if fuse >= 2 else compute1
 
