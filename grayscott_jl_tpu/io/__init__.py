@@ -24,9 +24,15 @@ def count_steps_upto(path: str, sim_step: int):
     many entries of its output/checkpoint stores and drops the abandoned
     trajectory's tail (pass the result as ``keep_steps``).
     """
-    if not os.path.isdir(path):
+    from .bplite import BpReader, _md_path
+
+    # Gate on the rank-0 metadata FILE, not the directory: in a
+    # multi-process restart with a fresh store, a peer's open_writer may
+    # have just created the directory while md.json can only ever be
+    # written by THIS process (writer 0) later — waiting on it here
+    # deadlocks. No committed metadata == nothing to roll back.
+    if not os.path.isfile(_md_path(path)):
         return None
-    from .bplite import BpReader
 
     r = BpReader(path)
     k = 0

@@ -155,19 +155,16 @@ def _edge_masks(ny, nz):
     }
 
 
-def _shifted(block, axis, shift, edge_value, masks=None):
-    """Neighbor values along a VMEM-resident axis: circular shift with the
-    wrapped boundary row/column replaced by ``edge_value`` (a scalar
-    boundary constant or a broadcastable face slab)."""
+def _shifted(block, axis, shift, edge_value, masks):
+    """Neighbor values along a VMEM-resident axis (1 = y, 2 = z):
+    circular shift with the wrapped boundary row/column replaced by
+    ``edge_value`` (a scalar boundary constant or a broadcastable face
+    slab); ``masks`` are the shared precomputed edge masks
+    (:func:`_edge_masks`)."""
     n = block.shape[axis]
     # roll(x, s)[i] = x[i - s]; a backward (-1) shift is circularly n-1.
     rolled = pltpu.roll(block, shift if shift > 0 else n - 1, axis)
-    if masks is not None and axis in (1, 2):
-        edge = masks[(axis, shift)]
-    else:
-        idx = lax.broadcasted_iota(jnp.int32, block.shape, axis)
-        edge = idx == (0 if shift == 1 else n - 1)
-    return jnp.where(edge, edge_value, rolled)
+    return jnp.where(masks[(axis, shift)], edge_value, rolled)
 
 
 def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
@@ -332,14 +329,16 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             dv = Dv * lap_v + uvv - (F + K) * v_c
             return u_c, du, v_c, dv
 
-        def noise_block(step_idx, g0, w):
+        def noise_block(step_idx, g0, w, iota_w=None):
             """Pre-scaled noise for ``w`` consecutive local x-planes
             starting at ``g0`` — one 3D evaluation of the identical
             per-plane stream (the (w,1,1) seed vector broadcasts into
             the (1,ny,nz) cell counter exactly as the scalar per-plane
-            seed does), replacing w unrolled plane hashes + stores."""
-            gx = (seeds[3] + g0
-                  + lax.broadcasted_iota(jnp.int32, (w, 1, 1), 0))
+            seed does), replacing w unrolled plane hashes + stores.
+            ``iota_w`` lets the caller share its plane iota."""
+            if iota_w is None:
+                iota_w = lax.broadcasted_iota(jnp.int32, (w, 1, 1), 0)
+            gx = seeds[3] + g0 + iota_w
             seed = plane_seed(seeds[0], seeds[1], step_idx, gx)
             iy = (lax.broadcasted_iota(jnp.uint32, (1, ny, 1), 1)
                   + _u32(seeds[4]))
@@ -401,13 +400,14 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 else:
                     buf = s % 2 if k > 2 else 0
                     g0 = b * bx - (k - 1 - s)
-                    if use_noise:
-                        du = du + noise_block(step_s, g0, w_out)
-                    # Ring planes outside the global domain stay at the
-                    # frozen boundary value.
-                    gx = g0 + lax.broadcasted_iota(
+                    iota_w = lax.broadcasted_iota(
                         jnp.int32, (w_out, 1, 1), 0
                     )
+                    if use_noise:
+                        du = du + noise_block(step_s, g0, w_out, iota_w)
+                    # Ring planes outside the global domain stay at the
+                    # frozen boundary value.
+                    gx = g0 + iota_w
                     valid = (gx >= 0) & (gx < nx)
 
                     def _round(x):

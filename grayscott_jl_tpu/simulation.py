@@ -376,51 +376,58 @@ class Simulation:
         if not sharded or nsteps < 2:
             return lax.fori_loop(0, nsteps, single_step, (u, v))
 
-        # Sharded temporal blocking: one width-2 halo exchange feeds TWO
-        # steps — stage A recomputes step n+1 on a +1-cell-extended
-        # window (neighbor-owned ring cells reproduce the owner's values
-        # bitwise: same inputs via the corner-propagated halo, same
-        # position-keyed noise), stage B computes step n+2 on the
-        # interior with the stage-A ring as its ghost shell. Halves the
-        # exchange count per step (the cost ``communication.jl:138-199``
-        # pays every step).
-        ext = tuple(s + 2 for s in u.shape)
+        # Sharded temporal blocking: ONE width-k halo exchange feeds k
+        # steps — stage s recomputes step n+1+s on a window extending
+        # (k-1-s) cells beyond the block (neighbor-owned ring cells
+        # reproduce the owner's values bitwise: same inputs via the
+        # corner-propagated halo, same position-keyed noise), and the
+        # shrinking ring doubles as the next stage's ghost shell. Cuts
+        # the exchange count per step by k (the cost
+        # ``communication.jl:138-199`` pays every step).
+        fuse = min(default_fuse(), nsteps, min(self.domain.local_shape))
 
-        def freeze_out_of_domain(arr, bv):
-            """Ring positions outside the global domain stay at the
-            frozen boundary value (MPI.PROC_NULL ghost semantics)."""
+        def freeze_out_of_domain(arr, bv, m):
+            """The outermost ``m`` ring positions, where they fall
+            outside the global domain, stay at the frozen boundary
+            value (MPI.PROC_NULL ghost semantics)."""
+            if m == 0:
+                return arr
             out = arr
             for dim, (ax, n) in enumerate(zip(AXIS_NAMES, dims)):
                 idx = lax.axis_index(ax)
                 pos = lax.broadcasted_iota(jnp.int32, out.shape, dim)
-                lo = (pos == 0) & (idx == 0)
-                hi = (pos == out.shape[dim] - 1) & (idx == n - 1)
+                lo = (pos < m) & (idx == 0)
+                hi = (pos >= out.shape[dim] - m) & (idx == n - 1)
                 out = jnp.where(lo | hi, jnp.asarray(bv, out.dtype), out)
             return out
 
-        def pair_step(i, carry):
-            u, v = carry
-            step = step0 + 2 * i
-            u_p2, v_p2 = halo.halo_pad_wide(
-                (u, v), boundaries, AXIS_NAMES, dims, 2
+        def chain(u, v, step, depth):
+            """``depth`` steps from one ``depth``-wide exchange."""
+            u_w, v_w = halo.halo_pad_wide(
+                (u, v), boundaries, AXIS_NAMES, dims, depth
             )
-            if use_noise:
-                nz_a = params.noise * unit_noise(step, offs - 1, ext)
-            else:
-                nz_a = jnp.asarray(0.0, u.dtype)
-            u_a, v_a = stencil.reaction_update(u_p2, v_p2, nz_a, params)
-            u_a = freeze_out_of_domain(u_a, stencil.U_BOUNDARY)
-            v_a = freeze_out_of_domain(v_a, stencil.V_BOUNDARY)
-            if use_noise:
-                nz_b = params.noise * unit_noise(step + 1, offs, u.shape)
-            else:
-                nz_b = jnp.asarray(0.0, u.dtype)
-            return stencil.reaction_update(u_a, v_a, nz_b, params)
+            for s in range(depth):
+                m_out = depth - 1 - s
+                out_shape = tuple(d + 2 * m_out for d in u.shape)
+                if use_noise:
+                    nz = params.noise * unit_noise(
+                        step + s, offs - m_out, out_shape
+                    )
+                else:
+                    nz = jnp.asarray(0.0, u.dtype)
+                u_w, v_w = stencil.reaction_update(u_w, v_w, nz, params)
+                u_w = freeze_out_of_domain(u_w, stencil.U_BOUNDARY, m_out)
+                v_w = freeze_out_of_domain(v_w, stencil.V_BOUNDARY, m_out)
+            return u_w, v_w
 
-        pairs, rem = divmod(nsteps, 2)
-        u, v = lax.fori_loop(0, pairs, pair_step, (u, v))
+        def chain_body(i, carry):
+            u, v = carry
+            return chain(u, v, step0 + fuse * i, fuse)
+
+        rounds, rem = divmod(nsteps, fuse)
+        u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
         if rem:
-            u, v = single_step(nsteps - 1, (u, v))
+            u, v = chain(u, v, step0 + fuse * rounds, rem)
         return u, v
 
     def _runner(self, nsteps: int):

@@ -175,3 +175,20 @@ def test_append_mode(tmp_path):
 def test_missing_store_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         BpReader(str(tmp_path / "absent.bp"))
+
+
+def test_count_steps_upto_ignores_metadata_less_store(tmp_path):
+    """A store directory without committed rank-0 metadata has nothing to
+    roll back. In a multi-process restart with a fresh output store, a
+    peer writer may create the directory (and its own md.N.json) before
+    THIS process — the only writer of md.json — gets there; blocking on
+    md.json here deadlocked the restart (found by
+    test_two_process_restart_from_distributed_checkpoint)."""
+    from grayscott_jl_tpu.io import count_steps_upto
+
+    assert count_steps_upto(str(tmp_path / "absent.bp"), 10) is None
+
+    racy = tmp_path / "racy.bp"
+    racy.mkdir()
+    (racy / "md.1.json").write_text('{"complete": false, "steps": []}')
+    assert count_steps_upto(str(racy), 10) is None

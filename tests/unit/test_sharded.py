@@ -80,6 +80,27 @@ def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps, lang):
 
 
 @requires8
+@pytest.mark.parametrize("depth", [3, 4])
+def test_sharded_deep_chain_matches_stepwise(depth, monkeypatch):
+    """The XLA sharded path chains ``GS_FUSE`` steps from ONE
+    depth-wide halo exchange (shrinking extended windows). Deep chains
+    (k > 2) must reproduce the step-at-a-time trajectory exactly,
+    noise included, with a remainder chain for non-multiples."""
+    monkeypatch.setenv("GS_FUSE", str(depth))
+    L = 16
+    nsteps = depth + 1  # exercises one full chain + a remainder chain
+    fused = Simulation(_settings(L=L, noise=0.1), n_devices=8, seed=7)
+    stepwise = Simulation(_settings(L=L, noise=0.1), n_devices=8, seed=7)
+    fused.iterate(nsteps)
+    for _ in range(nsteps):
+        stepwise.iterate(1)
+    uf, vf = fused.get_fields()
+    us, vs = stepwise.get_fields()
+    np.testing.assert_allclose(uf, us, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(vf, vs, rtol=1e-6, atol=1e-7)
+
+
+@requires8
 def test_sharded_init_matches_single():
     ref = Simulation(_settings(L=16), n_devices=1)
     sh = Simulation(_settings(L=16), n_devices=8)
