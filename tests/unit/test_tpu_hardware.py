@@ -188,6 +188,12 @@ def test_faces_kernel_on_hardware(noise):
     seeds = jnp.asarray([3, 1, 9], jnp.int32)
     use_noise = noise != 0.0
 
+    # Guard against vacuity: if fused_step would take its own XLA
+    # fallback (VMEM-too-small part, lane misalignment), this test
+    # compares the oracle with itself and proves nothing.
+    assert pallas_stencil.pick_block_planes(L, L, L, 4, 1) > 0
+    assert L % 128 == 0, "lane-misaligned L would route to XLA"
+
     got_u, got_v = pallas_stencil.fused_step(
         u, v, params, seeds, faces, use_noise=use_noise
     )
