@@ -22,14 +22,16 @@ Wedge-proofing design:
 * Timed-out children get SIGTERM + grace before SIGKILL — a SIGKILLed
   PJRT client can wedge the chip grant server-side for the next user.
 
-Baseline anchor (see BASELINE.md): the reference publishes no numbers; its
-GPU target hardware is the Summit V100 (job scripts, ``scripts/job_summit.sh``).
-A bandwidth-roofline estimate for the reference's CUDA.jl kernel on V100 is
-  900 GB/s HBM / 16 bytes-per-cell-update (2 fields x read+write x f32)
-  = 5.6e10 cell-updates/s,
-an *upper* bound for the reference (its 2D-grid serial-x kernel with
-in-kernel Distributions.Uniform sampling does not reach roofline).
-vs_baseline = measured / 5.6e10.
+Baseline anchors (bracketed; derivation in BASELINE.md "Anchors"): the
+reference publishes no numbers; its GPU target hardware is the Summit
+V100 (``scripts/job_summit.sh``).
+* Upper: V100 HBM roofline 900 GB/s / 16 B-per-cell-update = 5.6e10
+  cell-updates/s — unreachable for any single-step kernel.
+  ``vs_baseline`` = measured / 5.6e10 (conservative).
+* Lower: traffic model of the kernel as written (warp lanes stride
+  whole planes -> 12.5% load-sector efficiency,
+  ``/root/reference/ext/CUDAExt.jl:138-176``) ~= 7.0e9.
+  ``vs_ref_kernel_model`` = measured / 7.0e9.
 
 The Pallas kernel is the measured path (the framework's TPU-native fused
 kernel); set GS_BENCH_KERNEL=Plain for the XLA path. GS_BENCH_L /
@@ -46,13 +48,15 @@ import time
 
 L = int(os.environ.get("GS_BENCH_L", "256"))
 STEPS_PER_ROUND = int(os.environ.get("GS_BENCH_STEPS", "100"))
-ROUNDS = int(os.environ.get("GS_BENCH_ROUNDS", "5"))
+ROUNDS = int(os.environ.get("GS_BENCH_ROUNDS", "7"))
 KERNEL = os.environ.get("GS_BENCH_KERNEL", "Pallas")
 PROBE_TIMEOUT = float(os.environ.get("GS_BENCH_PROBE_TIMEOUT", "75"))
 PROBE_RETRIES = int(os.environ.get("GS_BENCH_PROBE_RETRIES", "3"))
 PROBE_DELAY = float(os.environ.get("GS_BENCH_PROBE_DELAY", "20"))
 RUN_TIMEOUT = float(os.environ.get("GS_BENCH_RUN_TIMEOUT", "900"))
-BASELINE_CELL_UPDATES = 5.6e10  # V100 roofline estimate, see module docstring
+SUSTAIN_SECONDS = float(os.environ.get("GS_BENCH_SUSTAIN_SECONDS", "10"))
+BASELINE_CELL_UPDATES = 5.6e10  # upper anchor, see module docstring
+REF_KERNEL_MODEL = 7.0e9  # lower anchor: the reference kernel as written
 
 PROBE_SRC = (
     "import jax, jax.numpy as jnp;"
@@ -147,6 +151,7 @@ def worker(platform: str, kernel: str) -> None:
 
     r = bench_one(
         L, "Float32", kernel, noise=0.1, steps=STEPS_PER_ROUND, rounds=ROUNDS,
+        sustain_seconds=SUSTAIN_SECONDS,
     )
     print("GSRESULT " + json.dumps(r), flush=True)
 
@@ -161,12 +166,27 @@ def emit(result, error=None) -> None:
             if result
             else None
         ),
+        "vs_ref_kernel_model": (
+            result["cell_updates_per_s"] / REF_KERNEL_MODEL
+            if result
+            else None
+        ),
         # Which kernel/platform actually produced the number — a Pallas
         # regression falling back must be visible in the recorded payload,
         # not only on stderr.
         "kernel": result["kernel"] if result else KERNEL,
         "platform": result["platform"] if result else None,
     }
+    if result:
+        # Artifact hygiene: the tunnel chip's clock throttle spreads
+        # identical configs ~1.7x, so the artifact carries every round
+        # (chronological), the median, and the fixed-duration sustained
+        # number alongside the headline best (BASELINE.md caveats).
+        for k in ("rounds_us_per_step", "median_us_per_step",
+                  "median_cell_updates_per_s", "sustained_us_per_step",
+                  "sustained_cell_updates_per_s"):
+            if k in result:
+                payload[k] = result[k]
     if error:
         payload["error"] = error
     print(json.dumps(payload))

@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Round-robin A/B probe for Pallas-kernel variants on one chip.
+
+The tunnel chip's VPU clock throttles under sustained load and recovers
+over minutes (BASELINE.md "measurement caveats"), so timing variant A for
+a minute and then variant B for a minute confounds the variant with the
+clock state. This harness warms every configuration up front, then
+interleaves them ROUND-ROBIN in one process: each timing round visits
+every config within a few seconds of the others, so a cross-config
+comparison inside one round shares clock state, and the per-config best
+across rounds catches each config's fastest window.
+
+    python benchmarks/ab_probe.py \
+        --case fuse=4,bx=16,noise=0.1 --case fuse=6,bx=16,noise=0.1
+
+Emits one JSON line per config with every round's µs/step plus
+best/median (the artifact-hygiene format BASELINE.md documents), then a
+summary table on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    old = {k: os.environ.get(k) for k in kv}
+    os.environ.update({k: str(v) for k, v in kv.items() if v is not None})
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _parse_case(text: str) -> dict:
+    out = {"fuse": 4, "bx": None, "noise": 0.1, "lang": "Pallas",
+           "precision": "Float32"}
+    for part in text.split(","):
+        k, _, v = part.partition("=")
+        k = k.strip()
+        if k not in out:
+            raise SystemExit(f"unknown case key {k!r} in {text!r}")
+        out[k] = v if k in ("lang", "precision") else (
+            float(v) if k == "noise" else int(v)
+        )
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--case", action="append", default=[],
+                    help="fuse=K,bx=N,noise=X[,lang=Pallas][,precision=F32]")
+    ap.add_argument("--l", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--out", default=None, help="write JSONL here too")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    cases = [_parse_case(c) for c in args.case]
+    if not cases:
+        raise SystemExit("no --case given")
+
+    def sync(sim) -> float:
+        # Dependent scalar readback: block_until_ready is unreliable
+        # through the axon tunnel (utils/benchmark.time_sim).
+        return float(jnp.sum(sim.u[:1, :1, :4]))
+
+    sims = []
+    for c in cases:
+        settings = Settings(
+            L=args.l, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0,
+            noise=c["noise"], precision=c["precision"],
+            backend="CPU" if args.cpu else "TPU",
+            kernel_language=c["lang"],
+        )
+        sim = Simulation(settings, n_devices=1)
+        # GS_FUSE / GS_BX are read at trace time: pin them for the
+        # compile-triggering warmup; the cached runner keeps them.
+        with _env(GS_FUSE=c["fuse"], GS_BX=c["bx"]):
+            t0 = time.perf_counter()
+            sim.iterate(args.steps)
+            sync(sim)
+            print(f"probe: warmed {c} in {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+        sims.append(sim)
+
+    rounds = [[] for _ in cases]
+    for r in range(args.rounds):
+        for i, sim in enumerate(sims):
+            t0 = time.perf_counter()
+            sim.iterate(args.steps)
+            sync(sim)
+            rounds[i].append((time.perf_counter() - t0) / args.steps * 1e6)
+
+    results = []
+    for c, rs in zip(cases, rounds):
+        best = min(rs)
+        results.append({
+            **c, "L": args.l, "steps": args.steps,
+            "rounds_us_per_step": [round(x, 1) for x in rs],
+            "best_us_per_step": round(best, 1),
+            "median_us_per_step": round(statistics.median(rs), 1),
+            "best_cell_updates_per_s": round(args.l ** 3 / (best * 1e-6), 1),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+
+    print("\n| fuse | bx | noise | lang | best µs/step | median | cu/s |",
+          file=sys.stderr)
+    print("|---|---|---|---|---|---|---|", file=sys.stderr)
+    for r in results:
+        print(
+            f"| {r['fuse']} | {r['bx']} | {r['noise']} | {r['lang']} | "
+            f"{r['best_us_per_step']} | {r['median_us_per_step']} | "
+            f"{r['best_cell_updates_per_s']:.3e} |",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,13 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--kernel", default="Plain")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument(
+        "--fuse-sweep", default=None, metavar="K1,K2,...",
+        help="instead of timing, lower a k-step chunk per depth and "
+        "report the compiled collective count — the 1/k exchange-"
+        "amortization claim as numbers (6 ppermutes per chunk, so "
+        "collectives per STEP scale 6/k)",
+    )
     args = ap.parse_args()
 
     kside = round(args.devices ** (1 / 3))
@@ -59,6 +66,33 @@ def main() -> int:
     base = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.0,
                 precision="Float32", backend=backend,
                 kernel_language=args.kernel)
+
+    if args.fuse_sweep:
+        import re
+
+        import jax.numpy as jnp
+
+        for k in (int(s) for s in args.fuse_sweep.split(",")):
+            os.environ["GS_FUSE"] = str(k)
+            sim = Simulation(
+                Settings(L=L_global, **base), n_devices=args.devices
+            )
+            runner = sim._runner(k)  # one chain round
+            txt = runner.lower(
+                sim.u, sim.v, sim.base_key, jnp.int32(0), sim.params
+            ).compile().as_text()
+            n_perm = len(
+                re.findall(r"collective-permute(?:-start)?\(", txt)
+            )
+            print(json.dumps({
+                "platform": backend.lower(),
+                "devices": args.devices,
+                "kernel": args.kernel,
+                "fuse": k,
+                "collectives_per_chunk": n_perm,
+                "collectives_per_step": round(n_perm / k, 2),
+            }))
+        return 0
 
     sharded = Simulation(
         Settings(L=L_global, **base), n_devices=args.devices

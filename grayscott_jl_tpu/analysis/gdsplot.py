@@ -20,7 +20,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..io.bplite import BpReader
+from ..io import open_reader
 
 _AXES = {"x": 0, "y": 1, "z": 2}
 
@@ -33,7 +33,7 @@ def load_slice(
     index: Optional[int] = None,
 ) -> np.ndarray:
     """A 2D slice of ``var`` at output step ``step`` (negative = from end)."""
-    r = BpReader(path)
+    r = open_reader(path)
     n = r.num_steps()
     if n == 0:
         raise ValueError(f"{path} contains no steps")
@@ -87,7 +87,7 @@ def plot_pdf(
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    r = BpReader(path)
+    r = open_reader(path)
     n = r.num_steps()
     if step < 0:
         step = n + step

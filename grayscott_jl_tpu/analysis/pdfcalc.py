@@ -33,8 +33,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from ..io import open_writer
-from ..io.bplite import BpReader, StepStatus
+from ..io import open_reader, open_writer
+from ..io.bplite import StepStatus
 
 _EPS = 1.0e-20  # reference ``_epsilon`` threshold (pdfcalc.jl:5-7)
 
@@ -126,7 +126,10 @@ def read_data_write_pdf(
     one worker the whole volume is processed. ``max_not_ready`` bounds the
     NOT_READY retries (None = retry forever, the reference behavior).
     """
-    reader = BpReader(in_filename)
+    # open_reader dispatches on the store format: BP-lite from this
+    # framework's runs, or — when the adios2 bindings are importable — a
+    # real ADIOS2 BP store (including the reference's own output).
+    reader = open_reader(in_filename)
     # All workers cooperate on ONE output store (the reference's
     # MPI-parallel pdfcalc writes a single output.bp the same way).
     writer = open_writer(out_filename, writer_id=rank, nwriters=size)
@@ -200,11 +203,26 @@ def read_data_write_pdf(
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry. Parallel operation (the reference's pdfcalc is
+    MPI-parallel, ``pdfcalc.jl:126-144``) uses the same environment
+    contract as the simulation's multi-host launch: start
+    ``GS_TPU_NUM_PROCESSES`` copies, each with its own
+    ``GS_TPU_PROCESS_ID``; each worker reads its x-share via selection
+    and writes its block into ONE shared multi-writer output store."""
+    import os
     import sys
 
     ns = parse_arguments(sys.argv[1:] if argv is None else argv)
+    rank = int(os.environ.get("GS_TPU_PROCESS_ID", "0"))
+    size = int(os.environ.get("GS_TPU_NUM_PROCESSES", "1"))
+    if not 0 <= rank < size:
+        raise SystemExit(
+            f"pdfcalc: GS_TPU_PROCESS_ID={rank} out of range for "
+            f"GS_TPU_NUM_PROCESSES={size}"
+        )
     read_data_write_pdf(
-        ns.input, ns.output, ns.N, ns.output_inputdata, verbose=True
+        ns.input, ns.output, ns.N, ns.output_inputdata,
+        rank=rank, size=size, verbose=rank == 0,
     )
     return 0
 

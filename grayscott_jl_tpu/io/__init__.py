@@ -1,19 +1,32 @@
 """I/O subsystem: BP-lite streaming stores, VTK output, checkpointing.
 
-Two interchangeable writer engines for the same on-disk format (the
+Three interchangeable writer engines behind :func:`open_writer` (the
 reference's single engine is the ADIOS2 C++ library, ``IO.jl``):
 
-* native (``csrc/libbplite.so`` via ``io/native.py``) — C++, async step
-  pipeline with background write/fsync/publish; default when built;
-* pure Python (``io/bplite.py``) — reference implementation and format
-  spec; always available.
+* real ADIOS2 (``io/adios.py``) — genuine ``.bp`` output, used
+  automatically when the ``adios2`` wheel is importable (single-writer,
+  non-append stores); ADIOS2/Fides/ParaView tooling opens it exactly as
+  it opens the reference's output;
+* native BP-lite (``csrc/libbplite.so`` via ``io/native.py``) — C++,
+  async step pipeline with background write/fsync/publish; default when
+  built;
+* pure Python BP-lite (``io/bplite.py``) — reference implementation and
+  format spec; always available.
 
-``GS_TPU_NATIVE_IO=0`` forces the Python engine.
+``GS_TPU_ADIOS2=0`` / ``GS_TPU_NATIVE_IO=0`` force the fallbacks.
+:func:`open_reader` dispatches the matching reader by inspecting the
+store (BP-lite directories carry ``md.json``).
 """
 
 from __future__ import annotations
 
 import os
+
+
+def _md_path_of(path: str) -> str:
+    from .bplite import _md_path
+
+    return _md_path(path)
 
 
 def count_steps_upto(path: str, sim_step: int):
@@ -52,14 +65,46 @@ def open_writer(
     nwriters: int = 1,
     append: bool = False,
     keep_steps=None,
+    prefer_adios2: bool = True,
 ):
-    """Open a BP-lite writer with the best available engine.
+    """Open a step-based writer with the best available engine.
 
-    Both engines implement the full multi-writer layout (``nwriters > 1``,
-    one writer per JAX process, private ``data.<w>`` payload +
-    per-writer metadata, reader-side merge) — pod-scale runs get the
-    async native engine too.
+    Preference order: real ADIOS2 (genuine ``.bp``; single-writer
+    non-append stores when the wheel is importable), then the native C++
+    BP-lite engine, then pure-Python BP-lite. The BP-lite engines
+    implement the full multi-writer layout (``nwriters > 1``, one writer
+    per JAX process, private ``data.<w>`` payload + per-writer metadata,
+    reader-side merge) and rollback-append — pod-scale runs get the
+    async native engine.
     """
+    if (
+        prefer_adios2
+        and os.environ.get("GS_TPU_ADIOS2", "1") != "0"
+        and nwriters == 1
+        and not append
+    ):
+        from . import adios
+
+        if adios.available():
+            # Overwriting a previous BP-lite run at this path: drop its
+            # metadata/payload files, or open_reader would later find the
+            # stale md.json and silently serve the OLD run's data.
+            if os.path.isdir(path):
+                for name in os.listdir(path):
+                    if name == "md.json" or (
+                        name.startswith(("md.", "data."))
+                        and not name.endswith(".bp")
+                    ):
+                        os.remove(os.path.join(path, name))
+            return adios.Adios2Writer(path, writer_id=writer_id,
+                                      nwriters=nwriters)
+    if append and os.path.isdir(path) and not os.path.isfile(_md_path_of(path)):
+        raise RuntimeError(
+            f"{path} exists but is not a BP-lite store (a real ADIOS2 BP "
+            "store from a previous run?); rollback-append is a BP-lite "
+            "feature — rerun the original run with GS_TPU_ADIOS2=0, or "
+            "point the restart at a fresh output path"
+        )
     if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native
 
@@ -73,4 +118,43 @@ def open_writer(
     return BpWriter(
         path, writer_id=writer_id, nwriters=nwriters, append=append,
         keep_steps=keep_steps,
+    )
+
+
+def open_reader(path: str):
+    """Open a store with the matching reader engine.
+
+    BP-lite stores are directories carrying ``md.json``; anything else is
+    a real ADIOS2 BP store and needs the adios2 bindings (a clear error
+    when they are absent).
+    """
+    from .bplite import BpReader, _md_path
+
+    def _bplite_evidence() -> bool:
+        # A BP-lite store mid-startup may exist without md.json yet
+        # (rank 0 commits it after peers create the directory): any
+        # md.<w>.json marks it ours, and an empty directory gets
+        # BpReader's retry-until-metadata behavior. Only .json metadata
+        # is distinguishing — real ADIOS2 BP4 stores also carry bare
+        # data.0 / md.0 subfiles.
+        if os.path.isfile(_md_path(path)):
+            return True
+        try:
+            names = os.listdir(path)
+        except (FileNotFoundError, NotADirectoryError):
+            return False
+        return not names or any(
+            n.startswith("md.") and n.endswith((".json", ".json.tmp"))
+            for n in names
+        )
+
+    if not os.path.exists(path) or _bplite_evidence():
+        return BpReader(path)
+    from . import adios
+
+    if adios.available():
+        return adios.Adios2Reader(path)
+    raise RuntimeError(
+        f"{path} is not a BP-lite store and the adios2 bindings are not "
+        "importable to read it as a real BP store"
     )

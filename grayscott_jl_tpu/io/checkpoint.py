@@ -43,12 +43,18 @@ class CheckpointWriter:
             from . import count_steps_upto
 
             keep = count_steps_upto(settings.checkpoint_output, resume_step)
+        # Checkpoints stay on the BP-lite engines even when adios2 is
+        # importable: rollback-append and selection-restore are BP-lite
+        # semantics, and nothing downstream needs ADIOS2 byte
+        # compatibility for checkpoints (the visualization/analysis
+        # output store is where that matters).
         self.writer = open_writer(
             settings.checkpoint_output,
             writer_id=writer_id,
             nwriters=nwriters,
             append=settings.restart,
             keep_steps=keep,
+            prefer_adios2=False,
         )
         if writer_id == 0:
             self.writer.define_attribute("L", settings.L)

@@ -65,13 +65,27 @@ def plane_seed(k0, k1, step, gx):
     )
 
 
+def cell_hash(iy, iz, row):
+    """Avalanche hash of the per-cell (y, z) counter — a pure function of
+    the global cell column, independent of key/step/plane. Broadcast
+    shapes keep this a 2D computation: for (1, ny, 1) x (1, 1, nz)
+    inputs the result is (1, ny, nz), so in the fused kernel the counter
+    hash costs ny*nz lanes once per draw instead of nx*ny*nz."""
+    return hash32(iy * _u32(row) + iz)
+
+
 def block_bits(seed, iy, iz, row):
     """uint32 noise bits for cells at broadcastable global y/z
     coordinate arrays ``iy``/``iz`` (uint32); ``row`` is the global row
     length (grid side L), making the per-cell counter a global
     coordinate. ONE definition of the seed/counter mix — the XLA block
-    form and the Pallas in-kernel form must produce identical bits."""
-    return hash32(hash32(iy * _u32(row) + iz + seed) ^ seed)
+    form and the Pallas in-kernel form must produce identical bits.
+
+    Split as ``hash32(cell_hash(y, z) ^ seed)`` so only one of the two
+    avalanche rounds runs at full 3D rank (``seed`` carries the x/step
+    variation at (nx, 1, 1)): per-cell noise cost is one hash32 + xor,
+    with the counter hash amortized over the x axis."""
+    return hash32(cell_hash(iy, iz, row) ^ seed)
 
 
 def bits_to_pm1(bits, dtype):
@@ -92,9 +106,12 @@ def uniform_pm1_block(key_i32, step, offsets, shape, row, dtype):
     grid side L. Identical values to the Pallas kernel's per-plane draws
     for the same global cells.
     """
-    gx = lax.broadcasted_iota(jnp.uint32, shape, 0) + _u32(offsets[0])
+    gx = (lax.broadcasted_iota(jnp.uint32, (shape[0], 1, 1), 0)
+          + _u32(offsets[0]))
     seed = plane_seed(key_i32[0], key_i32[1], step, gx)
-    iy = lax.broadcasted_iota(jnp.uint32, shape, 1) + _u32(offsets[1])
-    iz = lax.broadcasted_iota(jnp.uint32, shape, 2) + _u32(offsets[2])
+    iy = (lax.broadcasted_iota(jnp.uint32, (1, shape[1], 1), 1)
+          + _u32(offsets[1]))
+    iz = (lax.broadcasted_iota(jnp.uint32, (1, 1, shape[2]), 2)
+          + _u32(offsets[2]))
     bits = block_bits(seed, iy, iz, row)
     return bits_to_pm1(bits, dtype)

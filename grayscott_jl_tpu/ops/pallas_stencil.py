@@ -26,12 +26,14 @@ cell per step), so the kernel is designed around HBM traffic:
   semantics, ``Simulation_CPU.jl:23-24``); on an interior shard edge it
   substitutes the neighbor face delivered by the ``ppermute`` halo
   exchange (``parallel/halo.exchange_faces``);
-* **temporal blocking** (``fuse=k``, single-block runs): each slab pass
-  advances k timesteps through a chain of shrinking windows — stage s
-  computes step n+1+s on a (BX+2(k-1-s))-plane window, recomputing one
-  overlap plane per side per stage — so HBM traffic per *step* drops to
+* **temporal blocking** (``fuse=k``): each slab pass advances k
+  timesteps through a chain of shrinking windows — stage s computes
+  step n+1+s on a (BX+2(k-1-s))-plane window, recomputing one overlap
+  plane per side per stage — so HBM traffic per *step* drops to
   ~((BX+2k)/BX + 1)/k passes (~5 bytes/cell at BX=16, k=4, f32), far
   below the 1-read-1-write "roofline" of any single-step schedule.
+  Multi-block slabs fuse too (any BX >= k, the production shape at
+  L=128+); only the with-faces/sharded combination requires fuse=1.
   Measured on the v5e, the slab DMA pipeline has a hard per-pass
   envelope (~2 ms at L=256 f32) that is flat in compute content, so
   per-step time scales ~1/k until the k-fold stage compute fills the
@@ -75,6 +77,19 @@ from .noise import _u32, block_bits, plane_seed, uniform_pm1_block
 _VMEM_BUDGETS = {True: 96 * 1024 * 1024, False: 12 * 1024 * 1024}
 _VMEM_BUDGET = None
 
+#: Messages already emitted by :func:`_warn_once` (one line per distinct
+#: silent-fallback condition per process — benchmark users must see when
+#: "Pallas" is measuring the XLA kernel).
+_WARNED: set = set()
+
+
+def _warn_once(msg: str) -> None:
+    if msg not in _WARNED:
+        _WARNED.add(msg)
+        import sys
+
+        print(f"gray-scott: warning: {msg}", file=sys.stderr)
+
 
 def _vmem_budget() -> int:
     global _VMEM_BUDGET
@@ -109,23 +124,43 @@ def pick_block_planes(
 ) -> int:
     """Largest slab depth BX (dividing nx) whose double-buffered u/v
     in/mid/out scratch fits the VMEM budget; 0 if even BX=1 does not
-    fit. ``fuse`` is the temporal-blocking depth (input halo width)."""
+    fit. ``fuse`` is the temporal-blocking depth (input halo width).
+    ``GS_BX`` forces a specific depth (benchmark sweeps) when it divides
+    ``nx`` and fits; otherwise it is ignored with a warning."""
     budget = _vmem_budget()
-    for bx in (16, 8, 4, 2, 1):
+
+    def fits(bx: int) -> bool:
         if nx % bx:
-            continue
+            return False
         if bx < nx and bx < fuse:
             # Interior slabs read [b*bx - fuse, b*bx + bx + fuse); with
             # bx < halo the slab next to the boundary would read out of
             # bounds. (Single-block nx == bx has no interior slabs.)
-            continue
+            return False
         in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
         nbuf, mid_planes = _mid_layout(bx, fuse)
         # Mid buffers hold the compute dtype — at least f32 for 16-bit
         # fields (_compute_dtype), hence the 4-byte floor.
         mid_bytes = 2 * nbuf * mid_planes * ny * nz * max(itemsize, 4)
         out_bytes = 2 * 2 * bx * ny * nz * itemsize
-        if in_bytes + mid_bytes + out_bytes <= budget:
+        return in_bytes + mid_bytes + out_bytes <= budget
+
+    import os
+
+    override = os.environ.get("GS_BX", "")
+    if override:
+        try:
+            bx = int(override)
+        except ValueError:
+            bx = -1
+        if bx > 0 and fits(bx):
+            return bx
+        _warn_once(
+            f"GS_BX={override!r} does not fit "
+            f"(nx={nx}, fuse={fuse}); using automatic slab depth"
+        )
+    for bx in (16, 8, 4, 2, 1):
+        if fits(bx):
             return bx
     return 0
 
@@ -221,7 +256,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
         Du, Dv, F, K, dt, noise = (
             params[j].astype(cdt) for j in range(6)
         )
-        six = jnp.asarray(6.0, cdt)
+        inv_six = jnp.asarray(1.0 / 6.0, cdt)
         one = jnp.asarray(1.0, cdt)
 
         def slab_io(slot, b, start):
@@ -301,7 +336,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
         def lap(win, c, edges):
             """7-point Laplacian over the window interior ``c``
-            (``Common.jl:13-18`` — keep the /6)."""
+            (``Common.jl:13-18``), in the same ``sum * (1/6) - center``
+            form and neighbor order as ``stencil.laplacian`` — the
+            per-cell divide of the literal ``(sum - 6c)/6`` was
+            measurable VPU time in the fused pass."""
             n = c.shape[0]
             ylo, yhi, zlo, zhi = edges
             return (
@@ -310,8 +348,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 + _shifted(c, 1, -1, yhi, masks)
                 + _shifted(c, 2, 1, zlo, masks)
                 + _shifted(c, 2, -1, zhi, masks)
-                - six * c
-            ) / six
+            ) * inv_six - c
 
         def euler_terms(u_win, v_win, u_edges, v_edges):
             """Rate terms (u_c, du, v_c, dv) of the window interior —
@@ -550,7 +587,8 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi, u_zlo, u_zhi, v_zlo,
     v_zhi)`` with x faces shaped (1, ny, nz), y faces (nx, 1, nz),
     z faces (nx, ny, 1). ``fuse=k`` temporal blocking advances k steps
-    per HBM pass (single-block runs only). ``detect_races`` (interpret
+    per HBM pass (single- or multi-block; incompatible only with
+    ``faces``). ``detect_races`` (interpret
     mode only) runs the TPU interpreter's DMA/compute race detector; it
     is a static jit argument, so toggling it recompiles rather than
     reusing a stale cache entry.
@@ -610,6 +648,13 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     # compiles). Unaligned shapes take the XLA kernel, which handles any L.
     sublane = 16 if dtype == jnp.bfloat16 else 8
     aligned = nz % 128 == 0 and ny % sublane == 0
+    if on_tpu and not aligned:
+        _warn_once(
+            f"Pallas kernel requested but the local grid "
+            f"({nx}x{ny}x{nz}, {dtype}) is not Mosaic-tile-aligned "
+            f"(needs nz % 128 == 0 and ny % {sublane} == 0); "
+            "running the XLA kernel instead"
+        )
     if (dtype == jnp.float64 and on_tpu) or bx == 0 or (
         on_tpu and not aligned
     ) or (

@@ -1,33 +1,33 @@
-"""Halo-amortized temporal pairing for the sharded Pallas path.
+"""Halo-amortized k-deep temporal blocking for the sharded Pallas path.
 
 The fused Pallas kernel (``ops/pallas_stencil.py``) reads interior-shaped
-blocks plus 1-thick resolved halo faces; a sharded run therefore pays one
-6-``ppermute`` exchange per step. This module halves that: ONE 2-deep
-ghost exchange feeds TWO kernel steps —
+blocks plus 1-thick resolved halo faces — its Mosaic layout needs the
+lane dimension to stay 128-aligned, so unlike the XLA language it cannot
+consume the shrinking ghost-padded windows the XLA chain uses
+(``simulation.py``). A step-at-a-time sharded run therefore pays one
+6-``ppermute`` exchange per step. This module cuts that by ``k``: ONE
+k-deep ghost exchange feeds ``k`` kernel steps —
 
-1. :func:`exchange_wide_faces` delivers 2-deep ghost slabs (with the
-   edge/corner data deep stencils need, via the sequential
-   axis-by-axis corner-propagation ordering) **without materializing a
-   padded block** — slab-level concats only, so the kernel keeps its
-   no-ghost-pad HBM layout;
-2. step n+1 runs the kernel with the inner ghost planes as faces;
-3. :func:`ring_faces` recomputes, *locally and in XLA*, the 1-plane ring
-   of step-(n+1) values owned by each neighbor — O(n^2) work on slab
-   windows assembled from the wide ghosts. Position-keyed noise
-   (``ops/noise.py``) makes the recomputed values identical to what the
-   neighbor computed;
-4. step n+2 runs the kernel with that ring as its faces.
+1. ``halo.halo_pad_wide`` materializes a depth-k padded frame per field
+   (edge/corner ghosts included, via the sequential corner-propagation
+   ordering the reference's xy/xz/yz exchange also has,
+   ``communication.jl:138-199``);
+2. each stage s advances the interior n^3 block with the Pallas kernel,
+   its 6 faces sliced from the frame (:func:`_frame_faces`);
+3. between stages, the frame's ghost SHELL — O(k * n^2) cells — advances
+   one step in XLA (:func:`_advance_frame`): six overlapping stencil
+   windows around the shell, reassembled with the kernel's interior into
+   a depth-(m-1) frame, out-of-domain ghosts re-frozen
+   (:func:`freeze_out_of_domain`). Position-keyed noise (``ops/noise.py``)
+   makes the shell's recomputed cells identical to what the owning
+   neighbor computed, so the chain reproduces the step-at-a-time
+   trajectory exactly.
 
-Per two steps: one exchange + two kernel HBM passes + O(n^2) ring math,
-vs two exchanges + two passes for step-at-a-time — the amortization the
-reference pays for with ``exchange!`` every step
-(``communication.jl:138-199``). The XLA kernel language amortizes
-differently (extended-window recompute on a width-2 padded block,
-``simulation.py``); both reproduce the step-at-a-time trajectory.
-
-Ghost slab shapes for an (nx, ny, nz) block (2-deep, corner-propagated):
-x: (2, ny, nz); y: (nx+4, 2, nz) — x-extended; z: (nx+4, ny+4, 2) —
-x- and y-extended. Global-edge slabs hold the frozen boundary value.
+Per ``k`` steps: one exchange + k kernel HBM passes + O(k^2 n^2) XLA
+shell math — vs k exchanges for step-at-a-time. The XLA kernel language
+amortizes the same way but without the kernel/shell split (its whole
+window shrinks, ``simulation.py``); both reproduce the stepwise
+trajectory, noise included.
 """
 
 from __future__ import annotations
@@ -37,230 +37,130 @@ from typing import Sequence, Tuple
 import jax.numpy as jnp
 from jax import lax
 
+from . import halo
 
-def exchange_wide_faces(
-    arrays: Sequence[jnp.ndarray],
-    boundary_values: Sequence[float],
-    axis_names: Tuple[str, str, str],
-    axis_sizes: Tuple[int, int, int],
-):
-    """2-deep ghost slabs for each array; see module docstring.
 
-    Returns, per array, ``((x_lo, x_hi), (y_lo, y_hi), (z_lo, z_hi))``.
-    Must be called inside ``shard_map``.
-    """
-    arrays = list(arrays)
-    n_arr = len(arrays)
-    ghosts = [[] for _ in arrays]
-
-    def ext_slab(i, dim, lo_take):
-        """Width-2 boundary slab of array ``i`` along ``dim``, extended
-        with the already-received ghosts of axes < dim (that inclusion
-        is what propagates edge/corner data)."""
-
-        def slab(x):
-            sl = [slice(None)] * 3
-            sl[dim] = slice(0, 2) if lo_take else slice(-2, None)
-            return x[tuple(sl)]
-
-        core = slab(arrays[i])
-        for d2 in range(dim):
-            lo2, hi2 = ghosts[i][d2]
-            core = jnp.concatenate([slab(lo2), core, slab(hi2)], axis=d2)
-        return core
-
+def freeze_out_of_domain(arr, bv, m, axis_names, axis_sizes):
+    """Pin the outermost ``m`` ring positions to the frozen boundary
+    value where they fall outside the global domain (the reference's
+    ``MPI.PROC_NULL`` ghost semantics). Must run inside ``shard_map``."""
+    if m == 0:
+        return arr
+    out = arr
     for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
-        sends_up = [ext_slab(i, dim, lo_take=False) for i in range(n_arr)]
-        sends_dn = [ext_slab(i, dim, lo_take=True) for i in range(n_arr)]
-        if n == 1:
-            for i, bv in enumerate(boundary_values):
-                bvt = jnp.asarray(bv, arrays[i].dtype)
-                shape = sends_up[i].shape
-                f = jnp.full(shape, bvt)
-                ghosts[i].append((f, f))
-            continue
         idx = lax.axis_index(ax)
-        up_perm = [(r, r + 1) for r in range(n - 1)]
-        dn_perm = [(r + 1, r) for r in range(n - 1)]
-        recv_lo = lax.ppermute(
-            jnp.concatenate(sends_up, axis=dim), ax, up_perm
+        pos = lax.broadcasted_iota(jnp.int32, out.shape, dim)
+        lo = (pos < m) & (idx == 0)
+        hi = (pos >= out.shape[dim] - m) & (idx == n - 1)
+        out = jnp.where(lo | hi, jnp.asarray(bv, out.dtype), out)
+    return out
+
+
+def _frame_faces(u_w, v_w, m, shape):
+    """1-thick kernel faces adjacent to the interior block, sliced from
+    depth-``m`` padded frames, in ``fused_step``'s face order
+    (u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, ..., v_zhi)."""
+
+    def face(w, dim, lo):
+        sl = [slice(m, m + s) for s in shape]
+        sl[dim] = (
+            slice(m - 1, m) if lo else slice(m + shape[dim], m + shape[dim] + 1)
         )
-        recv_hi = lax.ppermute(
-            jnp.concatenate(sends_dn, axis=dim), ax, dn_perm
-        )
-        lo_slabs = jnp.split(recv_lo, n_arr, axis=dim)
-        hi_slabs = jnp.split(recv_hi, n_arr, axis=dim)
-        for i, bv in enumerate(boundary_values):
-            bvt = jnp.asarray(bv, arrays[i].dtype)
-            lo = jnp.where(idx > 0, lo_slabs[i], bvt)
-            hi = jnp.where(idx < n - 1, hi_slabs[i], bvt)
-            ghosts[i].append((lo, hi))
+        return w[tuple(sl)]
 
-    return ghosts
-
-
-def inner_faces(gu, gv):
-    """The 1-thick resolved faces for the FIRST kernel step, sliced from
-    the wide ghosts — the plane adjacent to the block (x=-1 is index 1 of
-    the 2-deep lo slab; x=nx is index 0 of the hi slab). Order matches
-    ``ops/pallas_stencil.fused_step``."""
-    (uxl, uxh), (uyl, uyh), (uzl, uzh) = gu
-    (vxl, vxh), (vyl, vyh), (vzl, vzh) = gv
-    return (
-        uxl[1:2], uxh[0:1], vxl[1:2], vxh[0:1],
-        uyl[2:-2, 1:2, :], uyh[2:-2, 0:1, :],
-        vyl[2:-2, 1:2, :], vyh[2:-2, 0:1, :],
-        uzl[2:-2, 2:-2, 1:2], uzh[2:-2, 2:-2, 0:1],
-        vzl[2:-2, 2:-2, 1:2], vzh[2:-2, 2:-2, 0:1],
+    return tuple(
+        face(w, dim, lo)
+        for dim in range(3)
+        for w in (u_w, v_w)
+        for lo in (True, False)
     )
 
 
-def _windows(a, g, ny, nz, nx):
-    """Per-direction stencil windows around the block's six ghost ring
-    planes, assembled from block ``a`` and its wide ghosts ``g``.
-
-    Index maps (x-lo as the worked example; the rest are mirrors):
-    the ring plane x=-1 needs inputs x∈{-2,-1,0}, y∈[-1,ny+1),
-    z∈[-1,nz+1). x∈{-2,-1} comes from the x-lo slab, x=0 from the block;
-    the y borders at those x come from the y slabs (x-extended: global
-    x=-2 is index 0), the z borders from the z slabs (x- and
-    y-extended: global x=-2 index 0, global y=-1 index 1).
-    """
-    (x_lo, x_hi), (y_lo, y_hi), (z_lo, z_hi) = g
-    cat = jnp.concatenate
-
-    def xdir(core, xsl):
-        w = cat([y_lo[xsl, 1:2, :], core, y_hi[xsl, 0:1, :]], axis=1)
-        return cat(
-            [z_lo[xsl, 1:ny + 3, 1:2], w, z_hi[xsl, 1:ny + 3, 0:1]],
-            axis=2,
-        )
-
-    def ydir(core, ysl_lo, ysl_hi, xb_lo, xb_hi):
-        w = cat([xb_lo, core, xb_hi], axis=0)
-        return cat(
-            [z_lo[1:nx + 3, ysl_lo, 1:2], w, z_hi[1:nx + 3, ysl_hi, 0:1]],
-            axis=2,
-        )
-
-    return {
-        "x_lo": xdir(cat([x_lo, a[0:1]], axis=0), slice(0, 3)),
-        "x_hi": xdir(cat([a[-1:], x_hi], axis=0), slice(-3, None)),
-        "y_lo": ydir(
-            cat([y_lo[2:-2], a[:, 0:1]], axis=1),
-            slice(0, 3), slice(0, 3),
-            cat([y_lo[1:2], x_lo[1:2, 0:1, :]], axis=1),
-            cat([y_lo[-2:-1], x_hi[0:1, 0:1, :]], axis=1),
-        ),
-        "y_hi": ydir(
-            cat([a[:, -1:], y_hi[2:-2]], axis=1),
-            slice(-3, None), slice(-3, None),
-            cat([x_lo[1:2, -1:, :], y_hi[1:2]], axis=1),
-            cat([x_hi[0:1, -1:, :], y_hi[-2:-1]], axis=1),
-        ),
-        "z_lo": cat(
-            [
-                cat(
-                    [z_lo[1:nx + 3, 1:2, :],
-                     y_lo[1:nx + 3, 1:2, 0:1]], axis=2
-                ),
-                cat(
-                    [
-                        cat([z_lo[1:2, 2:-2, :],
-                             x_lo[1:2, :, 0:1]], axis=2),
-                        cat([z_lo[2:-2, 2:-2, :], a[:, :, 0:1]], axis=2),
-                        cat([z_lo[-2:-1, 2:-2, :],
-                             x_hi[0:1, :, 0:1]], axis=2),
-                    ],
-                    axis=0,
-                ),
-                cat(
-                    [z_lo[1:nx + 3, -2:-1, :],
-                     y_hi[1:nx + 3, 0:1, 0:1]], axis=2
-                ),
-            ],
-            axis=1,
-        ),
-        "z_hi": cat(
-            [
-                cat(
-                    [y_lo[1:nx + 3, 1:2, -1:],
-                     z_hi[1:nx + 3, 1:2, :]], axis=2
-                ),
-                cat(
-                    [
-                        cat([x_lo[1:2, :, -1:],
-                             z_hi[1:2, 2:-2, :]], axis=2),
-                        cat([a[:, :, -1:], z_hi[2:-2, 2:-2, :]], axis=2),
-                        cat([x_hi[0:1, :, -1:],
-                             z_hi[-2:-1, 2:-2, :]], axis=2),
-                    ],
-                    axis=0,
-                ),
-                cat(
-                    [y_hi[1:nx + 3, 0:1, -1:],
-                     z_hi[1:nx + 3, -2:-1, :]], axis=2
-                ),
-            ],
-            axis=1,
-        ),
-    }
-
-
-def ring_faces(
-    u, v, gu, gv, params, *, step, offs, L, use_noise, unit_noise,
-    axis_names, axis_sizes, boundaries,
+def _advance_frame(
+    u_w, v_w, u_new, v_new, params, *, m, step_idx, offs, use_noise,
+    unit_noise, axis_names, axis_sizes, boundaries,
 ):
-    """Step-(n+1) values on the six neighbor-adjacent ring planes,
-    recomputed locally from the wide ghosts — the faces for the SECOND
-    kernel step. On a global edge the ring is the frozen boundary value.
-
-    ``unit_noise(step, offsets, shape)`` must draw from the same
-    position-keyed stream as the kernel; that is what makes the local
-    recomputation reproduce the neighbor's computation exactly.
-    """
+    """Advance a depth-``m`` frame one step: the six ghost-shell regions
+    in XLA (six overlapping stencil windows), the interior from the
+    already-kernel-advanced ``u_new``/``v_new``; returns depth-(m-1)
+    frames with out-of-domain ghosts re-frozen."""
     from ..ops import stencil
 
-    nx, ny, nz = u.shape
-    wu = _windows(u, gu, ny, nz, nx)
-    wv = _windows(v, gv, ny, nz, nx)
-    u_bv, v_bv = boundaries
+    nx, ny, nz = u_new.shape
+    X, Y, Z = nx + 2 * m, ny + 2 * m, nz + 2 * m
+    d = m - 1
 
-    ring_offsets = {
-        "x_lo": (offs[0] - 1, offs[1], offs[2]),
-        "x_hi": (offs[0] + nx, offs[1], offs[2]),
-        "y_lo": (offs[0], offs[1] - 1, offs[2]),
-        "y_hi": (offs[0], offs[1] + ny, offs[2]),
-        "z_lo": (offs[0], offs[1], offs[2] - 1),
-        "z_hi": (offs[0], offs[1], offs[2] + nz),
-    }
-    has_nbr = {
-        "x_lo": lax.axis_index(axis_names[0]) > 0,
-        "x_hi": lax.axis_index(axis_names[0]) < axis_sizes[0] - 1,
-        "y_lo": lax.axis_index(axis_names[1]) > 0,
-        "y_hi": lax.axis_index(axis_names[1]) < axis_sizes[1] - 1,
-        "z_lo": lax.axis_index(axis_names[2]) > 0,
-        "z_hi": lax.axis_index(axis_names[2]) < axis_sizes[2] - 1,
-    }
-
-    rings = {}
-    for d in ("x_lo", "x_hi", "y_lo", "y_hi", "z_lo", "z_hi"):
-        shape = tuple(s - 2 for s in wu[d].shape)
+    def upd(usl, vsl, origin):
+        """One XLA stencil step on a window (returns its interior)."""
         if use_noise:
-            nz_ring = params.noise * unit_noise(step, ring_offsets[d], shape)
+            shape = tuple(s - 2 for s in usl.shape)
+            nzf = params.noise * unit_noise(step_idx, origin, shape)
         else:
-            nz_ring = jnp.asarray(0.0, u.dtype)
-        ru, rv = stencil.reaction_update(wu[d], wv[d], nz_ring, params)
-        rings[d] = (
-            jnp.where(has_nbr[d], ru, jnp.asarray(u_bv, u.dtype)),
-            jnp.where(has_nbr[d], rv, jnp.asarray(v_bv, v.dtype)),
-        )
+            nzf = jnp.asarray(0.0, u_new.dtype)
+        return stencil.reaction_update(usl, vsl, nzf, params)
 
-    return (
-        rings["x_lo"][0], rings["x_hi"][0],
-        rings["x_lo"][1], rings["x_hi"][1],
-        rings["y_lo"][0], rings["y_hi"][0],
-        rings["y_lo"][1], rings["y_hi"][1],
-        rings["z_lo"][0], rings["z_hi"][0],
-        rings["z_lo"][1], rings["z_hi"][1],
+    o = offs
+
+    def go(dx, dy, dz):
+        return (o[0] + dx, o[1] + dy, o[2] + dz)
+
+    # x shells span the full frame y/z extent (their outputs carry the
+    # new frame's corners); y shells span full z; z shells are core-only.
+    xl_u, xl_v = upd(u_w[0:m + 1], v_w[0:m + 1], go(-d, -d, -d))
+    xh_u, xh_v = upd(u_w[X - m - 1:], v_w[X - m - 1:], go(nx, -d, -d))
+    xsl = slice(m - 1, m + nx + 1)
+    yl_u, yl_v = upd(u_w[xsl, 0:m + 1], v_w[xsl, 0:m + 1], go(0, -d, -d))
+    yh_u, yh_v = upd(u_w[xsl, Y - m - 1:], v_w[xsl, Y - m - 1:], go(0, ny, -d))
+    ysl = slice(m - 1, m + ny + 1)
+    zl_u, zl_v = upd(
+        u_w[xsl, ysl, 0:m + 1], v_w[xsl, ysl, 0:m + 1], go(0, 0, -d)
     )
+    zh_u, zh_v = upd(
+        u_w[xsl, ysl, Z - m - 1:], v_w[xsl, ysl, Z - m - 1:], go(0, 0, nz)
+    )
+
+    def assemble(zl, core, zh, yl, yh, xl, xh):
+        inner = jnp.concatenate([zl, core, zh], axis=2)
+        mid = jnp.concatenate([yl, inner, yh], axis=1)
+        return jnp.concatenate([xl, mid, xh], axis=0)
+
+    u_bv, v_bv = boundaries
+    u_out = assemble(zl_u, u_new, zh_u, yl_u, yh_u, xl_u, xh_u)
+    v_out = assemble(zl_v, v_new, zh_v, yl_v, yh_v, xl_v, xh_v)
+    u_out = freeze_out_of_domain(u_out, u_bv, d, axis_names, axis_sizes)
+    v_out = freeze_out_of_domain(v_out, v_bv, d, axis_names, axis_sizes)
+    return u_out, v_out
+
+
+def pallas_chain(
+    u, v, params, *, depth, step, offs, use_noise, unit_noise,
+    kernel_step, axis_names, axis_sizes,
+    boundaries: Sequence[float],
+):
+    """``depth`` sharded Pallas kernel steps from ONE depth-wide halo
+    exchange; see module docstring. ``kernel_step(u, v, step_idx, faces)``
+    runs the fused kernel on an interior block; ``unit_noise(step_idx,
+    origin, shape)`` draws from the shared position-keyed stream. Must be
+    called inside ``shard_map``."""
+    if depth == 1:
+        faces = halo.exchange_faces(
+            (u, v), boundaries, axis_names, axis_sizes
+        )
+        return kernel_step(u, v, step, faces)
+
+    u_w, v_w = halo.halo_pad_wide(
+        (u, v), boundaries, axis_names, axis_sizes, depth
+    )
+    shape = u.shape
+    for s in range(depth):
+        m = depth - s
+        faces = _frame_faces(u_w, v_w, m, shape)
+        u, v = kernel_step(u, v, step + s, faces)
+        if m > 1:
+            u_w, v_w = _advance_frame(
+                u_w, v_w, u, v, params, m=m, step_idx=step + s, offs=offs,
+                use_noise=use_noise, unit_noise=unit_noise,
+                axis_names=axis_names, axis_sizes=axis_sizes,
+                boundaries=boundaries,
+            )
+    return u, v

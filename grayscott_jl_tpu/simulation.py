@@ -42,7 +42,7 @@ from .config.settings import Settings
 from .models import grayscott
 from .ops import noise as noise_ops
 from .ops import stencil, validate_kernel_language
-from .parallel import halo
+from .parallel import halo, temporal
 from .parallel.domain import CartDomain
 
 AXIS_NAMES = ("x", "y", "z")
@@ -51,11 +51,12 @@ AXIS_NAMES = ("x", "y", "z")
 def default_fuse() -> int:
     """Temporal-blocking depth for single-block Pallas runs.
 
-    The v5e slab pipeline is DMA-envelope-bound (measured: per-pass wall
-    is flat in compute content), so deeper fusion is a near-linear
-    per-step win until stage compute fills the envelope. ``GS_FUSE``
-    overrides; off-TPU the interpreter pays per-stage simulation cost,
-    so tests keep the historical depth 2.
+    Deeper fusion cuts HBM passes per step ~1/k until stage compute
+    fills the DMA envelope; after the round-3 op diet (mul-form
+    Laplacian, 2D-amortized noise hash) the measured optimum on the v5e
+    moved from k=4 to k=5 (`benchmarks/results/ab_r3_deepfuse_*`).
+    ``GS_FUSE`` overrides; off-TPU the interpreter pays per-stage
+    simulation cost, so tests keep the historical depth 2.
     """
     import os
 
@@ -67,7 +68,7 @@ def default_fuse() -> int:
             raise ValueError(
                 f"GS_FUSE must be a positive integer, got {v!r}"
             ) from e
-    return 4 if jax.default_backend() == "tpu" else 2
+    return 5 if jax.default_backend() == "tpu" else 2
 
 
 #: Platforms this process has already reached successfully — skips the
@@ -305,7 +306,6 @@ class Simulation:
 
         if self.kernel_language == "pallas":
             from .ops import pallas_stencil
-            from .parallel import temporal
 
             def step_seeds(step_idx):
                 return jnp.stack(
@@ -325,35 +325,32 @@ class Simulation:
                 )
 
             if sharded:
-                # Halo-amortized pairing: ONE 2-deep exchange feeds two
-                # kernel steps (step n+2's faces are step n+1 ring
-                # values recomputed locally from the wide ghosts) —
-                # exchange count halves vs step-at-a-time
-                # (``parallel/temporal.py``).
-                def pair_body(i, carry):
-                    u, v = carry
-                    step = step0 + 2 * i
-                    gu, gv = temporal.exchange_wide_faces(
-                        (u, v), boundaries, AXIS_NAMES, dims
-                    )
-                    u1, v1 = kernel_step(
-                        u, v, step, temporal.inner_faces(gu, gv)
-                    )
-                    faces2 = temporal.ring_faces(
-                        u, v, gu, gv, params, step=step, offs=offs, L=L,
-                        use_noise=use_noise, unit_noise=unit_noise,
-                        axis_names=AXIS_NAMES, axis_sizes=dims,
-                        boundaries=boundaries,
-                    )
-                    return kernel_step(u1, v1, step + 1, faces2)
+                # Halo-amortized k-deep chain: ONE k-wide exchange feeds
+                # k kernel steps (the ghost shell advances in XLA between
+                # kernel stages, ``parallel/temporal.pallas_chain``) —
+                # exchange count drops 1/k vs step-at-a-time, matching
+                # the XLA language's chain depth.
+                fuse = min(
+                    default_fuse(), max(nsteps, 1),
+                    min(self.domain.local_shape),
+                )
 
-                pairs, rem = divmod(nsteps, 2) if nsteps >= 2 else (0, nsteps)
-                u, v = lax.fori_loop(0, pairs, pair_body, (u, v))
-                if rem:
-                    faces = halo.exchange_faces(
-                        (u, v), boundaries, AXIS_NAMES, dims
+                def chain(u, v, step, depth):
+                    return temporal.pallas_chain(
+                        u, v, params, depth=depth, step=step, offs=offs,
+                        use_noise=use_noise, unit_noise=unit_noise,
+                        kernel_step=kernel_step, axis_names=AXIS_NAMES,
+                        axis_sizes=dims, boundaries=boundaries,
                     )
-                    u, v = kernel_step(u, v, step0 + 2 * pairs, faces)
+
+                def chain_body(i, carry):
+                    u, v = carry
+                    return chain(u, v, step0 + fuse * i, fuse)
+
+                rounds, rem = divmod(nsteps, fuse)
+                u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
+                if rem:
+                    u, v = chain(u, v, step0 + fuse * rounds, rem)
                 return u, v
 
             # Single block: in-kernel temporal blocking (``fuse`` steps
@@ -411,21 +408,6 @@ class Simulation:
         # ``communication.jl:138-199`` pays every step).
         fuse = min(default_fuse(), nsteps, min(self.domain.local_shape))
 
-        def freeze_out_of_domain(arr, bv, m):
-            """The outermost ``m`` ring positions, where they fall
-            outside the global domain, stay at the frozen boundary
-            value (MPI.PROC_NULL ghost semantics)."""
-            if m == 0:
-                return arr
-            out = arr
-            for dim, (ax, n) in enumerate(zip(AXIS_NAMES, dims)):
-                idx = lax.axis_index(ax)
-                pos = lax.broadcasted_iota(jnp.int32, out.shape, dim)
-                lo = (pos < m) & (idx == 0)
-                hi = (pos >= out.shape[dim] - m) & (idx == n - 1)
-                out = jnp.where(lo | hi, jnp.asarray(bv, out.dtype), out)
-            return out
-
         def chain(u, v, step, depth):
             """``depth`` steps from one ``depth``-wide exchange."""
             u_w, v_w = halo.halo_pad_wide(
@@ -441,8 +423,12 @@ class Simulation:
                 else:
                     nz = jnp.asarray(0.0, u.dtype)
                 u_w, v_w = stencil.reaction_update(u_w, v_w, nz, params)
-                u_w = freeze_out_of_domain(u_w, stencil.U_BOUNDARY, m_out)
-                v_w = freeze_out_of_domain(v_w, stencil.V_BOUNDARY, m_out)
+                u_w = temporal.freeze_out_of_domain(
+                    u_w, stencil.U_BOUNDARY, m_out, AXIS_NAMES, dims
+                )
+                v_w = temporal.freeze_out_of_domain(
+                    v_w, stencil.V_BOUNDARY, m_out, AXIS_NAMES, dims
+                )
             return u_w, v_w
 
         def chain_body(i, carry):

@@ -1,0 +1,26 @@
+# Environment for a v5e-8 slice (8 chips, 1 host) — the TPU analog of the
+# reference's per-site config scripts (config_summit.sh:1-20: module
+# loads + MPI binary selection; here: mesh/kernel tuning knobs).
+#
+# Topology facts this config encodes:
+#   * 8 chips -> CartDomain.dims_create picks a 2x2x2 logical mesh; the
+#     v5e-8 ICI is a 2D torus, so mesh_utils.create_device_mesh maps the
+#     third logical axis onto it (simulation.py warns if it cannot).
+#   * 16 GiB HBM/chip: L=256 f32 shards to 128^3 blocks/chip — far below
+#     memory limits; L up to ~1024 fits comfortably.
+#   * v5e VMEM is 128 MiB/core: the Pallas kernel's automatic slab/fuse
+#     selection (GS_FUSE default 4) is measured fastest at L>=128.
+#
+# Usage: source this, then scripts/pod/job_v5e_8.sh (or run_tpu_pod.sh).
+
+export TPU_NAME="${TPU_NAME:-gs-v5e-8}"
+export ZONE="${ZONE:-us-west4-a}"
+export ACCELERATOR_TYPE="v5litepod-8"
+
+# Temporal-blocking depth for the single-block Pallas path; sharded runs
+# use the k-deep wide-halo exchange with the same depth (simulation.py).
+export GS_FUSE="${GS_FUSE:-4}"
+# Per-phase wall-clock + cell-updates/s JSON, one file per process.
+export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
+# Uncomment for a jax.profiler device trace of the run:
+# export GS_TPU_PROFILE=/tmp/gs_trace

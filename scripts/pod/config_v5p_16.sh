@@ -1,0 +1,22 @@
+# Environment for a v5p-16 slice (8 chips, 2 hosts) — TPU analog of the
+# reference's per-site config scripts (config_summit.sh:1-20). First
+# multi-HOST topology: one process per host, jax.distributed.initialize
+# autodetects the slice (GS_TPU_DISTRIBUTED=auto, set by run_tpu_pod.sh).
+#
+# Topology facts this config encodes:
+#   * v5p-16 = 8 chips across 2 hosts (4 chips/host).
+#   * 8 chips -> CartDomain.dims_create picks a 2x2x2 mesh, mapped onto
+#     the v5p 3D torus so each of the 6 halo faces is a single ICI hop.
+#   * Each process owns 4 chip-shards; output is per-process multi-writer
+#     (data.<w> blocks merged on read — io/bplite.py), no MPI-IO analog
+#     needed.
+#
+# Usage: source this, then scripts/pod/job_v5p_16.sh.
+
+export TPU_NAME="${TPU_NAME:-gs-v5p-16}"
+export ZONE="${ZONE:-us-east5-a}"
+export ACCELERATOR_TYPE="v5p-16"
+
+export GS_FUSE="${GS_FUSE:-4}"
+export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
+# export GS_TPU_PROFILE=/tmp/gs_trace

@@ -1,0 +1,22 @@
+# Environment for a v5p-256 slice (128 chips, 32 hosts) — the
+# weak-scaling target topology (BASELINE.json config #5). TPU analog of
+# the reference's largest-site config (config_summit.sh).
+#
+# Topology facts this config encodes:
+#   * v5p-256 = 128 chips across 32 hosts.
+#   * 128 chips -> CartDomain.dims_create picks an 8x4x4 mesh; requires
+#     L divisible by 8 on x and 4 on y/z — L=1024 gives 128x256x256
+#     blocks/chip.
+#   * Checkpointing at this scale: per-shard selection restore means a
+#     restart never gathers the global array (simulation.py
+#     restore_from_reader); keep checkpoint = true in the config.
+#
+# Usage: source this, then scripts/pod/job_v5p_256.sh.
+
+export TPU_NAME="${TPU_NAME:-gs-v5p-256}"
+export ZONE="${ZONE:-us-east5-a}"
+export ACCELERATOR_TYPE="v5p-256"
+
+export GS_FUSE="${GS_FUSE:-4}"
+export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
+# export GS_TPU_PROFILE=/tmp/gs_trace

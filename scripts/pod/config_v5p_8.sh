@@ -1,0 +1,20 @@
+# Environment for a v5p-8 slice (4 chips, 1 host) — TPU analog of the
+# reference's per-site config scripts (config_summit.sh:1-20).
+#
+# Topology facts this config encodes:
+#   * v5p counts cores: v5p-8 = 4 chips on one host.
+#   * 4 chips -> CartDomain.dims_create picks a 2x2x1 mesh; halo
+#     ppermutes ride single-hop ICI links on the 3D torus
+#     (mesh_utils.create_device_mesh maps logical->physical).
+#   * 95 GiB HBM/chip and ~2.8 TB/s: per-chip L-blocks up to ~1500^3 fit;
+#     the roofline scales the v5e numbers by ~3.4x (BASELINE.md).
+#
+# Usage: source this, then scripts/pod/job_v5p_8.sh (or run_tpu_pod.sh).
+
+export TPU_NAME="${TPU_NAME:-gs-v5p-8}"
+export ZONE="${ZONE:-us-east5-a}"
+export ACCELERATOR_TYPE="v5p-8"
+
+export GS_FUSE="${GS_FUSE:-4}"
+export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
+# export GS_TPU_PROFILE=/tmp/gs_trace

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# v5e-8 job: 8-chip single-host run of the pod-slice workload — the TPU
+# analog of the reference's batch scripts (job_summit.sh:1-27: allocate,
+# set env, run one workload).
+#
+#   ./scripts/pod/job_v5e_8.sh [config.toml]
+#
+# Provisioning (once):
+#   gcloud compute tpus tpu-vm create "$TPU_NAME" --zone "$ZONE" \
+#     --accelerator-type v5litepod-8 --version v2-alpha-tpuv5-lite
+#   gcloud compute tpus tpu-vm scp --recurse . "$TPU_NAME":~/grayscott \
+#     --zone "$ZONE" --worker=all
+
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+source "${HERE}/config_v5e_8.sh"
+CONFIG="${1:-examples/settings-pod-slice.toml}"
+exec "${HERE}/../run_tpu_pod.sh" "${TPU_NAME}" "${ZONE}" "${CONFIG}"

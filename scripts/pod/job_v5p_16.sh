@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# v5p-16 job: 8 chips / 2 hosts — first multi-host rung; the command
+# fans out to both workers and jax.distributed.initialize wires them
+# (reference analog: the multi-rank jsrun lines, job_summit.sh:22-26).
+#
+#   ./scripts/pod/job_v5p_16.sh [config.toml]
+#
+# Provisioning (once):
+#   gcloud compute tpus tpu-vm create "$TPU_NAME" --zone "$ZONE" \
+#     --accelerator-type v5p-16 --version v2-alpha-tpuv5
+#   gcloud compute tpus tpu-vm scp --recurse . "$TPU_NAME":~/grayscott \
+#     --zone "$ZONE" --worker=all
+
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+source "${HERE}/config_v5p_16.sh"
+CONFIG="${1:-examples/settings-pod-v5p16.toml}"
+exec "${HERE}/../run_tpu_pod.sh" "${TPU_NAME}" "${ZONE}" "${CONFIG}"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# v5p-256 job: 128 chips / 32 hosts — the weak-scaling workload
+# (BASELINE.json config #5: L=1024, checkpoint + parallel output).
+#
+#   ./scripts/pod/job_v5p_256.sh [config.toml]
+#
+# Provisioning (once):
+#   gcloud compute tpus tpu-vm create "$TPU_NAME" --zone "$ZONE" \
+#     --accelerator-type v5p-256 --version v2-alpha-tpuv5
+#   gcloud compute tpus tpu-vm scp --recurse . "$TPU_NAME":~/grayscott \
+#     --zone "$ZONE" --worker=all
+
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+source "${HERE}/config_v5p_256.sh"
+CONFIG="${1:-examples/settings-weakscale-v5p256.toml}"
+exec "${HERE}/../run_tpu_pod.sh" "${TPU_NAME}" "${ZONE}" "${CONFIG}"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# v5p-8 job: 4-chip single-host run (reference analog: job_summit.sh).
+#
+#   ./scripts/pod/job_v5p_8.sh [config.toml]
+#
+# Provisioning (once):
+#   gcloud compute tpus tpu-vm create "$TPU_NAME" --zone "$ZONE" \
+#     --accelerator-type v5p-8 --version v2-alpha-tpuv5
+#   gcloud compute tpus tpu-vm scp --recurse . "$TPU_NAME":~/grayscott \
+#     --zone "$ZONE" --worker=all
+
+set -euo pipefail
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+source "${HERE}/config_v5p_8.sh"
+CONFIG="${1:-examples/settings-pod-slice.toml}"
+exec "${HERE}/../run_tpu_pod.sh" "${TPU_NAME}" "${ZONE}" "${CONFIG}"

@@ -9,6 +9,10 @@
 # Usage:
 #   ./scripts/run_tpu_pod.sh <tpu-name> <zone> <config.toml>
 #
+# Tuning environment set locally (GS_FUSE, GS_TPU_STATS, GS_TPU_PROFILE,
+# XLA_FLAGS, LIBTPU_INIT_ARGS, ...) is forwarded to every worker — the
+# per-topology wrappers in scripts/pod/ set these before delegating here.
+#
 # Requires: gcloud configured, the repo present at the same path on every
 # worker (or use --worker=all scp first).
 
@@ -19,5 +23,15 @@ ZONE="${2:?zone}"
 CONFIG="${3:?config.toml}"
 REPO_DIR="${REPO_DIR:-$(pwd)}"
 
+# Forward the framework's tuning env vars into the remote command.
+FWD=""
+while IFS='=' read -r name value; do
+  case "${name}" in
+    GS_*|XLA_FLAGS|LIBTPU_INIT_ARGS|JAX_TRACEBACK_FILTERING)
+      FWD+="${name}=$(printf %q "${value}") "
+      ;;
+  esac
+done < <(env)
+
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone "${ZONE}" --worker=all \
-  --command "cd $(printf %q "${REPO_DIR}") && GS_TPU_DISTRIBUTED=auto python3 gray-scott.py $(printf %q "${CONFIG}")"
+  --command "cd $(printf %q "${REPO_DIR}") && ${FWD}GS_TPU_DISTRIBUTED=auto python3 gray-scott.py $(printf %q "${CONFIG}")"

@@ -39,8 +39,13 @@ checkpoint_output = "ckpt.bp"
 mesh_type = "image"
 precision = "Float32"
 backend = "CPU"
+kernel_language = "{lang}"
 verbose = true
 """
+
+
+def _config(lang="Plain"):
+    return CONFIG.format(lang=lang)
 
 
 def _free_port() -> int:
@@ -58,10 +63,10 @@ def _env(base, devices, extra=None):
     return env
 
 
-def _run_single(tmp_path):
+def _run_single(tmp_path, lang="Plain"):
     d = tmp_path / "single"
     d.mkdir()
-    (d / "config.toml").write_text(CONFIG)
+    (d / "config.toml").write_text(_config(lang))
     res = subprocess.run(
         [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
         cwd=d, env=_env(d, 8), capture_output=True, text=True, timeout=600,
@@ -70,10 +75,7 @@ def _run_single(tmp_path):
     return d
 
 
-def _run_dual(tmp_path):
-    d = tmp_path / "dual"
-    d.mkdir()
-    (d / "config.toml").write_text(CONFIG)
+def _spawn_pair(cwd, config_name):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -84,21 +86,52 @@ def _run_dual(tmp_path):
         }
         procs.append(
             subprocess.Popen(
-                [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
-                cwd=d, env=_env(d, 4, extra),
+                [sys.executable, str(REPO / "gray-scott.py"), config_name],
+                cwd=cwd, env=_env(cwd, 4, extra),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             )
         )
-    outs = [p.communicate(timeout=600) for p in procs]
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, out + err
+    return [p.communicate(timeout=600) for p in procs], procs
+
+
+def _run_pair(cwd, config_name):
+    """Run the two-process CLI pair, retrying once on the Gloo
+    bring-up race: XLA's CPU collectives have a hardcoded 30s
+    key-value handshake timeout, and a loaded CI host can push one
+    process's compile past it — a flake of the harness environment,
+    not of the framework (jax.distributed itself came up fine)."""
+    for attempt in range(2):
+        outs, procs = _spawn_pair(cwd, config_name)
+        if all(p.returncode == 0 for p in procs):
+            return outs
+        gloo_race = any(
+            "Gloo context initialization failed" in out + err
+            for out, err in outs
+        )
+        if not (gloo_race and attempt == 0):
+            for p, (out, err) in zip(procs, outs):
+                assert p.returncode == 0, out + err
+    return outs
+
+
+def _run_dual(tmp_path, lang="Plain"):
+    d = tmp_path / "dual"
+    d.mkdir()
+    (d / "config.toml").write_text(_config(lang))
+    outs = _run_pair(d, "config.toml")
     return d, outs
 
 
 @pytest.mark.slow
-def test_two_process_run_matches_single_process(tmp_path):
-    single = _run_single(tmp_path)
-    dual, outs = _run_dual(tmp_path)
+@pytest.mark.parametrize("lang", ["Plain", "Pallas"])
+def test_two_process_run_matches_single_process(tmp_path, lang):
+    """Both kernel languages cross the process boundary: Pallas runs the
+    sharded pair path (wide ppermute halo exchange + ring-face recompute,
+    ``simulation.py``) across two real processes — on CPU the kernel body
+    itself takes the XLA fallback, but the distributed machinery around
+    it is exactly the TPU path's."""
+    single = _run_single(tmp_path, lang)
+    dual, outs = _run_dual(tmp_path, lang)
 
     rs = BpReader(str(single / "out.bp"))
     rd = BpReader(str(dual / "out.bp"))
@@ -152,30 +185,13 @@ def test_two_process_restart_from_distributed_checkpoint(tmp_path):
     # restart the two-process run from its own distributed checkpoint,
     # extending to step 30
     cfg = (
-        CONFIG.replace("steps = 20", "steps = 30")
+        _config().replace("steps = 20", "steps = 30")
         .replace('output = "out.bp"', 'output = "out2.bp"')
         .replace("checkpoint = true", "checkpoint = false")
         + 'restart = true\nrestart_input = "ckpt.bp"\n'
     )
     (dual / "config2.toml").write_text(cfg)
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        extra = {
-            "GS_TPU_COORDINATOR": f"127.0.0.1:{port}",
-            "GS_TPU_NUM_PROCESSES": "2",
-            "GS_TPU_PROCESS_ID": str(pid),
-        }
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(REPO / "gray-scott.py"), "config2.toml"],
-                cwd=dual, env=_env(dual, 4, extra),
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            )
-        )
-    outs = [p.communicate(timeout=600) for p in procs]
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, out + err
+    outs = _run_pair(dual, "config2.toml")
     assert "Restarted from ckpt.bp at step 20" in outs[0][0]
 
     r = BpReader(str(dual / "out2.bp"))
@@ -185,7 +201,9 @@ def test_two_process_restart_from_distributed_checkpoint(tmp_path):
     # and it must equal an uninterrupted single-process 30-step run
     single = tmp_path / "single30"
     single.mkdir()
-    (single / "config.toml").write_text(CONFIG.replace("steps = 20", "steps = 30"))
+    (single / "config.toml").write_text(
+        _config().replace("steps = 20", "steps = 30")
+    )
     res = subprocess.run(
         [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
         cwd=single, env=_env(single, 8), capture_output=True, text=True,

@@ -1,0 +1,139 @@
+"""Real-ADIOS2 engine adapter tests.
+
+Engine *selection* is covered unconditionally; everything touching the
+actual adios2 bindings is availability-gated (``requires_adios2``) —
+the same pattern as the TPU-hardware gate (``test_tpu_hardware.py``),
+since the adios2 wheel is not installable in this environment. On a
+machine with the wheel these verify the framework emits genuine BP
+stores carrying the reference's exact variable/attribute/schema contract
+(``/root/reference/src/simulation/IO.jl:37-70,123-163``).
+"""
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.io import adios, open_reader, open_writer
+from grayscott_jl_tpu.io.bplite import BpReader, BpWriter, StepStatus
+
+requires_adios2 = pytest.mark.skipif(
+    not adios.available(), reason="needs the adios2 python bindings"
+)
+
+
+def test_open_writer_falls_back_without_adios2(tmp_path, monkeypatch):
+    """Engine selection: without the wheel (or with GS_TPU_ADIOS2=0) the
+    BP-lite engines serve; the chosen engine must present the same
+    interface either way."""
+    monkeypatch.setenv("GS_TPU_ADIOS2", "0")
+    monkeypatch.setenv("GS_TPU_NATIVE_IO", "0")
+    w = open_writer(str(tmp_path / "out.bp"))
+    assert isinstance(w, BpWriter)
+    w.define_variable("x", np.float32, (4,))
+    w.begin_step()
+    w.put("x", np.arange(4, dtype=np.float32))
+    w.end_step()
+    w.close()
+    r = open_reader(str(tmp_path / "out.bp"))
+    assert isinstance(r, BpReader)
+    np.testing.assert_array_equal(
+        r.get("x", step=0), np.arange(4, dtype=np.float32)
+    )
+
+
+def test_open_reader_rejects_foreign_store_without_adios2(tmp_path):
+    """A directory that is not a BP-lite store needs the adios2 bindings;
+    absent them the error must say so instead of misparsing."""
+    d = tmp_path / "real.bp"
+    d.mkdir()
+    (d / "data.0.bp").write_bytes(b"\x00" * 16)  # BP4-ish layout, no md.json
+    if adios.available():
+        pytest.skip("adios2 present: the store would be dispatched to it")
+    with pytest.raises(RuntimeError, match="adios2"):
+        open_reader(str(d))
+
+
+def test_append_to_foreign_store_is_refused(tmp_path):
+    """Rollback-append is BP-lite-only; appending onto a real-BP store
+    from an adios2-enabled run must fail loudly, not scribble md.json
+    into it."""
+    d = tmp_path / "real.bp"
+    d.mkdir()
+    (d / "data.0.bp").write_bytes(b"\x00" * 16)
+    with pytest.raises(RuntimeError, match="BP-lite"):
+        open_writer(str(d), append=True)
+
+
+@requires_adios2
+def test_adios2_writer_reader_roundtrip(tmp_path):
+    """Blocks with (start, count) boxes, scalars, attributes, and
+    step streaming through the real bindings."""
+    path = str(tmp_path / "real.bp")
+    w = adios.Adios2Writer(path)
+    w.define_attribute("F", 0.02)
+    w.define_attribute("note", "hello")
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (4, 4))
+    for s in range(2):
+        w.begin_step()
+        w.put("step", np.int32(s))
+        block = np.full((2, 4), s, np.float32)
+        w.put("U", block, start=(0, 0), count=(2, 4))
+        w.put("U", block + 10, start=(2, 0), count=(2, 4))
+        w.end_step()
+    w.close()
+
+    r = adios.Adios2Reader(path)
+    assert r.num_steps() == 2
+    assert r.attributes()["note"] == "hello"
+    u1 = r.get("U", step=1)
+    assert u1.shape == (4, 4)
+    np.testing.assert_array_equal(u1[:2], np.full((2, 4), 1, np.float32))
+    np.testing.assert_array_equal(u1[2:], np.full((2, 4), 11, np.float32))
+    r.close()
+
+    # streaming access with the pdfcalc polling contract
+    r = adios.Adios2Reader(path)
+    assert r.begin_step(timeout=5.0) == StepStatus.OK
+    r.set_selection("U", (1, 0), (2, 4))
+    got = r.get("U")
+    assert got.shape == (2, 4)
+    r.end_step()
+    r.close()
+
+
+@requires_adios2
+def test_sim_stream_emits_real_bp(tmp_path, monkeypatch):
+    """The SAME SimStream code path produces a genuine BP store when
+    adios2 is importable: variables U/V/step, provenance attributes, and
+    the Fides/VTK schemas — byte-identical contract to the reference's
+    IO.init (IO.jl:37-70, 123-163)."""
+    monkeypatch.chdir(tmp_path)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.driver import main
+    from grayscott_jl_tpu.io.stream import fides_vtk_schemas
+
+    cfg = tmp_path / "config.toml"
+    cfg.write_text(
+        'L = 8\nDu = 0.2\nDv = 0.1\nF = 0.02\nk = 0.048\ndt = 1.0\n'
+        'plotgap = 5\nsteps = 10\nnoise = 0.1\noutput = "out.bp"\n'
+        'mesh_type = "image"\nprecision = "Float32"\nbackend = "CPU"\n'
+    )
+    sim = main([str(cfg)], n_devices=1)
+
+    import os
+
+    assert not os.path.isfile(tmp_path / "out.bp" / "md.json"), (
+        "adios2 importable but the output is a BP-lite store"
+    )
+    r = adios.Adios2Reader(str(tmp_path / "out.bp"))
+    assert r.num_steps() == 2
+    atts = r.attributes()
+    assert float(atts["F"]) == pytest.approx(0.02)
+    assert atts["Fides_Data_Model"] == "uniform"
+    assert atts["vtk.xml"] == fides_vtk_schemas(8)["vtk.xml"]
+    u = r.get("U", step=1)
+    np.testing.assert_array_equal(u, sim.get_fields()[0])
+    r.close()

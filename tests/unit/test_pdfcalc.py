@@ -133,6 +133,39 @@ def test_pdfcalc_worker_split_covers_volume(tmp_path):
     assert int(full.sum()) == 8 * 8 * 8  # every cell counted exactly once
 
 
+def test_pdfcalc_parallel_cli(tmp_path):
+    """Two real pdfcalc worker processes (the reference launches pdfcalc
+    under mpirun, ``pdfcalc.jl:126-144``): rank/size come from the
+    GS_TPU_PROCESS_ID / GS_TPU_NUM_PROCESSES env contract and both
+    workers merge into one output store."""
+    import os
+    import subprocess
+    import sys
+
+    w = _write_sim_store(tmp_path / "sim.bp", nsteps=2)
+    w.close()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["GS_TPU_PROCESS_ID"] = str(rank)
+        env["GS_TPU_NUM_PROCESSES"] = "2"
+        env["JAX_PLATFORMS"] = "cpu"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "grayscott_jl_tpu.analysis.pdfcalc",
+             str(tmp_path / "sim.bp"), str(tmp_path / "pdf.bp"), "8"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, out + err
+    r = BpReader(str(tmp_path / "pdf.bp"))
+    assert r.num_steps() == 2
+    full = r.get("U/pdf", step=1)  # merged across both workers' blocks
+    assert full.shape == (8, 8)
+    assert int(full.sum()) == 8 * 8 * 8
+
+
 def test_write_inputdata_passthrough(tmp_path):
     w = _write_sim_store(tmp_path / "sim.bp", nsteps=1)
     w.close()

@@ -81,23 +81,63 @@ def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps, lang):
 
 @requires8
 @pytest.mark.parametrize("depth", [3, 4])
-def test_sharded_deep_chain_matches_stepwise(depth, monkeypatch):
-    """The XLA sharded path chains ``GS_FUSE`` steps from ONE
-    depth-wide halo exchange (shrinking extended windows). Deep chains
+@pytest.mark.parametrize("lang", ["XLA", "Pallas"])
+def test_sharded_deep_chain_matches_stepwise(depth, lang, monkeypatch):
+    """Both sharded kernel languages chain ``GS_FUSE`` steps from ONE
+    depth-wide halo exchange — the XLA language via shrinking extended
+    windows (``simulation.py``), Pallas via the kernel + XLA-advanced
+    ghost shell (``parallel/temporal.pallas_chain``). Deep chains
     (k > 2) must reproduce the step-at-a-time trajectory exactly,
-    noise included, with a remainder chain for non-multiples."""
-    monkeypatch.setenv("GS_FUSE", str(depth))
+    noise included, with a remainder chain for non-multiples. Stepwise
+    baselines run with GS_FUSE=1 so only the fused side chains."""
     L = 16
     nsteps = depth + 1  # exercises one full chain + a remainder chain
-    fused = Simulation(_settings(L=L, noise=0.1), n_devices=8, seed=7)
-    stepwise = Simulation(_settings(L=L, noise=0.1), n_devices=8, seed=7)
+    monkeypatch.setenv("GS_FUSE", str(depth))
+    fused = Simulation(
+        _settings(L=L, noise=0.1, kernel_language=lang), n_devices=8, seed=7
+    )
     fused.iterate(nsteps)
+    monkeypatch.setenv("GS_FUSE", "1")
+    stepwise = Simulation(
+        _settings(L=L, noise=0.1, kernel_language=lang), n_devices=8, seed=7
+    )
     for _ in range(nsteps):
         stepwise.iterate(1)
     uf, vf = fused.get_fields()
     us, vs = stepwise.get_fields()
+    # identical elementwise ops on identical inputs (noise included —
+    # position-keyed draws are exact); the tolerance only absorbs XLA
+    # FMA-contraction differences between window shapes
     np.testing.assert_allclose(uf, us, rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(vf, vs, rtol=1e-6, atol=1e-7)
+
+
+@requires8
+@pytest.mark.parametrize("lang", ["XLA", "Pallas"])
+def test_collective_count_per_chunk_is_six_per_k_steps(lang, monkeypatch):
+    """The halo-amortization claim as a *compiled* invariant: a k-step
+    chain round contains exactly ONE 6-ppermute exchange (3 axes x 2
+    directions), so an 8-step runner at GS_FUSE=4 lowers to 6
+    collective-permutes total (inside the 2-round fori_loop body) — not
+    6 per step. Fails if someone reintroduces per-step exchanges
+    (the cost the reference pays every step, communication.jl:138-199)."""
+    import re
+
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("GS_FUSE", "4")
+    sim = Simulation(
+        _settings(L=16, noise=0.1, kernel_language=lang), n_devices=8
+    )
+    runner = sim._runner(8)  # 2 chain rounds of k=4, no remainder
+    txt = runner.lower(
+        sim.u, sim.v, sim.base_key, jnp.int32(0), sim.params
+    ).compile().as_text()
+    n_permutes = len(re.findall(r"collective-permute(?:-start)?\(", txt))
+    assert n_permutes == 6, (
+        f"expected one 6-ppermute exchange per 4-step chain, found "
+        f"{n_permutes} collective-permutes in the compiled module"
+    )
 
 
 @requires8

@@ -129,10 +129,14 @@ def test_cli_end_to_end_on_hardware(tmp_path):
 
     repo = Path(__file__).resolve().parents[2]
     cfg = tmp_path / "config.toml"
+    # L=128 + kernel_language=Pallas: the lane-alignment gate routes
+    # L=64 to the XLA kernel on TPU, and Settings defaults to Plain —
+    # both would silently turn this into a Plain/XLA CLI test.
     cfg.write_text(
-        'L = 64\nDu = 0.2\nDv = 0.1\nF = 0.02\nk = 0.048\ndt = 1.0\n'
+        'L = 128\nDu = 0.2\nDv = 0.1\nF = 0.02\nk = 0.048\ndt = 1.0\n'
         'plotgap = 10\nsteps = 20\nnoise = 0.1\noutput = "out.bp"\n'
         'mesh_type = "image"\nprecision = "Float32"\nbackend = "TPU"\n'
+        'kernel_language = "Pallas"\n'
     )
     env = dict(os.environ)
     env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
@@ -148,7 +152,7 @@ def test_cli_end_to_end_on_hardware(tmp_path):
     r = BpReader(str(tmp_path / "out.bp"))
     assert r.num_steps() == 2
     u = r.get("U", step=1)
-    assert u.shape == (64, 64, 64)
+    assert u.shape == (128, 128, 128)
     assert np.isfinite(u).all()
     # ParaView-openable side-channel: .vti frames + series index
     # (VtiSeriesWriter writes <base>.vtk/series.pvd + step_*.vti).
