@@ -1,0 +1,387 @@
+#!/usr/bin/env python3
+"""Decompose the Pallas slab-walk pass into DMA and VPU components.
+
+BASELINE.md records a hard per-pass envelope (~2 ms at L=256 f32) that is
+flat in compute content; VERDICT r2 asks whether that envelope is real
+HBM time or descriptor/serialization overhead. This probe times, in ONE
+process (so the clock-throttle state is shared):
+
+  xla_stream   in-jit chained ``u = u * c`` over both fields — XLA's
+               HBM streaming bandwidth upper bound for read+write of
+               2 fields (what a perfect single-step schedule pays).
+  dma_walk     the production kernel's exact slab-DMA structure
+               (double-buffered (bx+2k)-plane input windows, bx-plane
+               outputs, same semaphores) with ZERO vector ops — output
+               DMAs source directly from the input scratch slice. The
+               pure DMA envelope.
+  compute_walk one resident input window, the full fuse=k stage chain
+               (real Laplacian/reaction/noise math) re-run per slab
+               with only a final output DMA — the pure VPU cost of a
+               pass.
+  full         the production ``fused_step`` at the same (bx, fuse).
+
+Interpretation: full ≈ max(dma_walk, compute_walk) means the pipeline
+overlaps well and the larger component is the wall; full ≈ sum means the
+pipeline serializes. dma_walk >> the analytic traffic/819 GB/s bound
+means DMA issue overhead, not bandwidth, sets the envelope.
+
+Emits one JSON line per case (`--out` appends JSONL).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--l", type=int, default=256)
+    ap.add_argument("--bx", type=int, default=16)
+    ap.add_argument("--fuse", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=100,
+                    help="simulation steps per timing round (full case); "
+                    "pass cases run steps/fuse passes")
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--noise", type=float, default=0.1)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from grayscott_jl_tpu.models.grayscott import Params
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    L, bx, fuse = args.l, args.bx, args.fuse
+    nblocks = L // bx
+    halo = fuse
+    win_n = bx + 2 * halo
+    ny = nz = L
+    dtype = jnp.float32
+    interpret = jax.default_backend() != "tpu"
+    n_passes = max(1, args.steps // fuse)
+
+    u = jnp.ones((L, L, L), dtype)
+    v = jnp.zeros((L, L, L), dtype)
+
+    def sync(x) -> float:
+        return float(jnp.sum(x[:1, :1, :4]))
+
+    # ---- case: xla_stream ------------------------------------------------
+    @jax.jit
+    def xla_stream(u, v):
+        def body(_, uv):
+            uu, vv = uv
+            return uu * jnp.float32(1.0000001), vv * jnp.float32(1.0000001)
+
+        return lax.fori_loop(0, n_passes, body, (u, v))
+
+    # ---- case: dma_walk --------------------------------------------------
+    def dma_kernel(u_ref, v_ref, u_out, v_out, in_u, in_v, in_sems,
+                   out_sems):
+        fields = ((u_ref, in_u, u_out, 0), (v_ref, in_v, v_out, 1))
+
+        def in_dma(slot, b, tag):
+            field_ref, scr = fields[tag][0], fields[tag][1]
+            # Interior-slab shape everywhere (clamped at the edges) —
+            # identical descriptor count and near-identical traffic to
+            # the production slab_io without its edge branches.
+            start = jnp.clip(b * bx - halo, 0, L - win_n)
+            return pltpu.make_async_copy(
+                field_ref.at[pl.ds(start, win_n)],
+                scr.at[slot],
+                in_sems.at[slot, tag],
+            )
+
+        def out_dma(slot, b, tag):
+            scr, ref = fields[tag][1], fields[tag][2]
+            return pltpu.make_async_copy(
+                scr.at[slot, pl.ds(halo, bx)],
+                ref.at[pl.ds(b * bx, bx)],
+                out_sems.at[slot, tag],
+            )
+
+        for tag in (0, 1):
+            in_dma(0, jnp.int32(0), tag).start()
+
+        def body(b, _):
+            slot = lax.rem(b, 2)
+            nxt = lax.rem(b + 1, 2)
+
+            @pl.when(b + 1 < nblocks)
+            def _():
+                for tag in (0, 1):
+                    in_dma(nxt, b + 1, tag).start()
+
+            for tag in (0, 1):
+                in_dma(slot, b, tag).wait()
+
+            @pl.when(b >= 2)
+            def _():
+                for tag in (0, 1):
+                    out_dma(slot, b - 2, tag).wait()
+
+            for tag in (0, 1):
+                out_dma(slot, b, tag).start()
+            return 0
+
+        lax.fori_loop(0, nblocks, body, 0)
+        for tail_b in (nblocks - 2, nblocks - 1):
+            if tail_b >= 0:
+                for tag in (0, 1):
+                    out_dma(tail_b % 2, jnp.int32(tail_b), tag).wait()
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    interp = (
+        pltpu.InterpretParams(dma_execution_mode="eager")
+        if interpret
+        else False
+    )
+
+    dma_call = pl.pallas_call(
+        dma_kernel,
+        in_specs=[any_spec, any_spec],
+        out_specs=[any_spec, any_spec],
+        out_shape=[jax.ShapeDtypeStruct((L, L, L), dtype)] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((2, win_n, ny, nz), dtype),
+            pltpu.VMEM((2, win_n, ny, nz), dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
+        ),
+        interpret=interp,
+    )
+
+    @jax.jit
+    def dma_walk(u, v):
+        def body(_, uv):
+            return tuple(dma_call(*uv))
+
+        return lax.fori_loop(0, n_passes, body, (u, v))
+
+    # ---- case: compute_walk ---------------------------------------------
+    params = Params(
+        Du=jnp.float32(0.2), Dv=jnp.float32(0.1), F=jnp.float32(0.02),
+        k=jnp.float32(0.048), dt=jnp.float32(1.0),
+        noise=jnp.float32(args.noise),
+    )
+    use_noise = args.noise > 0
+
+    def make_compute_kernel():
+        # One input window resident in VMEM; per "slab" run the real
+        # fuse-stage chain (production kernel body via ps internals) and
+        # keep results in out scratch; single final out DMA.
+        def kernel(params_s, seeds_s, u_ref, v_ref, u_out, v_out,
+                   in_u, in_v, mid_u, mid_v, out_u, out_v, in_sems,
+                   out_sems):
+            cdt = dtype
+            for tag, (ref, scr) in enumerate(
+                ((u_ref, in_u), (v_ref, in_v))
+            ):
+                pltpu.make_async_copy(
+                    ref.at[pl.ds(0, win_n)], scr.at[0], in_sems.at[0, tag]
+                ).start()
+            for tag, (ref, scr) in enumerate(
+                ((u_ref, in_u), (v_ref, in_v))
+            ):
+                pltpu.make_async_copy(
+                    ref.at[pl.ds(0, win_n)], scr.at[0], in_sems.at[0, tag]
+                ).wait()
+
+            masks = ps._edge_masks(ny, nz)
+            u_bv = jnp.asarray(1.0, cdt)
+            v_bv = jnp.asarray(0.0, cdt)
+            Du, Dv, F, K, dt, noise = (
+                params_s[j].astype(cdt) for j in range(6)
+            )
+            inv_six = jnp.asarray(1.0 / 6.0, cdt)
+            one = jnp.asarray(1.0, cdt)
+
+            def lap(win, c):
+                n = c.shape[0]
+                return (
+                    win[0:n] + win[2:n + 2]
+                    + ps._shifted(c, 1, 1, u_bv, masks)
+                    + ps._shifted(c, 1, -1, u_bv, masks)
+                    + ps._shifted(c, 2, 1, u_bv, masks)
+                    + ps._shifted(c, 2, -1, u_bv, masks)
+                ) * inv_six - c
+
+            def noise_block(step_idx, g0, w):
+                iota_w = lax.broadcasted_iota(jnp.int32, (w, 1, 1), 0)
+                gx = seeds_s[3] + g0 + iota_w
+                seed = ps.plane_seed(seeds_s[0], seeds_s[1], step_idx, gx)
+                iy = lax.broadcasted_iota(jnp.uint32, (1, ny, 1), 1)
+                iz = lax.broadcasted_iota(jnp.uint32, (1, 1, nz), 2)
+                bits = ps.block_bits(seed, iy, iz, seeds_s[6])
+                return noise * ps._kernel_pm1(bits, cdt)
+
+            def chain(b, _):
+                k = fuse
+                for s in range(k):
+                    w_out = bx + 2 * (k - 1 - s)
+                    if s == 0:
+                        u_win = in_u[0]
+                        v_win = in_v[0]
+                    else:
+                        buf = (s - 1) % 2 if k > 2 else 0
+                        u_win = mid_u[buf, pl.ds(0, w_out + 2)]
+                        v_win = mid_v[buf, pl.ds(0, w_out + 2)]
+                    n = u_win.shape[0] - 2
+                    u_c = u_win[1:n + 1]
+                    v_c = v_win[1:n + 1]
+                    lap_u = lap(u_win, u_c)
+                    lap_v = lap(v_win, v_c)
+                    uvv = u_c * v_c * v_c
+                    du = Du * lap_u - uvv + F * (one - u_c)
+                    dv = Dv * lap_v + uvv - (F + K) * v_c
+                    if use_noise:
+                        du = du + noise_block(seeds_s[2] + s, b * bx, w_out)
+                    if s == k - 1:
+                        out_u[0] = (u_c + du * dt).astype(dtype)
+                        out_v[0] = (v_c + dv * dt).astype(dtype)
+                    else:
+                        buf = s % 2 if k > 2 else 0
+                        mid_u[buf, pl.ds(0, w_out)] = u_c + du * dt
+                        mid_v[buf, pl.ds(0, w_out)] = v_c + dv * dt
+                return 0
+
+            lax.fori_loop(0, nblocks, chain, 0)
+            for tag, (ref, scr) in enumerate(
+                ((u_out, out_u), (v_out, out_v))
+            ):
+                pltpu.make_async_copy(
+                    scr.at[0], ref.at[pl.ds(0, bx)], out_sems.at[0, tag]
+                ).start()
+            for tag, (ref, scr) in enumerate(
+                ((u_out, out_u), (v_out, out_v))
+            ):
+                pltpu.make_async_copy(
+                    scr.at[0], ref.at[pl.ds(0, bx)], out_sems.at[0, tag]
+                ).wait()
+
+        return kernel
+
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    nbuf, mid_planes = ps._mid_layout(bx, fuse)
+    compute_call = pl.pallas_call(
+        make_compute_kernel(),
+        in_specs=[smem_spec, smem_spec, any_spec, any_spec],
+        out_specs=[any_spec, any_spec],
+        out_shape=[jax.ShapeDtypeStruct((L, L, L), dtype)] * 2,
+        scratch_shapes=[
+            pltpu.VMEM((1, win_n, ny, nz), dtype),
+            pltpu.VMEM((1, win_n, ny, nz), dtype),
+            pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
+            pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
+            pltpu.VMEM((1, bx, ny, nz), dtype),
+            pltpu.VMEM((1, bx, ny, nz), dtype),
+            pltpu.SemaphoreType.DMA((1, 2)),
+            pltpu.SemaphoreType.DMA((1, 2)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
+        ),
+        interpret=interp,
+    )
+
+    params_vec = jnp.stack(
+        [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
+    )
+    seeds7 = jnp.asarray([1, 2, 0, 0, 0, 0, L], jnp.int32)
+
+    @jax.jit
+    def compute_walk(u, v):
+        def body(_, uv):
+            return tuple(compute_call(params_vec, seeds7, *uv))
+
+        return lax.fori_loop(0, n_passes, body, (u, v))
+
+    # ---- case: full (production fused_step chain) ------------------------
+    @functools.partial(jax.jit, static_argnames=())
+    def full(u, v):
+        def body(i, uv):
+            uu, vv = uv
+            seeds = jnp.asarray([1, 2, 0], jnp.int32).at[2].set(i * fuse)
+            return ps.fused_step(
+                uu, vv, params, seeds, use_noise=use_noise, fuse=fuse,
+            )
+
+        return lax.fori_loop(0, n_passes, body, (u, v))
+
+    os.environ["GS_BX"] = str(bx)
+    cases = [
+        ("xla_stream", xla_stream),
+        ("dma_walk", dma_walk),
+        ("compute_walk", compute_walk),
+        ("full", full),
+    ]
+
+    # Warmup (compile) everything first, then round-robin.
+    for name, fn in cases:
+        t0 = time.perf_counter()
+        out = fn(u, v)
+        sync(out[0])
+        print(f"probe: warmed {name} in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+
+    rounds: dict = {name: [] for name, _ in cases}
+    for _ in range(args.rounds):
+        for name, fn in cases:
+            t0 = time.perf_counter()
+            out = fn(u, v)
+            sync(out[0])
+            rounds[name].append(
+                (time.perf_counter() - t0) / n_passes * 1e6
+            )
+
+    results = []
+    traffic_mb = {
+        "xla_stream": 2 * 2 * L**3 * 4 / 1e6,
+        "dma_walk": (2 * win_n + 2 * bx) * nblocks * ny * nz * 4 / 1e6,
+        "compute_walk": 0.0,
+        "full": (2 * win_n + 2 * bx) * nblocks * ny * nz * 4 / 1e6,
+    }
+    for name, rs in rounds.items():
+        best = min(rs)
+        results.append({
+            "case": name, "L": L, "bx": bx, "fuse": fuse,
+            "noise": args.noise, "n_passes": n_passes,
+            "rounds_us_per_pass": [round(x, 1) for x in rs],
+            "best_us_per_pass": round(best, 1),
+            "median_us_per_pass": round(statistics.median(rs), 1),
+            "traffic_mb_per_pass": round(traffic_mb[name], 1),
+            "effective_gbps": round(traffic_mb[name] / best * 1e3, 1)
+            if traffic_mb[name] else None,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,31 @@ def _md_path_of(path: str) -> str:
     return _md_path(path)
 
 
+def _real_bp_evidence(path: str) -> bool:
+    """Is ``path`` a real ADIOS2 BP store (vs BP-lite, possibly
+    mid-startup)?
+
+    The test must be POSITIVE evidence of ADIOS2, not absence of BP-lite
+    metadata: a BP-lite multi-writer store mid-startup may contain only
+    bare ``data.<w>`` payload files — writer 0 commits ``md.json`` last,
+    after peers have already created the directory and opened their
+    payloads — and that window is exactly when a peer's ``open_writer``
+    or a live-coupled reader inspects the store. ADIOS2 BP4/BP5 engines
+    create ``md.idx`` and extensionless ``md.<n>`` subfiles at open
+    time; BP-lite's metadata is always ``md[.<w>].json``.
+    """
+    try:
+        names = os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return False
+    return any(
+        n == "md.idx"
+        or n == "mmd.0"
+        or (n.startswith("md.") and n[3:].isdigit())
+        for n in names
+    )
+
+
 def count_steps_upto(path: str, sim_step: int):
     """Number of leading step entries in a store whose recorded ``step``
     scalar is <= ``sim_step`` (None when the store does not exist).
@@ -98,7 +123,7 @@ def open_writer(
                         os.remove(os.path.join(path, name))
             return adios.Adios2Writer(path, writer_id=writer_id,
                                       nwriters=nwriters)
-    if append and os.path.isdir(path) and not os.path.isfile(_md_path_of(path)):
+    if append and _real_bp_evidence(path):
         raise RuntimeError(
             f"{path} exists but is not a BP-lite store (a real ADIOS2 BP "
             "store from a previous run?); rollback-append is a BP-lite "
@@ -124,31 +149,15 @@ def open_writer(
 def open_reader(path: str):
     """Open a store with the matching reader engine.
 
-    BP-lite stores are directories carrying ``md.json``; anything else is
-    a real ADIOS2 BP store and needs the adios2 bindings (a clear error
-    when they are absent).
+    Real ADIOS2 BP stores (positive ``md.idx``/``md.<n>`` evidence,
+    :func:`_real_bp_evidence`) need the adios2 bindings (a clear error
+    when they are absent); anything else — including a BP-lite store
+    mid-startup whose metadata is not committed yet — gets ``BpReader``
+    and its poll-until-metadata behavior.
     """
-    from .bplite import BpReader, _md_path
+    from .bplite import BpReader
 
-    def _bplite_evidence() -> bool:
-        # A BP-lite store mid-startup may exist without md.json yet
-        # (rank 0 commits it after peers create the directory): any
-        # md.<w>.json marks it ours, and an empty directory gets
-        # BpReader's retry-until-metadata behavior. Only .json metadata
-        # is distinguishing — real ADIOS2 BP4 stores also carry bare
-        # data.0 / md.0 subfiles.
-        if os.path.isfile(_md_path(path)):
-            return True
-        try:
-            names = os.listdir(path)
-        except (FileNotFoundError, NotADirectoryError):
-            return False
-        return not names or any(
-            n.startswith("md.") and n.endswith((".json", ".json.tmp"))
-            for n in names
-        )
-
-    if not os.path.exists(path) or _bplite_evidence():
+    if not _real_bp_evidence(path):
         return BpReader(path)
     from . import adios
 

@@ -40,27 +40,54 @@ def test_open_writer_falls_back_without_adios2(tmp_path, monkeypatch):
     )
 
 
-def test_open_reader_rejects_foreign_store_without_adios2(tmp_path):
-    """A directory that is not a BP-lite store needs the adios2 bindings;
-    absent them the error must say so instead of misparsing."""
-    d = tmp_path / "real.bp"
+def _make_fake_bp4_store(d):
+    """The subfile layout every ADIOS2 BP4/BP5 engine creates at open
+    time (``md.idx`` + extensionless ``md.0`` are the positive markers
+    ``io._real_bp_evidence`` keys on — BP-lite metadata is always
+    ``md[.<w>].json``)."""
     d.mkdir()
-    (d / "data.0.bp").write_bytes(b"\x00" * 16)  # BP4-ish layout, no md.json
+    (d / "data.0").write_bytes(b"\x00" * 16)
+    (d / "md.0").write_bytes(b"\x00" * 16)
+    (d / "md.idx").write_bytes(b"\x00" * 16)
+
+
+def test_open_reader_rejects_real_bp_store_without_adios2(tmp_path):
+    """A real ADIOS2 BP store needs the adios2 bindings; absent them the
+    error must say so instead of misparsing. A bare ``data.<w>`` file is
+    NOT sufficient evidence — a BP-lite multi-writer store mid-startup
+    looks exactly like that (md.json is committed last), and the reader
+    must poll it, not reject it."""
+    d = tmp_path / "real.bp"
+    _make_fake_bp4_store(d)
     if adios.available():
         pytest.skip("adios2 present: the store would be dispatched to it")
     with pytest.raises(RuntimeError, match="adios2"):
         open_reader(str(d))
 
 
-def test_append_to_foreign_store_is_refused(tmp_path):
+def test_append_to_real_bp_store_is_refused(tmp_path):
     """Rollback-append is BP-lite-only; appending onto a real-BP store
     from an adios2-enabled run must fail loudly, not scribble md.json
     into it."""
     d = tmp_path / "real.bp"
-    d.mkdir()
-    (d / "data.0.bp").write_bytes(b"\x00" * 16)
+    _make_fake_bp4_store(d)
     with pytest.raises(RuntimeError, match="BP-lite"):
         open_writer(str(d), append=True)
+
+
+def test_append_during_peer_startup_is_not_refused(tmp_path, monkeypatch):
+    """The multi-process restart race (r3): writer 1 reaches
+    ``open_writer(append=True)`` on a fresh store after writer 0 created
+    the directory and its ``data.0`` payload but before any metadata is
+    committed. That window must dispatch to a BP-lite writer, not raise
+    the foreign-store error."""
+    monkeypatch.setenv("GS_TPU_NATIVE_IO", "0")
+    d = tmp_path / "out.bp"
+    d.mkdir()
+    (d / "data.0").write_bytes(b"")
+    w = open_writer(str(d), writer_id=1, nwriters=2, append=True)
+    assert isinstance(w, BpWriter)
+    w.close()
 
 
 @requires_adios2
