@@ -125,6 +125,16 @@ def main() -> int:
             slot = lax.rem(b, 2)
             nxt = lax.rem(b + 1, 2)
 
+            # Unlike production (whose out DMAs source a separate out
+            # scratch), these out DMAs source the INPUT scratch — so
+            # slot nxt's previous output must drain before the prefetch
+            # overwrites it. Slightly less overlap than production; no
+            # race.
+            @pl.when(b >= 1)
+            def _():
+                for tag in (0, 1):
+                    out_dma(nxt, b - 1, tag).wait()
+
             @pl.when(b + 1 < nblocks)
             def _():
                 for tag in (0, 1):
@@ -133,20 +143,13 @@ def main() -> int:
             for tag in (0, 1):
                 in_dma(slot, b, tag).wait()
 
-            @pl.when(b >= 2)
-            def _():
-                for tag in (0, 1):
-                    out_dma(slot, b - 2, tag).wait()
-
             for tag in (0, 1):
                 out_dma(slot, b, tag).start()
             return 0
 
         lax.fori_loop(0, nblocks, body, 0)
-        for tail_b in (nblocks - 2, nblocks - 1):
-            if tail_b >= 0:
-                for tag in (0, 1):
-                    out_dma(tail_b % 2, jnp.int32(tail_b), tag).wait()
+        for tag in (0, 1):
+            out_dma((nblocks - 1) % 2, jnp.int32(nblocks - 1), tag).wait()
 
     any_spec = pl.BlockSpec(memory_space=pl.ANY)
     interp = (
@@ -187,10 +190,13 @@ def main() -> int:
     )
     use_noise = args.noise > 0
 
-    def make_compute_kernel():
+    def make_compute_kernel(noise_on=None, selects=True, rolls=True,
+                            fma=False, minimal=False, nomid=False):
         # One input window resident in VMEM; per "slab" run the real
         # fuse-stage chain (production kernel body via ps internals) and
         # keep results in out scratch; single final out DMA.
+        noisy = use_noise if noise_on is None else noise_on
+
         def kernel(params_s, seeds_s, u_ref, v_ref, u_out, v_out,
                    in_u, in_v, mid_u, mid_v, out_u, out_v, in_sems,
                    out_sems):
@@ -217,24 +223,102 @@ def main() -> int:
             inv_six = jnp.asarray(1.0 / 6.0, cdt)
             one = jnp.asarray(1.0, cdt)
 
-            def lap(win, c):
+            def shifted(c, axis, shift):
+                if not rolls:
+                    return c
+                n = c.shape[axis]
+                r = pltpu.roll(c, shift if shift > 0 else n - 1, axis)
+                if not selects:
+                    return r
+                return jnp.where(masks[(axis, shift)], u_bv, r)
+
+            def nsum(win, c):
                 n = c.shape[0]
                 return (
                     win[0:n] + win[2:n + 2]
-                    + ps._shifted(c, 1, 1, u_bv, masks)
-                    + ps._shifted(c, 1, -1, u_bv, masks)
-                    + ps._shifted(c, 2, 1, u_bv, masks)
-                    + ps._shifted(c, 2, -1, u_bv, masks)
-                ) * inv_six - c
+                    + shifted(c, 1, 1) + shifted(c, 1, -1)
+                    + shifted(c, 2, 1) + shifted(c, 2, -1)
+                )
 
-            def noise_block(step_idx, g0, w):
+            def lap(win, c):
+                return nsum(win, c) * inv_six - c
+
+            def raw_bits(step_idx, g0, w):
                 iota_w = lax.broadcasted_iota(jnp.int32, (w, 1, 1), 0)
                 gx = seeds_s[3] + g0 + iota_w
                 seed = ps.plane_seed(seeds_s[0], seeds_s[1], step_idx, gx)
                 iy = lax.broadcasted_iota(jnp.uint32, (1, ny, 1), 1)
                 iz = lax.broadcasted_iota(jnp.uint32, (1, 1, nz), 2)
-                bits = ps.block_bits(seed, iy, iz, seeds_s[6])
+                return ps.block_bits(seed, iy, iz, seeds_s[6])
+
+            def noise_block(step_idx, g0, w):
+                bits = raw_bits(step_idx, g0, w)
                 return noise * ps._kernel_pm1(bits, cdt)
+
+            # dt-folded coefficient form (fma variant): u' and v' as a
+            # linear combination with precomputed scalars — drops the
+            # explicit lap()/du/dv intermediates.
+            au = one - dt * (Du + F)
+            bu = dt * Du * inv_six
+            cu = dt * F
+            av = one - dt * (Dv + F + K)
+            bv2 = dt * Dv * inv_six
+            noise_dt = noise * dt
+
+            def chain_minimal(b, _):
+                # Same per-stage window loads and mid/out stores, ONE
+                # multiply of arithmetic: the structural floor of the
+                # stage chain (VMEM movement + scheduling).
+                k = fuse
+                for s in range(k):
+                    w_out = bx + 2 * (k - 1 - s)
+                    if s == 0:
+                        u_win, v_win = in_u[0], in_v[0]
+                    else:
+                        buf = (s - 1) % 2 if k > 2 else 0
+                        u_win = mid_u[buf, pl.ds(0, w_out + 2)]
+                        v_win = mid_v[buf, pl.ds(0, w_out + 2)]
+                    n = u_win.shape[0] - 2
+                    u_new = u_win[1:n + 1] * au
+                    v_new = v_win[1:n + 1] * av
+                    if s == k - 1:
+                        out_u[0] = u_new.astype(dtype)
+                        out_v[0] = v_new.astype(dtype)
+                    else:
+                        buf = s % 2 if k > 2 else 0
+                        mid_u[buf, pl.ds(0, w_out)] = u_new
+                        mid_v[buf, pl.ds(0, w_out)] = v_new
+                return 0
+
+            def chain_nomid(b, _):
+                # Full per-stage arithmetic (rolls, selects, noise) but
+                # every stage reads the resident input window and chains
+                # through an accumulator — no mid-buffer VMEM
+                # round-trips, one final store. Garbage numerics; kept
+                # live via the accumulator.
+                k = fuse
+                acc_u = in_u[0, pl.ds(1, bx)] * one
+                acc_v = in_v[0, pl.ds(1, bx)] * one
+                for s in range(k):
+                    w_out = bx + 2 * (k - 1 - s)
+                    u_win = in_u[0, pl.ds(0, w_out + 2)]
+                    v_win = in_v[0, pl.ds(0, w_out + 2)]
+                    n = w_out
+                    u_c = u_win[1:n + 1]
+                    v_c = v_win[1:n + 1]
+                    lap_u = lap(u_win, u_c)
+                    lap_v = lap(v_win, v_c)
+                    uvv = u_c * v_c * v_c
+                    du = Du * lap_u - uvv + F * (one - u_c)
+                    dv = Dv * lap_v + uvv - (F + K) * v_c
+                    if noisy:
+                        du = du + noise_block(seeds_s[2] + s, b * bx,
+                                              w_out)
+                    acc_u = acc_u + (u_c + du * dt)[:bx]
+                    acc_v = acc_v + (v_c + dv * dt)[:bx]
+                out_u[0] = acc_u.astype(dtype)
+                out_v[0] = acc_v.astype(dtype)
+                return 0
 
             def chain(b, _):
                 k = fuse
@@ -250,23 +334,43 @@ def main() -> int:
                     n = u_win.shape[0] - 2
                     u_c = u_win[1:n + 1]
                     v_c = v_win[1:n + 1]
-                    lap_u = lap(u_win, u_c)
-                    lap_v = lap(v_win, v_c)
-                    uvv = u_c * v_c * v_c
-                    du = Du * lap_u - uvv + F * (one - u_c)
-                    dv = Dv * lap_v + uvv - (F + K) * v_c
-                    if use_noise:
-                        du = du + noise_block(seeds_s[2] + s, b * bx, w_out)
+                    if fma:
+                        uvv_dt = u_c * v_c * v_c * dt
+                        u_new = (u_c * au + bu * nsum(u_win, u_c)
+                                 + cu - uvv_dt)
+                        v_new = (v_c * av + bv2 * nsum(v_win, v_c)
+                                 + uvv_dt)
+                        if noisy:
+                            u_new = u_new + noise_dt * ps._kernel_pm1(
+                                raw_bits(seeds_s[2] + s, b * bx, w_out),
+                                cdt,
+                            )
+                    else:
+                        lap_u = lap(u_win, u_c)
+                        lap_v = lap(v_win, v_c)
+                        uvv = u_c * v_c * v_c
+                        du = Du * lap_u - uvv + F * (one - u_c)
+                        dv = Dv * lap_v + uvv - (F + K) * v_c
+                        if noisy:
+                            du = du + noise_block(
+                                seeds_s[2] + s, b * bx, w_out
+                            )
+                        u_new = u_c + du * dt
+                        v_new = v_c + dv * dt
                     if s == k - 1:
-                        out_u[0] = (u_c + du * dt).astype(dtype)
-                        out_v[0] = (v_c + dv * dt).astype(dtype)
+                        out_u[0] = u_new.astype(dtype)
+                        out_v[0] = v_new.astype(dtype)
                     else:
                         buf = s % 2 if k > 2 else 0
-                        mid_u[buf, pl.ds(0, w_out)] = u_c + du * dt
-                        mid_v[buf, pl.ds(0, w_out)] = v_c + dv * dt
+                        mid_u[buf, pl.ds(0, w_out)] = u_new
+                        mid_v[buf, pl.ds(0, w_out)] = v_new
                 return 0
 
-            lax.fori_loop(0, nblocks, chain, 0)
+            body_fn = (
+                chain_minimal if minimal else
+                chain_nomid if nomid else chain
+            )
+            lax.fori_loop(0, nblocks, body_fn, 0)
             for tag, (ref, scr) in enumerate(
                 ((u_out, out_u), (v_out, out_v))
             ):
@@ -284,38 +388,43 @@ def main() -> int:
 
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     nbuf, mid_planes = ps._mid_layout(bx, fuse)
-    compute_call = pl.pallas_call(
-        make_compute_kernel(),
-        in_specs=[smem_spec, smem_spec, any_spec, any_spec],
-        out_specs=[any_spec, any_spec],
-        out_shape=[jax.ShapeDtypeStruct((L, L, L), dtype)] * 2,
-        scratch_shapes=[
-            pltpu.VMEM((1, win_n, ny, nz), dtype),
-            pltpu.VMEM((1, win_n, ny, nz), dtype),
-            pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
-            pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
-            pltpu.VMEM((1, bx, ny, nz), dtype),
-            pltpu.VMEM((1, bx, ny, nz), dtype),
-            pltpu.SemaphoreType.DMA((1, 2)),
-            pltpu.SemaphoreType.DMA((1, 2)),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
-        ),
-        interpret=interp,
-    )
-
     params_vec = jnp.stack(
         [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
     )
     seeds7 = jnp.asarray([1, 2, 0, 0, 0, 0, L], jnp.int32)
 
-    @jax.jit
-    def compute_walk(u, v):
-        def body(_, uv):
-            return tuple(compute_call(params_vec, seeds7, *uv))
+    def build_compute_walk(**variant):
+        call = pl.pallas_call(
+            make_compute_kernel(**variant),
+            in_specs=[smem_spec, smem_spec, any_spec, any_spec],
+            out_specs=[any_spec, any_spec],
+            out_shape=[jax.ShapeDtypeStruct((L, L, L), dtype)] * 2,
+            scratch_shapes=[
+                pltpu.VMEM((1, win_n, ny, nz), dtype),
+                pltpu.VMEM((1, win_n, ny, nz), dtype),
+                pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
+                pltpu.VMEM((nbuf or 1, mid_planes, ny, nz), dtype),
+                pltpu.VMEM((1, bx, ny, nz), dtype),
+                pltpu.VMEM((1, bx, ny, nz), dtype),
+                pltpu.SemaphoreType.DMA((1, 2)),
+                pltpu.SemaphoreType.DMA((1, 2)),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
+            ),
+            interpret=interp,
+        )
 
-        return lax.fori_loop(0, n_passes, body, (u, v))
+        @jax.jit
+        def compute_walk(u, v):
+            def body(_, uv):
+                return tuple(call(params_vec, seeds7, *uv))
+
+            return lax.fori_loop(0, n_passes, body, (u, v))
+
+        return compute_walk
+
+    compute_walk = build_compute_walk()
 
     # ---- case: full (production fused_step chain) ------------------------
     @functools.partial(jax.jit, static_argnames=())
@@ -336,6 +445,19 @@ def main() -> int:
         ("compute_walk", compute_walk),
         ("full", full),
     ]
+    if os.environ.get("GS_PROBE_COMPUTE_VARIANTS", "0") != "0":
+        # Compute decomposition: pairwise deltas isolate noise hash,
+        # boundary selects, and y/z rolls; compute_fma measures the
+        # dt-folded coefficient form against the shipped arithmetic.
+        cases += [
+            ("compute_nonoise", build_compute_walk(noise_on=False)),
+            ("compute_noselect", build_compute_walk(selects=False)),
+            ("compute_noyz", build_compute_walk(selects=False,
+                                                rolls=False)),
+            ("compute_fma", build_compute_walk(fma=True)),
+            ("compute_minimal", build_compute_walk(minimal=True)),
+            ("compute_nomid", build_compute_walk(nomid=True)),
+        ]
 
     # Warmup (compile) everything first, then round-robin.
     for name, fn in cases:
@@ -364,15 +486,15 @@ def main() -> int:
     }
     for name, rs in rounds.items():
         best = min(rs)
+        mb = traffic_mb.get(name, 0.0)
         results.append({
             "case": name, "L": L, "bx": bx, "fuse": fuse,
             "noise": args.noise, "n_passes": n_passes,
             "rounds_us_per_pass": [round(x, 1) for x in rs],
             "best_us_per_pass": round(best, 1),
             "median_us_per_pass": round(statistics.median(rs), 1),
-            "traffic_mb_per_pass": round(traffic_mb[name], 1),
-            "effective_gbps": round(traffic_mb[name] / best * 1e3, 1)
-            if traffic_mb[name] else None,
+            "traffic_mb_per_pass": round(mb, 1),
+            "effective_gbps": round(mb / best * 1e3, 1) if mb else None,
         })
         print(json.dumps(results[-1]), flush=True)
 

@@ -23,12 +23,6 @@ from __future__ import annotations
 import os
 
 
-def _md_path_of(path: str) -> str:
-    from .bplite import _md_path
-
-    return _md_path(path)
-
-
 def _real_bp_evidence(path: str) -> bool:
     """Is ``path`` a real ADIOS2 BP store (vs BP-lite, possibly
     mid-startup)?
@@ -40,8 +34,11 @@ def _real_bp_evidence(path: str) -> bool:
     payloads — and that window is exactly when a peer's ``open_writer``
     or a live-coupled reader inspects the store. ADIOS2 BP4/BP5 engines
     create ``md.idx`` and extensionless ``md.<n>`` subfiles at open
-    time; BP-lite's metadata is always ``md[.<w>].json``.
+    time; a BP3 store is a single regular FILE (BP-lite stores are
+    always directories); BP-lite's metadata is always ``md[.<w>].json``.
     """
+    if os.path.isfile(path):
+        return True
     try:
         names = os.listdir(path)
     except (FileNotFoundError, NotADirectoryError):
@@ -52,6 +49,29 @@ def _real_bp_evidence(path: str) -> bool:
         or (n.startswith("md.") and n[3:].isdigit())
         for n in names
     )
+
+
+def _foreign_dir(path: str) -> bool:
+    """Is ``path`` a non-empty directory with NO BP-lite-shaped entries?
+
+    Guards rollback-append against scribbling into an unrelated
+    directory (a typo'd or stale config path): BP-lite entries are
+    ``md[.<w>].json[.tmp]`` metadata and ``data.<w>`` payloads; an empty
+    directory is presumed ours (a peer just created it, mid-startup).
+    """
+    try:
+        names = os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return False
+
+    def ours(n: str) -> bool:
+        if n in ("md.json", "md.json.tmp"):
+            return True
+        if n.startswith("md.") and n.endswith((".json", ".json.tmp")):
+            return True
+        return n.startswith("data.") and n[5:].isdigit()
+
+    return bool(names) and not any(ours(n) for n in names)
 
 
 def count_steps_upto(path: str, sim_step: int):
@@ -123,12 +143,13 @@ def open_writer(
                         os.remove(os.path.join(path, name))
             return adios.Adios2Writer(path, writer_id=writer_id,
                                       nwriters=nwriters)
-    if append and _real_bp_evidence(path):
+    if append and (_real_bp_evidence(path) or _foreign_dir(path)):
         raise RuntimeError(
             f"{path} exists but is not a BP-lite store (a real ADIOS2 BP "
-            "store from a previous run?); rollback-append is a BP-lite "
-            "feature — rerun the original run with GS_TPU_ADIOS2=0, or "
-            "point the restart at a fresh output path"
+            "store from a previous run, or an unrelated directory?); "
+            "rollback-append is a BP-lite feature — rerun the original "
+            "run with GS_TPU_ADIOS2=0, or point the restart at a fresh "
+            "output path"
         )
     if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native

@@ -37,7 +37,8 @@ def setup_platform(cpu: bool, devices: int = 1) -> str:
 
 
 def time_sim_rounds(
-    sim, steps: int, rounds: int, sustain_seconds: float = 0.0
+    sim, steps: int, rounds: int, sustain_seconds: float = 0.0,
+    round_sleep: float = 0.0,
 ) -> Dict[str, object]:
     """Per-round seconds-per-step of ``steps`` fused simulation steps
     (after a compile-triggering warmup chunk), plus an optional
@@ -47,12 +48,17 @@ def time_sim_rounds(
     halo_bench.py and weak_scaling.py all go through here so the
     completion workaround below cannot drift between entry points.
 
-    The tunnel chip's clock throttles under sustained load (BASELINE.md
-    caveats), so a single best-of-N hides a ~1.7x spread: callers should
-    record ALL of ``rounds_s_per_step`` (chronological), ``best``,
-    ``median``, and — when ``sustain_seconds`` > 0 — ``sustained``
-    (continuous back-to-back chunks for at least that long, the
-    throttled steady-state number).
+    The tunnel chip's clock wanders between throttled and fast states on
+    a minutes timescale independently of load (BASELINE.md caveats;
+    ~1.7x spread, and the r3 envelope probe measured HBM streaming
+    itself varying ~3x), so a single best-of-N hides the spread AND
+    samples only one clock state: callers should record ALL of
+    ``rounds_s_per_step`` (chronological), ``best``, ``median``, and —
+    when ``sustain_seconds`` > 0 — ``sustained`` (continuous
+    back-to-back chunks for at least that long, the throttled
+    steady-state number). ``round_sleep`` spaces the rounds out in
+    wall-clock so they sample more clock states (fast windows appear
+    opportunistically; idle time costs nothing on a shared chip).
     """
     import statistics
 
@@ -66,7 +72,9 @@ def time_sim_rounds(
     sim.iterate(steps)  # warmup: trigger compile
     sync()
     per_round = []
-    for _ in range(rounds):
+    for i in range(rounds):
+        if i and round_sleep > 0:
+            time.sleep(round_sleep)
         t0 = time.perf_counter()
         sim.iterate(steps)
         sync()
@@ -102,6 +110,7 @@ def bench_one(
     steps: int = 100,
     rounds: int = 3,
     sustain_seconds: float = 0.0,
+    round_sleep: float = 0.0,
 ) -> Dict[str, object]:
     """Throughput of ``steps``-step chunks at grid side ``L`` on the
     default JAX backend (single device): best / median over ``rounds``
@@ -120,7 +129,8 @@ def bench_one(
         precision=precision, backend=backend, kernel_language=lang,
     )
     sim = Simulation(settings, n_devices=1)
-    t = time_sim_rounds(sim, steps, rounds, sustain_seconds=sustain_seconds)
+    t = time_sim_rounds(sim, steps, rounds, sustain_seconds=sustain_seconds,
+                        round_sleep=round_sleep)
     out = {
         "L": L,
         "precision": precision,

@@ -110,6 +110,22 @@ def test_cli_end_to_end(tmp_path, kernel_language):
     assert (vtk_dir / "step_0000010.vti").exists()
 
 
+def test_stats_json_written(tmp_path):
+    """GS_TPU_STATS captures the structured run summary (the reference's
+    observability is one ``@time``, ``gray-scott.jl:12`` — SURVEY §5)."""
+    import json
+
+    cfg = write_config(tmp_path, noise=0.1)
+    stats_path = tmp_path / "stats.json"
+    res = run_cli(tmp_path, cfg, extra_env={"GS_TPU_STATS": str(stats_path)})
+    assert res.returncode == 0, res.stderr + res.stdout
+    stats = json.loads(stats_path.read_text())
+    assert stats["L"] == 32 and stats["steps"] == 40
+    assert stats["cell_updates_per_s"] > 0
+    assert {"compute", "output"} <= set(stats["phases_s"])
+    assert stats["wall_s"] >= sum(stats["phases_s"].values()) * 0.5
+
+
 def test_cli_rejects_bad_config(tmp_path):
     bad = tmp_path / "config.json"
     bad.write_text("{}")

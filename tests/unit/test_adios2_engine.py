@@ -75,6 +75,16 @@ def test_append_to_real_bp_store_is_refused(tmp_path):
         open_writer(str(d), append=True)
 
 
+def test_append_to_unrelated_directory_is_refused(tmp_path):
+    """A restart pointed at some non-store directory (typo'd/stale
+    config) must fail loudly, not scribble md.json/data.<w> into it."""
+    d = tmp_path / "gs.vtk"
+    d.mkdir()
+    (d / "step_0000010.vti").write_bytes(b"<VTKFile/>")
+    with pytest.raises(RuntimeError, match="BP-lite"):
+        open_writer(str(d), append=True)
+
+
 def test_append_during_peer_startup_is_not_refused(tmp_path, monkeypatch):
     """The multi-process restart race (r3): writer 1 reaches
     ``open_writer(append=True)`` on a fresh store after writer 0 created

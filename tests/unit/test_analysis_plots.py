@@ -1,0 +1,95 @@
+"""Plotting / decomposition-inspection tests.
+
+The reference ships 0-byte stubs for both tools (``src/plot/gdsplot.jl``,
+``src/plot/decomp.jl`` — SURVEY §2); these cover the implementations:
+slice extraction and rendering from a BP store, the pdfcalc-output
+heatmap, and the decomposition describer.
+"""
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.analysis import decomp, gdsplot
+from grayscott_jl_tpu.io.bplite import BpWriter
+
+
+@pytest.fixture()
+def sim_store(tmp_path):
+    """A tiny simulation-shaped store: U/V at two steps."""
+    path = str(tmp_path / "out.bp")
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (6, 6, 6))
+    w.define_variable("V", np.float32, (6, 6, 6))
+    for s in range(2):
+        w.begin_step()
+        w.put("step", np.int32((s + 1) * 10))
+        vol = np.arange(216, dtype=np.float32).reshape(6, 6, 6) + 1000 * s
+        w.put("U", vol, start=(0, 0, 0), count=(6, 6, 6))
+        w.put("V", -vol, start=(0, 0, 0), count=(6, 6, 6))
+        w.end_step()
+    w.close()
+    return path
+
+
+def test_load_slice_axes_and_steps(sim_store):
+    vol = np.arange(216, dtype=np.float32).reshape(6, 6, 6)
+    np.testing.assert_array_equal(
+        gdsplot.load_slice(sim_store, "U", step=0, axis="x"), vol[3]
+    )
+    np.testing.assert_array_equal(
+        gdsplot.load_slice(sim_store, "U", step=0, axis="z", index=1),
+        vol[:, :, 1],
+    )
+    # negative step = from the end; V is the negated volume
+    np.testing.assert_array_equal(
+        gdsplot.load_slice(sim_store, "V", step=-1, axis="y", index=0),
+        -(vol + 1000)[:, 0, :],
+    )
+
+
+def test_gdsplot_cli_writes_png(sim_store, tmp_path, capsys):
+    out = tmp_path / "slice.png"
+    assert gdsplot.main([sim_store, "--var", "U", "--output", str(out)]) == 0
+    assert out.stat().st_size > 0
+    assert str(out) in capsys.readouterr().out
+
+
+def test_gdsplot_pdf_heatmap(tmp_path):
+    # pdfcalc-shaped store: per-slice histograms + bin centers
+    path = str(tmp_path / "pdf.bp")
+    w = BpWriter(path)
+    w.define_variable("U/pdf", np.float32, (4, 8))
+    w.define_variable("U/bins", np.float32, (8,))
+    w.begin_step()
+    w.put("U/pdf", np.random.default_rng(0)
+          .random((4, 8)).astype(np.float32))
+    w.put("U/bins", np.linspace(0, 1, 8, dtype=np.float32))
+    w.end_step()
+    w.close()
+    out = tmp_path / "pdf.png"
+    assert gdsplot.main([path, "--pdf", "--output", str(out)]) == 0
+    assert out.stat().st_size > 0
+
+
+def test_gdsplot_empty_store_raises(tmp_path):
+    path = str(tmp_path / "empty.bp")
+    w = BpWriter(path)
+    w.close()
+    with pytest.raises(ValueError, match="no steps"):
+        gdsplot.load_slice(path)
+
+
+def test_decomp_describe_even_and_uneven():
+    text = decomp.describe(8, 256)
+    assert "(2, 2, 2)" in text
+    assert "equal blocks 128x128x128" in text
+    # every rank row present with sizes/offsets
+    assert text.count("(128, 128, 128)") >= 8
+    uneven = decomp.describe(3, 16)
+    assert "UNEVEN" in uneven
+
+
+def test_decomp_cli(capsys):
+    assert decomp.main(["8", "--L", "64"]) == 0
+    assert "mesh dims" in capsys.readouterr().out
