@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Analytic ICI weak-scaling projection for the pod-slice configs.
+
+No multi-chip hardware is reachable from this environment, and the
+8-virtual-device CPU mesh bounds only framework overhead (BASELINE.md:
+CPU-emulated collectives serialize — efficiency 0.043 is not
+predictive). This model replaces that vacuum with a quantified
+projection from measured single-chip numbers plus published fabric
+parameters, with every assumption stated and overridable — the same
+kind of traffic model BASELINE.md's "Anchors" section applies to the
+reference's CUDA kernel.
+
+Model (per step, per device, cubic local block of side ``local``):
+
+* compute time  = measured single-chip µs/step for that local volume
+  (from ``benchmarks/results`` sweeps, or ``--us-per-step``), assumed
+  throughput-flat in L (measured: 73% of roofline at L=512 vs 45% at
+  L=256 — so flat is CONSERVATIVE for larger locals on the compute
+  side);
+* halo bytes    = 6 faces x local^2 cells x itemsize x 2 fields
+  x 1/fuse (the k-deep temporal-blocked exchange sends a k-wide slab
+  every k steps: k x the bytes at 1/k the frequency -> amortized
+  1x bytes at 1/k frequency per face pair, plus corner growth
+  (local+2k)^2/local^2 accounted below);
+* comm time     = halo bytes / (links x per-link BW) + 6 x hop latency;
+  nearest-neighbor faces ride DISTINCT torus links in a 3D mesh
+  mapping (the topology-aware mesh maps grid axes onto torus axes), so
+  links = 6 for interior devices of a 3D-torus slice (v5p) and 4 for
+  the v5e 2D torus (z faces share links with y);
+* efficiency    = compute / (compute + exposed_comm), with
+  ``--overlap`` fraction of comm hidden behind compute (XLA pipelines
+  collectives with the fori_loop body when dataflow allows; 0 = fully
+  exposed, the worst case).
+
+Fabric parameters (overridable): v5p ICI ~90 GB/s per link per
+direction and ~1 µs hop latency; v5e ~45 GB/s. These are public
+figures for the generation; the point of the model is sensitivity, not
+decimal precision — rerun with your own numbers.
+
+    python benchmarks/ici_model.py            # the BASELINE.json configs
+    python benchmarks/ici_model.py --local 256 --fuse 5 --overlap 0.5
+
+Emits one JSON line per config plus a markdown table on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def project(
+    local: int,
+    fuse: int,
+    us_per_step: float,
+    *,
+    itemsize: int = 4,
+    links: int = 6,
+    link_gbps: float = 90.0,
+    hop_us: float = 1.0,
+    overlap: float = 0.0,
+) -> dict:
+    """Weak-scaling efficiency projection for one config."""
+    wide = local + 2 * fuse  # corner-propagated k-wide exchange slab
+    face_bytes = wide * wide * fuse * itemsize * 2  # per face, per k steps
+    total_bytes = 6 * face_bytes
+    # The exchange completes at the MAX-loaded link, not at aggregate
+    # bandwidth: with 6 links each face rides its own (1 face/link);
+    # with 4 (v5e 2D torus) the y/z-shared links carry 2 faces each.
+    faces_per_link = -(-6 // links)  # ceil
+    ser_us = faces_per_link * face_bytes / (link_gbps * 1e3) / fuse
+    lat_us = 6 * hop_us / fuse  # one exchange round per k steps
+    comm_us = (ser_us + lat_us) * (1.0 - overlap)
+    eff = us_per_step / (us_per_step + comm_us)
+    return {
+        "local": local,
+        "fuse": fuse,
+        "compute_us_per_step": round(us_per_step, 1),
+        "halo_bytes_per_round": total_bytes,
+        "comm_us_per_step_exposed": round(comm_us, 2),
+        "links": links,
+        "link_gbps": link_gbps,
+        "overlap": overlap,
+        "projected_weak_scaling_eff": round(eff, 4),
+    }
+
+
+#: Measured single-chip Pallas f32 noisy µs/step by local side
+#: (BASELINE.md v5e table, fast-window best-of; the throttled state
+#: scales compute and comm denominators together, so efficiency is
+#: roughly state-invariant).
+MEASURED_US = {128: 396.0, 256: 727.6, 512: 3618.2}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local", type=int, default=None)
+    ap.add_argument("--fuse", type=int, default=5)
+    ap.add_argument("--us-per-step", type=float, default=None)
+    ap.add_argument("--links", type=int, default=6)
+    ap.add_argument("--link-gbps", type=float, default=90.0)
+    ap.add_argument("--hop-us", type=float, default=1.0)
+    ap.add_argument("--overlap", type=float, default=0.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.local is not None:
+        us = args.us_per_step or MEASURED_US.get(args.local)
+        if us is None:
+            ap.error(f"no measured µs/step for local={args.local}; "
+                     "pass --us-per-step")
+        rows = [project(args.local, args.fuse, us, links=args.links,
+                        link_gbps=args.link_gbps, hop_us=args.hop_us,
+                        overlap=args.overlap)]
+    else:
+        # The 3-config path pins links/bandwidth/µs-per-step per config;
+        # a fabric override silently ignored would fake sensitivity.
+        for flag, default in (("links", 6), ("link_gbps", 90.0),
+                              ("us_per_step", None)):
+            if getattr(args, flag) != default:
+                ap.error(f"--{flag.replace('_', '-')} requires --local "
+                         "(the default configs pin their own fabric)")
+        # The BASELINE.json pod configs: (name, local side, fabric)
+        configs = [
+            ("v5e-8 2x2x2, L=256", 128, 4, 45.0),
+            ("v5p-16 2x2x2, L=512", 256, 6, 90.0),
+            ("v5p-256 8x4x4, L=1024", 256, 6, 90.0),
+        ]
+        rows = []
+        for name, local, links, bw in configs:
+            r = project(local, args.fuse, MEASURED_US[local], links=links,
+                        link_gbps=bw, hop_us=args.hop_us,
+                        overlap=args.overlap)
+            r["config"] = name
+            rows.append(r)
+
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    print("\n| config | local | comm µs/step | eff (0 overlap) |",
+          file=sys.stderr)
+    print("|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r.get('config', r['local'])} | {r['local']}^3 | "
+            f"{r['comm_us_per_step_exposed']} | "
+            f"{r['projected_weak_scaling_eff']:.3f} |",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,8 +65,7 @@ def _foreign_dir(path: str) -> bool:
         return False
 
     def ours(n: str) -> bool:
-        if n in ("md.json", "md.json.tmp"):
-            return True
+        # md.json / md.json.tmp / md.<w>.json[.tmp] / data.<w>
         if n.startswith("md.") and n.endswith((".json", ".json.tmp")):
             return True
         return n.startswith("data.") and n[5:].isdigit()

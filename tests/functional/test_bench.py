@@ -57,3 +57,30 @@ def test_bench_contract_cpu():
     assert {"metric", "value", "unit", "vs_baseline"} <= set(payload)
     assert payload["kernel"] in ("Pallas", "Plain")
     assert payload["value"] > 0
+
+
+def test_ici_model_projection_contract():
+    """The analytic ICI projection (the only weak-scaling evidence
+    producible without a pod slice) emits the BASELINE configs with
+    sane efficiencies, and responds to fabric/fuse knobs in the right
+    direction."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "ici_model.py")],
+        env=_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(rows) == 3
+    for row in rows:
+        assert 0.9 < row["projected_weak_scaling_eff"] <= 1.0
+        assert row["comm_us_per_step_exposed"] > 0
+
+    # worse fabric => lower efficiency; shallower fuse => more rounds
+    worse = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "ici_model.py"),
+         "--local", "256", "--link-gbps", "9", "--fuse", "1"],
+        env=_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert worse.returncode == 0, worse.stderr[-2000:]
+    w = json.loads(worse.stdout.splitlines()[0])
+    assert w["projected_weak_scaling_eff"] < rows[1]["projected_weak_scaling_eff"]
