@@ -466,6 +466,27 @@ class Simulation:
         self._runners[nsteps] = fn
         return fn
 
+    def compile_chunk(self, nsteps: int) -> None:
+        """Ahead-of-time compile the ``nsteps`` runner without executing
+        a single step.
+
+        Launch support: pod jobs can pay the (20-60 s) compile before
+        opening streams/checkpoints rather than inside the first
+        ``iterate`` call, and a driver can compile-check a configuration
+        without advancing the simulation. The compiled executable
+        replaces the cached runner (same call signature), so ``iterate``
+        uses it directly — compiling here and re-tracing on call would
+        defeat the point. Note the first *execution* still pays a one-off
+        device program-load (~tens of ms).
+        """
+        runner = self._runner(nsteps)
+        if not hasattr(runner, "lower"):
+            return  # already AOT-compiled
+        compiled = runner.lower(
+            self.u, self.v, self.base_key, jnp.int32(self.step), self.params
+        ).compile()
+        self._runners[nsteps] = compiled
+
     # ---------------------------------------------------------------- public
 
     def iterate(self, nsteps: int = 1) -> None:

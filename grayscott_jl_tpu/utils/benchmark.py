@@ -69,7 +69,13 @@ def time_sim_rounds(
         # tunnel; a dependent scalar readback forces real completion.
         return float(jnp.sum(sim.u[:1, :1, :4]))
 
-    sim.iterate(steps)  # warmup: trigger compile
+    # Execute-to-compile warmup: one untimed chunk triggers compile AND
+    # pays the first-execution program-load cost. (An AOT-only warmup
+    # via sim.compile_chunk was tried in r3: it shifts ~30 ms of
+    # program-load into round 1, and the hoped-for post-idle fast burst
+    # turned out to be an external clock lottery, not schedulable —
+    # see BASELINE.md throttle notes.)
+    sim.iterate(steps)
     sync()
     per_round = []
     for i in range(rounds):

@@ -70,17 +70,26 @@ def test_ici_model_projection_contract():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     rows = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
-    assert len(rows) == 3
+    assert len(rows) == 6  # 3 configs x 2 kernel languages
     for row in rows:
-        assert 0.9 < row["projected_weak_scaling_eff"] <= 1.0
         assert row["comm_us_per_step_exposed"] > 0
+        if row["kernel"] == "XLA":
+            # same-code weak scaling meets the >=90% BASELINE target
+            assert 0.9 < row["projected_weak_scaling_eff"] <= 1.0
+        else:
+            # Pallas sharded stages pay the measured 1.46x single-step
+            # ratio vs the fused single-chip baseline
+            assert 0.55 < row["projected_weak_scaling_eff"] < 0.9
 
-    # worse fabric => lower efficiency; shallower fuse => more rounds
-    worse = subprocess.run(
-        [sys.executable, str(REPO / "benchmarks" / "ici_model.py"),
-         "--local", "256", "--link-gbps", "9", "--fuse", "1"],
-        env=_env(), capture_output=True, text=True, timeout=120,
-    )
-    assert worse.returncode == 0, worse.stderr[-2000:]
-    w = json.loads(worse.stdout.splitlines()[0])
-    assert w["projected_weak_scaling_eff"] < rows[1]["projected_weak_scaling_eff"]
+    # fabric sensitivity: identical config, 10x worse link => lower eff
+    def one(link_gbps):
+        p = subprocess.run(
+            [sys.executable, str(REPO / "benchmarks" / "ici_model.py"),
+             "--local", "256", "--fuse", "1", "--link-gbps", link_gbps],
+            env=_env(), capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.splitlines()[0])
+
+    assert (one("9")["projected_weak_scaling_eff"]
+            < one("90")["projected_weak_scaling_eff"])

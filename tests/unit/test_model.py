@@ -120,3 +120,18 @@ def test_float64_path_enables_x64():
     sim.iterate(1)
     u, _ = sim.get_fields()
     assert u.dtype == np.float64
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_compile_chunk_aot_matches_executed(n_devices):
+    """AOT-compiled runners (the benchmark warmup path) advance bitwise
+    identically to trace-on-first-call runners, single and sharded."""
+    a = Simulation(_settings(L=16, noise=0.1), n_devices=n_devices)
+    b = Simulation(_settings(L=16, noise=0.1), n_devices=n_devices)
+    b.compile_chunk(10)
+    a.iterate(10)
+    b.iterate(10)
+    np.testing.assert_array_equal(
+        np.asarray(a.get_fields()[0]), np.asarray(b.get_fields()[0])
+    )
+    assert a.step == b.step == 10
