@@ -147,6 +147,11 @@ def main() -> int:
     ap.add_argument("--local", type=int, default=None)
     ap.add_argument("--fuse", type=int, default=5)
     ap.add_argument("--us-per-step", type=float, default=None)
+    ap.add_argument("--stage-ratio", type=float, default=None,
+                    help="sharded per-stage cost over the baseline "
+                    "us/step; defaults to the measured Pallas ratio "
+                    "when the measured Pallas baseline is used, else "
+                    "1.0")
     ap.add_argument("--links", type=int, default=6)
     ap.add_argument("--link-gbps", type=float, default=90.0)
     ap.add_argument("--hop-us", type=float, default=1.0)
@@ -164,17 +169,26 @@ def main() -> int:
                      "pass --us-per-step")
         if us <= 0:
             ap.error("--us-per-step must be positive")
-        rows = [project(args.local, args.fuse, us, links=args.links,
-                        link_gbps=args.link_gbps, hop_us=args.hop_us,
-                        overlap=args.overlap)]
+        # Consistency with the sweep mode: the measured Pallas baseline
+        # implies the measured Pallas sharded stage ratio unless the
+        # caller overrides either.
+        ratio = args.stage_ratio
+        if ratio is None:
+            ratio = 1.0 if args.us_per_step is not None else \
+                STAGE_RATIO["Pallas"]
+        rows = [project(args.local, args.fuse, us, stage_ratio=ratio,
+                        links=args.links, link_gbps=args.link_gbps,
+                        hop_us=args.hop_us, overlap=args.overlap)]
     else:
         # The 3-config path pins links/bandwidth/µs-per-step per config;
         # a fabric override silently ignored would fake sensitivity.
         for flag, default in (("links", 6), ("link_gbps", 90.0),
-                              ("us_per_step", None)):
+                              ("us_per_step", None), ("fuse", 5),
+                              ("stage_ratio", None)):
             if getattr(args, flag) != default:
                 ap.error(f"--{flag.replace('_', '-')} requires --local "
-                         "(the default configs pin their own fabric)")
+                         "(the default configs pin their own fabric "
+                         "and sweep the fuse depth)")
         # The BASELINE.json pod configs: (name, local side, fabric)
         configs = [
             ("v5e-8 2x2x2, L=256", 128, 4, 45.0),

@@ -206,33 +206,47 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                  fuse):
     """Build the fused single-program kernel body; see module docstring.
 
-    Ref order (faces present only when ``with_faces``, which requires
-    ``fuse == 1``; mid scratch present only when ``fuse == 2``):
+    Two faces modes: ``with_faces`` with ``fuse == 1`` takes the full
+    12-face tuple of a 3D-sharded block; ``with_faces`` with
+    ``fuse >= 2`` is the 1D-x-sharded temporal chain — ONLY the four
+    x faces, each ``fuse`` planes wide, feeding the in-kernel k-stage
+    chain (y/z stay global frozen boundaries), with mid-stage
+    out-of-domain pinning keyed on GLOBAL x coordinates so interior
+    shards recompute the neighbor ring instead of freezing it.
+
+    Ref order (mid scratch present only when ``fuse >= 2``):
       params(SMEM f32[6]; f64 for f64 fields — never bf16, Mosaic SMEM
       support for bf16 scalars is shaky),
       seeds(SMEM i32[7] = key lo, key hi, step, x/y/z global offset,
       global row length L — the position-keyed noise coordinates),
       u, v (ANY/HBM, (nx, ny, nz)),
-      [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (1, ny, nz)),
-       u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
-       u_zlo, u_zhi, v_zlo, v_zhi (VMEM, (nx, ny, 1))],
+      [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (fuse, ny, nz)),
+       fuse==1 only: u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
+                     u_zlo, u_zhi, v_zlo, v_zhi (VMEM, (nx, ny, 1))],
       u_out, v_out (ANY/HBM),
       scratch: in_u, in_v (VMEM (2, bx+2*fuse, ny, nz)),
-               [mid_u, mid_v (VMEM (bx+2, ny, nz))],
+               [mid_u, mid_v (VMEM (nbuf, bx+2(fuse-1), ny, nz))],
                out_u, out_v (VMEM (2, bx, ny, nz)),
                in_sems (DMA (2, 2)), out_sems (DMA (2, 2)),
                [face_sems (DMA (2, 2, 2))]
     """
     halo = fuse
     win_n = bx + 2 * halo
+    x_chain = with_faces and fuse >= 2
 
     def kernel(params, seeds, u, v, *rest):
-        if with_faces:
+        if with_faces and not x_chain:
             (u_xlo, u_xhi, v_xlo, v_xhi,
              u_ylo, u_yhi, v_ylo, v_yhi,
              u_zlo, u_zhi, v_zlo, v_zhi,
              u_out, v_out,
              in_u, in_v, out_u, out_v,
+             in_sems, out_sems, face_sems) = rest
+            x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
+        elif x_chain:
+            (u_xlo, u_xhi, v_xlo, v_xhi,
+             u_out, v_out,
+             in_u, in_v, mid_u, mid_v, out_u, out_v,
              in_sems, out_sems, face_sems) = rest
             x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
         elif fuse >= 2:
@@ -302,17 +316,20 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                             field_ref.at[pl.ds(b * bx - halo, win_n)],
                             scr.at[slot], sem))
 
-                # Ghost x-planes on the slab's outer side(s).
+                # Ghost x-planes on the slab's outer side(s): DMA'd from
+                # the face operands (``halo`` planes wide — 1 for the
+                # 3D-sharded mode, ``fuse`` for the x-chain mode), or
+                # filled with the frozen boundary constant.
                 for which, cond in ((0, b == 0), (1, b == nblocks - 1)):
                     if with_faces:
                         xref = x_faces[tag][which]
-                        plane = 0 if which == 0 else bx + 1
+                        plane = 0 if which == 0 else bx + halo
 
                         @pl.when(cond)
                         def _():
                             go(lambda: pltpu.make_async_copy(
                                 xref,
-                                scr.at[slot, pl.ds(plane, 1)],
+                                scr.at[slot, pl.ds(plane, halo)],
                                 face_sems.at[slot, tag, which]))
                     elif start:
                         planes = (
@@ -442,10 +459,19 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                     )
                     if use_noise:
                         du = du + noise_block(step_s, g0, w_out, iota_w)
-                    # Ring planes outside the global domain stay at the
-                    # frozen boundary value.
+                    # Ring planes outside the domain stay at the frozen
+                    # boundary value. In the x-chain (1D-sharded) mode
+                    # "domain" is the GLOBAL grid: interior shards own
+                    # no global edge, so their rings recompute neighbor
+                    # values (from the face data) instead of freezing —
+                    # the bitwise ring-recompute property that makes
+                    # fuse=k equal k exchanged single steps.
                     gx = g0 + iota_w
-                    valid = (gx >= 0) & (gx < nx)
+                    if x_chain:
+                        gxg = seeds[3] + gx
+                        valid = (gxg >= 0) & (gxg < seeds[6])
+                    else:
+                        valid = (gx >= 0) & (gx < nx)
 
                     def _round(x):
                         # Mid stages round through the FIELD dtype so
@@ -516,8 +542,12 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
     in_specs = [smem_spec, smem_spec, any_spec, any_spec]
     operands = [params_vec, seeds, u, v]
     if with_faces:
-        # x faces ride DMA from HBM (ANY); y/z faces are small -> VMEM.
-        in_specs += [any_spec] * 4 + [vmem_spec] * 8
+        # x faces ride DMA from HBM (ANY); y/z faces (12-face mode
+        # only) are small -> VMEM. The 4-face tuple is the x-chain
+        # mode: fuse-wide x slabs, no y/z faces.
+        in_specs += [any_spec] * 4
+        if len(faces) == 12:
+            in_specs += [vmem_spec] * 8
         operands += list(faces)
 
     scratch_shapes = [
@@ -582,13 +612,22 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     int32[3]) is the block's global origin and ``row`` the global grid
     side L — together they make the noise position-keyed across shard
     layouts (defaults: zero origin, row = local nz — the single-block
-    case). ``faces`` (optional, fuse=1 only) is the 12-tuple of resolved
-    halo faces for a sharded block, in the order ``(u_xlo, u_xhi,
-    v_xlo, v_xhi, u_ylo, u_yhi, v_ylo, v_yhi, u_zlo, u_zhi, v_zlo,
-    v_zhi)`` with x faces shaped (1, ny, nz), y faces (nx, 1, nz),
-    z faces (nx, ny, 1). ``fuse=k`` temporal blocking advances k steps
-    per HBM pass (single- or multi-block; incompatible only with
-    ``faces``). ``detect_races`` (interpret
+    case). ``faces`` takes one of two forms:
+
+    * 12-tuple (fuse=1 only) — resolved halo faces of a 3D-sharded
+      block, in the order ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi,
+      v_ylo, v_yhi, u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped
+      (1, ny, nz), y faces (nx, 1, nz), z faces (nx, ny, 1);
+    * 4-tuple ``(u_xlo, u_xhi, v_xlo, v_xhi)`` with fuse >= 2, each
+      shaped (fuse, ny, nz) — the 1D-x-sharded **x-chain** mode: the
+      fuse-wide x slabs feed the in-kernel temporal chain across the
+      shard boundary (y/z stay global frozen boundaries, and mid-stage
+      ring pinning switches to GLOBAL x coordinates so interior shards
+      recompute the neighbor ring bitwise instead of freezing it).
+
+    ``fuse=k`` temporal blocking advances k steps per HBM pass
+    (single- or multi-block; with faces only in the 4-tuple x-chain
+    form). ``detect_races`` (interpret
     mode only) runs the TPU interpreter's DMA/compute race detector; it
     is a static jit argument, so toggling it recompiles rather than
     reusing a stale cache entry.
@@ -607,8 +646,14 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     sharded kernel path is instead covered by the single-device
     with-faces interpret test plus the TPU hardware tests.
     """
-    if fuse > 1 and faces is not None:
-        raise ValueError("temporal blocking requires a single block")
+    x_chain = faces is not None and len(faces) == 4
+    if fuse > 1 and faces is not None and not x_chain:
+        raise ValueError(
+            "temporal blocking with faces requires the 4-tuple x-chain "
+            "mode (1D-sharded); the 12-face 3D mode is fuse=1 only"
+        )
+    if x_chain and fuse < 2:
+        raise ValueError("the x-chain faces mode requires fuse >= 2")
     nx, ny, nz = u.shape
     dtype = u.dtype
     on_tpu = jax.default_backend() == "tpu"
@@ -617,9 +662,16 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
         offsets = jnp.zeros((3,), jnp.int32)
     offsets = jnp.asarray(offsets, jnp.int32)
     row = jnp.asarray(nz if row is None else row, jnp.int32)
+    if x_chain:
+        for f in faces:
+            if f.shape != (fuse, ny, nz):
+                raise ValueError(
+                    f"x-chain faces must be ({fuse}, {ny}, {nz}); "
+                    f"got {f.shape}"
+                )
 
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
-    if bx == 0 and fuse > 1:
+    if bx == 0 and fuse > 1 and not x_chain:
         # The requested depth overflows VMEM for this shape, but a
         # shallower chain may still fit — step down rather than losing
         # the Pallas kernel entirely (large grids are exactly where the
@@ -660,6 +712,11 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     ) or (
         not on_tpu and not allow_interpret
     ):
+        if x_chain:
+            return _xla_xchain_fallback(
+                u, v, params, seeds, faces, fuse=fuse,
+                use_noise=use_noise, offsets=offsets, row=row,
+            )
         for s in range(fuse):
             u, v = _xla_fallback(
                 u, v, params, seeds.at[2].add(s) if s else seeds, faces,
@@ -680,6 +737,51 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
         bx=bx, use_noise=use_noise, interpret=not on_tpu,
         fuse=fuse, detect_races=detect_races and not on_tpu,
     )
+
+
+def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
+                         offsets, row):
+    """XLA form of the in-kernel x-chain (1D-sharded temporal blocking):
+    ``fuse`` stages on an x-extended window seeded by the fuse-wide x
+    faces, with y/z frozen at the global boundary and out-of-global-
+    domain x planes pinned per stage. Bitwise-equal to the Mosaic
+    x-chain for f32/f64 (same op order, same position-keyed noise) —
+    the CPU-mesh / f64 / lane-misaligned path of the same design."""
+    u_xlo, u_xhi, v_xlo, v_xhi = faces
+    nx, ny, nz = u.shape
+    k = fuse
+    u_bv = jnp.asarray(stencil.U_BOUNDARY, u.dtype)
+    v_bv = jnp.asarray(stencil.V_BOUNDARY, v.dtype)
+    u_w = jnp.concatenate([u_xlo, u, u_xhi], axis=0)
+    v_w = jnp.concatenate([v_xlo, v, v_xhi], axis=0)
+
+    def pad_yz(x, bv):
+        return jnp.pad(
+            x, ((0, 0), (1, 1), (1, 1)), constant_values=bv
+        )
+
+    for s in range(k):
+        m_out = k - 1 - s
+        w_out = nx + 2 * m_out
+        if use_noise:
+            offs_w = jnp.stack(
+                [offsets[0] - m_out, offsets[1], offsets[2]]
+            )
+            unit = uniform_pm1_block(
+                seeds[:2], seeds[2] + s, offs_w, (w_out, ny, nz), row,
+                u.dtype,
+            )
+            nz_field = params.noise * unit
+        else:
+            nz_field = jnp.asarray(0.0, u.dtype)
+        u_w, v_w = stencil.reaction_update(
+            pad_yz(u_w, u_bv), pad_yz(v_w, v_bv), nz_field, params
+        )
+        gx = offsets[0] - m_out + jnp.arange(w_out)
+        valid = ((gx >= 0) & (gx < row))[:, None, None]
+        u_w = jnp.where(valid, u_w, u_bv)
+        v_w = jnp.where(valid, v_w, v_bv)
+    return u_w, v_w
 
 
 def _xla_fallback(u, v, params, seeds, faces, *, use_noise, offsets=None,

@@ -72,7 +72,33 @@ class CartDomain:
 
     @classmethod
     def create(cls, n_devices: int, L: int) -> "CartDomain":
-        dims = dims_create(n_devices, 3)
+        """Balanced MPI ``Dims_create`` factorization, overridable with
+        ``GS_TPU_MESH_DIMS=nx,ny,nz`` (e.g. ``8,1,1`` selects the 1D
+        x-sharded decomposition whose halos feed the Pallas kernel's
+        in-kernel fused chain — the fastest pod-slice layout for the
+        Pallas language at <=16 chips, see BASELINE.md)."""
+        import os
+
+        override = os.environ.get("GS_TPU_MESH_DIMS", "")
+        if override:
+            try:
+                dims = tuple(int(x) for x in override.split(","))
+            except ValueError:
+                raise ValueError(
+                    f"GS_TPU_MESH_DIMS={override!r} is not 'nx,ny,nz'"
+                ) from None
+            if len(dims) != 3 or any(d < 1 for d in dims):
+                raise ValueError(
+                    f"GS_TPU_MESH_DIMS={override!r} must be three "
+                    "positive integers"
+                )
+            if dims[0] * dims[1] * dims[2] != n_devices:
+                raise ValueError(
+                    f"GS_TPU_MESH_DIMS={override!r} does not factor "
+                    f"{n_devices} devices"
+                )
+        else:
+            dims = dims_create(n_devices, 3)
         if n_devices > 1:
             for d in dims:
                 if L % d != 0:

@@ -182,6 +182,49 @@ def halo_pad_wide(
     return tuple(padded)
 
 
+def exchange_x_slabs(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    ax: str,
+    n: int,
+    width: int,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """``width``-wide (lo, hi) x-slab halos for each array.
+
+    The 1D-x-sharded in-kernel temporal chain's exchange: ONE ppermute
+    per direction carries a ``width``-plane slab of all arrays (stacked),
+    feeding ``width`` fused kernel steps from a single exchange round —
+    2 collectives per k steps where the reference exchanges 6 faces
+    every step (``communication.jl:138-199``). Global-edge shards get
+    the frozen boundary constant. Must be called inside ``shard_map``.
+    """
+    arrays = list(arrays)
+    if n == 1:
+        out = []
+        for a, bv in zip(arrays, boundary_values):
+            f = jnp.full((width,) + a.shape[1:], bv, a.dtype)
+            out.append((f, f))
+        return out
+
+    idx = lax.axis_index(ax)
+    send_up = jnp.concatenate([a[-width:] for a in arrays], 0)
+    send_dn = jnp.concatenate([a[:width] for a in arrays], 0)
+    up_perm = [(i, i + 1) for i in range(n - 1)]
+    dn_perm = [(i + 1, i) for i in range(n - 1)]
+    recv_lo = lax.ppermute(send_up, ax, up_perm)  # lower nbr's top slab
+    recv_hi = lax.ppermute(send_dn, ax, dn_perm)  # upper nbr's bottom
+    lo_s = jnp.split(recv_lo, len(arrays), axis=0)
+    hi_s = jnp.split(recv_hi, len(arrays), axis=0)
+    out = []
+    for i, (a, bv) in enumerate(zip(arrays, boundary_values)):
+        bvt = jnp.asarray(bv, a.dtype)
+        out.append((
+            jnp.where(idx > 0, lo_s[i], bvt),
+            jnp.where(idx < n - 1, hi_s[i], bvt),
+        ))
+    return out
+
+
 def exchange_faces(
     arrays: Sequence[jnp.ndarray],
     boundary_values: Sequence[float],

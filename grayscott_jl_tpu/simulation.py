@@ -304,6 +304,22 @@ class Simulation:
                 key_i32, step_idx, offsets, shape, L, u.dtype
             )
 
+        def run_chain_rounds(chain, fuse, u, v):
+            """Drive ``nsteps`` as full-depth chain rounds plus a
+            shallower remainder chain — the shared loop of all three
+            temporal-blocking paths (1D x-chain, 3D Pallas chain,
+            sharded XLA chain)."""
+
+            def chain_body(i, carry):
+                uu, vv = carry
+                return chain(uu, vv, step0 + fuse * i, fuse)
+
+            rounds, rem = divmod(nsteps, fuse)
+            u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
+            if rem:
+                u, v = chain(u, v, step0 + fuse * rounds, rem)
+            return u, v
+
         if self.kernel_language == "pallas":
             from .ops import pallas_stencil
 
@@ -324,6 +340,44 @@ class Simulation:
                     fuse=1, offsets=offs, row=L,
                 )
 
+            if sharded and dims[1] == 1 and dims[2] == 1:
+                # 1D x-sharded mesh (GS_TPU_MESH_DIMS=n,1,1): the ONLY
+                # shard boundaries are x faces — the kernel's natural
+                # element (leading-dim slabs, no lane-alignment issue) —
+                # so the in-kernel fused chain runs ACROSS the shard
+                # boundary: one 2-ppermute exchange of k-wide x slabs
+                # feeds one fuse=k kernel launch per chain. Unlike the
+                # general 3D chain below (single-step kernel stages +
+                # XLA ghost advance), every sharded step here runs at
+                # the fused single-chip schedule — the fastest
+                # pod-slice layout for the Pallas language (<=16 chips;
+                # at higher counts the 1D surface/volume ratio loses to
+                # 3D, see BASELINE.md's ICI projection).
+                fuse = min(
+                    default_fuse(), max(nsteps, 1),
+                    self.domain.local_shape[0],
+                )
+
+                def chain(u, v, step, depth):
+                    if depth == 1:
+                        faces12 = halo.exchange_faces(
+                            (u, v), boundaries, AXIS_NAMES, dims
+                        )
+                        return kernel_step(u, v, step, faces12)
+                    pairs = halo.exchange_x_slabs(
+                        (u, v), boundaries, AXIS_NAMES[0], dims[0], depth
+                    )
+                    faces4 = (pairs[0][0], pairs[0][1],
+                              pairs[1][0], pairs[1][1])
+                    return pallas_stencil.fused_step(
+                        u, v, params, step_seeds(step), faces4,
+                        use_noise=use_noise,
+                        allow_interpret=allow_interpret,
+                        fuse=depth, offsets=offs, row=L,
+                    )
+
+                return run_chain_rounds(chain, fuse, u, v)
+
             if sharded:
                 # Halo-amortized k-deep chain: ONE k-wide exchange feeds
                 # k kernel steps (the ghost shell advances in XLA between
@@ -343,15 +397,7 @@ class Simulation:
                         axis_sizes=dims, boundaries=boundaries,
                     )
 
-                def chain_body(i, carry):
-                    u, v = carry
-                    return chain(u, v, step0 + fuse * i, fuse)
-
-                rounds, rem = divmod(nsteps, fuse)
-                u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
-                if rem:
-                    u, v = chain(u, v, step0 + fuse * rounds, rem)
-                return u, v
+                return run_chain_rounds(chain, fuse, u, v)
 
             # Single block: in-kernel temporal blocking (``fuse`` steps
             # per HBM pass — the slab pipeline is DMA-envelope-bound on
@@ -431,15 +477,7 @@ class Simulation:
                 )
             return u_w, v_w
 
-        def chain_body(i, carry):
-            u, v = carry
-            return chain(u, v, step0 + fuse * i, fuse)
-
-        rounds, rem = divmod(nsteps, fuse)
-        u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
-        if rem:
-            u, v = chain(u, v, step0 + fuse * rounds, rem)
-        return u, v
+        return run_chain_rounds(chain, fuse, u, v)
 
     def _runner(self, nsteps: int):
         """Compiled ``nsteps``-step advance, cached per nsteps."""

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from grayscott_jl_tpu.config.settings import Settings
 from grayscott_jl_tpu.models import grayscott
-from grayscott_jl_tpu.ops import pallas_stencil
+from grayscott_jl_tpu.ops import pallas_stencil, stencil
 from grayscott_jl_tpu.simulation import Simulation
 
 PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
@@ -344,3 +344,92 @@ def test_pallas_sharded_matches_single_device():
     np.testing.assert_allclose(
         one.get_fields()[1], eight.get_fields()[1], rtol=1e-5, atol=1e-6
     )
+
+
+def _xchain_inputs(nx=32, ny=16, nz=128, k=3, seed=7):
+    rng = np.random.default_rng(seed)
+    u = jnp.asarray(rng.random((nx, ny, nz)), jnp.float32)
+    v = jnp.asarray(rng.random((nx, ny, nz)), jnp.float32)
+    faces = tuple(
+        jnp.asarray(rng.random((k, ny, nz)), jnp.float32) for _ in range(4)
+    )
+    params = grayscott.Params.from_settings(
+        _settings("Pallas", L=nx, noise=0.2), jnp.float32
+    )
+    seeds = jnp.asarray([3, 5, 11], jnp.int32)
+    return u, v, faces, params, seeds
+
+
+@pytest.mark.parametrize("use_noise", [False, True])
+def test_x_chain_kernel_matches_fallback(use_noise):
+    """The in-kernel fused x-chain (fuse-wide x faces, the 1D-sharded
+    mode) against its XLA fallback: same elementwise program, so the
+    tolerance absorbs interpret-kernel vs XLA op-scheduling rounding,
+    amplified here by uniform-random fields (gradients far steeper than
+    simulation states) across k chained stages — the bitwise guarantees
+    are the bv-faces test below and the sharded-vs-single-device test
+    (test_sharded.py), both comparing like against like. nx=32 with
+    GS_BX=16 exercises the multi-slab face-DMA branches (lo slab, hi
+    slab, interior)."""
+    nx, ny, nz, k = 32, 16, 128, 3
+    u, v, faces, params, seeds = _xchain_inputs(nx, ny, nz, k)
+    offs = jnp.asarray([16, 0, 0], jnp.int32)  # interior shard
+    row = jnp.int32(64)
+    import os
+
+    os.environ["GS_BX"] = "16"
+    try:
+        a = pallas_stencil.fused_step(
+            u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
+            offsets=offs, row=row,
+        )
+    finally:
+        del os.environ["GS_BX"]
+    b = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=use_noise,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[0]), np.asarray(b[0]), rtol=1e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[1]), np.asarray(b[1]), rtol=1e-4, atol=2e-6
+    )
+
+
+def test_x_chain_with_boundary_faces_equals_no_faces_chain():
+    """A whole-domain block fed frozen-boundary faces must reproduce the
+    single-block in-kernel chain BITWISE — the face-DMA ghost source and
+    the memset ghost source carry identical values, and the global-
+    coordinate mid-stage pinning must degrade exactly to the local
+    test."""
+    nx, ny, nz, k = 16, 16, 128, 3
+    u, v, _, params, seeds = _xchain_inputs(nx, ny, nz, k)
+    bv = ((stencil.U_BOUNDARY,) * 2 + (stencil.V_BOUNDARY,) * 2)
+    faces = tuple(
+        jnp.full((k, ny, nz), b, jnp.float32) for b in bv
+    )
+    offs = jnp.zeros((3,), jnp.int32)
+    row = jnp.int32(nx)
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        offsets=offs, row=row,
+    )
+    b = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=k,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_x_chain_rejects_bad_faces():
+    u, v, faces, params, seeds = _xchain_inputs(k=3)
+    with pytest.raises(ValueError, match="fuse >= 2"):
+        pallas_stencil.fused_step(
+            u, v, params, seeds, faces, fuse=1,
+        )
+    with pytest.raises(ValueError, match="x-chain faces"):
+        pallas_stencil.fused_step(
+            u, v, params, seeds, tuple(f[:2] for f in faces), fuse=3,
+        )

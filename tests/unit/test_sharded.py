@@ -168,3 +168,81 @@ def test_sharded_field_sharding_layout():
     # each shard holds an (8,8,8) block of the 16^3 grid under (2,2,2) dims
     shard_shape = sh.u.sharding.shard_shape(sh.u.shape)
     assert shard_shape == (8, 8, 8)
+
+
+@requires8
+@pytest.mark.parametrize("noise", [0.0, 0.1])
+def test_1d_xchain_sharded_matches_single_device(noise, monkeypatch):
+    """GS_TPU_MESH_DIMS=8,1,1 routes the sharded Pallas path through
+    the in-kernel fused x-chain (k-wide x-slab exchange + one fuse=k
+    kernel per chain; on CPU the kernel body is the XLA x-chain
+    fallback). Bitwise against single-device stepwise XLA — the
+    fallback is the same elementwise program, noise included."""
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    sh = Simulation(
+        _settings(L=32, noise=noise, kernel_language="Pallas"),
+        n_devices=8, seed=5,
+    )
+    assert sh.domain.dims == (8, 1, 1)
+    sh.iterate(10)
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    ref = Simulation(
+        _settings(L=32, noise=noise, kernel_language="Plain"),
+        n_devices=1, seed=5,
+    )
+    ref.iterate(10)
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[0]), np.asarray(ref.get_fields()[0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[1]), np.asarray(ref.get_fields()[1])
+    )
+
+
+@requires8
+def test_1d_xchain_fuse_equals_local_nx(monkeypatch):
+    """The deepest legal chain (fuse == local nx: the exchanged slab is
+    the neighbor's whole block) stays exact."""
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    monkeypatch.setenv("GS_FUSE", "4")
+    sh = Simulation(
+        _settings(L=32, noise=0.1, kernel_language="Pallas"),
+        n_devices=8, seed=3,
+    )
+    sh.iterate(8)
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    monkeypatch.delenv("GS_FUSE")
+    ref = Simulation(
+        _settings(L=32, noise=0.1, kernel_language="Plain"),
+        n_devices=1, seed=3,
+    )
+    ref.iterate(8)
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[0]), np.asarray(ref.get_fields()[0])
+    )
+
+
+@requires8
+def test_1d_xchain_collective_count_is_two_per_k_steps(monkeypatch):
+    """The 1D x-chain's halo amortization as a compiled invariant: one
+    2-ppermute slab exchange per k steps — the chain-round fori_loop
+    body lowers to exactly 2 collective-permutes (vs 6 for the 3D
+    mesh's 6-face exchange), and nothing exchanges per step."""
+    import re
+
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    monkeypatch.setenv("GS_FUSE", "4")
+    sim = Simulation(
+        _settings(L=32, noise=0.1, kernel_language="Pallas"), n_devices=8
+    )
+    runner = sim._runner(8)  # 2 chain rounds of k=4
+    txt = runner.lower(
+        sim.u, sim.v, sim.base_key, jnp.int32(0), sim.params
+    ).compile().as_text()
+    n_permutes = len(re.findall(r"collective-permute(?:-start)?\(", txt))
+    assert n_permutes == 2, (
+        f"expected one 2-ppermute x-slab exchange per 4-step chain, "
+        f"found {n_permutes} collective-permutes"
+    )

@@ -250,3 +250,72 @@ def test_pallas_matches_xla_on_tpu(noise):
     np.testing.assert_allclose(
         a.get_fields()[1], b.get_fields()[1], rtol=1e-5, atol=1e-6
     )
+
+
+@requires_tpu
+def test_x_chain_kernel_on_hardware():
+    """The Mosaic-compiled x-chain (fuse-wide x faces feeding the
+    in-kernel temporal chain — the 1D-sharded mode's kernel) against
+    the XLA x-chain fallback on real hardware, noise on, multi-slab
+    (L=256 local block, bx=16). Catches Mosaic-only lowering faults in
+    the face-DMA width generalization and the global-coordinate ring
+    pinning that interpret mode cannot."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    nx = ny = nz = 256
+    k = 5
+    s = Settings(L=nx, noise=0.2, precision="Float32", backend="TPU",
+                 kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02,
+                 k=0.048, dt=1.0)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(s, dtype)
+    key = jax.random.PRNGKey(42)
+    u = jax.random.uniform(key, (nx, ny, nz), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
+    faces = tuple(
+        jax.random.uniform(jax.random.fold_in(key, 2 + i), (k, ny, nz),
+                           dtype)
+        for i in range(4)
+    )
+    seeds = jnp.asarray([5, 9, 31], jnp.int32)
+    offs = jnp.asarray([256, 0, 0], jnp.int32)  # interior shard
+    row = jnp.int32(1024)
+
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        offsets=offs, row=row,
+    )
+    b = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[0]), np.asarray(b[0]), rtol=1e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[1]), np.asarray(b[1]), rtol=1e-4, atol=2e-6
+    )
+
+    # and the bv-faces <-> no-faces bitwise identity on Mosaic
+    from grayscott_jl_tpu.ops import stencil as st
+
+    bfaces = tuple(
+        jnp.full((k, ny, nz), b, dtype)
+        for b in (st.U_BOUNDARY, st.U_BOUNDARY, st.V_BOUNDARY,
+                  st.V_BOUNDARY)
+    )
+    offs0 = jnp.zeros((3,), jnp.int32)
+    c = pallas_stencil.fused_step(
+        u, v, params, seeds, bfaces, use_noise=True, fuse=k,
+        offsets=offs0, row=jnp.int32(nx),
+    )
+    d = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=k,
+        offsets=offs0, row=jnp.int32(nx),
+    )
+    np.testing.assert_array_equal(np.asarray(c[0]), np.asarray(d[0]))
+    np.testing.assert_array_equal(np.asarray(c[1]), np.asarray(d[1]))
