@@ -23,5 +23,12 @@ while [ ! -e "$STOP_FILE" ]; do
     if [ -n "$line" ]; then
         printf '{"t": "%s", "r": %s}\n' "$(date -u +%FT%TZ)" "$line" >>"$LOG"
     fi
+    # Also sample the L=512 row (BASELINE config #5's size; its fast
+    # windows are where the 73%-of-roofline record came from) with a
+    # shorter round budget.
+    line=$(GS_BENCH_L=512 GS_BENCH_ROUNDS=8 python bench.py 2>/dev/null | tail -1)
+    if [ -n "$line" ]; then
+        printf '{"t": "%s", "r": %s}\n' "$(date -u +%FT%TZ)" "$line" >>"$LOG"
+    fi
     sleep "$INTERVAL"
 done

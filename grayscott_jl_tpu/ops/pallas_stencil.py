@@ -33,7 +33,10 @@ cell per step), so the kernel is designed around HBM traffic:
   ~((BX+2k)/BX + 1)/k passes (~5 bytes/cell at BX=16, k=4, f32), far
   below the 1-read-1-write "roofline" of any single-step schedule.
   Multi-block slabs fuse too (any BX >= k, the production shape at
-  L=128+); only the with-faces/sharded combination requires fuse=1.
+  L=128+). With faces, fusion crosses the shard boundary in the
+  1D-x-sharded **x-chain** mode (4-tuple of fuse-wide x faces; r3);
+  only the 12-face 3D-sharded mode requires fuse=1 (y/z halos break
+  Mosaic lane alignment).
   Measured on the v5e, the slab DMA pipeline has a hard per-pass
   envelope (~2 ms at L=256 f32) that is flat in compute content, so
   per-step time scales ~1/k until the k-fold stage compute fills the

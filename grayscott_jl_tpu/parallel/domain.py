@@ -80,6 +80,13 @@ class CartDomain:
         import os
 
         override = os.environ.get("GS_TPU_MESH_DIMS", "")
+        if n_devices == 1:
+            # A single device has exactly one decomposition; ignoring
+            # the override here lets a pod config export
+            # GS_TPU_MESH_DIMS for its multi-chip jobs without breaking
+            # single-device runs (bench.py, smoke tests) in the same
+            # shell.
+            override = ""
         if override:
             try:
                 dims = tuple(int(x) for x in override.split(","))

@@ -17,6 +17,15 @@ export TPU_NAME="${TPU_NAME:-gs-v5e-8}"
 export ZONE="${ZONE:-us-west4-a}"
 export ACCELERATOR_TYPE="v5litepod-8"
 
+# 1D x-sharded mesh: at <=16 chips the Pallas kernel's in-kernel fused
+# chain can cross the shard boundary (x halos are its leading-dim
+# element), so sharded steps run at the fused single-chip schedule —
+# the fastest pod-slice layout for kernel_language=Pallas (projected
+# weak-scaling 0.80-0.90 vs 0.67 on the 3D mesh, BASELINE.md). Unset
+# to fall back to the MPI-style dims_create 3D factorization (the
+# right choice for the XLA language and for >16 chips).
+export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
+
 # Temporal-blocking depth for the single-block Pallas path; sharded runs
 # use the k-deep wide-halo exchange with the same depth (simulation.py).
 export GS_FUSE="${GS_FUSE:-4}"

@@ -70,16 +70,28 @@ def test_ici_model_projection_contract():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     rows = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
-    assert len(rows) == 6  # 3 configs x 2 kernel languages
+    assert len(rows) == 9  # 3 configs x 2 languages + 3 Pallas-1D rows
     for row in rows:
         assert row["comm_us_per_step_exposed"] > 0
         if row["kernel"] == "XLA":
             # same-code weak scaling meets the >=90% BASELINE target
             assert 0.9 < row["projected_weak_scaling_eff"] <= 1.0
-        else:
-            # Pallas sharded stages pay the measured 1.46x single-step
+        elif row["kernel"] == "Pallas":
+            # 3D-mesh Pallas stages pay the measured 1.46x single-step
             # ratio vs the fused single-chip baseline
             assert 0.55 < row["projected_weak_scaling_eff"] < 0.9
+        else:  # Pallas-1D-xchain
+            assert 0.5 < row["projected_weak_scaling_eff"] < 1.0
+    # the 1D x-chain must beat the 3D mesh for the Pallas language at
+    # <=16 chips (that is its purpose), and lose at 128 chips
+    by = {(r["config"], r["kernel"]): r["projected_weak_scaling_eff"]
+          for r in rows}
+    assert by[("v5e-8 1D, L=256", "Pallas-1D-xchain")] > \
+        by[("v5e-8 2x2x2, L=256", "Pallas")]
+    assert by[("v5p-16 1D, L=512", "Pallas-1D-xchain")] > \
+        by[("v5p-16 2x2x2, L=512", "Pallas")]
+    assert by[("v5p-256 1D, L=1024", "Pallas-1D-xchain")] < \
+        by[("v5p-256 8x4x4, L=1024", "Pallas")]
 
     # fabric sensitivity: identical config, 10x worse link => lower eff
     def one(link_gbps):
