@@ -25,7 +25,8 @@ while [ ! -e "$STOP_FILE" ]; do
     fi
     # Also sample the L=512 row (BASELINE config #5's size; its fast
     # windows are where the 73%-of-roofline record came from) with a
-    # shorter round budget.
+    # shorter round budget — unless a stop was requested mid-cycle.
+    [ -e "$STOP_FILE" ] && break
     line=$(GS_BENCH_L=512 GS_BENCH_ROUNDS=8 python bench.py 2>/dev/null | tail -1)
     if [ -n "$line" ]; then
         printf '{"t": "%s", "r": %s}\n' "$(date -u +%FT%TZ)" "$line" >>"$LOG"

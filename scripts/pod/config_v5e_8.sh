@@ -9,7 +9,7 @@
 #   * 16 GiB HBM/chip: L=256 f32 shards to 128^3 blocks/chip — far below
 #     memory limits; L up to ~1024 fits comfortably.
 #   * v5e VMEM is 128 MiB/core: the Pallas kernel's automatic slab/fuse
-#     selection (GS_FUSE default 4) is measured fastest at L>=128.
+#     selection (GS_FUSE default 5 since the r3 op-diet) is measured fastest at L>=128.
 #
 # Usage: source this, then scripts/pod/job_v5e_8.sh (or run_tpu_pod.sh).
 
@@ -28,7 +28,7 @@ export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
 
 # Temporal-blocking depth for the single-block Pallas path; sharded runs
 # use the k-deep wide-halo exchange with the same depth (simulation.py).
-export GS_FUSE="${GS_FUSE:-4}"
+export GS_FUSE="${GS_FUSE:-5}"
 # Per-phase wall-clock + cell-updates/s JSON, one file per process.
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # Uncomment for a jax.profiler device trace of the run:

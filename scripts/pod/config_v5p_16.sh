@@ -17,6 +17,15 @@ export TPU_NAME="${TPU_NAME:-gs-v5p-16}"
 export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-16"
 
-export GS_FUSE="${GS_FUSE:-4}"
+# 1D x-sharded mesh: the Pallas kernel's in-kernel fused chain can
+# cross the shard boundary when x faces are the only halos (they ride
+# the leading dim), so sharded steps run at the fused single-chip
+# schedule — the fastest layout for kernel_language=Pallas at this
+# scale (BASELINE.md "ICI weak scaling"). Unset to fall back to the
+# MPI-style dims_create 3D factorization (the right choice for the
+# XLA language and for >16 chips). Ignored by single-device runs.
+export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
+
+export GS_FUSE="${GS_FUSE:-5}"
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # export GS_TPU_PROFILE=/tmp/gs_trace

@@ -17,6 +17,6 @@ export TPU_NAME="${TPU_NAME:-gs-v5p-256}"
 export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-256"
 
-export GS_FUSE="${GS_FUSE:-4}"
+export GS_FUSE="${GS_FUSE:-5}"
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # export GS_TPU_PROFILE=/tmp/gs_trace

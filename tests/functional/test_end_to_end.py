@@ -178,6 +178,48 @@ def test_checkpoint_and_restart_reproduce_trajectory(tmp_path):
     np.testing.assert_array_equal(vf, vr)
 
 
+def test_restart_across_mesh_layouts_and_kernels(tmp_path):
+    """Resume a (2,2,2)-mesh XLA run on an (8,1,1)-mesh Pallas x-chain
+    run — different decomposition AND kernel language — and match the
+    uninterrupted run bitwise, noise on. The position-keyed noise stream
+    and the per-shard selection restore make trajectories
+    layout-invariant; the reference's global-RNG draws cannot reproduce
+    across layouts at all (Simulation_CPU.jl:101-103)."""
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    cfg = write_config(full_dir, noise=0.1, output="full.bp")
+    assert run_cli(full_dir, cfg).returncode == 0
+
+    part_dir = tmp_path / "part"
+    part_dir.mkdir()
+    cfg1 = write_config(
+        part_dir, "phase1.toml", noise=0.1, output="p1.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(part_dir, cfg1).returncode == 0
+
+    cfg2 = write_config(
+        part_dir, "phase2.toml", noise=0.1, output="p2.bp",
+        restart="true", restart_input="ckpt.bp", restart_step=20,
+        kernel_language="Pallas",
+    )
+    res = run_cli(part_dir, cfg2,
+                  extra_env={"GS_TPU_MESH_DIMS": "8,1,1"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "Restarted from ckpt.bp at step 20" in res.stdout
+
+    rf = BpReader(str(full_dir / "full.bp"))
+    rp = BpReader(str(part_dir / "p2.bp"))
+    np.testing.assert_array_equal(
+        rf.get("U", step=rf.num_steps() - 1),
+        rp.get("U", step=rp.num_steps() - 1),
+    )
+    np.testing.assert_array_equal(
+        rf.get("V", step=rf.num_steps() - 1),
+        rp.get("V", step=rp.num_steps() - 1),
+    )
+
+
 def test_rollback_restart_truncates_stale_trajectory(tmp_path):
     """Rolling back (restart_step earlier than the last run's end) while
     reusing the SAME output and checkpoint stores must drop the

@@ -75,7 +75,7 @@ def _run_single(tmp_path, lang="Plain"):
     return d
 
 
-def _spawn_pair(cwd, config_name):
+def _spawn_pair(cwd, config_name, extra_env=None):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -83,6 +83,7 @@ def _spawn_pair(cwd, config_name):
             "GS_TPU_COORDINATOR": f"127.0.0.1:{port}",
             "GS_TPU_NUM_PROCESSES": "2",
             "GS_TPU_PROCESS_ID": str(pid),
+            **(extra_env or {}),
         }
         procs.append(
             subprocess.Popen(
@@ -94,14 +95,14 @@ def _spawn_pair(cwd, config_name):
     return [p.communicate(timeout=600) for p in procs], procs
 
 
-def _run_pair(cwd, config_name):
+def _run_pair(cwd, config_name, extra_env=None):
     """Run the two-process CLI pair, retrying once on the Gloo
     bring-up race: XLA's CPU collectives have a hardcoded 30s
     key-value handshake timeout, and a loaded CI host can push one
     process's compile past it — a flake of the harness environment,
     not of the framework (jax.distributed itself came up fine)."""
     for attempt in range(2):
-        outs, procs = _spawn_pair(cwd, config_name)
+        outs, procs = _spawn_pair(cwd, config_name, extra_env)
         if all(p.returncode == 0 for p in procs):
             return outs
         gloo_race = any(
@@ -214,3 +215,39 @@ def test_two_process_restart_from_distributed_checkpoint(tmp_path):
     np.testing.assert_array_equal(
         rs.get("U", step=rs.num_steps() - 1), u30
     )
+
+
+@pytest.mark.slow
+def test_two_process_1d_xchain_matches_single_process(tmp_path):
+    """The 1D x-sharded in-kernel fused chain across a REAL process
+    boundary: two processes x 4 virtual devices form the (8,1,1) mesh,
+    so the k-wide x-slab ppermute crosses the process boundary every
+    chain. Output must be bit-identical to a single-process (8,1,1)
+    run."""
+    extra = {"GS_TPU_MESH_DIMS": "8,1,1"}
+
+    single = tmp_path / "single"
+    single.mkdir()
+    (single / "config.toml").write_text(_config("Pallas"))
+    res = subprocess.run(
+        [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
+        cwd=single, env=_env(single, 8, extra), capture_output=True,
+        text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr
+
+    dual = tmp_path / "dual"
+    dual.mkdir()
+    (dual / "config.toml").write_text(_config("Pallas"))
+    _run_pair(dual, "config.toml", extra_env=extra)
+
+    rs = BpReader(str(single / "out.bp"))
+    rd = BpReader(str(dual / "out.bp"))
+    assert rd.num_steps() == rs.num_steps() == 2
+    for step in range(2):
+        np.testing.assert_array_equal(
+            rs.get("U", step=step), rd.get("U", step=step)
+        )
+        np.testing.assert_array_equal(
+            rs.get("V", step=step), rd.get("V", step=step)
+        )
