@@ -9,6 +9,13 @@
 #
 #   nohup benchmarks/headline_hunter.sh &   # from the repo root
 #   GS_HUNT_INTERVAL=1200 GS_HUNT_LOG=... override the defaults
+#
+# Ops notes: run exactly ONE instance (concurrent tunnel dials contend
+# and can push each other's probes into CPU fallback). To stop, create
+# $GS_HUNT_STOP and wait — never SIGKILL mid-bench (orphans the tunnel
+# client). NEVER edit this file while an instance runs: bash reads
+# scripts lazily by byte offset, so a running instance executes
+# garbage after an edit — stop, edit, relaunch.
 set -u
 cd "$(dirname "$0")/.."
 LOG="${GS_HUNT_LOG:-benchmarks/results/headline_hunt_$(date +%F).jsonl}"

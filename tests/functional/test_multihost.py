@@ -63,13 +63,14 @@ def _env(base, devices, extra=None):
     return env
 
 
-def _run_single(tmp_path, lang="Plain"):
+def _run_single(tmp_path, lang="Plain", extra_env=None):
     d = tmp_path / "single"
     d.mkdir()
     (d / "config.toml").write_text(_config(lang))
     res = subprocess.run(
         [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
-        cwd=d, env=_env(d, 8), capture_output=True, text=True, timeout=600,
+        cwd=d, env=_env(d, 8, extra_env), capture_output=True, text=True,
+        timeout=600,
     )
     assert res.returncode == 0, res.stderr
     return d
@@ -226,15 +227,7 @@ def test_two_process_1d_xchain_matches_single_process(tmp_path):
     run."""
     extra = {"GS_TPU_MESH_DIMS": "8,1,1"}
 
-    single = tmp_path / "single"
-    single.mkdir()
-    (single / "config.toml").write_text(_config("Pallas"))
-    res = subprocess.run(
-        [sys.executable, str(REPO / "gray-scott.py"), "config.toml"],
-        cwd=single, env=_env(single, 8, extra), capture_output=True,
-        text=True, timeout=600,
-    )
-    assert res.returncode == 0, res.stderr
+    single = _run_single(tmp_path, "Pallas", extra_env=extra)
 
     dual = tmp_path / "dual"
     dual.mkdir()

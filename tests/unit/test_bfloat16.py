@@ -54,3 +54,31 @@ def test_bfloat16_sharded():
         np.asarray(one.get_fields()[0]).astype(np.float32),
         np.asarray(eight.get_fields()[0]).astype(np.float32),
     )
+
+
+def test_bfloat16_1d_xchain_sharded(monkeypatch):
+    """BFloat16 through the 1D x-chain mesh (bf16 face slabs DMA'd into
+    the ghost planes, f32 in-kernel compute via _compute_dtype; the XLA
+    x-chain fallback on CPU) — tracks the equivalent Plain run at bf16
+    precision."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    sh = Simulation(
+        _settings("BFloat16", lang="Pallas"), n_devices=8,
+        seed=5,
+    )
+    assert sh.domain.dims == (8, 1, 1)
+    sh.iterate(10)
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    ref = Simulation(
+        _settings("BFloat16", lang="Plain"), n_devices=1,
+        seed=5,
+    )
+    ref.iterate(10)
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[0]).astype(np.float32),
+        np.asarray(ref.get_fields()[0]).astype(np.float32),
+    )

@@ -40,3 +40,55 @@ def test_pipelined_kernel_has_no_dma_races():
     np.testing.assert_allclose(
         np.asarray(u1), np.asarray(want_u), rtol=1e-6, atol=5e-7
     )
+
+
+def test_x_chain_kernel_has_no_dma_races():
+    """The x-chain mode adds fuse-wide face DMAs landing in the ghost
+    planes of the slab windows while interior slab DMAs and out-DMAs
+    are in flight — run the detector over a multi-slab chain."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    nx, ny, nz, k = 48, 16, 128, 3  # GS_BX=16 -> 3 slabs
+    dtype = jnp.float32
+    s = Settings(L=nx, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.1,
+                 precision="Float32", backend="CPU",
+                 kernel_language="Pallas")
+    params = grayscott.Params.from_settings(s, dtype)
+    import jax
+
+    key = jax.random.PRNGKey(3)
+    u = jax.random.uniform(key, (nx, ny, nz), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
+    faces = tuple(
+        jax.random.uniform(jax.random.fold_in(key, 2 + i), (k, ny, nz),
+                           dtype)
+        for i in range(4)
+    )
+    seeds = jnp.asarray([9, 8, 7], jnp.int32)
+    offs = jnp.asarray([48, 0, 0], jnp.int32)
+    row = jnp.int32(144)
+
+    import os
+
+    os.environ["GS_BX"] = "16"
+    try:
+        u1, v1 = pallas_stencil.fused_step(
+            u, v, params, seeds, faces, use_noise=True, fuse=k,
+            offsets=offs, row=row, detect_races=True,
+        )
+    finally:
+        del os.environ["GS_BX"]
+    want_u, want_v = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_allclose(
+        np.asarray(u1), np.asarray(want_u), rtol=1e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(want_v), rtol=1e-4, atol=2e-6
+    )
