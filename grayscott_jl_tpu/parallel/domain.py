@@ -17,6 +17,7 @@ equal blocks (L divisible by dims) — see :func:`CartDomain.create`.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Tuple
 
 
@@ -77,8 +78,6 @@ class CartDomain:
         x-sharded decomposition whose halos feed the Pallas kernel's
         in-kernel fused chain — the fastest pod-slice layout for the
         Pallas language at <=16 chips, see BASELINE.md)."""
-        import os
-
         override = os.environ.get("GS_TPU_MESH_DIMS", "")
         if n_devices == 1:
             # A single device has exactly one decomposition; ignoring

@@ -29,10 +29,11 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _face(x: jnp.ndarray, dim: int, index: int) -> jnp.ndarray:
-    """Extract a 1-thick boundary face along ``dim`` (kept 3-D)."""
+def _slab(x: jnp.ndarray, dim: int, index: int, width: int) -> jnp.ndarray:
+    """Extract a ``width``-thick boundary slab along ``dim`` (kept 3-D);
+    ``index`` 0 = first slab, -1 = last slab."""
     idx = [slice(None)] * x.ndim
-    idx[dim] = slice(index, index + 1) if index >= 0 else slice(index, None)
+    idx[dim] = slice(0, width) if index == 0 else slice(-width, None)
     return x[tuple(idx)]
 
 
@@ -42,8 +43,10 @@ def _exchange_dim(
     dim: int,
     ax: str,
     n: int,
+    width: int = 1,
 ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Resolved (lo, hi) ghost faces along one mesh axis for each array.
+    """Resolved (lo, hi) ``width``-thick ghost slabs along one mesh axis
+    for each array.
 
     One ``ppermute`` per direction carries all arrays (stacked along the
     transfer axis); global-edge shards get the frozen boundary value.
@@ -53,7 +56,7 @@ def _exchange_dim(
         out = []
         for a, bv in zip(arrays, boundary_values):
             shape = list(a.shape)
-            shape[dim] = 1
+            shape[dim] = width
             f = jnp.full(shape, bv, a.dtype)
             out.append((f, f))
         return out
@@ -61,10 +64,12 @@ def _exchange_dim(
     n_arr = len(arrays)
     idx = lax.axis_index(ax)
 
-    # Stack the last faces of all arrays -> send "up" (coord+1);
-    # stack the first faces -> send "down" (coord-1).
-    send_up = jnp.concatenate([_face(a, dim, -1) for a in arrays], dim)
-    send_dn = jnp.concatenate([_face(a, dim, 0) for a in arrays], dim)
+    # Stack the last slabs of all arrays -> send "up" (coord+1);
+    # stack the first slabs -> send "down" (coord-1).
+    send_up = jnp.concatenate([_slab(a, dim, -1, width) for a in arrays],
+                              dim)
+    send_dn = jnp.concatenate([_slab(a, dim, 0, width) for a in arrays],
+                              dim)
 
     up_perm = [(i, i + 1) for i in range(n - 1)]
     dn_perm = [(i + 1, i) for i in range(n - 1)]
@@ -197,32 +202,10 @@ def exchange_x_slabs(
     2 collectives per k steps where the reference exchanges 6 faces
     every step (``communication.jl:138-199``). Global-edge shards get
     the frozen boundary constant. Must be called inside ``shard_map``.
+    (The width-generalized form of the per-axis exchange every other
+    path uses — one implementation, ``_exchange_dim``.)
     """
-    arrays = list(arrays)
-    if n == 1:
-        out = []
-        for a, bv in zip(arrays, boundary_values):
-            f = jnp.full((width,) + a.shape[1:], bv, a.dtype)
-            out.append((f, f))
-        return out
-
-    idx = lax.axis_index(ax)
-    send_up = jnp.concatenate([a[-width:] for a in arrays], 0)
-    send_dn = jnp.concatenate([a[:width] for a in arrays], 0)
-    up_perm = [(i, i + 1) for i in range(n - 1)]
-    dn_perm = [(i + 1, i) for i in range(n - 1)]
-    recv_lo = lax.ppermute(send_up, ax, up_perm)  # lower nbr's top slab
-    recv_hi = lax.ppermute(send_dn, ax, dn_perm)  # upper nbr's bottom
-    lo_s = jnp.split(recv_lo, len(arrays), axis=0)
-    hi_s = jnp.split(recv_hi, len(arrays), axis=0)
-    out = []
-    for i, (a, bv) in enumerate(zip(arrays, boundary_values)):
-        bvt = jnp.asarray(bv, a.dtype)
-        out.append((
-            jnp.where(idx > 0, lo_s[i], bvt),
-            jnp.where(idx < n - 1, hi_s[i], bvt),
-        ))
-    return out
+    return _exchange_dim(list(arrays), boundary_values, 0, ax, n, width)
 
 
 def exchange_faces(
