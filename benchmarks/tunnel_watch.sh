@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Tunnel-recovery watcher: probe the TPU grant gently until it answers,
+# then launch exactly one headline hunter and exit.
+#
+# Rationale (BASELINE.md "grant-wedge timescale"): a wedged chip grant
+# recovers on an hours timescale and nothing client-side accelerates
+# it. This loop keeps the probing cost low (one bounded dial every
+# GS_WATCH_INTERVAL seconds) and converts recovery into headline
+# samples immediately instead of at the next human check-in.
+#
+#   nohup benchmarks/tunnel_watch.sh >/tmp/gs_watch.log 2>&1 &
+#
+# Stop via $GS_WATCH_STOP (default /tmp/gs_watch_stop). Probes are
+# SIGTERM-bounded with a kill grace (same contract as bench.py) —
+# never SIGKILL first; a SIGKILLed tunnel client re-wedges the grant.
+set -u
+cd "$(dirname "$0")/.."
+STOP_FILE="${GS_WATCH_STOP:-/tmp/gs_watch_stop}"
+INTERVAL="${GS_WATCH_INTERVAL:-150}"
+PROBE_TIMEOUT="${GS_WATCH_PROBE_TIMEOUT:-90}"
+LOCK=/tmp/gs_watch_lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+    echo "watcher already running ($LOCK exists)"; exit 1
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+while [ ! -e "$STOP_FILE" ]; do
+    out=$(timeout -k 20 "$PROBE_TIMEOUT" python -c \
+        "import jax, jax.numpy as jnp; d=jax.devices()[0]; \
+x=float(jnp.ones((8,8)).sum()); print('GSPROBE', d.platform, x)" 2>/dev/null)
+    case "$out" in
+        *"GSPROBE tpu"*)
+            echo "$(date -u +%FT%TZ) tunnel up — launching hunter"
+            # One instance only: the hunter has no lock of its own, so
+            # guard here (this watcher is the only launcher).
+            # The [h] bracket keeps this grep from matching its own
+            # /proc entry (and tunnel_watch lines are filtered so this
+            # script never matches itself either).
+            if ! ls /proc/*/cmdline 2>/dev/null | while read -r f; do
+                   tr '\0' ' ' <"$f" 2>/dev/null; echo
+                 done | grep -v tunnel_watch \
+                      | grep -q '[h]eadline_hunter\.sh'; then
+                nohup benchmarks/headline_hunter.sh \
+                    >>/tmp/gs_hunter.log 2>&1 &
+            fi
+            exit 0
+            ;;
+        *)
+            echo "$(date -u +%FT%TZ) tunnel still down"
+            ;;
+    esac
+    sleep "$INTERVAL"
+done
+echo "$(date -u +%FT%TZ) stop requested"

@@ -129,7 +129,10 @@ def read_data_write_pdf(
     # open_reader dispatches on the store format: BP-lite from this
     # framework's runs, or — when the adios2 bindings are importable — a
     # real ADIOS2 BP store (including the reference's own output).
-    reader = open_reader(in_filename)
+    # live=True: this is the streaming coupling — the simulation may
+    # still be in its first-step compile window, so the store is allowed
+    # to not exist yet (begin_step polls NOT_READY until it appears).
+    reader = open_reader(in_filename, live=True)
     # All workers cooperate on ONE output store (the reference's
     # MPI-parallel pdfcalc writes a single output.bp the same way).
     writer = open_writer(out_filename, writer_id=rank, nwriters=size)

@@ -166,24 +166,93 @@ def open_writer(
     )
 
 
-def open_reader(path: str):
+def open_reader(path: str, *, live: bool = False):
     """Open a store with the matching reader engine.
 
     Real ADIOS2 BP stores (positive ``md.idx``/``md.<n>`` evidence,
     :func:`_real_bp_evidence`) need the adios2 bindings (a clear error
-    when they are absent); anything else — including a BP-lite store
-    mid-startup whose metadata is not committed yet — gets ``BpReader``
-    and its poll-until-metadata behavior.
+    when they are absent); anything else gets ``BpReader``.
+
+    ``live=True`` is the streaming-coupling form (pdfcalc attaching to
+    a simulation that may still be in its first-step compile window):
+    the store is allowed to not exist yet — construction succeeds with
+    zero steps and ``begin_step`` polls (NOT_READY until its timeout)
+    until the writer creates the store, at which point the reader
+    engine is dispatched on the store's ACTUAL format (the writer may
+    turn out to be either engine). The default is strict: for offline
+    analysis (gdsplot) a missing store is an operator error that must
+    fail fast with the path in the message.
     """
     from .bplite import BpReader
 
-    if not _real_bp_evidence(path):
+    if _real_bp_evidence(path):
+        from . import adios
+
+        if adios.available():
+            return adios.Adios2Reader(path)
+        raise RuntimeError(
+            f"{path} is not a BP-lite store and the adios2 bindings are "
+            "not importable to read it as a real BP store"
+        )
+    if not live:
         return BpReader(path)
     from . import adios
 
-    if adios.available():
-        return adios.Adios2Reader(path)
-    raise RuntimeError(
-        f"{path} is not a BP-lite store and the adios2 bindings are not "
-        "importable to read it as a real BP store"
-    )
+    if not adios.available():
+        # Without the wheel every writer engine in this process family
+        # produces BP-lite metadata — commit to the polling BpReader.
+        return BpReader(path, wait_for_writer=True)
+    return _LiveReader(path)
+
+
+class _LiveReader:
+    """Deferred-dispatch reader for live coupling when the store does
+    not exist yet AND the adios2 bindings are importable — the writer
+    may turn out to be the real-ADIOS2 engine (``md.idx``, no
+    ``md.json``) or a BP-lite engine, and committing to either reader
+    class up front would hang forever on the other (review finding r4).
+
+    ``begin_step`` polls until the store's format is identifiable, then
+    instantiates the matching reader and delegates everything to it.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._inner = None
+
+    def _try_attach(self):
+        from .bplite import BpReader, _md_path
+
+        if _real_bp_evidence(self.path):
+            from . import adios
+
+            self._inner = adios.Adios2Reader(self.path)
+        elif os.path.isfile(_md_path(self.path)):
+            self._inner = BpReader(self.path, wait_for_writer=True)
+        return self._inner
+
+    def begin_step(self, timeout: float = 10.0):
+        import time
+
+        from .bplite import StepStatus
+
+        deadline = time.monotonic() + timeout
+        while self._inner is None:
+            if self._try_attach() is not None:
+                break
+            if time.monotonic() >= deadline:
+                return StepStatus.NOT_READY
+            time.sleep(0.05)
+        return self._inner.begin_step(
+            timeout=max(0.0, deadline - time.monotonic())
+        )
+
+    def __getattr__(self, name):
+        # Everything except begin_step requires an attached store; the
+        # streaming protocol guarantees callers begin_step first.
+        if self._inner is None:
+            raise RuntimeError(
+                f"store {self.path} has not appeared yet; call "
+                "begin_step until it returns OK before other reads"
+            )
+        return getattr(self._inner, name)

@@ -269,9 +269,18 @@ class BpReader:
     the reference's pdfcalc loop relies on (``pdfcalc.jl:112-123``).
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, wait_for_writer: bool = False):
+        """``wait_for_writer=True`` tolerates a store that does not exist
+        yet (no directory, or no committed ``md.json``): construction
+        succeeds with zero visible steps and ``begin_step`` polls until
+        the writer commits — the live-coupling form ``open_reader``
+        uses, where the reader may attach during the writer's first-step
+        compile window (20-60 s). The default is strict (immediate
+        ``FileNotFoundError``), the right behavior for checkpoint
+        restores where a missing store is an operator error."""
         self.path = path
-        if not os.path.isdir(path):
+        self._wait_for_writer = wait_for_writer
+        if not wait_for_writer and not os.path.isdir(path):
             raise FileNotFoundError(f"No such BP-lite store: {path}")
         self._consumed = 0
         self._current: Optional[dict] = None
@@ -282,7 +291,17 @@ class BpReader:
     def _load_md(self) -> None:
         # Writers replace their metadata files atomically; retry briefly on
         # the window where a JSON read could race a slow filesystem.
-        md0 = self._load_one(_md_path(self.path), required=True)
+        md0 = self._load_one(
+            _md_path(self.path), required=not self._wait_for_writer
+        )
+        if md0 is None:
+            # Writer not started yet (wait_for_writer mode): nothing
+            # visible; begin_step keeps polling until md.json appears.
+            self._md = {
+                "format": FORMAT_NAME, "complete": False, "steps": [],
+                "attributes": {}, "variables": {},
+            }
+            return
         nwriters = int(md0.get("nwriters", 1))
         if nwriters == 1:
             self._md = md0

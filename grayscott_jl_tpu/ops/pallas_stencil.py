@@ -168,6 +168,20 @@ def pick_block_planes(
     return 0
 
 
+def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
+                      fuse: int) -> int:
+    """Deepest chain depth <= ``fuse`` whose slab scratch fits the VMEM
+    budget (:func:`pick_block_planes` > 0); 0 if not even ``fuse=1``
+    fits. Dispatch-time guard for the in-kernel chain modes: the
+    exchange width must match a depth Mosaic can actually serve, or the
+    kernel silently degrades to its XLA fallback (e.g. the v5p-16 pod
+    shape 64x512x512 f32 fits fuse=3 at bx=4 but not fuse=5)."""
+    for k in range(fuse, 0, -1):
+        if pick_block_planes(nx, ny, nz, itemsize, k) > 0:
+            return k
+    return 0
+
+
 def _kernel_pm1(bits, dtype):
     """uint32 bits -> uniform [-1, 1), Mosaic form of
     ``noise.bits_to_pm1`` (``pltpu.bitcast`` instead of lax bitcast)."""
@@ -679,10 +693,7 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
         # shallower chain may still fit — step down rather than losing
         # the Pallas kernel entirely (large grids are exactly where the
         # kernel matters most).
-        shallower = next(
-            (k for k in range(fuse - 1, 0, -1)
-             if pick_block_planes(nx, ny, nz, dtype.itemsize, k) > 0), 0,
-        )
+        shallower = max_feasible_fuse(nx, ny, nz, dtype.itemsize, fuse - 1)
         if shallower:
             done = 0
             while done < fuse:
@@ -716,6 +727,19 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
         not on_tpu and not allow_interpret
     ):
         if x_chain:
+            if on_tpu and bx == 0:
+                # On hardware this is a silent perf cliff, not a
+                # correctness issue — make it visible (the module's
+                # stated invariant: benchmark users must see when
+                # "Pallas" is measuring the XLA kernel). Callers should
+                # cap the chain depth with max_feasible_fuse so the
+                # exchange width matches a depth Mosaic can serve.
+                _warn_once(
+                    f"x-chain fuse={fuse} does not fit VMEM for local "
+                    f"grid {nx}x{ny}x{nz} ({dtype}); running the XLA "
+                    "x-chain fallback — cap the depth with "
+                    "max_feasible_fuse"
+                )
             return _xla_xchain_fallback(
                 u, v, params, seeds, faces, fuse=fuse,
                 use_noise=use_noise, offsets=offsets, row=row,

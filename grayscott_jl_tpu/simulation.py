@@ -357,6 +357,24 @@ class Simulation:
                     default_fuse(), max(nsteps, 1),
                     self.domain.local_shape[0],
                 )
+                # The exchange width must match a chain depth the
+                # Mosaic kernel can actually serve — an infeasible
+                # depth would silently run every step on the XLA
+                # fallback (e.g. the v5p-16 pod shape 64x512x512 f32
+                # fits fuse=3, not 5). Depth 1 falls through to the
+                # 12-face single-step exchange below.
+                feasible = pallas_stencil.max_feasible_fuse(
+                    *self.domain.local_shape,
+                    jnp.dtype(self.dtype).itemsize, fuse,
+                )
+                if feasible < fuse:
+                    capped = max(feasible, 1)
+                    pallas_stencil._warn_once(
+                        f"x-chain depth capped at {capped} "
+                        f"(fuse={fuse} does not fit VMEM for local grid "
+                        f"{self.domain.local_shape})"
+                    )
+                    fuse = capped
 
                 def chain(u, v, step, depth):
                     if depth == 1:

@@ -26,6 +26,14 @@ export ACCELERATOR_TYPE="v5p-16"
 # XLA language and for >16 chips). Ignored by single-device runs.
 export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
 
+# Chain depth. NOTE the two kernel languages diverge on this config:
+# the XLA wide-halo chain has no VMEM constraint and wants the measured
+# optimum k=5, while the Pallas x-chain on the 64x512x512-f32 local
+# block only fits Mosaic's VMEM at fuse=3 (bx=4) — the dispatch caps it
+# there automatically (simulation.py max_feasible_fuse guard, with a
+# warning), trimming the exchange width to match. So 5 is right for
+# both: Pallas runs depth 3 either way, XLA keeps its full
+# amortization.
 export GS_FUSE="${GS_FUSE:-5}"
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # export GS_TPU_PROFILE=/tmp/gs_trace

@@ -177,6 +177,55 @@ def test_missing_store_raises(tmp_path):
         BpReader(str(tmp_path / "absent.bp"))
 
 
+def test_wait_for_writer_attaches_before_store_exists(tmp_path):
+    """Live coupling: a reader may attach while the writer is still in
+    its first-step jit-compile window (20-60 s) — before the store
+    directory or md.json exists. ``wait_for_writer`` construction must
+    succeed with zero steps, report NOT_READY from ``begin_step``'s
+    bounded poll, then see the writer's steps once committed."""
+    path = _store(tmp_path, "live.bp")
+    r = BpReader(path, wait_for_writer=True)
+    assert r.num_steps() == 0
+    assert r.begin_step(timeout=0.05) == StepStatus.NOT_READY
+
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(7))
+    w.end_step()
+    w.close()
+
+    assert r.begin_step(timeout=5.0) == StepStatus.OK
+    assert int(r.get("step", step=0)) == 7
+    r.end_step()
+    assert r.begin_step(timeout=5.0) == StepStatus.END_OF_STREAM
+
+
+def test_live_reader_defers_engine_dispatch(tmp_path):
+    """The wheel-present live-coupling wrapper (io._LiveReader) must not
+    commit to a reader class before the store exists: it polls, then
+    dispatches on the store's actual format (here: BP-lite appears)."""
+    from grayscott_jl_tpu.io import _LiveReader
+
+    path = _store(tmp_path, "deferred.bp")
+    r = _LiveReader(path)
+    assert r.begin_step(timeout=0.05) == StepStatus.NOT_READY
+    with pytest.raises(RuntimeError, match="has not appeared"):
+        r.num_steps()
+
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(3))
+    w.end_step()
+    w.close()
+
+    assert r.begin_step(timeout=5.0) == StepStatus.OK
+    assert int(r.get("step", step=0)) == 3
+    r.end_step()
+    assert r.begin_step(timeout=2.0) == StepStatus.END_OF_STREAM
+
+
 def test_count_steps_upto_ignores_metadata_less_store(tmp_path):
     """A store directory without committed rank-0 metadata has nothing to
     roll back. In a multi-process restart with a fresh output store, a

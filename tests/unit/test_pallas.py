@@ -249,6 +249,21 @@ def test_fuse_steps_down_when_vmem_overflows():
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
 
 
+def test_max_feasible_fuse_caps_the_v5p16_pod_shape():
+    """The dispatch-side chain-depth guard: on the v5p-16 1D pod shape
+    (local 64x512x512 f32) the x-chain fits Mosaic's VMEM budget at
+    fuse=3 (bx=4) but not 4 or 5 — an uncapped GS_FUSE=5 would silently
+    run the XLA fallback every step (advisor finding r3)."""
+    saved = pallas_stencil._VMEM_BUDGET
+    pallas_stencil._VMEM_BUDGET = pallas_stencil._VMEM_BUDGETS[True]
+    try:
+        assert pallas_stencil.max_feasible_fuse(64, 512, 512, 4, 5) == 3
+        # And a shape that fits the requested depth is left alone.
+        assert pallas_stencil.max_feasible_fuse(64, 128, 256, 4, 5) == 5
+    finally:
+        pallas_stencil._VMEM_BUDGET = saved
+
+
 @pytest.mark.parametrize("nsteps", [1, 3, 7])
 def test_pallas_odd_step_counts_match_xla(nsteps):
     """Odd chunk sizes take the fuse pairs + one fuse=rem remainder
@@ -361,7 +376,7 @@ def _xchain_inputs(nx=32, ny=16, nz=128, k=3, seed=7):
 
 
 @pytest.mark.parametrize("use_noise", [False, True])
-def test_x_chain_kernel_matches_fallback(use_noise):
+def test_x_chain_kernel_matches_fallback(use_noise, monkeypatch):
     """The in-kernel fused x-chain (fuse-wide x faces, the 1D-sharded
     mode) against its XLA fallback: same elementwise program, so the
     tolerance absorbs interpret-kernel vs XLA op-scheduling rounding,
@@ -375,16 +390,12 @@ def test_x_chain_kernel_matches_fallback(use_noise):
     u, v, faces, params, seeds = _xchain_inputs(nx, ny, nz, k)
     offs = jnp.asarray([16, 0, 0], jnp.int32)  # interior shard
     row = jnp.int32(64)
-    import os
-
-    os.environ["GS_BX"] = "16"
-    try:
-        a = pallas_stencil.fused_step(
-            u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
-            offsets=offs, row=row,
-        )
-    finally:
-        del os.environ["GS_BX"]
+    monkeypatch.setenv("GS_BX", "16")  # restores any pre-existing value
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
+        offsets=offs, row=row,
+    )
+    monkeypatch.undo()
     b = pallas_stencil._xla_xchain_fallback(
         u, v, params, seeds, faces, fuse=k, use_noise=use_noise,
         offsets=offs, row=row,

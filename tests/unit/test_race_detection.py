@@ -42,7 +42,7 @@ def test_pipelined_kernel_has_no_dma_races():
     )
 
 
-def test_x_chain_kernel_has_no_dma_races():
+def test_x_chain_kernel_has_no_dma_races(monkeypatch):
     """The x-chain mode adds fuse-wide face DMAs landing in the ghost
     planes of the slab windows while interior slab DMAs and out-DMAs
     are in flight — run the detector over a multi-slab chain."""
@@ -72,16 +72,12 @@ def test_x_chain_kernel_has_no_dma_races():
     offs = jnp.asarray([48, 0, 0], jnp.int32)
     row = jnp.int32(144)
 
-    import os
-
-    os.environ["GS_BX"] = "16"
-    try:
-        u1, v1 = pallas_stencil.fused_step(
-            u, v, params, seeds, faces, use_noise=True, fuse=k,
-            offsets=offs, row=row, detect_races=True,
-        )
-    finally:
-        del os.environ["GS_BX"]
+    monkeypatch.setenv("GS_BX", "16")  # restores any pre-existing value
+    u1, v1 = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        offsets=offs, row=row, detect_races=True,
+    )
+    monkeypatch.undo()
     want_u, want_v = pallas_stencil._xla_xchain_fallback(
         u, v, params, seeds, faces, fuse=k, use_noise=True,
         offsets=offs, row=row,
