@@ -26,7 +26,11 @@ while [ ! -e "$STOP_FILE" ]; do
     # (probe retries, RUN_TIMEOUT, SIGTERM-grace-SIGKILL) and always
     # exits 0; killing it from outside would orphan the in-flight TPU
     # worker holding the tunnel grant — the exact wedge it prevents.
-    line=$(python bench.py 2>/dev/null | tail -1)
+    # GS_BENCH_TPU_HORIZON=0: the long re-probe horizon is bench.py's
+    # own wedge-riding mode for one-shot (driver) runs; THIS loop
+    # already provides the long horizon, so each cycle should fail
+    # fast and let the interval pacing work.
+    line=$(GS_BENCH_TPU_HORIZON=0 python bench.py 2>/dev/null | tail -1)
     if [ -n "$line" ]; then
         printf '{"t": "%s", "r": %s}\n' "$(date -u +%FT%TZ)" "$line" >>"$LOG"
     fi
@@ -34,7 +38,8 @@ while [ ! -e "$STOP_FILE" ]; do
     # windows are where the 73%-of-roofline record came from) with a
     # shorter round budget — unless a stop was requested mid-cycle.
     [ -e "$STOP_FILE" ] && break
-    line=$(GS_BENCH_L=512 GS_BENCH_ROUNDS=8 python bench.py 2>/dev/null | tail -1)
+    line=$(GS_BENCH_TPU_HORIZON=0 GS_BENCH_L=512 GS_BENCH_ROUNDS=8 \
+           python bench.py 2>/dev/null | tail -1)
     if [ -n "$line" ]; then
         printf '{"t": "%s", "r": %s}\n' "$(date -u +%FT%TZ)" "$line" >>"$LOG"
     fi

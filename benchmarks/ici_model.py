@@ -128,6 +128,181 @@ FUSE_COST_RATIO = {1: 1493.1 / 1023.9, 2: 1.174, 3: 1.079,
                    4: 1077.0 / 1044.0, 5: 1.0, 6: 1069.3 / 1044.0}
 
 
+_PALLAS_STENCIL = None
+
+
+def _pallas_stencil():
+    """Import ``ops.pallas_stencil`` once, with the repo root on the
+    path and the v4/v5/v6 VMEM budget pinned so no device is dialed."""
+    global _PALLAS_STENCIL
+    if _PALLAS_STENCIL is None:
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+        ps._VMEM_BUDGET = ps._VMEM_BUDGETS[True]
+        _PALLAS_STENCIL = ps
+    return _PALLAS_STENCIL
+
+
+def _feasible_chain_depth(local, itemsize, kmax, sublane=8, ypad=True):
+    """Deepest chain depth the real Mosaic VMEM feasibility check
+    admits for this local shape (``pallas_stencil.max_feasible_fuse*``);
+    ``ypad`` selects the xy-chain form (y-extended operand) vs the 1D
+    x-chain."""
+    ps = _pallas_stencil()
+    if ypad:
+        return ps.max_feasible_fuse_ypad(*local, itemsize, kmax, sublane)
+    return ps.max_feasible_fuse(*local, itemsize, kmax)
+
+
+def band_cells_per_round(local, k):
+    """Output cells of the two z-side XLA band chains per k-step round
+    (``parallel/temporal.window_chain``): stage s shrinks the
+    (nx+2k, ny+2k, 3k) window by one cell per side."""
+    nx, ny, nz = local
+    cells = 0
+    for s in range(k):
+        cells += ((nx + 2 * (k - s) - 2) * (ny + 2 * (k - s) - 2)
+                  * (3 * k - 2 * s - 2))
+    return 2 * cells
+
+
+def project_chain(
+    dims,
+    L: int,
+    fuse: int,
+    base_us_full: float,
+    *,
+    itemsize: int = 4,
+    sublane: int = 8,
+    link_gbps: float = 90.0,
+    hop_us: float = 1.0,
+    overlap: float = 0.0,
+    xla_us_per_cell: float = None,
+) -> dict:
+    """Weak-scaling projection for the round-4 cross-shard fused chain
+    (``parallel/temporal.xy_chain``) on an (n, m, p) mesh.
+
+    Every sharded stage runs IN-KERNEL at the fused schedule (the 1.46x
+    single-step penalty of the retired round-3 design is gone); the
+    overheads are:
+
+    * ``FUSE_COST_RATIO[k]`` — in-kernel depth vs the k=5 optimum;
+    * y-plane growth — the operand carries a k-deep y halo rounded up
+      to the sublane tile, so every plane computes
+      (ny + 2k + align)/ny more rows;
+    * x ring recompute — mid-stage windows extend (k-1-s) planes per
+      side, 1 + (k-1)/nx extra volume (same as the 1D x-chain);
+    * z bands (p > 1 only) — two k-wide bands per round recomputed in
+      XLA at the measured big-grid XLA per-cell rate (conservative: the
+      band working set can be VMEM-resident, which XLA fuses faster);
+    * exposed comm — 4 slab ppermutes per round for (n, m, 1), 6 for
+      z-sharded, each face on its own torus link, serialization at the
+      largest face.
+
+    ``base_us_full`` is the fused single-chip µs/step for the WHOLE L^3
+    grid; per-shard compute is 1/(n*m*p) of it (throughput-flat,
+    conservative for big locals).
+    """
+    n, m, p = dims
+    local = (L // n, L // m, L // p)
+    nx, ny, nz = local
+    us_base = base_us_full / (n * m * p)
+    r = FUSE_COST_RATIO.get(fuse)
+    if r is None:
+        raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
+    k = fuse
+    ny_ext = ny + 2 * k
+    ny_ext += (-ny_ext) % sublane
+    y_over = ny_ext / ny if (m > 1 or p > 1) else 1.0
+    x_ring = 1.0 + (k - 1) / nx
+    compute_us = us_base * r * y_over * x_ring
+
+    if p > 1:
+        if xla_us_per_cell is None:
+            xla_us_per_cell = MEASURED_US[("XLA", 256)] / 256**3
+        band_us = band_cells_per_round(local, k) * xla_us_per_cell / k
+        # Frame faces span the padded extents (corner propagation).
+        zx, zy = nz + 2 * k, ny + 2 * k
+        face_bytes = max(
+            zy * zx, (nx + 2 * k) * zx, (nx + 2 * k) * zy
+        ) * itemsize * 2
+        n_faces = 6
+    else:
+        band_us = 0.0
+        face_bytes = max(ny_ext * nz, nx * nz) * itemsize * 2
+        n_faces = (2 if n > 1 else 0) + (2 if m > 1 else 0)
+    # k-wide slabs every k steps -> per-step bytes are k-independent;
+    # completion at the largest face's link.
+    ser_us = face_bytes / (link_gbps * 1e3)
+    lat_us = n_faces * hop_us / k
+    comm_us = (ser_us + lat_us) * (1.0 - overlap)
+
+    eff = us_base / (compute_us + band_us + comm_us)
+    return {
+        "mesh": f"{n},{m},{p}",
+        "local": list(local),
+        "fuse": k,
+        "fuse_cost_ratio": r,
+        "fuse_cost_ratio_interpolated": k in (2, 3),
+        "compute_us_per_step": round(us_base, 1),
+        "y_plane_overhead": round(y_over, 4),
+        "x_ring_recompute": round(x_ring, 4),
+        "z_band_us_per_step": round(band_us, 2),
+        "comm_us_per_step_exposed": round(comm_us, 2),
+        "link_gbps": link_gbps,
+        "overlap": overlap,
+        "projected_weak_scaling_eff": round(eff, 4),
+    }
+
+
+def _mesh_candidates(n_devices: int, L: int):
+    """All (n, m, p) ordered factorizations of ``n_devices`` whose dims
+    divide L — the mixed-mesh sweep space."""
+    out = []
+    for n in range(1, n_devices + 1):
+        if n_devices % n or L % n:
+            continue
+        rest = n_devices // n
+        for m in range(1, rest + 1):
+            if rest % m or L % m:
+                continue
+            p = rest // m
+            if L % p:
+                continue
+            out.append((n, m, p))
+    return out
+
+
+def best_chain(n_devices, L, base_us_full, *, itemsize=4, kmax=8, **kw):
+    """Sweep mesh factorization x feasible chain depth for the round-4
+    chain; returns the best row (the VERDICT-8 mixed-mesh sweep)."""
+    best = None
+    for dims in _mesh_candidates(n_devices, L):
+        local = tuple(L // d for d in dims)
+        if min(local) < 2:
+            continue
+        cap = min(kmax, local[0], local[1])
+        if dims[2] > 1:
+            cap = min(cap, local[2] // 2)
+        cap = _feasible_chain_depth(local, itemsize, cap)
+        for k in range(2, cap + 1):
+            if k not in FUSE_COST_RATIO:
+                continue
+            r = project_chain(dims, L, k, base_us_full,
+                              itemsize=itemsize, **kw)
+            if (best is None
+                    or r["projected_weak_scaling_eff"]
+                    > best["projected_weak_scaling_eff"]):
+                best = r
+    return best
+
+
 def project_1d(
     n: int,
     L: int,
@@ -179,8 +354,14 @@ def project_1d(
     }
 
 
-def best_fuse_1d(n, L, base_us, **kw):
-    ks = [k for k in FUSE_COST_RATIO if k <= max(2, L // n)]
+def best_fuse_1d(n, L, base_us, *, itemsize=4, **kw):
+    # Only depths whose slab scratch actually fits Mosaic's VMEM budget
+    # count — the dispatch caps infeasible depths (advisor finding r3),
+    # so projecting them would promise an unobtainable schedule.
+    cap = _feasible_chain_depth(
+        (L // n, L, L), itemsize, max(2, L // n), ypad=False
+    )
+    ks = [k for k in FUSE_COST_RATIO if k <= cap]
     return max(
         (project_1d(n, L, k, base_us, **kw) for k in ks),
         key=lambda r: r["projected_weak_scaling_eff"],
@@ -265,16 +446,39 @@ def main() -> int:
         ]
         rows = []
         for name, local, links, bw in configs:
-            for lang in ("XLA", "Pallas"):
-                r = best_fuse(
-                    local, MEASURED_US[(lang, local)],
-                    stage_ratio=STAGE_RATIO[lang], links=links,
-                    link_gbps=bw, hop_us=args.hop_us,
-                    overlap=args.overlap,
-                )
-                r["config"] = name
-                r["kernel"] = lang
-                rows.append(r)
+            r = best_fuse(
+                local, MEASURED_US[("XLA", local)],
+                stage_ratio=STAGE_RATIO["XLA"], links=links,
+                link_gbps=bw, hop_us=args.hop_us,
+                overlap=args.overlap,
+            )
+            r["config"] = name
+            r["kernel"] = "XLA"
+            rows.append(r)
+        # Pallas rows: the round-4 cross-shard fused chain, swept over
+        # ALL mesh factorizations x feasible chain depths (the retired
+        # round-3 per-stage design — 1.46x stage ratio — no longer
+        # exists in the code, so it is no longer projected). The fused
+        # single-chip anchor is rescaled throughput-flat to the config's
+        # global volume from the closest measured L.
+        for name, n_dev, L, base_key, bw in (
+            ("v5e-8 chain, L=256", 8, 256, ("Pallas", 256), 45.0),
+            ("v5p-16 chain, L=512", 8, 512, ("Pallas", 512), 90.0),
+            # v5p-256 = 128 chips (the 8x4x4 mesh of the pod config).
+            ("v5p-256 chain, L=1024", 128, 1024, ("Pallas", 512), 90.0),
+            # The scale a 128-chip slice exists for: at L=2048 the
+            # per-chip surface/volume ratio recovers and the chain
+            # approaches the >=0.9 regime (documented in BASELINE.md).
+            ("v5p-256 chain, L=2048", 128, 2048, ("Pallas", 512), 90.0),
+        ):
+            base = MEASURED_US[base_key]
+            if L != base_key[1]:
+                base = base * (L / base_key[1]) ** 3
+            r = best_chain(n_dev, L, base, link_gbps=bw,
+                           hop_us=args.hop_us, overlap=args.overlap)
+            r["config"] = name
+            r["kernel"] = "Pallas-chain"
+            rows.append(r)
         # The 1D x-sharded alternative (GS_TPU_MESH_DIMS=n,1,1): the
         # in-kernel fused chain crosses the shard boundary, so Pallas
         # stages run at the fused schedule. Wins <=16 chips; the
@@ -309,9 +513,13 @@ def main() -> int:
           "eff (0 overlap) |", file=sys.stderr)
     print("|---|---|---|---|---|---|", file=sys.stderr)
     for r in rows:
-        shape = (
-            f"{r['local']}-slab" if "mesh" in r else f"{r['local']}^3"
-        )
+        if isinstance(r["local"], list):
+            shape = "x".join(str(d) for d in r["local"])
+            shape += f" @ {r['mesh']}"
+        elif "mesh" in r:
+            shape = f"{r['local']}-slab"
+        else:
+            shape = f"{r['local']}^3"
         print(
             f"| {r.get('config', r['local'])} | {r.get('kernel', '-')} | "
             f"{shape} | {r['fuse']} | "

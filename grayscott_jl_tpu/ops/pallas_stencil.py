@@ -182,6 +182,20 @@ def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
     return 0
 
 
+def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
+                           fuse: int, sublane: int = 8) -> int:
+    """:func:`max_feasible_fuse` for the xy-chain mode, where the
+    operand arrives y-extended: depth k widens every plane to
+    ``ny + 2k`` rows rounded up to the sublane tile, so feasibility
+    must be judged on the padded shape."""
+    for k in range(fuse, 0, -1):
+        ny_ext = ny + 2 * k
+        ny_ext += (-ny_ext) % sublane
+        if pick_block_planes(nx, ny_ext, nz, itemsize, k) > 0:
+            return k
+    return 0
+
+
 def _kernel_pm1(bits, dtype):
     """uint32 bits -> uniform [-1, 1), Mosaic form of
     ``noise.bits_to_pm1`` (``pltpu.bitcast`` instead of lax bitcast)."""
@@ -449,6 +463,19 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             planes pinned to the frozen boundary value; the last stage
             writes the bx output planes."""
             k = fuse
+            if x_chain:
+                # xy-chain support: when the operand is y-extended (its
+                # rows cover global [seeds[4], seeds[4]+ny), which may
+                # start negative or cross L), mid-stage rows outside the
+                # GLOBAL domain pin to the boundary value exactly like
+                # out-of-domain x planes — while in-domain rows of the
+                # y pad ring-recompute the y neighbor's values, the
+                # property that lets the chain cross a y shard boundary.
+                # In the 1D x-chain (block spans full L in y) every row
+                # is in-domain and this mask is all-true.
+                gy = (lax.broadcasted_iota(jnp.int32, (1, ny, 1), 1)
+                      + seeds[4])
+                valid_y = (gy >= 0) & (gy < seeds[6])
             for s in range(k):
                 w_out = bx + 2 * (k - 1 - s)
                 if s == 0:
@@ -486,7 +513,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                     gx = g0 + iota_w
                     if x_chain:
                         gxg = seeds[3] + gx
-                        valid = (gxg >= 0) & (gxg < seeds[6])
+                        valid = ((gxg >= 0) & (gxg < seeds[6])) & valid_y
                     else:
                         valid = (gx >= 0) & (gx < nx)
 
@@ -636,11 +663,20 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
       v_ylo, v_yhi, u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped
       (1, ny, nz), y faces (nx, 1, nz), z faces (nx, ny, 1);
     * 4-tuple ``(u_xlo, u_xhi, v_xlo, v_xhi)`` with fuse >= 2, each
-      shaped (fuse, ny, nz) — the 1D-x-sharded **x-chain** mode: the
+      shaped (fuse, ny, nz) — the x-sharded **x-chain** mode: the
       fuse-wide x slabs feed the in-kernel temporal chain across the
-      shard boundary (y/z stay global frozen boundaries, and mid-stage
-      ring pinning switches to GLOBAL x coordinates so interior shards
+      shard boundary (z stays a global frozen boundary, and mid-stage
+      ring pinning uses GLOBAL x *and y* coordinates so interior shards
       recompute the neighbor ring bitwise instead of freezing it).
+      The **xy-chain** is the same mode with a y-extended operand
+      (``parallel/temporal.xy_chain``): rows cover global
+      ``[offsets[1], offsets[1] + ny)`` including a fuse-deep exchanged
+      y halo (plus sublane-alignment filler rows at the high end), so
+      the chain also crosses y shard boundaries — in-domain pad rows
+      ring-recompute the y neighbor's values, out-of-domain rows pin to
+      the boundary constant, and the caller slices the y interior from
+      the result. y is the sublane dim (8/16-granularity tiling), which
+      is what makes this extension Mosaic-cheap, unlike the 128-lane z.
 
     ``fuse=k`` temporal blocking advances k steps per HBM pass
     (single- or multi-block; with faces only in the 4-tuple x-chain
@@ -770,9 +806,13 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
                          offsets, row):
     """XLA form of the in-kernel x-chain (1D-sharded temporal blocking):
     ``fuse`` stages on an x-extended window seeded by the fuse-wide x
-    faces, with y/z frozen at the global boundary and out-of-global-
-    domain x planes pinned per stage. Bitwise-equal to the Mosaic
-    x-chain for f32/f64 (same op order, same position-keyed noise) —
+    faces, with z frozen at the global boundary and out-of-global-domain
+    x planes AND y rows pinned per stage — the y pinning is the xy-chain
+    mode, where the operand arrives y-extended (rows covering global
+    [offsets[1], offsets[1]+ny)) and in-domain pad rows ring-recompute
+    the y neighbor's values (it is an all-true no-op for the 1D x-chain,
+    whose block spans the full L in y). Bitwise-equal to the Mosaic
+    chain for f32/f64 (same op order, same position-keyed noise) —
     the CPU-mesh / f64 / lane-misaligned path of the same design."""
     u_xlo, u_xhi, v_xlo, v_xhi = faces
     nx, ny, nz = u.shape
@@ -781,6 +821,8 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
     v_bv = jnp.asarray(stencil.V_BOUNDARY, v.dtype)
     u_w = jnp.concatenate([u_xlo, u, u_xhi], axis=0)
     v_w = jnp.concatenate([v_xlo, v, v_xhi], axis=0)
+    gy = offsets[1] + jnp.arange(ny)
+    valid_y = ((gy >= 0) & (gy < row))[None, :, None]
 
     def pad_yz(x, bv):
         return jnp.pad(
@@ -804,8 +846,15 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
         u_w, v_w = stencil.reaction_update(
             pad_yz(u_w, u_bv), pad_yz(v_w, v_bv), nz_field, params
         )
+        if s == k - 1:
+            # Mirror the kernel: the final stage writes its output
+            # unpinned (out-of-domain y pad rows hold computed ring
+            # garbage in both implementations; callers slice the y
+            # interior). In the 1D x-chain the output is entirely
+            # in-domain and this changes nothing.
+            break
         gx = offsets[0] - m_out + jnp.arange(w_out)
-        valid = ((gx >= 0) & (gx < row))[:, None, None]
+        valid = ((gx >= 0) & (gx < row))[:, None, None] & valid_y
         u_w = jnp.where(valid, u_w, u_bv)
         v_w = jnp.where(valid, v_w, v_bv)
     return u_w, v_w

@@ -208,6 +208,24 @@ def exchange_x_slabs(
     return _exchange_dim(list(arrays), boundary_values, 0, ax, n, width)
 
 
+def exchange_slabs(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    dim: int,
+    ax: str,
+    n: int,
+    width: int,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """``width``-wide (lo, hi) boundary slabs along any one mesh axis —
+    the axis-generic form of :func:`exchange_x_slabs` (one ppermute per
+    direction carries all arrays; global-edge shards get the frozen
+    boundary constant). The xy-chain exchanges its y halos with this
+    before exchanging x slabs of the y-padded fields, so the x slabs
+    carry the y corner data the in-kernel ring recompute needs. Must be
+    called inside ``shard_map``."""
+    return _exchange_dim(list(arrays), boundary_values, dim, ax, n, width)
+
+
 def exchange_faces(
     arrays: Sequence[jnp.ndarray],
     boundary_values: Sequence[float],

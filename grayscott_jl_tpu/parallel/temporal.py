@@ -1,38 +1,43 @@
-"""Halo-amortized k-deep temporal blocking for the sharded Pallas path.
+"""Cross-shard temporal blocking for the sharded Pallas path.
 
-The fused Pallas kernel (``ops/pallas_stencil.py``) reads interior-shaped
-blocks plus 1-thick resolved halo faces — its Mosaic layout needs the
-lane dimension to stay 128-aligned, so unlike the XLA language it cannot
-consume the shrinking ghost-padded windows the XLA chain uses
-(``simulation.py``). A step-at-a-time sharded run therefore pays one
-6-``ppermute`` exchange per step. This module cuts that by ``k``: ONE
-k-deep ghost exchange feeds ``k`` kernel steps —
+The fused Pallas kernel (``ops/pallas_stencil.py``) chains ``k``
+timesteps per HBM pass by walking shrinking windows along its leading
+(x) axis. Crossing shard boundaries with that chain needs k-deep halo
+data on every sharded axis — and what that costs depends on which
+*Mosaic tiling dimension* the axis lands on:
 
-1. ``halo.halo_pad_wide`` materializes a depth-k padded frame per field
-   (edge/corner ghosts included, via the sequential corner-propagation
-   ordering the reference's xy/xz/yz exchange also has,
-   ``communication.jl:138-199``);
-2. each stage s advances the interior n^3 block with the Pallas kernel,
-   its 6 faces sliced from the frame (:func:`_frame_faces`);
-3. between stages, the frame's ghost SHELL — O(k * n^2) cells — advances
-   one step in XLA (:func:`_advance_frame`): six overlapping stencil
-   windows around the shell, reassembled with the kernel's interior into
-   a depth-(m-1) frame, out-of-domain ghosts re-frozen
-   (:func:`freeze_out_of_domain`). Position-keyed noise (``ops/noise.py``)
-   makes the shell's recomputed cells identical to what the owning
-   neighbor computed, so the chain reproduces the step-at-a-time
-   trajectory exactly.
+* **x** (untiled leading dim): free — the x-chain mode consumes k-wide
+  exchanged x slabs directly (round 3);
+* **y** (sublane dim, 8/16-granularity): cheap — :func:`xy_chain`
+  extends the operand by a k-deep exchanged y halo (rounded up to the
+  sublane tile with boundary-constant filler rows) and the kernel's
+  mid-stage global-coordinate pinning makes in-domain pad rows
+  ring-recompute the y neighbor's values, so the in-kernel chain
+  crosses y shard boundaries at a few percent of plane-area overhead;
+* **z** (128-lane dim): expensive — a ±k z pad would round the lane
+  extent up to the next 128 multiple (up to ~50% wasted vector work),
+  so z shard boundaries are instead handled OUTSIDE the kernel:
+  the kernel runs with frozen z edges, contaminating the outermost k
+  z-cells per sharded z side (one cell per stage), and
+  :func:`window_chain` recomputes those k-wide bands in XLA from a
+  corner-propagated k-deep frame (``halo.halo_pad_wide``) — O(k * n^2)
+  cells per side per round against the kernel's O(n^3).
 
-Per ``k`` steps: one exchange + k kernel HBM passes + O(k^2 n^2) XLA
-shell math — vs k exchanges for step-at-a-time. The XLA kernel language
-amortizes the same way but without the kernel/shell split (its whole
-window shrinks, ``simulation.py``); both reproduce the stepwise
-trajectory, noise included.
+Per ``k`` steps: ONE exchange round (4 ppermutes for an (n, m, 1)
+mesh, 6 with z sharded — the per-step cost the reference pays in
+``communication.jl:138-199``), one fused k-deep kernel pass, and — only
+when z is sharded — two thin XLA band chains. Everything reproduces the
+step-at-a-time trajectory exactly (position-keyed noise,
+``ops/noise.py``), which the CPU-mesh bitwise tests assert.
+
+This supersedes the round-3 design (single-step kernel launches with an
+XLA-advanced ghost shell), which paid a measured 1.46x per-stage
+penalty because in-kernel fusion stopped at every shard boundary.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Callable, Sequence, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -56,111 +61,125 @@ def freeze_out_of_domain(arr, bv, m, axis_names, axis_sizes):
     return out
 
 
-def _frame_faces(u_w, v_w, m, shape):
-    """1-thick kernel faces adjacent to the interior block, sliced from
-    depth-``m`` padded frames, in ``fused_step``'s face order
-    (u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, ..., v_zhi)."""
-
-    def face(w, dim, lo):
-        sl = [slice(m, m + s) for s in shape]
-        sl[dim] = (
-            slice(m - 1, m) if lo else slice(m + shape[dim], m + shape[dim] + 1)
-        )
-        return w[tuple(sl)]
-
-    return tuple(
-        face(w, dim, lo)
-        for dim in range(3)
-        for w in (u_w, v_w)
-        for lo in (True, False)
-    )
-
-
-def _advance_frame(
-    u_w, v_w, u_new, v_new, params, *, m, step_idx, offs, use_noise,
-    unit_noise, axis_names, axis_sizes, boundaries,
-):
-    """Advance a depth-``m`` frame one step: the six ghost-shell regions
-    in XLA (six overlapping stencil windows), the interior from the
-    already-kernel-advanced ``u_new``/``v_new``; returns depth-(m-1)
-    frames with out-of-domain ghosts re-frozen."""
-    from ..ops import stencil
-
-    nx, ny, nz = u_new.shape
-    X, Y, Z = nx + 2 * m, ny + 2 * m, nz + 2 * m
-    d = m - 1
-
-    def upd(usl, vsl, origin):
-        """One XLA stencil step on a window (returns its interior)."""
-        if use_noise:
-            shape = tuple(s - 2 for s in usl.shape)
-            nzf = params.noise * unit_noise(step_idx, origin, shape)
-        else:
-            nzf = jnp.asarray(0.0, u_new.dtype)
-        return stencil.reaction_update(usl, vsl, nzf, params)
-
-    o = offs
-
-    def go(dx, dy, dz):
-        return (o[0] + dx, o[1] + dy, o[2] + dz)
-
-    # x shells span the full frame y/z extent (their outputs carry the
-    # new frame's corners); y shells span full z; z shells are core-only.
-    xl_u, xl_v = upd(u_w[0:m + 1], v_w[0:m + 1], go(-d, -d, -d))
-    xh_u, xh_v = upd(u_w[X - m - 1:], v_w[X - m - 1:], go(nx, -d, -d))
-    xsl = slice(m - 1, m + nx + 1)
-    yl_u, yl_v = upd(u_w[xsl, 0:m + 1], v_w[xsl, 0:m + 1], go(0, -d, -d))
-    yh_u, yh_v = upd(u_w[xsl, Y - m - 1:], v_w[xsl, Y - m - 1:], go(0, ny, -d))
-    ysl = slice(m - 1, m + ny + 1)
-    zl_u, zl_v = upd(
-        u_w[xsl, ysl, 0:m + 1], v_w[xsl, ysl, 0:m + 1], go(0, 0, -d)
-    )
-    zh_u, zh_v = upd(
-        u_w[xsl, ysl, Z - m - 1:], v_w[xsl, ysl, Z - m - 1:], go(0, 0, nz)
-    )
-
-    def assemble(zl, core, zh, yl, yh, xl, xh):
-        inner = jnp.concatenate([zl, core, zh], axis=2)
-        mid = jnp.concatenate([yl, inner, yh], axis=1)
-        return jnp.concatenate([xl, mid, xh], axis=0)
-
-    u_bv, v_bv = boundaries
-    u_out = assemble(zl_u, u_new, zh_u, yl_u, yh_u, xl_u, xh_u)
-    v_out = assemble(zl_v, v_new, zh_v, yl_v, yh_v, xl_v, xh_v)
-    u_out = freeze_out_of_domain(u_out, u_bv, d, axis_names, axis_sizes)
-    v_out = freeze_out_of_domain(v_out, v_bv, d, axis_names, axis_sizes)
-    return u_out, v_out
-
-
-def pallas_chain(
-    u, v, params, *, depth, step, offs, use_noise, unit_noise,
-    kernel_step, axis_names, axis_sizes,
+def window_chain(
+    u_w, v_w, params, *, depth, step, origin, row, use_noise, unit_noise,
     boundaries: Sequence[float],
 ):
-    """``depth`` sharded Pallas kernel steps from ONE depth-wide halo
-    exchange; see module docstring. ``kernel_step(u, v, step_idx, faces)``
-    runs the fused kernel on an interior block; ``unit_noise(step_idx,
-    origin, shape)`` draws from the shared position-keyed stream. Must be
-    called inside ``shard_map``."""
-    if depth == 1:
-        faces = halo.exchange_faces(
-            (u, v), boundaries, axis_names, axis_sizes
-        )
-        return kernel_step(u, v, step, faces)
+    """``depth`` XLA steps on a ghost-inclusive window, shrinking one
+    cell per side per stage; returns the (shape - 2*depth) core.
 
-    u_w, v_w = halo.halo_pad_wide(
-        (u, v), boundaries, axis_names, axis_sizes, depth
-    )
-    shape = u.shape
+    ``origin`` (int32[3]) is the global coordinate of ``u_w[0, 0, 0]``;
+    after each stage, cells outside the global domain are pinned to the
+    frozen ``boundaries`` values by global-coordinate masks (the
+    windowed form of :func:`freeze_out_of_domain` that works on any
+    offset sub-box of a shard). Same op order and position-keyed noise
+    as every other path — bitwise-exact against the stepwise
+    trajectory, so a band it computes can be stitched next to
+    kernel-computed cells seamlessly."""
+    from ..ops import stencil
+
+    u_bv, v_bv = boundaries
+    origin = jnp.asarray(origin, jnp.int32)
     for s in range(depth):
-        m = depth - s
-        faces = _frame_faces(u_w, v_w, m, shape)
-        u, v = kernel_step(u, v, step + s, faces)
-        if m > 1:
-            u_w, v_w = _advance_frame(
-                u_w, v_w, u, v, params, m=m, step_idx=step + s, offs=offs,
+        shape = tuple(d - 2 for d in u_w.shape)
+        o = origin + (s + 1)
+        if use_noise:
+            nzf = params.noise * unit_noise(step + s, o, shape)
+        else:
+            nzf = jnp.asarray(0.0, u_w.dtype)
+        u_w, v_w = stencil.reaction_update(u_w, v_w, nzf, params)
+        valid = None
+        for dim in range(3):
+            g = o[dim] + jnp.arange(shape[dim])
+            vd = ((g >= 0) & (g < row)).reshape(
+                tuple(shape[dim] if d == dim else 1 for d in range(3))
+            )
+            valid = vd if valid is None else valid & vd
+        u_w = jnp.where(valid, u_w, jnp.asarray(u_bv, u_w.dtype))
+        v_w = jnp.where(valid, v_w, jnp.asarray(v_bv, v_w.dtype))
+    return u_w, v_w
+
+
+def xy_chain(
+    u, v, params, *, depth, step, offs, chain_kernel: Callable,
+    use_noise, unit_noise, row, axis_names, axis_sizes,
+    boundaries: Sequence[float], sublane: int = 8,
+):
+    """``depth`` fused steps on an (n, m, p) sharded block: in-kernel
+    chain across x and y shard boundaries, XLA band correction on
+    sharded z sides. See the module docstring for the design.
+
+    ``chain_kernel(u_p, v_p, faces4, step, offs_p)`` runs the fused
+    kernel (or its bitwise XLA fallback) at ``fuse=depth`` on the
+    y-extended operand; ``unit_noise(step_idx, origin, shape)`` draws
+    from the shared position-keyed stream. Must be called inside
+    ``shard_map``."""
+    nx, ny, nz = u.shape
+    dims = axis_sizes
+    k = depth
+    u_bv, v_bv = boundaries
+    z_sharded = dims[2] > 1
+
+    if z_sharded:
+        # One corner-propagated k-deep frame serves the kernel operand,
+        # its x faces, AND the z-band windows (6 ppermutes total).
+        u_w, v_w = halo.halo_pad_wide(
+            (u, v), boundaries, axis_names, dims, k
+        )
+        u_p = u_w[k:k + nx, :, k:k + nz]
+        v_p = v_w[k:k + nx, :, k:k + nz]
+        faces = (
+            u_w[0:k, :, k:k + nz], u_w[k + nx:, :, k:k + nz],
+            v_w[0:k, :, k:k + nz], v_w[k + nx:, :, k:k + nz],
+        )
+    else:
+        # Lean 4-ppermute build: k-wide y slabs first, then x slabs of
+        # the y-padded fields so the x faces carry y corner data.
+        (u_ylo, u_yhi), (v_ylo, v_yhi) = halo.exchange_slabs(
+            [u, v], boundaries, 1, axis_names[1], dims[1], k
+        )
+        u_p = jnp.concatenate([u_ylo, u, u_yhi], axis=1)
+        v_p = jnp.concatenate([v_ylo, v, v_yhi], axis=1)
+        pairs = halo.exchange_slabs(
+            [u_p, v_p], boundaries, 0, axis_names[0], dims[0], k
+        )
+        faces = (pairs[0][0], pairs[0][1], pairs[1][0], pairs[1][1])
+
+    # Round the y extent up to the sublane tile with boundary-constant
+    # filler rows at the high end — Mosaic needs sublane-aligned planes,
+    # and extra rows only push the contamination front farther from the
+    # interior (they are sliced away with the rest of the pad).
+    extra = (-(ny + 2 * k)) % sublane
+    if extra:
+        def pad_y(a, bv):
+            return jnp.pad(
+                a, ((0, 0), (0, extra), (0, 0)), constant_values=bv
+            )
+
+        u_p, v_p = pad_y(u_p, u_bv), pad_y(v_p, v_bv)
+        faces = (pad_y(faces[0], u_bv), pad_y(faces[1], u_bv),
+                 pad_y(faces[2], v_bv), pad_y(faces[3], v_bv))
+
+    offs_p = jnp.stack([offs[0], offs[1] - k, offs[2]])
+    u_o, v_o = chain_kernel(u_p, v_p, faces, step, offs_p)
+    u_o = u_o[:, k:k + ny, :]
+    v_o = v_o[:, k:k + ny, :]
+
+    if z_sharded:
+        # The kernel ran with frozen z edges: its outermost k z-cells
+        # are stale wherever a z neighbor exists (and exactly correct
+        # on global z edges). Recompute both k-wide bands from the
+        # frame — bitwise the same values, so overwriting
+        # unconditionally is correct on edge shards too.
+        base = jnp.stack([offs[0] - k, offs[1] - k, offs[2]])
+        for z0, dz in ((0, -k), (nz - k, nz - 2 * k)):
+            bu, bv_ = window_chain(
+                u_w[:, :, z0:z0 + 3 * k], v_w[:, :, z0:z0 + 3 * k],
+                params, depth=k, step=step,
+                origin=base.at[2].add(dz), row=row,
                 use_noise=use_noise, unit_noise=unit_noise,
-                axis_names=axis_names, axis_sizes=axis_sizes,
                 boundaries=boundaries,
             )
-    return u, v
+            u_o = lax.dynamic_update_slice(u_o, bu, (0, 0, z0))
+            v_o = lax.dynamic_update_slice(v_o, bv_, (0, 0, z0))
+    return u_o, v_o

@@ -397,22 +397,55 @@ class Simulation:
                 return run_chain_rounds(chain, fuse, u, v)
 
             if sharded:
-                # Halo-amortized k-deep chain: ONE k-wide exchange feeds
-                # k kernel steps (the ghost shell advances in XLA between
-                # kernel stages, ``parallel/temporal.pallas_chain``) —
-                # exchange count drops 1/k vs step-at-a-time, matching
-                # the XLA language's chain depth.
-                fuse = min(
-                    default_fuse(), max(nsteps, 1),
-                    min(self.domain.local_shape),
+                # xy-chain (+ z-band correction when z is sharded): the
+                # in-kernel k-deep chain crosses x AND y shard
+                # boundaries (y is the cheap sublane dim), so every
+                # sharded stage runs at the fused single-chip schedule;
+                # only sharded-z sides pay a thin XLA band recompute
+                # (``parallel/temporal.xy_chain``). One exchange round
+                # per k steps, like the XLA language's chain.
+                block = self.domain.local_shape
+                cap = [block[0], block[1]]
+                if dims[2] > 1:
+                    # z-band windows need local nz >= 2*depth.
+                    cap.append(block[2] // 2)
+                # Floor of 1: a cap of 0 (local nz == 1 on a z-sharded
+                # mesh) must degrade to the depth-1 12-face path, not
+                # divide by zero in run_chain_rounds.
+                fuse = max(1, min(default_fuse(), max(nsteps, 1), *cap))
+                sublane = 16 if self.dtype == jnp.bfloat16 else 8
+                feasible = pallas_stencil.max_feasible_fuse_ypad(
+                    *block, jnp.dtype(self.dtype).itemsize, fuse, sublane,
                 )
+                if feasible < fuse:
+                    pallas_stencil._warn_once(
+                        f"xy-chain depth capped at {max(feasible, 1)} "
+                        f"(fuse={fuse} does not fit VMEM for local grid "
+                        f"{block} with its y halo)"
+                    )
+                    fuse = max(feasible, 1)
 
                 def chain(u, v, step, depth):
-                    return temporal.pallas_chain(
+                    if depth == 1:
+                        faces12 = halo.exchange_faces(
+                            (u, v), boundaries, AXIS_NAMES, dims
+                        )
+                        return kernel_step(u, v, step, faces12)
+
+                    def chain_kernel(u_p, v_p, faces4, stp, offs_p):
+                        return pallas_stencil.fused_step(
+                            u_p, v_p, params, step_seeds(stp), faces4,
+                            use_noise=use_noise,
+                            allow_interpret=allow_interpret,
+                            fuse=depth, offsets=offs_p, row=L,
+                        )
+
+                    return temporal.xy_chain(
                         u, v, params, depth=depth, step=step, offs=offs,
-                        use_noise=use_noise, unit_noise=unit_noise,
-                        kernel_step=kernel_step, axis_names=AXIS_NAMES,
-                        axis_sizes=dims, boundaries=boundaries,
+                        chain_kernel=chain_kernel, use_noise=use_noise,
+                        unit_noise=unit_noise, row=L,
+                        axis_names=AXIS_NAMES, axis_sizes=dims,
+                        boundaries=boundaries, sublane=sublane,
                     )
 
                 return run_chain_rounds(chain, fuse, u, v)

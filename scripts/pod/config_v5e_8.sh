@@ -17,18 +17,20 @@ export TPU_NAME="${TPU_NAME:-gs-v5e-8}"
 export ZONE="${ZONE:-us-west4-a}"
 export ACCELERATOR_TYPE="v5litepod-8"
 
-# 1D x-sharded mesh: at <=16 chips the Pallas kernel's in-kernel fused
-# chain can cross the shard boundary (x halos are its leading-dim
-# element), so sharded steps run at the fused single-chip schedule —
-# the fastest pod-slice layout for kernel_language=Pallas (projected
-# weak-scaling 0.80-0.90 vs 0.67 on the 3D mesh, BASELINE.md). Unset
-# to fall back to the MPI-style dims_create 3D factorization (the
-# right choice for the XLA language and for >16 chips).
-export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
+# 2D (x,y)-sharded mesh: the round-4 xy-chain runs the in-kernel fused
+# schedule across BOTH sharded axes (y rides the cheap sublane tiling;
+# z stays unsharded so no 128-lane padding and no band correction) —
+# the fastest layout for kernel_language=Pallas at this scale:
+# projected weak-scaling 0.82 at L=256 vs 0.80 for the 1D x-chain and
+# 0.67 for the retired per-stage 3D design (benchmarks/ici_model.py
+# sweep, r4 artifact). Unset to fall back to the MPI-style dims_create
+# 3D factorization (the right choice for the XLA language).
+export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-4,2,1}"
 
-# Temporal-blocking depth for the single-block Pallas path; sharded runs
-# use the k-deep wide-halo exchange with the same depth (simulation.py).
-export GS_FUSE="${GS_FUSE:-5}"
+# Temporal-blocking depth. k=4 keeps the xy-chain's y halo exactly one
+# sublane tile (2k = 8 rows, zero alignment filler) — the sweep's
+# optimum for every xy-sharded config.
+export GS_FUSE="${GS_FUSE:-4}"
 # Per-phase wall-clock + cell-updates/s JSON, one file per process.
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # Uncomment for a jax.profiler device trace of the run:

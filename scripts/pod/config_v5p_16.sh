@@ -17,23 +17,22 @@ export TPU_NAME="${TPU_NAME:-gs-v5p-16}"
 export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-16"
 
-# 1D x-sharded mesh: the Pallas kernel's in-kernel fused chain can
-# cross the shard boundary when x faces are the only halos (they ride
-# the leading dim), so sharded steps run at the fused single-chip
-# schedule — the fastest layout for kernel_language=Pallas at this
-# scale (BASELINE.md "ICI weak scaling"). Unset to fall back to the
-# MPI-style dims_create 3D factorization (the right choice for the
-# XLA language and for >16 chips). Ignored by single-device runs.
-export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-8,1,1}"
+# 2D (x,y)-sharded mesh: the round-4 xy-chain runs the in-kernel fused
+# schedule across BOTH sharded axes — local blocks 128x256x512, the
+# mixed-mesh sweep's best for kernel_language=Pallas at this config
+# (projected weak-scaling 0.895 vs 0.858 for the 1D x-chain, whose
+# 64x512x512 local caps the feasible depth at 3, and 0.68 for the
+# retired per-stage 3D design — benchmarks/ici_model.py r4 artifact).
+# Unset to fall back to the MPI-style dims_create 3D factorization
+# (the right choice for the XLA language). Ignored by single-device
+# runs.
+export GS_TPU_MESH_DIMS="${GS_TPU_MESH_DIMS:-4,2,1}"
 
-# Chain depth. NOTE the two kernel languages diverge on this config:
-# the XLA wide-halo chain has no VMEM constraint and wants the measured
-# optimum k=5, while the Pallas x-chain on the 64x512x512-f32 local
-# block only fits Mosaic's VMEM at fuse=3 (bx=4) — the dispatch caps it
-# there automatically (simulation.py max_feasible_fuse guard, with a
-# warning), trimming the exchange width to match. So 5 is right for
-# both: Pallas runs depth 3 either way, XLA keeps its full
-# amortization.
-export GS_FUSE="${GS_FUSE:-5}"
+# Chain depth. k=4 keeps the xy-chain's y halo exactly one sublane
+# tile (2k = 8 rows, zero alignment filler) and fits VMEM at this
+# local shape (the dispatch would cap an infeasible depth with a
+# warning either way); the XLA wide-halo chain is depth-insensitive
+# between 4 and 5, so one export serves both languages.
+export GS_FUSE="${GS_FUSE:-4}"
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # export GS_TPU_PROFILE=/tmp/gs_trace

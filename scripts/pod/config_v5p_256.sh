@@ -17,6 +17,20 @@ export TPU_NAME="${TPU_NAME:-gs-v5p-256}"
 export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-256"
 
+# Kernel-language mesh choice at 128 chips / L=1024 (the ici_model.py
+# r4 mixed-mesh sweep over all 128-chip factorizations):
+#   * XLA kernel: leave GS_TPU_MESH_DIMS unset -> dims_create 8x4x4
+#     (projected weak-scaling 0.994 — the >=90% target holder at this
+#     exact config).
+#   * Pallas kernel: export GS_TPU_MESH_DIMS=16,8,1 + GS_FUSE=4 — the
+#     xy-chain (in-kernel fused schedule across x AND y, z unsharded)
+#     projects 0.829, up from 0.68 for the retired per-stage design.
+#     At 4.2M cells/chip the remaining gap is structural surface work
+#     (y-halo sublane tile >= 8 rows + x ring + comm); at L=2048 the
+#     sweep's best (8,8,2 with z bands, k=3) recovers to 0.85 and the
+#     chain approaches the target regime as locals grow.
+# export GS_TPU_MESH_DIMS=16,8,1
+
 export GS_FUSE="${GS_FUSE:-5}"
 export GS_TPU_STATS="${GS_TPU_STATS:-/tmp/gs_stats.json}"
 # export GS_TPU_PROFILE=/tmp/gs_trace

@@ -434,6 +434,69 @@ def test_x_chain_with_boundary_faces_equals_no_faces_chain():
     np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
 
 
+@pytest.mark.parametrize("use_noise", [False, True])
+def test_xy_chain_kernel_matches_fallback(use_noise, monkeypatch):
+    """The xy-chain mode of the Mosaic kernel body (interpret mode):
+    a y-EXTENDED operand — rows covering a y halo below and above the
+    interior plus sublane filler, global y origin negative — against
+    the XLA xy-chain fallback. Exercises the in-kernel global-y
+    mid-stage pinning that lets the chain cross y shard boundaries
+    (``temporal.xy_chain`` builds exactly this operand). ny=24 = 8
+    interior + 2*3 halo + 2 filler rows at the hi end stays
+    sublane-aligned the way the dispatch pads it."""
+    nx, k = 32, 3
+    ny_int, nz = 8, 128
+    ny = ny_int + 2 * k + 2  # interior + halos + alignment filler
+    u, v, faces, params, seeds = _xchain_inputs(nx, ny, nz, k)
+    # Interior shard in x AND y of a 64^3 global grid: y origin is the
+    # block's origin minus the halo depth.
+    offs = jnp.asarray([16, 8 - k, 0], jnp.int32)
+    row = jnp.int32(64)
+    monkeypatch.setenv("GS_BX", "16")  # multi-slab face-DMA branches
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
+        offsets=offs, row=row,
+    )
+    monkeypatch.undo()
+    b = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=use_noise,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[0]), np.asarray(b[0]), rtol=1e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[1]), np.asarray(b[1]), rtol=1e-4, atol=2e-6
+    )
+
+
+def test_xy_chain_edge_block_pins_out_of_domain_rows(monkeypatch):
+    """A global-y-EDGE block's y-extended operand has out-of-domain pad
+    rows (gy < 0): the kernel must pin them to the boundary value each
+    mid stage — so feeding boundary-constant y-halo content must equal
+    the fallback bitwise on the interior rows."""
+    nx, k = 16, 2
+    ny_int, nz = 12, 128
+    ny = ny_int + 2 * k  # 16, already sublane-aligned
+    u, v, _, params, seeds = _xchain_inputs(nx, ny, nz, k)
+    bv = ((stencil.U_BOUNDARY,) * 2 + (stencil.V_BOUNDARY,) * 2)
+    faces = tuple(jnp.full((k, ny, nz), b, jnp.float32) for b in bv)
+    # y origin -k: rows [0, k) are outside the global domain.
+    offs = jnp.asarray([0, -k, 0], jnp.int32)
+    row = jnp.int32(64)
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        offsets=offs, row=row,
+    )
+    b = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        offsets=offs, row=row,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[0]), np.asarray(b[0]), rtol=1e-4, atol=2e-6
+    )
+
+
 def test_x_chain_rejects_bad_faces():
     u, v, faces, params, seeds = _xchain_inputs(k=3)
     with pytest.raises(ValueError, match="fuse >= 2"):

@@ -85,8 +85,8 @@ def test_sharded_temporal_blocking_matches_stepwise(noise, nsteps, lang):
 def test_sharded_deep_chain_matches_stepwise(depth, lang, monkeypatch):
     """Both sharded kernel languages chain ``GS_FUSE`` steps from ONE
     depth-wide halo exchange — the XLA language via shrinking extended
-    windows (``simulation.py``), Pallas via the kernel + XLA-advanced
-    ghost shell (``parallel/temporal.pallas_chain``). Deep chains
+    windows (``simulation.py``), Pallas via the in-kernel xy-chain plus
+    z-band correction (``parallel/temporal.xy_chain``). Deep chains
     (k > 2) must reproduce the step-at-a-time trajectory exactly,
     noise included, with a remainder chain for non-multiples. Stepwise
     baselines run with GS_FUSE=1 so only the fused side chains."""
@@ -219,6 +219,69 @@ def test_1d_xchain_fuse_equals_local_nx(monkeypatch):
     ref.iterate(8)
     np.testing.assert_array_equal(
         np.asarray(sh.get_fields()[0]), np.asarray(ref.get_fields()[0])
+    )
+
+
+@requires8
+@pytest.mark.parametrize("mesh", ["4,2,1", "2,4,1", "2,2,2", "1,2,4"])
+@pytest.mark.parametrize("depth", [2, 3])
+def test_xy_chain_sharded_matches_single_device(mesh, depth, monkeypatch):
+    """The cross-shard fused chain on 2D/3D meshes (round-4 design):
+    in-kernel chaining across x AND y shard boundaries (y-extended
+    operand), with XLA band recompute on sharded z sides. Bitwise
+    against single-device stepwise XLA at fuse >= 2 — on CPU the kernel
+    body is the XLA xy-chain fallback, the same elementwise program,
+    noise included. Meshes cover: both x+y sharded (4,2,1 / 2,4,1),
+    the full 3D case with z bands (2,2,2), and no x sharding at all
+    with z bands (1,2,4 — x faces are frozen constants)."""
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+    monkeypatch.setenv("GS_FUSE", str(depth))
+    sh = Simulation(
+        _settings(L=16, noise=0.1, kernel_language="Pallas"),
+        n_devices=8, seed=11,
+    )
+    assert sh.domain.dims == tuple(int(x) for x in mesh.split(","))
+    sh.iterate(depth + 1)  # one full chain round + a remainder chain
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    monkeypatch.delenv("GS_FUSE")
+    ref = Simulation(
+        _settings(L=16, noise=0.1, kernel_language="Plain"),
+        n_devices=1, seed=11,
+    )
+    for _ in range(depth + 1):
+        ref.iterate(1)
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[0]), np.asarray(ref.get_fields()[0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sh.get_fields()[1]), np.asarray(ref.get_fields()[1])
+    )
+
+
+@requires8
+def test_xy_chain_collective_count_is_four_per_k_steps(monkeypatch):
+    """The (n, m, 1) xy-chain's halo amortization as a compiled
+    invariant: one exchange round per k steps costs 2 ppermutes for the
+    y slabs + 2 for the x slabs of the y-padded fields — 4 total in the
+    chain-round fori_loop body (vs 6 for a z-sharded mesh's
+    corner-propagated frame), and nothing exchanges per step."""
+    import re
+
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "4,2,1")
+    monkeypatch.setenv("GS_FUSE", "4")
+    sim = Simulation(
+        _settings(L=16, noise=0.1, kernel_language="Pallas"), n_devices=8
+    )
+    runner = sim._runner(8)  # 2 chain rounds of k=4
+    txt = runner.lower(
+        sim.u, sim.v, sim.base_key, jnp.int32(0), sim.params
+    ).compile().as_text()
+    n_permutes = len(re.findall(r"collective-permute(?:-start)?\(", txt))
+    assert n_permutes == 4, (
+        f"expected one 4-ppermute xy exchange per 4-step chain, "
+        f"found {n_permutes} collective-permutes"
     )
 
 

@@ -319,3 +319,59 @@ def test_x_chain_kernel_on_hardware():
     )
     np.testing.assert_array_equal(np.asarray(c[0]), np.asarray(d[0]))
     np.testing.assert_array_equal(np.asarray(c[1]), np.asarray(d[1]))
+
+
+@requires_tpu
+def test_xy_chain_kernel_on_hardware():
+    """The Mosaic-compiled xy-chain (round 4): a y-EXTENDED operand —
+    interior + 2k-deep y halo + sublane filler rows, global y origin
+    negative — through the in-kernel chain with global-(x,y) mid-stage
+    ring pinning, against the XLA xy-chain fallback. This is the kernel
+    the (n, m, 1) pod meshes launch; catches Mosaic lowering faults in
+    the widened-plane slab walk that interpret mode cannot."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    nx, nz, k = 128, 256, 4
+    ny_int = 128
+    ny = ny_int + 2 * k  # 136 = 17 sublanes, already 8-aligned
+    s = Settings(L=512, noise=0.2, precision="Float32", backend="TPU",
+                 kernel_language="Pallas", Du=0.2, Dv=0.1, F=0.02,
+                 k=0.048, dt=1.0)
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(s, dtype)
+    key = jax.random.PRNGKey(17)
+    u = jax.random.uniform(key, (nx, ny, nz), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
+    faces = tuple(
+        jax.random.uniform(jax.random.fold_in(key, 2 + i), (k, ny, nz),
+                           dtype)
+        for i in range(4)
+    )
+    seeds = jnp.asarray([8, 4, 12], jnp.int32)
+    # Interior shard in x and y of the 512^3 global grid.
+    offs = jnp.asarray([128, 128 - k, 0], jnp.int32)
+    row = jnp.int32(512)
+
+    a = pallas_stencil.fused_step(
+        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        offsets=offs, row=row,
+    )
+    b = pallas_stencil._xla_xchain_fallback(
+        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        offsets=offs, row=row,
+    )
+    # Compare the y interior (the rows temporal.xy_chain consumes);
+    # pad rows carry ring values in both implementations but the
+    # comparison belongs on what downstream code reads.
+    np.testing.assert_allclose(
+        np.asarray(a[0][:, k:k + ny_int]), np.asarray(b[0][:, k:k + ny_int]),
+        rtol=1e-4, atol=2e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a[1][:, k:k + ny_int]), np.asarray(b[1][:, k:k + ny_int]),
+        rtol=1e-4, atol=2e-6,
+    )
