@@ -476,6 +476,13 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 gy = (lax.broadcasted_iota(jnp.int32, (1, ny, 1), 1)
                       + seeds[4])
                 valid_y = (gy >= 0) & (gy < seeds[6])
+                # z likewise (non-divisible L stores pad cells past the
+                # true domain inside the block; they must read back as
+                # the boundary value at every stage). All-true for
+                # divisible L.
+                gz = (lax.broadcasted_iota(jnp.int32, (1, 1, nz), 2)
+                      + seeds[5])
+                valid_yz = valid_y & ((gz >= 0) & (gz < seeds[6]))
             for s in range(k):
                 w_out = bx + 2 * (k - 1 - s)
                 if s == 0:
@@ -513,7 +520,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                     gx = g0 + iota_w
                     if x_chain:
                         gxg = seeds[3] + gx
-                        valid = ((gxg >= 0) & (gxg < seeds[6])) & valid_y
+                        valid = ((gxg >= 0) & (gxg < seeds[6])) & valid_yz
                     else:
                         valid = (gx >= 0) & (gx < nx)
 
@@ -823,6 +830,8 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
     v_w = jnp.concatenate([v_xlo, v, v_xhi], axis=0)
     gy = offsets[1] + jnp.arange(ny)
     valid_y = ((gy >= 0) & (gy < row))[None, :, None]
+    gz = offsets[2] + jnp.arange(nz)
+    valid_yz = valid_y & ((gz >= 0) & (gz < row))[None, None, :]
 
     def pad_yz(x, bv):
         return jnp.pad(
@@ -854,7 +863,7 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
             # in-domain and this changes nothing.
             break
         gx = offsets[0] - m_out + jnp.arange(w_out)
-        valid = ((gx >= 0) & (gx < row))[:, None, None] & valid_y
+        valid = ((gx >= 0) & (gx < row))[:, None, None] & valid_yz
         u_w = jnp.where(valid, u_w, u_bv)
         v_w = jnp.where(valid, v_w, v_bv)
     return u_w, v_w

@@ -8,10 +8,12 @@ plus a ``jax.sharding.Mesh`` — neighbor discovery is implicit in the mesh
 axes, and the halo exchange (``parallel/halo.py``) uses ``lax.ppermute``
 over ICI instead of ``MPI.Sendrecv!`` with derived datatypes.
 
-Block-size math uses integer arithmetic with remainder spread, fixing the
-reference's ``InexactError`` on non-divisible L (``communication.jl:73-87``,
-SURVEY defect #7). Note the *sharded* compute path additionally requires
-equal blocks (L divisible by dims) — see :func:`CartDomain.create`.
+Non-divisible L runs via **pad-and-mask** (r4): storage is padded to
+equal ``ceil(L/d)`` blocks per axis (SPMD needs equal shards), pad
+cells are pinned to the frozen boundary value by every step path, and
+outputs are clipped back to the true ``L^3`` domain — fixing the
+reference's ``InexactError`` on non-divisible L
+(``communication.jl:73-87``, SURVEY defect #7) with integer math.
 """
 
 from __future__ import annotations
@@ -48,14 +50,19 @@ def dims_create(nnodes: int, ndims: int = 3) -> Tuple[int, ...]:
 
 
 def block_size_offset(L: int, ndiv: int, coord: int) -> Tuple[int, int]:
-    """Size and 0-based global offset of block ``coord`` of ``L`` over ``ndiv``.
+    """TRUE-domain size and 0-based global offset of block ``coord`` of
+    ``L`` over ``ndiv``.
 
-    Remainder cells go to the lowest-coordinate blocks, matching the
-    reference's intent (``communication.jl:76-87``) with integer math.
+    Pad-and-mask scheme (r4): SPMD compute needs EQUAL per-shard blocks,
+    so storage is padded to ``ceil(L/ndiv) * ndiv`` and each block owns
+    the clip of its equal slice to ``[0, L)`` — the high-coordinate
+    block absorbs the shortfall. This actually runs non-divisible L on
+    the sharded path, where the reference's remainder-spread attempt
+    dies with InexactError (``communication.jl:73-87``, defect #7).
     """
-    base, rem = divmod(L, ndiv)
-    size = base + (1 if coord < rem else 0)
-    offset = base * coord + min(rem, coord)
+    b = -(-L // ndiv)  # ceil: the equal storage block
+    offset = min(b * coord, L)
+    size = max(0, min(L - offset, b))
     return size, offset
 
 
@@ -107,12 +114,15 @@ class CartDomain:
             dims = dims_create(n_devices, 3)
         if n_devices > 1:
             for d in dims:
-                if L % d != 0:
+                # Non-divisible L runs via pad-and-mask (storage padded
+                # to equal blocks, pad cells pinned to the boundary
+                # value); the only hard requirement is that every block
+                # owns at least one true-domain cell.
+                if -(-L // d) * (d - 1) >= L:
                     raise ValueError(
-                        f"L={L} must be divisible by mesh dims {dims} for the "
-                        "sharded path (the reference de facto requires this "
-                        "too: non-divisible L raises InexactError at "
-                        "communication.jl:73)"
+                        f"L={L} is too small for mesh dims {dims}: block "
+                        f"{d - 1} of axis size {d} would own no "
+                        "true-domain cells"
                     )
         return cls(L=L, dims=dims)
 
@@ -143,5 +153,20 @@ class CartDomain:
 
     @property
     def local_shape(self) -> Tuple[int, int, int]:
-        """Per-shard block shape (equal blocks; sharded path only)."""
-        return tuple(self.L // d for d in self.dims)
+        """Per-shard STORAGE block shape (equal blocks; sharded path
+        only). For non-divisible L this is ``ceil(L/d)`` — the block
+        includes pad cells past the true domain on the high edge."""
+        return tuple(-(-self.L // d) for d in self.dims)
+
+    @property
+    def storage_shape(self) -> Tuple[int, int, int]:
+        """Global padded array shape actually allocated when sharded:
+        ``local_shape * dims`` per axis (== (L, L, L) for divisible L).
+        Cells at global coordinate >= L are pad, pinned to the frozen
+        boundary value by the step paths and stripped from every
+        output."""
+        return tuple(-(-self.L // d) * d for d in self.dims)
+
+    @property
+    def padded(self) -> bool:
+        return self.storage_shape != (self.L,) * 3

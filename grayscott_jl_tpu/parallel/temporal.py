@@ -45,20 +45,24 @@ from jax import lax
 from . import halo
 
 
-def freeze_out_of_domain(arr, bv, m, axis_names, axis_sizes):
-    """Pin the outermost ``m`` ring positions to the frozen boundary
-    value where they fall outside the global domain (the reference's
-    ``MPI.PROC_NULL`` ghost semantics). Must run inside ``shard_map``."""
-    if m == 0:
-        return arr
-    out = arr
-    for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
-        idx = lax.axis_index(ax)
-        pos = lax.broadcasted_iota(jnp.int32, out.shape, dim)
-        lo = (pos < m) & (idx == 0)
-        hi = (pos >= out.shape[dim] - m) & (idx == n - 1)
-        out = jnp.where(lo | hi, jnp.asarray(bv, out.dtype), out)
-    return out
+def pin_out_of_domain(arr, bv, origin, row):
+    """Pin every cell whose GLOBAL coordinate falls outside ``[0, row)``
+    on any axis to the frozen boundary value (the reference's
+    ``MPI.PROC_NULL`` ghost semantics); ``origin`` (int32[3]) is the
+    global coordinate of ``arr[0, 0, 0]``.
+
+    Works on any offset sub-box of a shard, and — unlike a mesh-edge
+    ring mask — also pins **pad cells inside the block** (non-divisible
+    L stores a padded grid, ``parallel/domain.py``)."""
+    origin = jnp.asarray(origin, jnp.int32)
+    valid = None
+    for dim in range(3):
+        g = origin[dim] + jnp.arange(arr.shape[dim])
+        vd = ((g >= 0) & (g < row)).reshape(
+            tuple(arr.shape[dim] if d == dim else 1 for d in range(3))
+        )
+        valid = vd if valid is None else valid & vd
+    return jnp.where(valid, arr, jnp.asarray(bv, arr.dtype))
 
 
 def window_chain(
@@ -70,9 +74,8 @@ def window_chain(
 
     ``origin`` (int32[3]) is the global coordinate of ``u_w[0, 0, 0]``;
     after each stage, cells outside the global domain are pinned to the
-    frozen ``boundaries`` values by global-coordinate masks (the
-    windowed form of :func:`freeze_out_of_domain` that works on any
-    offset sub-box of a shard). Same op order and position-keyed noise
+    frozen ``boundaries`` values by :func:`pin_out_of_domain`'s
+    global-coordinate masks. Same op order and position-keyed noise
     as every other path — bitwise-exact against the stepwise
     trajectory, so a band it computes can be stitched next to
     kernel-computed cells seamlessly."""
@@ -88,15 +91,8 @@ def window_chain(
         else:
             nzf = jnp.asarray(0.0, u_w.dtype)
         u_w, v_w = stencil.reaction_update(u_w, v_w, nzf, params)
-        valid = None
-        for dim in range(3):
-            g = o[dim] + jnp.arange(shape[dim])
-            vd = ((g >= 0) & (g < row)).reshape(
-                tuple(shape[dim] if d == dim else 1 for d in range(3))
-            )
-            valid = vd if valid is None else valid & vd
-        u_w = jnp.where(valid, u_w, jnp.asarray(u_bv, u_w.dtype))
-        v_w = jnp.where(valid, v_w, jnp.asarray(v_bv, v_w.dtype))
+        u_w = pin_out_of_domain(u_w, u_bv, o, row)
+        v_w = pin_out_of_domain(v_w, v_bv, o, row)
     return u_w, v_w
 
 

@@ -251,13 +251,17 @@ class Simulation:
             )
 
         dom = self.domain
-        gshape = (L, L, L)
+        # Non-divisible L stores a padded grid (equal blocks, pad cells
+        # at global coords >= L held at the boundary value — exactly
+        # what init_fields produces for out-of-seed cells).
+        gshape = dom.storage_shape
 
         def make(field: str):
             def cb(index):
                 offsets = tuple(s.start or 0 for s in index)
                 sizes = tuple(
-                    (s.stop or L) - (s.start or 0) for s in index
+                    (s.stop or g) - (s.start or 0)
+                    for s, g in zip(index, gshape)
                 )
                 u, v = grayscott.init_fields(
                     L, dtype, offsets=offsets, sizes=sizes
@@ -298,6 +302,20 @@ class Simulation:
             )
         else:
             offs = jnp.zeros((3,), jnp.int32)
+
+        padded = sharded and self.domain.padded
+
+        def pin_block(u, v):
+            """Re-pin the block's pad cells (global coords >= L) to the
+            boundary value — required after every chain round with
+            non-divisible L: the chain's final stage writes them
+            unpinned, and the next round's stencil reads them as the
+            frozen ghost shell."""
+            if not padded:
+                return u, v
+            u = temporal.pin_out_of_domain(u, boundaries[0], offs, L)
+            v = temporal.pin_out_of_domain(v, boundaries[1], offs, L)
+            return u, v
 
         def unit_noise(step_idx, offsets, shape):
             return noise_ops.uniform_pm1_block(
@@ -381,18 +399,18 @@ class Simulation:
                         faces12 = halo.exchange_faces(
                             (u, v), boundaries, AXIS_NAMES, dims
                         )
-                        return kernel_step(u, v, step, faces12)
+                        return pin_block(*kernel_step(u, v, step, faces12))
                     pairs = halo.exchange_x_slabs(
                         (u, v), boundaries, AXIS_NAMES[0], dims[0], depth
                     )
                     faces4 = (pairs[0][0], pairs[0][1],
                               pairs[1][0], pairs[1][1])
-                    return pallas_stencil.fused_step(
+                    return pin_block(*pallas_stencil.fused_step(
                         u, v, params, step_seeds(step), faces4,
                         use_noise=use_noise,
                         allow_interpret=allow_interpret,
                         fuse=depth, offsets=offs, row=L,
-                    )
+                    ))
 
                 return run_chain_rounds(chain, fuse, u, v)
 
@@ -430,7 +448,7 @@ class Simulation:
                         faces12 = halo.exchange_faces(
                             (u, v), boundaries, AXIS_NAMES, dims
                         )
-                        return kernel_step(u, v, step, faces12)
+                        return pin_block(*kernel_step(u, v, step, faces12))
 
                     def chain_kernel(u_p, v_p, faces4, stp, offs_p):
                         return pallas_stencil.fused_step(
@@ -440,13 +458,13 @@ class Simulation:
                             fuse=depth, offsets=offs_p, row=L,
                         )
 
-                    return temporal.xy_chain(
+                    return pin_block(*temporal.xy_chain(
                         u, v, params, depth=depth, step=step, offs=offs,
                         chain_kernel=chain_kernel, use_noise=use_noise,
                         unit_noise=unit_noise, row=L,
                         axis_names=AXIS_NAMES, axis_sizes=dims,
                         boundaries=boundaries, sublane=sublane,
-                    )
+                    ))
 
                 return run_chain_rounds(chain, fuse, u, v)
 
@@ -490,7 +508,9 @@ class Simulation:
                 nz = params.noise * unit_noise(step0 + i, offs, u.shape)
             else:
                 nz = jnp.asarray(0.0, u.dtype)
-            return stencil.reaction_update(u_pad, v_pad, nz, params)
+            return pin_block(
+                *stencil.reaction_update(u_pad, v_pad, nz, params)
+            )
 
         if not sharded or nsteps < 2:
             return lax.fori_loop(0, nsteps, single_step, (u, v))
@@ -520,12 +540,18 @@ class Simulation:
                 else:
                     nz = jnp.asarray(0.0, u.dtype)
                 u_w, v_w = stencil.reaction_update(u_w, v_w, nz, params)
-                u_w = temporal.freeze_out_of_domain(
-                    u_w, stencil.U_BOUNDARY, m_out, AXIS_NAMES, dims
-                )
-                v_w = temporal.freeze_out_of_domain(
-                    v_w, stencil.V_BOUNDARY, m_out, AXIS_NAMES, dims
-                )
+                # Global-coordinate pinning: ring cells outside the
+                # domain AND, for non-divisible L, pad cells inside the
+                # block — both must read back as the frozen ghost. The
+                # final stage (m_out == 0) has no ring, so divisible-L
+                # runs skip its provably-all-true mask.
+                if m_out or padded:
+                    u_w = temporal.pin_out_of_domain(
+                        u_w, stencil.U_BOUNDARY, offs - m_out, L
+                    )
+                    v_w = temporal.pin_out_of_domain(
+                        v_w, stencil.V_BOUNDARY, offs - m_out, L
+                    )
             return u_w, v_w
 
         return run_chain_rounds(chain, fuse, u, v)
@@ -597,6 +623,7 @@ class Simulation:
         yields one whole-grid block.
         """
         jax.block_until_ready((self.u, self.v))
+        L = self.settings.L
         v_shards = {
             tuple(s.index if isinstance(s.index, tuple) else (s.index,)):
                 s for s in self.v.addressable_shards
@@ -608,15 +635,20 @@ class Simulation:
             )
             offsets = tuple(sl.start or 0 for sl in sh.index)
             sizes = tuple(
-                (sl.stop or self.settings.L) - (sl.start or 0)
-                for sl in sh.index
+                (sl.stop or g) - (sl.start or 0)
+                for sl, g in zip(sh.index, self.u.shape)
             )
+            # Clip to the true domain: non-divisible L stores pad cells
+            # past L on the high edge of the last block per axis; they
+            # are framework internals and never leave the process.
+            true = tuple(min(L - o, s) for o, s in zip(offsets, sizes))
+            sl = tuple(slice(0, t) for t in true)
             out.append(
                 (
                     offsets,
-                    sizes,
-                    np.asarray(sh.data),
-                    np.asarray(v_shards[key].data),
+                    true,
+                    np.asarray(sh.data)[sl],
+                    np.asarray(v_shards[key].data)[sl],
                 )
             )
         return out
@@ -633,23 +665,35 @@ class Simulation:
             )
             return
 
-        def make(name: str):
+        storage = self.domain.storage_shape
+        L = self.settings.L
+
+        def make(name: str, bv: float):
             def cb(index):
                 start = [s.start or 0 for s in index]
                 count = [
-                    (s.stop or self.settings.L) - (s.start or 0)
-                    for s in index
+                    (s.stop or g) - (s.start or 0)
+                    for s, g in zip(index, storage)
                 ]
-                return reader.get(
-                    name, step=step_index, start=start, count=count
+                # The store holds the true L^3 domain; pad cells (only
+                # present for non-divisible L) are reconstructed at the
+                # boundary value, exactly as a fresh init would.
+                true = [min(L - st, c) for st, c in zip(start, count)]
+                block = reader.get(
+                    name, step=step_index, start=start, count=true
                 ).astype(self.dtype)
+                if tuple(true) != tuple(count):
+                    buf = np.full(count, bv, dtype=self.dtype)
+                    buf[tuple(slice(0, t) for t in true)] = block
+                    return buf
+                return block
 
             return jax.make_array_from_callback(
-                (self.settings.L,) * 3, self.field_sharding, cb
+                storage, self.field_sharding, cb
             )
 
-        self.u = make("u")
-        self.v = make("v")
+        self.u = make("u", stencil.U_BOUNDARY)
+        self.v = make("v", stencil.V_BOUNDARY)
         self.step = int(step)
 
     def restore(self, u: np.ndarray, v: np.ndarray, step: int) -> None:
@@ -663,16 +707,31 @@ class Simulation:
                 f"Checkpoint shapes u={u.shape}, v={v.shape} do not match "
                 f"L={self.settings.L}"
             )
+        if self.sharded and self.domain.padded:
+            # Rebuild the pad shell at the boundary value (the stored
+            # arrays cover only the true domain).
+            pads = [
+                (0, g - self.settings.L)
+                for g in self.domain.storage_shape
+            ]
+            u = jnp.pad(u, pads, constant_values=stencil.U_BOUNDARY)
+            v = jnp.pad(v, pads, constant_values=stencil.V_BOUNDARY)
         target = self.field_sharding if self.sharded else self.device
         self.u = jax.device_put(u, target)
         self.v = jax.device_put(v, target)
         self.step = int(step)
 
     def get_fields(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Host copies of (u, v) — the ghost-strip + D->H analog
-        (``Simulation_CPU.jl:125-133``, ``CUDAExt.jl:199-209``)."""
+        """Host copies of (u, v), clipped to the true ``L^3`` domain —
+        the ghost-strip + D->H analog (``Simulation_CPU.jl:125-133``,
+        ``CUDAExt.jl:199-209``; the strip also removes the storage pad
+        of a non-divisible sharded L)."""
         jax.block_until_ready((self.u, self.v))
-        return np.asarray(self.u), np.asarray(self.v)
+        L = self.settings.L
+        return (
+            np.asarray(self.u)[:L, :L, :L],
+            np.asarray(self.v)[:L, :L, :L],
+        )
 
     def block_until_ready(self) -> None:
         jax.block_until_ready((self.u, self.v))

@@ -23,12 +23,15 @@ def test_dims_create_matches_mpi_semantics():
 
 
 def test_block_sizes_cover_domain():
-    # integer remainder spread (fixes reference InexactError, defect #7)
+    # pad-and-mask boxes (fixes reference InexactError, defect #7):
+    # equal ceil(L/n) storage blocks, true boxes clipped to [0, L)
     for L, n in [(64, 4), (65, 4), (7, 3), (128, 8)]:
         sizes = [block_size_offset(L, n, c)[0] for c in range(n)]
         offsets = [block_size_offset(L, n, c)[1] for c in range(n)]
         assert sum(sizes) == L
         assert offsets[0] == 0
+        b = -(-L // n)
+        assert all(s == b for s in sizes[:-1])  # equal except the clip
         for c in range(1, n):
             assert offsets[c] == offsets[c - 1] + sizes[c - 1]
 
@@ -43,9 +46,25 @@ def test_cart_domain_coords_rank_roundtrip():
     assert len(seen) == 8
 
 
-def test_cart_domain_divisibility_enforced():
-    with pytest.raises(ValueError, match="divisible"):
-        CartDomain.create(8, 65)
+def test_cart_domain_padding_and_limits(monkeypatch):
     dom = CartDomain.create(8, 64)
     assert dom.dims == (2, 2, 2)
     assert dom.local_shape == (32, 32, 32)
+    assert dom.storage_shape == (64, 64, 64)
+    assert not dom.padded
+
+    # Non-divisible L: equal ceil blocks, padded storage.
+    dom = CartDomain.create(8, 65)
+    assert dom.local_shape == (33, 33, 33)
+    assert dom.storage_shape == (66, 66, 66)
+    assert dom.padded
+    # True boxes still tile exactly L per axis.
+    assert dom.proc_sizes((0, 0, 0)) == (33, 33, 33)
+    assert dom.proc_sizes((1, 1, 1)) == (32, 32, 32)
+    assert dom.proc_offsets((1, 0, 1)) == (33, 0, 33)
+
+    # A block that would own no true-domain cells is rejected
+    # (L=14 over 8 x-shards: ceil(14/8)=2 -> block 7 starts at 14).
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    with pytest.raises(ValueError, match="too small"):
+        CartDomain.create(8, 14)

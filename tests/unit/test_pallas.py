@@ -408,13 +408,18 @@ def test_x_chain_kernel_matches_fallback(use_noise, monkeypatch):
     )
 
 
-def test_x_chain_with_boundary_faces_equals_no_faces_chain():
+def test_x_chain_with_boundary_faces_equals_no_faces_chain(monkeypatch):
     """A whole-domain block fed frozen-boundary faces must reproduce the
     single-block in-kernel chain BITWISE — the face-DMA ghost source and
     the memset ghost source carry identical values, and the global-
     coordinate mid-stage pinning must degrade exactly to the local
-    test."""
-    nx, ny, nz, k = 16, 16, 128, 3
+    test. The block is a CUBE spanning the whole global domain (the
+    chain mode pins all three axes against the global side ``row``; a
+    non-cubic block with an axis longer than row is not a configuration
+    the framework constructs). GS_BX=16 keeps the multi-slab face-DMA
+    branches covered."""
+    nx = ny = nz = 32
+    k = 3
     u, v, _, params, seeds = _xchain_inputs(nx, ny, nz, k)
     bv = ((stencil.U_BOUNDARY,) * 2 + (stencil.V_BOUNDARY,) * 2)
     faces = tuple(
@@ -422,6 +427,7 @@ def test_x_chain_with_boundary_faces_equals_no_faces_chain():
     )
     offs = jnp.zeros((3,), jnp.int32)
     row = jnp.int32(nx)
+    monkeypatch.setenv("GS_BX", "16")
     a = pallas_stencil.fused_step(
         u, v, params, seeds, faces, use_noise=True, fuse=k,
         offsets=offs, row=row,

@@ -259,6 +259,80 @@ def test_xy_chain_sharded_matches_single_device(mesh, depth, monkeypatch):
 
 
 @requires8
+@pytest.mark.parametrize("mesh,lang,fuse", [
+    ("8,1,1", "Plain", 2),
+    ("8,1,1", "Pallas", 2),   # x-chain with padded x storage
+    ("4,2,1", "Pallas", 2),   # xy-chain, x uneven (22 -> 6*4 storage)
+    ("1,2,4", "Pallas", 2),   # z bands over an uneven z axis
+    ("4,2,1", "Plain", 3),
+])
+def test_uneven_L_sharded_matches_single_device(mesh, lang, fuse,
+                                                monkeypatch):
+    """Non-divisible L via pad-and-mask (round 4, reference defect #7 —
+    communication.jl:73-87 raises InexactError on this input): storage
+    padded to equal ceil(L/d) blocks, pad cells pinned to the frozen
+    boundary value every stage/round, outputs clipped to L^3. Bitwise
+    against the single-device (unpadded) run — pad cells must be
+    perfectly invisible to the trajectory."""
+    L = 22  # 22/8 -> 3-plane blocks + 2 pad planes; 22/4 -> 6 + 2 pad
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+    monkeypatch.setenv("GS_FUSE", str(fuse))
+    sh = Simulation(
+        _settings(L=L, noise=0.1, kernel_language=lang), n_devices=8,
+        seed=3,
+    )
+    assert sh.u.shape == sh.domain.storage_shape
+    sh.iterate(fuse + 1)  # one full chain round + a remainder
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    monkeypatch.delenv("GS_FUSE")
+    ref = Simulation(
+        _settings(L=L, noise=0.1, kernel_language="Plain"), n_devices=1,
+        seed=3,
+    )
+    ref.iterate(fuse + 1)
+    us, vs = sh.get_fields()
+    ur, vr = ref.get_fields()
+    assert us.shape == (L, L, L)
+    np.testing.assert_array_equal(us, ur)
+    np.testing.assert_array_equal(vs, vr)
+
+
+@requires8
+def test_uneven_L_restart_roundtrip(monkeypatch, tmp_path):
+    """Checkpoint + restore with padded storage: the store carries only
+    the true L^3 domain; restore rebuilds the pad shell and the resumed
+    trajectory stays bitwise-equal to an uninterrupted run."""
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.io import checkpoint
+
+    L = 22
+    path = str(tmp_path / "ckpt.bp")
+    s = _settings(L=L, noise=0.1, kernel_language="Pallas",
+                  checkpoint_output=path)
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "4,2,1")
+    monkeypatch.setenv("GS_FUSE", "2")
+    base = Simulation(s, n_devices=8, seed=5)
+    base.iterate(4)
+    w = checkpoint.CheckpointWriter(s, jnp.float32)
+    w.save(base.step, base.local_blocks())
+    w.close()
+    base.iterate(3)
+
+    resumed = Simulation(s, n_devices=8, seed=5)
+    reader, idx, step = checkpoint.open_checkpoint(path, s)
+    resumed.restore_from_reader(reader, idx, step)
+    assert resumed.step == 4
+    resumed.iterate(3)
+    np.testing.assert_array_equal(
+        base.get_fields()[0], resumed.get_fields()[0]
+    )
+    np.testing.assert_array_equal(
+        base.get_fields()[1], resumed.get_fields()[1]
+    )
+
+
+@requires8
 def test_xy_chain_collective_count_is_four_per_k_steps(monkeypatch):
     """The (n, m, 1) xy-chain's halo amortization as a compiled
     invariant: one exchange round per k steps costs 2 ppermutes for the
