@@ -4,9 +4,10 @@ Three interchangeable writer engines behind :func:`open_writer` (the
 reference's single engine is the ADIOS2 C++ library, ``IO.jl``):
 
 * real ADIOS2 (``io/adios.py``) — genuine ``.bp`` output, used
-  automatically when the ``adios2`` wheel is importable (single-writer,
-  non-append stores); ADIOS2/Fides/ParaView tooling opens it exactly as
-  it opens the reference's output;
+  automatically when the ``adios2`` wheel is importable (single-writer
+  stores, including restart-append via BP4 Append mode; rollback-append
+  — step truncation — stays BP-lite); ADIOS2/Fides/ParaView tooling
+  opens it exactly as it opens the reference's output;
 * native BP-lite (``csrc/libbplite.so`` via ``io/native.py``) — C++,
   async step pipeline with background write/fsync/publish; default when
   built;
@@ -83,6 +84,29 @@ def count_steps_upto(path: str, sim_step: int):
     """
     from .bplite import BpReader, _md_path
 
+    def count_leading(r) -> int:
+        k = 0
+        for i in range(r.num_steps()):
+            if int(r.get("step", step=i)) <= sim_step:
+                k = i + 1
+            else:
+                break
+        return k
+
+    if _real_bp_evidence(path):
+        # Real-ADIOS2 store: countable only through the bindings. The
+        # None return for a wheel-less process keeps the old behavior
+        # (the loud append gate in open_writer catches it).
+        from . import adios
+
+        if not adios.available():
+            return None
+        r = adios.Adios2Reader(path)
+        try:
+            return count_leading(r)
+        finally:
+            r.close()
+
     # Gate on the rank-0 metadata FILE, not the directory: in a
     # multi-process restart with a fresh store, a peer's open_writer may
     # have just created the directory while md.json can only ever be
@@ -92,12 +116,7 @@ def count_steps_upto(path: str, sim_step: int):
         return None
 
     r = BpReader(path)
-    k = 0
-    for i in range(r.num_steps()):
-        if int(r.get("step", step=i)) <= sim_step:
-            k = i + 1
-        else:
-            break
+    k = count_leading(r)
     r.close()
     return k
 
@@ -114,41 +133,94 @@ def open_writer(
     """Open a step-based writer with the best available engine.
 
     Preference order: real ADIOS2 (genuine ``.bp``; single-writer
-    non-append stores when the wheel is importable), then the native C++
+    stores when the wheel is importable — including restart-append onto
+    an existing real-BP store or a fresh path), then the native C++
     BP-lite engine, then pure-Python BP-lite. The BP-lite engines
     implement the full multi-writer layout (``nwriters > 1``, one writer
     per JAX process, private ``data.<w>`` payload + per-writer metadata,
-    reader-side merge) and rollback-append — pod-scale runs get the
-    async native engine.
+    reader-side merge) and rollback-append (``keep_steps`` truncation —
+    BP4 cannot truncate steps, so a rollback restart stays on BP-lite);
+    pod-scale runs get the async native engine.
     """
     if (
         prefer_adios2
         and os.environ.get("GS_TPU_ADIOS2", "1") != "0"
         and nwriters == 1
-        and not append
     ):
         from . import adios
 
         if adios.available():
-            # Overwriting a previous BP-lite run at this path: drop its
-            # metadata/payload files, or open_reader would later find the
-            # stale md.json and silently serve the OLD run's data.
-            if os.path.isdir(path):
-                for name in os.listdir(path):
-                    if name == "md.json" or (
-                        name.startswith(("md.", "data."))
-                        and not name.endswith(".bp")
-                    ):
-                        os.remove(os.path.join(path, name))
-            return adios.Adios2Writer(path, writer_id=writer_id,
-                                      nwriters=nwriters)
+            if not append:
+                # Overwriting a previous BP-lite run at this path: drop
+                # its metadata/payload files, or open_reader would later
+                # find the stale md.json and silently serve the OLD
+                # run's data.
+                if os.path.isdir(path):
+                    for name in os.listdir(path):
+                        if name == "md.json" or (
+                            name.startswith(("md.", "data."))
+                            and not name.endswith(".bp")
+                        ):
+                            os.remove(os.path.join(path, name))
+                return adios.Adios2Writer(path, writer_id=writer_id,
+                                          nwriters=nwriters)
+            if _real_bp_evidence(path) or not os.path.exists(path):
+                # Restart-append: continue an existing real-BP store (or
+                # start fresh) in BP4 Append mode. BP4 cannot TRUNCATE,
+                # so a rollback (keep_steps below the store's step
+                # count: the abandoned trajectory's tail must be
+                # DROPPED) is refused loudly rather than silently
+                # appending a duplicate trajectory.
+                if keep_steps is not None and _real_bp_evidence(path):
+                    r = adios.Adios2Reader(path)
+                    try:
+                        total = r.num_steps()
+                    finally:
+                        r.close()
+                    if keep_steps < total:
+                        raise RuntimeError(
+                            f"{path} is a real ADIOS2 BP store holding "
+                            f"{total} steps, but the rollback restart "
+                            f"keeps only {keep_steps}: BP4 cannot "
+                            "truncate steps. Point the restart at a "
+                            "fresh output path, or rerun the original "
+                            "run with GS_TPU_ADIOS2=0 (BP-lite supports "
+                            "rollback-append)"
+                        )
+                return adios.Adios2Writer(path, writer_id=writer_id,
+                                          nwriters=nwriters, append=True)
     if append and (_real_bp_evidence(path) or _foreign_dir(path)):
+        if _foreign_dir(path):
+            why = "an unrelated directory (typo'd or stale config path?)"
+        else:
+            from . import adios
+
+            if not adios.available():
+                why = (
+                    "a real ADIOS2 BP store and the adios2 bindings are "
+                    "not importable to append to it"
+                )
+            elif nwriters != 1:
+                why = (
+                    "a real ADIOS2 BP store and the adios2 engine is "
+                    "single-writer (this is a multi-process run); "
+                    "multi-writer append is a BP-lite feature"
+                )
+            elif os.environ.get("GS_TPU_ADIOS2", "1") == "0":
+                why = (
+                    "a real ADIOS2 BP store but GS_TPU_ADIOS2=0 disables "
+                    "the adios2 engine; unset it to append to this store"
+                )
+            else:
+                why = (
+                    "a real ADIOS2 BP store and this restart needs "
+                    "rollback (step truncation), which BP4 cannot do"
+                )
         raise RuntimeError(
-            f"{path} exists but is not a BP-lite store (a real ADIOS2 BP "
-            "store from a previous run, or an unrelated directory?); "
-            "rollback-append is a BP-lite feature — rerun the original "
-            "run with GS_TPU_ADIOS2=0, or point the restart at a fresh "
-            "output path"
+            f"cannot append to {path}: it is {why}. Point the restart at "
+            "a fresh output path, or keep output stores on BP-lite "
+            "(GS_TPU_ADIOS2=0 from the first run) where multi-writer and "
+            "rollback-append are implemented"
         )
     if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native
