@@ -47,7 +47,7 @@ def _env(**kv):
 
 def _parse_case(text: str) -> dict:
     out = {"fuse": 4, "bx": None, "noise": 0.1, "lang": "Pallas",
-           "precision": "Float32"}
+           "precision": "Float32", "midbf16": 0}
     for part in text.split(","):
         k, _, v = part.partition("=")
         k = k.strip()
@@ -56,6 +56,8 @@ def _parse_case(text: str) -> dict:
         out[k] = v if k in ("lang", "precision") else (
             float(v) if k == "noise" else int(v)
         )
+    if out["midbf16"] not in (0, 1):
+        raise SystemExit(f"midbf16 must be 0 or 1 in {text!r}")
     return out
 
 
@@ -100,7 +102,11 @@ def main() -> int:
         sim = Simulation(settings, n_devices=1)
         # GS_FUSE / GS_BX are read at trace time: pin them for the
         # compile-triggering warmup; the cached runner keeps them.
-        with _env(GS_FUSE=c["fuse"], GS_BX=c["bx"]):
+        # GS_MID_BF16 is pinned EXPLICITLY both ways: leaving the
+        # baseline case at the ambient shell value would let an
+        # exported GS_MID_BF16=1 turn the A/B into bf16-vs-bf16.
+        with _env(GS_FUSE=c["fuse"], GS_BX=c["bx"],
+                  GS_MID_BF16=("1" if c["midbf16"] else "0")):
             t0 = time.perf_counter()
             sim.iterate(args.steps)
             sync(sim)

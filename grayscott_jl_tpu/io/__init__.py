@@ -200,6 +200,13 @@ def open_writer(
                     "a real ADIOS2 BP store and the adios2 bindings are "
                     "not importable to append to it"
                 )
+            elif not prefer_adios2:
+                why = (
+                    "a real ADIOS2 BP store, but this store type "
+                    "(checkpoints) stays on the BP-lite engines by "
+                    "design (rollback-append and selection-restore are "
+                    "BP-lite semantics)"
+                )
             elif nwriters != 1:
                 why = (
                     "a real ADIOS2 BP store and the adios2 engine is "

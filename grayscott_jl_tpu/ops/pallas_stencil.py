@@ -117,20 +117,43 @@ def _compute_dtype(dtype):
     """In-kernel compute dtype: bf16 fields are stored bf16 (the HBM
     traffic win) but computed in f32 — Mosaic's rotate has no 16-bit
     path, and f32 accumulate is the accuracy-correct choice anyway.
-    ONE definition shared by the kernel body, the mid-scratch
-    allocation, and the VMEM estimate."""
+    ONE definition shared by the kernel body and the VMEM estimate."""
     return jnp.float32 if dtype == jnp.bfloat16 else dtype
 
 
+def _mid_store_dtype(dtype, mid_bf16: bool):
+    """Storage dtype of the temporal-blocking mid buffers.
+
+    bf16 fields ALWAYS store mids as bf16: the exact chain already
+    rounds every mid stage through the field dtype (``_round``), so a
+    bf16 store + f32 read-back is bitwise-identical to the old
+    f32-store-of-rounded-values — at half the VMEM movement, which the
+    r3 envelope probe showed is the kernel's binding cost. f32 fields
+    store mids as bf16 only under ``GS_MID_BF16=1`` (``mid_bf16``): an
+    opt-in speed/accuracy trade that BREAKS bitwise equality with the
+    stepwise trajectory (mid stages round to 8-bit mantissas), for
+    benchmark A/B on hardware. f64 mids stay f64."""
+    if dtype == jnp.bfloat16:
+        return jnp.bfloat16
+    if mid_bf16 and dtype == jnp.float32:
+        return jnp.bfloat16
+    return _compute_dtype(dtype)
+
+
 def pick_block_planes(
-    nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1
+    nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1,
+    mid_itemsize: int = None,
 ) -> int:
     """Largest slab depth BX (dividing nx) whose double-buffered u/v
     in/mid/out scratch fits the VMEM budget; 0 if even BX=1 does not
-    fit. ``fuse`` is the temporal-blocking depth (input halo width).
+    fit. ``fuse`` is the temporal-blocking depth (input halo width);
+    ``mid_itemsize`` the mid-buffer element size (defaults to the
+    conservative f32 floor; bf16-mid configs pass 2).
     ``GS_BX`` forces a specific depth (benchmark sweeps) when it divides
     ``nx`` and fits; otherwise it is ignored with a warning."""
     budget = _vmem_budget()
+    if mid_itemsize is None:
+        mid_itemsize = max(itemsize, 4)
 
     def fits(bx: int) -> bool:
         if nx % bx:
@@ -142,9 +165,7 @@ def pick_block_planes(
             return False
         in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
         nbuf, mid_planes = _mid_layout(bx, fuse)
-        # Mid buffers hold the compute dtype — at least f32 for 16-bit
-        # fields (_compute_dtype), hence the 4-byte floor.
-        mid_bytes = 2 * nbuf * mid_planes * ny * nz * max(itemsize, 4)
+        mid_bytes = 2 * nbuf * mid_planes * ny * nz * mid_itemsize
         out_bytes = 2 * 2 * bx * ny * nz * itemsize
         return in_bytes + mid_bytes + out_bytes <= budget
 
@@ -168,8 +189,20 @@ def pick_block_planes(
     return 0
 
 
+def mid_itemsize_for(dtype) -> int:
+    """Mid-buffer element size for dispatch-time feasibility checks —
+    reads ``GS_MID_BF16`` exactly the way :func:`fused_step` does, so
+    the dispatch-side depth cap agrees with the kernel-side fit (bf16
+    mids halve the mid scratch and can admit a deeper chain)."""
+    import os
+
+    dt = jnp.dtype(dtype)
+    mid_bf16 = os.environ.get("GS_MID_BF16") == "1" and dt == jnp.float32
+    return jnp.dtype(_mid_store_dtype(dt, mid_bf16)).itemsize
+
+
 def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
-                      fuse: int) -> int:
+                      fuse: int, mid_itemsize: int = None) -> int:
     """Deepest chain depth <= ``fuse`` whose slab scratch fits the VMEM
     budget (:func:`pick_block_planes` > 0); 0 if not even ``fuse=1``
     fits. Dispatch-time guard for the in-kernel chain modes: the
@@ -177,13 +210,15 @@ def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
     kernel silently degrades to its XLA fallback (e.g. the v5p-16 pod
     shape 64x512x512 f32 fits fuse=3 at bx=4 but not fuse=5)."""
     for k in range(fuse, 0, -1):
-        if pick_block_planes(nx, ny, nz, itemsize, k) > 0:
+        if pick_block_planes(nx, ny, nz, itemsize, k,
+                             mid_itemsize=mid_itemsize) > 0:
             return k
     return 0
 
 
 def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
-                           fuse: int, sublane: int = 8) -> int:
+                           fuse: int, sublane: int = 8,
+                           mid_itemsize: int = None) -> int:
     """:func:`max_feasible_fuse` for the xy-chain mode, where the
     operand arrives y-extended: depth k widens every plane to
     ``ny + 2k`` rows rounded up to the sublane tile, so feasibility
@@ -191,7 +226,8 @@ def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
     for k in range(fuse, 0, -1):
         ny_ext = ny + 2 * k
         ny_ext += (-ny_ext) % sublane
-        if pick_block_planes(nx, ny_ext, nz, itemsize, k) > 0:
+        if pick_block_planes(nx, ny_ext, nz, itemsize, k,
+                             mid_itemsize=mid_itemsize) > 0:
             return k
     return 0
 
@@ -234,7 +270,7 @@ def _shifted(block, axis, shift, edge_value, masks):
 
 
 def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
-                 fuse):
+                 fuse, mid_bf16=False):
     """Build the fused single-program kernel body; see module docstring.
 
     Two faces modes: ``with_faces`` with ``fuse == 1`` takes the full
@@ -489,10 +525,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                     u_win = in_u[slot].astype(cdt)
                     v_win = in_v[slot].astype(cdt)
                 else:
-                    # mid buffers are already cdt (f32 for bf16 fields).
+                    # Mid buffers hold _mid_store_dtype values (bf16 for
+                    # bf16 fields / GS_MID_BF16); widen to the compute
+                    # dtype BEFORE any roll (no 16-bit rotate path).
                     buf = (s - 1) % 2 if k > 2 else 0
-                    u_win = mid_u[buf, pl.ds(0, w_out + 2)]
-                    v_win = mid_v[buf, pl.ds(0, w_out + 2)]
+                    u_win = mid_u[buf, pl.ds(0, w_out + 2)].astype(cdt)
+                    v_win = mid_v[buf, pl.ds(0, w_out + 2)].astype(cdt)
                 u_c, du, v_c, dv = euler_terms(
                     u_win, v_win, const_edges_u, const_edges_v
                 )
@@ -524,18 +562,27 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                     else:
                         valid = (gx >= 0) & (gx < nx)
 
-                    def _round(x):
-                        # Mid stages round through the FIELD dtype so
-                        # fuse=k stays bitwise equal to k single steps
-                        # (each of which stores the field); mids stay
-                        # cdt-typed for the 32-bit-only rotate.
-                        return x.astype(dtype).astype(cdt)
+                    ms = _mid_store_dtype(dtype, mid_bf16)
+                    if ms == cdt:
+                        # Exact f32/f64 path: mid stages round through
+                        # the FIELD dtype so fuse=k stays bitwise equal
+                        # to k single steps (each of which stores the
+                        # field).
+                        def _store(x):
+                            return x.astype(dtype).astype(cdt)
+                    else:
+                        # bf16 mid storage: the astype IS the rounding
+                        # (bitwise-identical to the old round-trip for
+                        # bf16 fields; the opt-in approximation for
+                        # f32 + GS_MID_BF16).
+                        def _store(x):
+                            return x.astype(ms)
 
-                    mid_u[buf, pl.ds(0, w_out)] = jnp.where(
-                        valid, _round(u_c + du * dt), u_bv
+                    mid_u[buf, pl.ds(0, w_out)] = _store(
+                        jnp.where(valid, u_c + du * dt, u_bv)
                     )
-                    mid_v[buf, pl.ds(0, w_out)] = jnp.where(
-                        valid, _round(v_c + dv * dt), v_bv
+                    mid_v[buf, pl.ds(0, w_out)] = _store(
+                        jnp.where(valid, v_c + dv * dt, v_bv)
                     )
 
         compute = compute_k if fuse >= 2 else compute1
@@ -577,10 +624,11 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bx", "use_noise", "interpret", "fuse", "detect_races"),
+    static_argnames=("bx", "use_noise", "interpret", "fuse",
+                     "detect_races", "mid_bf16"),
 )
 def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
-                interpret, fuse, detect_races=False):
+                interpret, fuse, detect_races=False, mid_bf16=False):
     nx, ny, nz = u.shape
     dtype = u.dtype
     nblocks = nx // bx
@@ -607,9 +655,7 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
     ]
     if fuse >= 2:
         nbuf, mid_planes = _mid_layout(bx, fuse)
-        # Mid buffers hold stage outputs in the COMPUTE dtype (they are
-        # re-shifted by the next stage).
-        mid_dtype = _compute_dtype(dtype)
+        mid_dtype = _mid_store_dtype(dtype, mid_bf16)
         scratch_shapes += [
             pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
             pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
@@ -625,7 +671,8 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
 
     return pl.pallas_call(
         _make_kernel(
-            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse
+            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse,
+            mid_bf16,
         ),
         in_specs=in_specs,
         out_specs=[any_spec, any_spec],
@@ -730,13 +777,26 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
                     f"got {f.shape}"
                 )
 
-    bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse)
+    import os
+
+    # GS_MID_BF16=1: store f32 configs' mid buffers as bf16 — an opt-in
+    # speed/accuracy trade for benchmark A/B (see _mid_store_dtype; the
+    # envelope probe showed mid-buffer VMEM movement is the kernel's
+    # binding cost). bf16 fields get bf16 mids unconditionally (bitwise
+    # identical to the old rounded f32 storage).
+    mid_bf16 = (
+        os.environ.get("GS_MID_BF16") == "1" and dtype == jnp.float32
+    )
+    mid_item = jnp.dtype(_mid_store_dtype(dtype, mid_bf16)).itemsize
+    bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse,
+                           mid_itemsize=mid_item)
     if bx == 0 and fuse > 1 and not x_chain:
         # The requested depth overflows VMEM for this shape, but a
         # shallower chain may still fit — step down rather than losing
         # the Pallas kernel entirely (large grids are exactly where the
         # kernel matters most).
-        shallower = max_feasible_fuse(nx, ny, nz, dtype.itemsize, fuse - 1)
+        shallower = max_feasible_fuse(nx, ny, nz, dtype.itemsize,
+                                      fuse - 1, mid_itemsize=mid_item)
         if shallower:
             done = 0
             while done < fuse:
@@ -806,6 +866,7 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
         tuple(faces) if faces is not None else None,
         bx=bx, use_noise=use_noise, interpret=not on_tpu,
         fuse=fuse, detect_races=detect_races and not on_tpu,
+        mid_bf16=mid_bf16,
     )
 
 

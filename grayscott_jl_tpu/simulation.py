@@ -384,6 +384,9 @@ class Simulation:
                 feasible = pallas_stencil.max_feasible_fuse(
                     *self.domain.local_shape,
                     jnp.dtype(self.dtype).itemsize, fuse,
+                    mid_itemsize=pallas_stencil.mid_itemsize_for(
+                        self.dtype
+                    ),
                 )
                 if feasible < fuse:
                     capped = max(feasible, 1)
@@ -434,6 +437,9 @@ class Simulation:
                 sublane = 16 if self.dtype == jnp.bfloat16 else 8
                 feasible = pallas_stencil.max_feasible_fuse_ypad(
                     *block, jnp.dtype(self.dtype).itemsize, fuse, sublane,
+                    mid_itemsize=pallas_stencil.mid_itemsize_for(
+                        self.dtype
+                    ),
                 )
                 if feasible < fuse:
                     pallas_stencil._warn_once(

@@ -13,6 +13,41 @@ happened yet when conftest runs.
 """
 
 import os
+import sys
+from pathlib import Path
+
+import pytest
+
+FAKE_ADIOS2_DIR = str(
+    Path(__file__).resolve().parent / "support" / "adios2_fake"
+)
+
+
+@pytest.fixture
+def fake_adios2(monkeypatch):
+    """Install the strict adios2 API fake (tests/support/adios2_fake)
+    as the importable ``adios2`` module and reset the adapter's
+    availability cache; restore on exit.
+
+    NB the teardown must NOT go through monkeypatch: monkeypatch undoes
+    its own operations after fixture finalization, so a
+    ``monkeypatch.delitem(sys.modules, ...)`` in teardown would restore
+    the fake module for every later test in the process."""
+    from grayscott_jl_tpu.io import adios
+
+    prior = sys.modules.pop("adios2", None)
+    monkeypatch.syspath_prepend(FAKE_ADIOS2_DIR)
+    monkeypatch.delenv("GS_TPU_ADIOS2", raising=False)
+    adios.available.cache_clear()
+    import adios2
+
+    assert adios2.__version__.endswith("fake")
+    yield adios2
+    sys.modules.pop("adios2", None)
+    if prior is not None:
+        sys.modules["adios2"] = prior
+    adios.available.cache_clear()
+
 
 if os.environ.get("GS_TPU_TESTS") == "1":
     # Explicit hardware-run request: leave the platform alone so the

@@ -11,40 +11,11 @@ rejection). The availability-gated suite (``test_adios2_engine.py``)
 still runs against the genuine wheel where one exists.
 """
 
-import pathlib
-import sys
-
 import numpy as np
 import pytest
 
-FAKE_DIR = str(
-    pathlib.Path(__file__).resolve().parents[1] / "support" / "adios2_fake"
-)
-
-
-@pytest.fixture
-def fake_adios2(monkeypatch):
-    """Install the fake as the importable ``adios2`` module and reset
-    the adapter's availability cache; restore on exit.
-
-    NB the teardown must NOT go through monkeypatch: monkeypatch undoes
-    its own operations after fixture finalization, so a
-    ``monkeypatch.delitem(sys.modules, ...)`` in teardown would restore
-    the fake module for every later test in the process."""
-    from grayscott_jl_tpu.io import adios
-
-    prior = sys.modules.pop("adios2", None)
-    monkeypatch.syspath_prepend(FAKE_DIR)
-    monkeypatch.delenv("GS_TPU_ADIOS2", raising=False)
-    adios.available.cache_clear()
-    import adios2
-
-    assert adios2.__version__.endswith("fake")
-    yield adios2
-    sys.modules.pop("adios2", None)
-    if prior is not None:
-        sys.modules["adios2"] = prior
-    adios.available.cache_clear()
+# The ``fake_adios2`` fixture (install/teardown of the fake module)
+# lives in tests/conftest.py, shared with the functional suite.
 
 
 def _write_store(path, *, steps=3, L=8, append=False):

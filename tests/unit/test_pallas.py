@@ -249,6 +249,39 @@ def test_fuse_steps_down_when_vmem_overflows():
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
 
 
+def test_bf16_mid_buffers_track_exact_chain(monkeypatch):
+    """GS_MID_BF16=1 stores f32 mid-stage buffers as bf16 — an opt-in
+    speed/accuracy trade (mid VMEM movement is the kernel's binding
+    cost, r3 envelope probe). The approximate chain must track the
+    exact one to bf16 mid precision, and the flag must change the
+    result (else the A/B measures nothing)."""
+    L, k = 16, 4
+    dtype = jnp.float32
+    params = grayscott.Params.from_settings(
+        _settings("Pallas", L=L, noise=0.1), dtype
+    )
+    key = jax.random.PRNGKey(9)
+    u = jax.random.uniform(key, (L, L, L), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
+    seeds = jnp.asarray([1, 2, 3], jnp.int32)
+
+    exact_u, exact_v = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=k
+    )
+    monkeypatch.setenv("GS_MID_BF16", "1")
+    approx_u, approx_v = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=k
+    )
+    monkeypatch.undo()
+    assert not np.array_equal(np.asarray(approx_u), np.asarray(exact_u))
+    np.testing.assert_allclose(
+        np.asarray(approx_u), np.asarray(exact_u), rtol=0.02, atol=0.02
+    )
+    np.testing.assert_allclose(
+        np.asarray(approx_v), np.asarray(exact_v), rtol=0.02, atol=0.02
+    )
+
+
 def test_max_feasible_fuse_caps_the_v5p16_pod_shape():
     """The dispatch-side chain-depth guard: on the v5p-16 1D pod shape
     (local 64x512x512 f32) the x-chain fits Mosaic's VMEM budget at
