@@ -116,11 +116,11 @@ def _run_pair(cwd, config_name, extra_env=None):
     return outs
 
 
-def _run_dual(tmp_path, lang="Plain"):
+def _run_dual(tmp_path, lang="Plain", extra_env=None):
     d = tmp_path / "dual"
     d.mkdir()
     (d / "config.toml").write_text(_config(lang))
-    outs = _run_pair(d, "config.toml")
+    outs = _run_pair(d, "config.toml", extra_env)
     return d, outs
 
 
@@ -219,20 +219,21 @@ def test_two_process_restart_from_distributed_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_1d_xchain_matches_single_process(tmp_path):
-    """The 1D x-sharded in-kernel fused chain across a REAL process
-    boundary: two processes x 4 virtual devices form the (8,1,1) mesh,
-    so the k-wide x-slab ppermute crosses the process boundary every
-    chain. Output must be bit-identical to a single-process (8,1,1)
-    run."""
-    extra = {"GS_TPU_MESH_DIMS": "8,1,1"}
-
+@pytest.mark.parametrize("extra", [
+    # The 1D x-sharded chain: the (8,1,1) mesh's k-wide x-slab
+    # ppermute crosses the process boundary every chain round.
+    {"GS_TPU_MESH_DIMS": "8,1,1"},
+    # The round-4 xy-chain: the (4,2,1) mesh's lean 4-ppermute
+    # exchange (y slabs, then x slabs of the y-padded fields) crosses
+    # the process boundary every chain round.
+    {"GS_TPU_MESH_DIMS": "4,2,1", "GS_FUSE": "3"},
+], ids=["1d-xchain", "xy-chain"])
+def test_two_process_chain_matches_single_process(tmp_path, extra):
+    """The in-kernel fused chain modes across a REAL process boundary:
+    two processes x 4 virtual devices form the mesh, and the output
+    must be bit-identical to a single-process run of the same mesh."""
     single = _run_single(tmp_path, "Pallas", extra_env=extra)
-
-    dual = tmp_path / "dual"
-    dual.mkdir()
-    (dual / "config.toml").write_text(_config("Pallas"))
-    _run_pair(dual, "config.toml", extra_env=extra)
+    dual, _ = _run_dual(tmp_path, "Pallas", extra_env=extra)
 
     rs = BpReader(str(single / "out.bp"))
     rd = BpReader(str(dual / "out.bp"))
