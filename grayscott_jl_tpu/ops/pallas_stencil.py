@@ -163,10 +163,13 @@ def pick_block_planes(
             # bx < halo the slab next to the boundary would read out of
             # bounds. (Single-block nx == bx has no interior slabs.)
             return False
-        in_bytes = 2 * 2 * (bx + 2 * fuse) * ny * nz * itemsize
+        # A whole-block slab (nblocks == 1) only ever touches buffer
+        # slot 0 — no double buffering to charge for.
+        nio = 1 if bx == nx else 2
+        in_bytes = 2 * nio * (bx + 2 * fuse) * ny * nz * itemsize
         nbuf, mid_planes = _mid_layout(bx, fuse)
         mid_bytes = 2 * nbuf * mid_planes * ny * nz * mid_itemsize
-        out_bytes = 2 * 2 * bx * ny * nz * itemsize
+        out_bytes = 2 * nio * bx * ny * nz * itemsize
         return in_bytes + mid_bytes + out_bytes <= budget
 
     import os
@@ -183,7 +186,13 @@ def pick_block_planes(
             f"GS_BX={override!r} does not fit "
             f"(nx={nx}, fuse={fuse}); using automatic slab depth"
         )
-    for bx in (16, 8, 4, 2, 1):
+    # Candidate order: the pipelined power-of-two depths first (slab
+    # overlap needs nblocks >= 2), then the whole block as a last
+    # resort — the only option with a fused chain when nx is odd (the
+    # uneven-pod pad shapes, e.g. local nx = 9 for L=26 over 3), where
+    # no power-of-two divides nx but a single slab has no divisibility
+    # or bx >= fuse constraint at all.
+    for bx in (16, 8, 4, 2, 1, nx):
         if fits(bx):
             return bx
     return 0
@@ -588,11 +597,15 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
         compute = compute_k if fuse >= 2 else compute1
 
         # ---- pipeline: prologue, steady-state loop, epilogue ----
+        # Buffer count matches the scratch allocation: single-slab runs
+        # carry one slot (slot/nxt stay 0 — the prefetch branch never
+        # fires), multi-slab runs double-buffer.
+        nio = 1 if nblocks == 1 else 2
         slab_io(0, jnp.int32(0), start=True)
 
         def body(b, _):
-            slot = lax.rem(b, 2)
-            nxt = lax.rem(b + 1, 2)
+            slot = lax.rem(b, nio)
+            nxt = lax.rem(b + 1, nio)
 
             @pl.when(b + 1 < nblocks)
             def _():
@@ -614,7 +627,7 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
         for tail_b in (nblocks - 2, nblocks - 1):
             if tail_b >= 0:
-                slot = tail_b % 2
+                slot = tail_b % nio
                 b = jnp.int32(tail_b)
                 out_dma(u_out, out_u, slot, b, 0).wait()
                 out_dma(v_out, out_v, slot, b, 1).wait()
@@ -649,9 +662,13 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
             in_specs += [vmem_spec] * 8
         operands += list(faces)
 
+    # Single-slab runs (nblocks == 1) only ever use buffer slot 0;
+    # allocating the second slot would double the scratch for nothing
+    # (pick_block_planes budgets the same way).
+    nio = 1 if nblocks == 1 else 2
     scratch_shapes = [
-        pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
-        pltpu.VMEM((2, bx + 2 * fuse, ny, nz), dtype),
+        pltpu.VMEM((nio, bx + 2 * fuse, ny, nz), dtype),
+        pltpu.VMEM((nio, bx + 2 * fuse, ny, nz), dtype),
     ]
     if fuse >= 2:
         nbuf, mid_planes = _mid_layout(bx, fuse)
@@ -661,13 +678,13 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
             pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
         ]
     scratch_shapes += [
-        pltpu.VMEM((2, bx, ny, nz), dtype),
-        pltpu.VMEM((2, bx, ny, nz), dtype),
-        pltpu.SemaphoreType.DMA((2, 2)),
-        pltpu.SemaphoreType.DMA((2, 2)),
+        pltpu.VMEM((nio, bx, ny, nz), dtype),
+        pltpu.VMEM((nio, bx, ny, nz), dtype),
+        pltpu.SemaphoreType.DMA((nio, 2)),
+        pltpu.SemaphoreType.DMA((nio, 2)),
     ]
     if with_faces:
-        scratch_shapes.append(pltpu.SemaphoreType.DMA((2, 2, 2)))
+        scratch_shapes.append(pltpu.SemaphoreType.DMA((nio, 2, 2)))
 
     return pl.pallas_call(
         _make_kernel(
