@@ -42,25 +42,24 @@ def test_pipelined_kernel_has_no_dma_races():
     )
 
 
-def test_x_chain_kernel_has_no_dma_races(monkeypatch):
-    """The x-chain mode adds fuse-wide face DMAs landing in the ghost
-    planes of the slab windows while interior slab DMAs and out-DMAs
-    are in flight — run the detector over a multi-slab chain."""
+def _chain_race_case(nx, ny, nz, k, offs, row, seed, monkeypatch,
+                     bx=None):
+    """Shared scaffolding for the chain-mode race tests: random fields
+    and faces, fused_step under the race detector vs the XLA chain
+    fallback, both fields asserted."""
+    import jax
     import jax.numpy as jnp
 
     from grayscott_jl_tpu.config.settings import Settings
     from grayscott_jl_tpu.models import grayscott
     from grayscott_jl_tpu.ops import pallas_stencil
 
-    nx, ny, nz, k = 48, 16, 128, 3  # GS_BX=16 -> 3 slabs
     dtype = jnp.float32
-    s = Settings(L=nx, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.1,
+    s = Settings(L=row, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.1,
                  precision="Float32", backend="CPU",
                  kernel_language="Pallas")
     params = grayscott.Params.from_settings(s, dtype)
-    import jax
-
-    key = jax.random.PRNGKey(3)
+    key = jax.random.PRNGKey(seed)
     u = jax.random.uniform(key, (nx, ny, nz), dtype)
     v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
     faces = tuple(
@@ -69,10 +68,11 @@ def test_x_chain_kernel_has_no_dma_races(monkeypatch):
         for i in range(4)
     )
     seeds = jnp.asarray([9, 8, 7], jnp.int32)
-    offs = jnp.asarray([48, 0, 0], jnp.int32)
-    row = jnp.int32(144)
+    offs = jnp.asarray(offs, jnp.int32)
+    row = jnp.int32(row)
 
-    monkeypatch.setenv("GS_BX", "16")  # restores any pre-existing value
+    if bx is not None:
+        monkeypatch.setenv("GS_BX", str(bx))
     u1, v1 = pallas_stencil.fused_step(
         u, v, params, seeds, faces, use_noise=True, fuse=k,
         offsets=offs, row=row, detect_races=True,
@@ -87,4 +87,65 @@ def test_x_chain_kernel_has_no_dma_races(monkeypatch):
     )
     np.testing.assert_allclose(
         np.asarray(v1), np.asarray(want_v), rtol=1e-4, atol=2e-6
+    )
+
+
+def test_x_chain_kernel_has_no_dma_races(monkeypatch):
+    """The x-chain mode adds fuse-wide face DMAs landing in the ghost
+    planes of the slab windows while interior slab DMAs and out-DMAs
+    are in flight — run the detector over a multi-slab chain
+    (GS_BX=16 -> 3 slabs: lo, interior, hi branches)."""
+    _chain_race_case(48, 16, 128, 3, offs=[48, 0, 0], row=144,
+                     seed=3, monkeypatch=monkeypatch, bx=16)
+
+
+def test_xy_chain_kernel_has_no_dma_races(monkeypatch):
+    """The xy-chain variant: y-EXTENDED operand (interior + 2k halo +
+    sublane filler) on a GLOBAL-y-EDGE shard (offsets[1] = -k, so the
+    out-of-domain y-pin branch executes) with fuse-wide x faces of the
+    same widened planes — the widened-plane slab and face DMAs must
+    stay race-free and match the XLA xy-chain fallback."""
+    k = 3
+    _chain_race_case(32, 8 + 2 * k + 2, 128, k, offs=[32, -k, 0],
+                     row=64, seed=13, monkeypatch=monkeypatch, bx=16)
+
+
+def test_single_buffer_whole_block_slab_has_no_dma_races():
+    """Odd nx takes the bx == nx whole-block candidate (r4) with
+    single-buffered scratch — the degenerate pipeline (no prefetch
+    branch, slot 0 only) must stay race-free and exact on BOTH
+    fields."""
+    import jax
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import pallas_stencil
+
+    nx = 11  # odd: no power-of-two divisor, whole-block slab
+    ny, nz, k = 16, 128, 3
+    dtype = jnp.float32
+    assert pallas_stencil.pick_block_planes(nx, ny, nz, 4, k) == nx
+    s = Settings(L=nx, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.1,
+                 precision="Float32", backend="CPU",
+                 kernel_language="Pallas")
+    params = grayscott.Params.from_settings(s, dtype)
+    key = jax.random.PRNGKey(21)
+    u = jax.random.uniform(key, (nx, ny, nz), dtype)
+    v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
+    seeds = jnp.asarray([3, 1, 4], jnp.int32)
+
+    u1, v1 = pallas_stencil.fused_step(
+        u, v, params, seeds, use_noise=True, fuse=k, detect_races=True,
+    )
+    us, vs = u, v
+    for step in range(k):
+        us, vs = pallas_stencil._xla_fallback(
+            us, vs, params, seeds.at[2].add(step), None, use_noise=True,
+        )
+    np.testing.assert_allclose(
+        np.asarray(u1), np.asarray(us), rtol=1e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(vs), rtol=1e-4, atol=2e-6
     )
