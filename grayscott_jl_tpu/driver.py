@@ -93,7 +93,17 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
 
     from .utils.profiler import RunStats, trace
 
-    stats = RunStats(settings.L)
+    stats = RunStats(settings.L, config={
+        "mesh_dims": list(sim.domain.dims),
+        "padded_storage": (
+            list(sim.domain.storage_shape) if sim.sharded
+            and sim.domain.padded else None
+        ),
+        "kernel_language": sim.kernel_language,
+        "precision": settings.precision,
+        "n_devices": sim.domain.n_blocks,
+        "n_processes": nprocs,
+    })
     step = restart_step
     t0 = time.perf_counter()
     with trace():

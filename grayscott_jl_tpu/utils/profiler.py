@@ -31,8 +31,13 @@ class RunStats:
     device traces for per-op attribution.
     """
 
-    def __init__(self, L: int):
+    def __init__(self, L: int, config: Optional[dict] = None):
         self.L = L
+        #: Static run configuration echoed into the summary (mesh dims,
+        #: kernel language, chain depth, ...) so a pod operator can
+        #: correlate a stats file with the layout that produced it
+        #: without reconstructing the launch environment.
+        self.config = dict(config or {})
         self.phases: Dict[str, float] = {}
         self.counters: Dict[str, int] = {}
         self._t0 = time.perf_counter()
@@ -56,6 +61,9 @@ class RunStats:
         compute = self.phases.get("compute", total)
         return {
             "L": self.L,
+            # Nested under one key so caller-supplied names can never
+            # collide with (and silently clobber) the built-in fields.
+            "config": dict(self.config),
             "steps": steps,
             "wall_s": round(total, 6),
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},

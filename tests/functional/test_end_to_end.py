@@ -68,7 +68,14 @@ def run_cli(tmp_path, config, extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # APPEND to any ambient XLA_FLAGS: setdefault would silently drop
+    # the forced device count whenever a shell exports unrelated flags,
+    # collapsing the mesh the device-count-sensitive assertions expect.
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     if extra_env:
         env.update(extra_env)
     return subprocess.run(
@@ -125,6 +132,13 @@ def test_stats_json_written(tmp_path):
     assert stats["cell_updates_per_s"] > 0
     assert {"compute", "output"} <= set(stats["phases_s"])
     assert stats["wall_s"] >= sum(stats["phases_s"].values()) * 0.5
+    # run-configuration echo (r4): correlate a stats file with the
+    # layout that produced it
+    cfg_echo = stats["config"]
+    assert cfg_echo["mesh_dims"] == [2, 2, 2]
+    assert cfg_echo["n_devices"] == 8
+    assert cfg_echo["kernel_language"] == "xla"  # "Plain" normalizes
+    assert cfg_echo["padded_storage"] is None  # divisible L
 
 
 def test_cli_rejects_bad_config(tmp_path):
