@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Round-4 hardware measurement queue — run ONCE when the tunnel answers
+# (BASELINE.md "Round-4 changes and the hardware queue" in executable
+# form; the priority order is deliberate: correctness evidence first,
+# then the measurements that update the ICI model, then sampling).
+#
+#   benchmarks/hw_queue.sh            # from the repo root
+#
+# Every stage is timeout-bounded with SIGTERM (never SIGKILL — a
+# SIGKILLed tunnel client wedges the chip grant server-side, BASELINE.md
+# wedge addendum), logs under benchmarks/results/, and a stage failing
+# does not stop the later ones. Ends by launching the long-horizon
+# headline hunter.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+STAMP=$(date +%F_%H%M)
+
+echo "== 1/5 hardware test suite (incl. xy-chain Mosaic lowering) =="
+GS_TPU_TESTS=1 timeout 1800 python -m pytest \
+    tests/unit/test_tpu_hardware.py -q 2>&1 \
+    | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
+
+echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
+timeout 1800 python benchmarks/ab_probe.py \
+    --case fuse=2 --case fuse=3 --case fuse=4 --case fuse=5 \
+    --rounds 6 --out "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl"
+
+echo "== 3/5 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
+timeout 1800 python benchmarks/ab_probe.py \
+    --case fuse=5 --case fuse=5,midbf16=1 \
+    --case fuse=4 --case fuse=4,midbf16=1 \
+    --rounds 6 --out "benchmarks/results/ab_r4_midbf16_${STAMP}.jsonl"
+
+echo "== 4/5 headline sample (wedge-riding bench) =="
+GS_BENCH_TPU_HORIZON=0 timeout 1800 python bench.py \
+    >"benchmarks/results/bench_r4_sample_${STAMP}.json" 2>/dev/null
+tail -c 400 "benchmarks/results/bench_r4_sample_${STAMP}.json"; echo
+
+echo "== 5/5 launching the long-horizon headline hunter =="
+if ! ls /proc/*/cmdline 2>/dev/null | while read -r f; do
+       tr '\0' ' ' <"$f" 2>/dev/null; echo
+     done | grep -v hw_queue | grep -q '[h]eadline_hunter\.sh'; then
+    nohup benchmarks/headline_hunter.sh >>/tmp/gs_hunter.log 2>&1 &
+    echo "hunter launched"
+else
+    echo "hunter already running"
+fi
+
+echo "queue done — update FUSE_COST_RATIO in benchmarks/ici_model.py and"
+echo "BASELINE.md from the measured medians, then re-run the model sweep."
