@@ -13,34 +13,45 @@
 # headline hunter.
 set -u
 cd "$(dirname "$0")/.."
+. benchmarks/proc_lib.sh
 mkdir -p benchmarks/results
 STAMP=$(date +%F_%H%M)
 
+# Stages 1-3 do not self-bound, so they get an outer timeout with the
+# sanctioned SIGTERM-grace-SIGKILL contract (-k after 30s, matching
+# tunnel_watch.sh). Stage 4 (bench.py) bounds every backend touch
+# itself and always exits 0 — an OUTER kill there would be the exact
+# mid-run client death the wedge postmortem forbids, so it runs bare.
+
 echo "== 1/5 hardware test suite (incl. xy-chain Mosaic lowering) =="
-GS_TPU_TESTS=1 timeout 1800 python -m pytest \
+GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
     tests/unit/test_tpu_hardware.py -q 2>&1 \
     | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
 
 echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
-timeout 1800 python benchmarks/ab_probe.py \
+timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=2 --case fuse=3 --case fuse=4 --case fuse=5 \
-    --rounds 6 --out "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl"
+    --rounds 6 --out "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl" \
+    && python benchmarks/update_fuse_ratio.py \
+        "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl"
 
 echo "== 3/5 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
-timeout 1800 python benchmarks/ab_probe.py \
+timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=5 --case fuse=5,midbf16=1 \
     --case fuse=4 --case fuse=4,midbf16=1 \
     --rounds 6 --out "benchmarks/results/ab_r4_midbf16_${STAMP}.jsonl"
 
-echo "== 4/5 headline sample (wedge-riding bench) =="
-GS_BENCH_TPU_HORIZON=0 timeout 1800 python bench.py \
-    >"benchmarks/results/bench_r4_sample_${STAMP}.json" 2>/dev/null
+echo "== 4/5 headline sample (self-bounding bench, no outer kill) =="
+GS_BENCH_TPU_HORIZON=0 python bench.py \
+    >"benchmarks/results/bench_r4_sample_${STAMP}.json" \
+    2>"benchmarks/results/bench_r4_sample_${STAMP}.err"
 tail -c 400 "benchmarks/results/bench_r4_sample_${STAMP}.json"; echo
 
 echo "== 5/5 launching the long-horizon headline hunter =="
-if ! ls /proc/*/cmdline 2>/dev/null | while read -r f; do
-       tr '\0' ' ' <"$f" 2>/dev/null; echo
-     done | grep -v hw_queue | grep -q '[h]eadline_hunter\.sh'; then
+if ! hunter_running hw_queue; then
+    # A stale stop file from a prior operator stop would make the new
+    # hunter exit before its first cycle.
+    rm -f "${GS_HUNT_STOP:-/tmp/gs_hunt_stop}"
     nohup benchmarks/headline_hunter.sh >>/tmp/gs_hunter.log 2>&1 &
     echo "hunter launched"
 else

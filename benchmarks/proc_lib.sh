@@ -1,0 +1,17 @@
+# Shared helper for the tunnel ops scripts (source, don't execute).
+#
+# hunter_running <self-pattern>
+#   True when a benchmarks/headline_hunter.sh instance is alive.
+#   Scans /proc cmdlines directly: pgrep -f is NOT trusted here because
+#   long argv blobs (e.g. a driver process whose prompt text mentions
+#   the hunter) have produced false positives before (r3 ops notes).
+#   The [h] bracket keeps the grep from matching its own /proc entry;
+#   <self-pattern> filters the CALLING script's own processes, which
+#   also mention the hunter in their argv.
+hunter_running() {
+    ls /proc/*/cmdline 2>/dev/null | while read -r f; do
+        # Grouped so a pid vanishing between ls and read (the redirect
+        # itself failing) stays silent instead of spamming stderr.
+        { tr '\0' ' ' <"$f"; echo; } 2>/dev/null
+    done | grep -v "$1" | grep -q '[h]eadline_hunter\.sh'
+}

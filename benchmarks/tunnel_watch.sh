@@ -15,6 +15,7 @@
 # never SIGKILL first; a SIGKILLed tunnel client re-wedges the grant.
 set -u
 cd "$(dirname "$0")/.."
+. benchmarks/proc_lib.sh
 STOP_FILE="${GS_WATCH_STOP:-/tmp/gs_watch_stop}"
 INTERVAL="${GS_WATCH_INTERVAL:-150}"
 PROBE_TIMEOUT="${GS_WATCH_PROBE_TIMEOUT:-90}"
@@ -32,14 +33,10 @@ x=float(jnp.ones((8,8)).sum()); print('GSPROBE', d.platform, x)" 2>/dev/null)
         *"GSPROBE tpu"*)
             echo "$(date -u +%FT%TZ) tunnel up — launching hunter"
             # One instance only: the hunter has no lock of its own, so
-            # guard here (this watcher is the only launcher).
-            # The [h] bracket keeps this grep from matching its own
-            # /proc entry (and tunnel_watch lines are filtered so this
-            # script never matches itself either).
-            if ! ls /proc/*/cmdline 2>/dev/null | while read -r f; do
-                   tr '\0' ' ' <"$f" 2>/dev/null; echo
-                 done | grep -v tunnel_watch \
-                      | grep -q '[h]eadline_hunter\.sh'; then
+            # guard here (this watcher is the only launcher); shared
+            # self-excluding /proc scan in proc_lib.sh.
+            if ! hunter_running tunnel_watch; then
+                rm -f /tmp/gs_hunt_stop  # a stale stop would kill it
                 nohup benchmarks/headline_hunter.sh \
                     >>/tmp/gs_hunter.log 2>&1 &
             fi

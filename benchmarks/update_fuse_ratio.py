@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Fold a measured fuse-ratio A/B artifact into the ICI model.
+
+Reads an ``ab_probe`` JSONL (the ``hw_queue.sh`` stage-2 output: one
+row per ``fuse=K`` case with ``median_us_per_step``/``best_us_per_step``),
+computes each depth's cost ratio relative to the fastest measured depth,
+and — with ``--apply`` — rewrites ``FUSE_COST_RATIO`` in
+``benchmarks/ici_model.py`` in place (the k=2,3 entries are currently
+a+b/k interpolations; this replaces interpolation with measurement, the
+BASELINE.md round-4 queue's step 2). Ratios use the MEDIAN by default:
+the round-robin A/B shares clock state within a round, and the median
+is the state-robust statistic (BASELINE.md "artifact hygiene").
+
+    python benchmarks/update_fuse_ratio.py benchmarks/results/ab_r4_*.jsonl
+    python benchmarks/update_fuse_ratio.py --apply <artifact.jsonl>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+
+def load_ratios(path: str, stat: str = "median_us_per_step") -> dict:
+    rows = [json.loads(ln) for ln in open(path, encoding="utf-8")
+            if ln.strip()]
+    by_k = {}
+    for r in rows:
+        if "fuse" not in r or stat not in r:
+            continue
+        # Ratio measurements must not mix kernel variants.
+        if r.get("midbf16"):
+            continue
+        by_k.setdefault(int(r["fuse"]), []).append(float(r[stat]))
+    if not by_k:
+        raise SystemExit(f"no fuse cases with {stat!r} in {path}")
+    us = {k: min(v) for k, v in by_k.items()}  # best artifact per depth
+    base = min(us.values())
+    return {k: us[k] / base for k in sorted(us)}
+
+
+def apply_to_model(ratios: dict, model_path: str) -> str:
+    src = open(model_path, encoding="utf-8").read()
+    m = re.search(r"FUSE_COST_RATIO = \{[^}]*\}", src)
+    if not m:
+        raise SystemExit(f"FUSE_COST_RATIO literal not found in {model_path}")
+    old = eval(m.group(0).split("=", 1)[1])  # noqa: S307 - our own literal
+    merged = {**old, **ratios}
+    body = ", ".join(f"{k}: {round(v, 4)}" for k, v in sorted(merged.items()))
+    new_src = src[:m.start()] + f"FUSE_COST_RATIO = {{{body}}}" + src[m.end():]
+    # Measured entries are no longer interpolations: the rows that used
+    # to flag k=2,3 must stop doing so if those depths were measured.
+    if {2, 3} <= set(ratios):
+        new_src = new_src.replace(
+            '"fuse_cost_ratio_interpolated": k in (2, 3)',
+            '"fuse_cost_ratio_interpolated": False',
+        ).replace(
+            '"fuse_cost_ratio_interpolated": fuse in (2, 3)',
+            '"fuse_cost_ratio_interpolated": False',
+        )
+    open(model_path, "w", encoding="utf-8").write(new_src)
+    return body
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifact", help="ab_probe JSONL with fuse=K cases")
+    ap.add_argument("--stat", default="median_us_per_step",
+                    choices=["median_us_per_step", "best_us_per_step"])
+    ap.add_argument("--apply", action="store_true",
+                    help="rewrite FUSE_COST_RATIO in benchmarks/ici_model.py")
+    args = ap.parse_args()
+
+    ratios = load_ratios(args.artifact, args.stat)
+    print(json.dumps({"measured_fuse_cost_ratio": ratios,
+                      "stat": args.stat, "artifact": args.artifact}))
+    if args.apply:
+        import os
+
+        model = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ici_model.py")
+        body = apply_to_model(ratios, model)
+        print(f"updated FUSE_COST_RATIO = {{{body}}} in {model}",
+              file=sys.stderr)
+        print("re-run: python benchmarks/ici_model.py --out "
+              "benchmarks/results/ici_projection_r4_measured.jsonl",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
