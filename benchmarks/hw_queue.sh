@@ -32,8 +32,12 @@ echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=2 --case fuse=3 --case fuse=4 --case fuse=5 \
     --rounds 6 --out "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl" \
-    && python benchmarks/update_fuse_ratio.py \
-        "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl"
+    && python benchmarks/update_fuse_ratio.py --apply \
+        "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl" \
+    && python benchmarks/ici_model.py --out \
+        "benchmarks/results/ici_projection_measured_${STAMP}.jsonl" \
+        >/dev/null \
+    && echo "model updated + sweep re-run (remember: commit the diff)"
 
 echo "== 3/5 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
@@ -49,10 +53,7 @@ tail -c 400 "benchmarks/results/bench_r4_sample_${STAMP}.json"; echo
 
 echo "== 5/5 launching the long-horizon headline hunter =="
 if ! hunter_running hw_queue; then
-    # A stale stop file from a prior operator stop would make the new
-    # hunter exit before its first cycle.
-    rm -f "${GS_HUNT_STOP:-/tmp/gs_hunt_stop}"
-    nohup benchmarks/headline_hunter.sh >>/tmp/gs_hunter.log 2>&1 &
+    launch_hunter
     echo "hunter launched"
 else
     echo "hunter already running"

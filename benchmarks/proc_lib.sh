@@ -15,3 +15,12 @@ hunter_running() {
         { tr '\0' ' ' <"$f"; echo; } 2>/dev/null
     done | grep -v "$1" | grep -q '[h]eadline_hunter\.sh'
 }
+
+# launch_hunter — start one long-horizon hunter from the repo root,
+# clearing a stale stop file first (which would otherwise make the new
+# instance exit before its first cycle); honors the same GS_HUNT_STOP
+# override the hunter itself reads.
+launch_hunter() {
+    rm -f "${GS_HUNT_STOP:-/tmp/gs_hunt_stop}"
+    nohup benchmarks/headline_hunter.sh >>/tmp/gs_hunter.log 2>&1 &
+}

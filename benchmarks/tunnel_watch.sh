@@ -36,9 +36,7 @@ x=float(jnp.ones((8,8)).sum()); print('GSPROBE', d.platform, x)" 2>/dev/null)
             # guard here (this watcher is the only launcher); shared
             # self-excluding /proc scan in proc_lib.sh.
             if ! hunter_running tunnel_watch; then
-                rm -f /tmp/gs_hunt_stop  # a stale stop would kill it
-                nohup benchmarks/headline_hunter.sh \
-                    >>/tmp/gs_hunter.log 2>&1 &
+                launch_hunter
             fi
             exit 0
             ;;

@@ -37,7 +37,16 @@ def load_ratios(path: str, stat: str = "median_us_per_step") -> dict:
     if not by_k:
         raise SystemExit(f"no fuse cases with {stat!r} in {path}")
     us = {k: min(v) for k, v in by_k.items()}  # best artifact per depth
-    base = min(us.values())
+    if 5 not in us:
+        # FUSE_COST_RATIO is normalized to the k=5 base everywhere (the
+        # model's preserved entries, STAGE_RATIO); normalizing a partial
+        # artifact to its own fastest depth would merge ratios on MIXED
+        # bases and silently skew every projection.
+        raise SystemExit(
+            "artifact must include a fuse=5 case — ratios are defined "
+            "relative to the k=5 base the model's other entries use"
+        )
+    base = us[5]
     return {k: us[k] / base for k in sorted(us)}
 
 

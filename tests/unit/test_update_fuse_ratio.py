@@ -1,0 +1,106 @@
+"""Tests for the fuse-ratio measurement folder (benchmarks/).
+
+The hardware queue's step 2 output becomes the ICI model's
+FUSE_COST_RATIO through this tool; a silent mis-fold would quietly skew
+every projected weak-scaling number, so the parse + rewrite are locked
+down here against synthetic artifacts.
+"""
+
+import importlib.util
+import json
+import pathlib
+import shutil
+
+import pytest
+
+BENCH = pathlib.Path(__file__).resolve().parents[2] / "benchmarks"
+
+
+def _load_module():
+    spec = importlib.util.spec_from_file_location(
+        "update_fuse_ratio", BENCH / "update_fuse_ratio.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _artifact(tmp_path, rows):
+    p = tmp_path / "ab.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_load_ratios_normalizes_to_fastest_depth(tmp_path):
+    m = _load_module()
+    path = _artifact(tmp_path, [
+        {"fuse": 2, "midbf16": 0, "median_us_per_step": 1200.0},
+        {"fuse": 4, "midbf16": 0, "median_us_per_step": 1030.0},
+        {"fuse": 5, "midbf16": 0, "median_us_per_step": 1000.0},
+        # duplicate case rows: best artifact per depth wins
+        {"fuse": 5, "midbf16": 0, "median_us_per_step": 990.0},
+        # bf16-mid variants must NOT contaminate the ratio measurement
+        {"fuse": 5, "midbf16": 1, "median_us_per_step": 850.0},
+    ])
+    r = m.load_ratios(path)
+    assert r[5] == 1.0
+    assert r[4] == pytest.approx(1030.0 / 990.0)
+    assert r[2] == pytest.approx(1200.0 / 990.0)
+    assert set(r) == {2, 4, 5}
+
+
+def test_load_ratios_rejects_empty(tmp_path):
+    m = _load_module()
+    path = _artifact(tmp_path, [{"fuse": 5, "midbf16": 1,
+                                 "median_us_per_step": 1.0}])
+    with pytest.raises(SystemExit):
+        m.load_ratios(path)
+
+
+def test_load_ratios_requires_the_k5_base(tmp_path):
+    """Ratios are defined relative to the model's k=5 base; a partial
+    artifact without k=5 would merge onto mixed bases and silently
+    skew every projection (review finding r4)."""
+    m = _load_module()
+    path = _artifact(tmp_path, [
+        {"fuse": 2, "midbf16": 0, "median_us_per_step": 1200.0},
+        {"fuse": 3, "midbf16": 0, "median_us_per_step": 1100.0},
+    ])
+    with pytest.raises(SystemExit, match="fuse=5"):
+        m.load_ratios(path)
+
+
+def test_load_ratios_allows_faster_than_k5(tmp_path):
+    """A clock-state lottery can measure k=4 faster than k=5; the ratio
+    must come out below 1.0 (still on the k=5 base), not renormalize."""
+    m = _load_module()
+    path = _artifact(tmp_path, [
+        {"fuse": 4, "midbf16": 0, "median_us_per_step": 980.0},
+        {"fuse": 5, "midbf16": 0, "median_us_per_step": 1000.0},
+    ])
+    r = m.load_ratios(path)
+    assert r[5] == 1.0
+    assert r[4] == pytest.approx(0.98)
+
+
+def test_apply_rewrites_model_in_place(tmp_path):
+    m = _load_module()
+    model = tmp_path / "ici_model.py"
+    shutil.copy(BENCH / "ici_model.py", model)
+    ratios = {2: 1.21, 3: 1.09, 4: 1.03, 5: 1.0}
+    m.apply_to_model(ratios, str(model))
+
+    src = model.read_text()
+    # measured entries replace interpolations; unmeasured keys survive
+    ns = {}
+    exec(  # noqa: S102 - executing our own rewritten literal
+        src[src.index("FUSE_COST_RATIO ="):].splitlines()[0], {}, ns
+    )
+    got = ns["FUSE_COST_RATIO"]
+    assert got[2] == 1.21 and got[3] == 1.09 and got[5] == 1.0
+    assert 1 in got and 6 in got  # unmeasured depths preserved
+    # k=2,3 measured -> the interpolation flags must be cleared
+    assert "interpolated\": k in (2, 3)" not in src
+    assert "interpolated\": fuse in (2, 3)" not in src
+    # and the rewritten model must still be valid Python
+    compile(src, str(model), "exec")
