@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Round-4 hardware measurement queue — run ONCE when the tunnel answers
-# (BASELINE.md "Round-4 changes and the hardware queue" in executable
+# Round-5 hardware measurement queue — run ONCE when the tunnel answers
+# (BASELINE.md "Round-4/5 changes and the hardware queue" in executable
 # form; the priority order is deliberate: correctness evidence first,
 # then the measurements that update the ICI model, then sampling).
 #
@@ -31,9 +31,9 @@ GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
 echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=2 --case fuse=3 --case fuse=4 --case fuse=5 \
-    --rounds 6 --out "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl" \
+    --rounds 6 --out "benchmarks/results/ab_r5_fuseratio_${STAMP}.jsonl" \
     && python benchmarks/update_fuse_ratio.py --apply \
-        "benchmarks/results/ab_r4_fuseratio_${STAMP}.jsonl" \
+        "benchmarks/results/ab_r5_fuseratio_${STAMP}.jsonl" \
     && python benchmarks/ici_model.py --out \
         "benchmarks/results/ici_projection_measured_${STAMP}.jsonl" \
         >/dev/null \
@@ -43,13 +43,13 @@ echo "== 3/5 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=5 --case fuse=5,midbf16=1 \
     --case fuse=4 --case fuse=4,midbf16=1 \
-    --rounds 6 --out "benchmarks/results/ab_r4_midbf16_${STAMP}.jsonl"
+    --rounds 6 --out "benchmarks/results/ab_r5_midbf16_${STAMP}.jsonl"
 
 echo "== 4/5 headline sample (self-bounding bench, no outer kill) =="
 GS_BENCH_TPU_HORIZON=0 python bench.py \
-    >"benchmarks/results/bench_r4_sample_${STAMP}.json" \
-    2>"benchmarks/results/bench_r4_sample_${STAMP}.err"
-tail -c 400 "benchmarks/results/bench_r4_sample_${STAMP}.json"; echo
+    >"benchmarks/results/bench_r5_sample_${STAMP}.json" \
+    2>"benchmarks/results/bench_r5_sample_${STAMP}.err"
+tail -c 400 "benchmarks/results/bench_r5_sample_${STAMP}.json"; echo
 
 echo "== 5/5 launching the long-horizon headline hunter =="
 if ! hunter_running hw_queue; then

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Analytic ICI weak-scaling projection for the pod-slice configs.
+"""Analytic ICI weak-scaling projection for the pod-slice configs (CLI).
 
 No multi-chip hardware is reachable from this environment, and the
 8-virtual-device CPU mesh bounds only framework overhead (BASELINE.md:
@@ -10,7 +10,10 @@ parameters, with every assumption stated and overridable — the same
 kind of traffic model BASELINE.md's "Anchors" section applies to the
 reference's CUDA kernel.
 
-Model (per step, per device, cubic local block of side ``local``):
+The model core lives in ``grayscott_jl_tpu/parallel/icimodel.py`` (it
+also powers ``kernel_language = "Auto"`` dispatch at run construction);
+this file is the CLI front-end. Model summary (per step, per device,
+cubic local block of side ``local``):
 
 * compute time  = measured single-chip µs/step for that local volume
   (from ``benchmarks/results`` sweeps, or ``--us-per-step``), assumed
@@ -47,351 +50,35 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-def project(
-    local: int,
-    fuse: int,
-    us_per_step: float,
-    *,
-    stage_ratio: float = 1.0,
-    itemsize: int = 4,
-    links: int = 6,
-    link_gbps: float = 90.0,
-    hop_us: float = 1.0,
-    overlap: float = 0.0,
-) -> dict:
-    """Weak-scaling efficiency projection for one config.
+from grayscott_jl_tpu.parallel.icimodel import (  # noqa: E402
+    FUSE_COST_RATIO,
+    MEASURED_US,
+    STAGE_RATIO,
+    best_chain,
+    best_fuse,
+    best_fuse_1d,
+    pin_big_vmem,
+    project,
+)
 
-    Efficiency is sharded-per-step time over the single-chip baseline
-    ``us_per_step``, accounting for ALL three sharding overheads:
-
-    * per-stage cost ratio — the sharded chain runs its stages as
-      SINGLE-step kernels (in-kernel temporal fusion cannot cross
-      shard boundaries: a +-k y/z halo breaks Mosaic's 128-lane
-      alignment), so for the Pallas language each sharded stage costs
-      ``stage_ratio`` x the fused single-chip step (measured 1.46x at
-      L=256 f32 in one process, ``ab_r3_fuse1v5`` artifact); the XLA
-      language is stepwise on one chip too, so its ratio is 1.0;
-    * ring recompute — stage s computes a (local+2(k-1-s))-wide
-      window (``parallel/temporal.py``), extra volume the single-chip
-      measurement does not contain;
-    * exposed communication (serialization at the max-loaded link +
-      hop latency), amortized over the k steps per exchange round.
-    """
-    wide = local + 2 * fuse  # corner-propagated k-wide exchange slab
-    face_bytes = wide * wide * fuse * itemsize * 2  # per face, per k steps
-    total_bytes = 6 * face_bytes
-    # The exchange completes at the MAX-loaded link, not at aggregate
-    # bandwidth: with 6 links each face rides its own (1 face/link);
-    # with 4 (v5e 2D torus) the y/z-shared links carry 2 faces each.
-    faces_per_link = -(-6 // links)  # ceil
-    ser_us = faces_per_link * face_bytes / (link_gbps * 1e3) / fuse
-    lat_us = 6 * hop_us / fuse  # one exchange round per k steps
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
-    recompute = sum(
-        (local + 2 * (fuse - 1 - s)) ** 3 for s in range(fuse)
-    ) / (fuse * local**3)
-    eff = us_per_step / (us_per_step * stage_ratio * recompute + comm_us)
-    return {
-        "local": local,
-        "fuse": fuse,
-        "stage_ratio": stage_ratio,
-        "compute_us_per_step": round(us_per_step, 1),
-        "ring_recompute_ratio": round(recompute, 4),
-        "halo_bytes_per_round": total_bytes,
-        "comm_us_per_step_exposed": round(comm_us, 2),
-        "links": links,
-        "link_gbps": link_gbps,
-        "overlap": overlap,
-        "projected_weak_scaling_eff": round(eff, 4),
-    }
-
-
-def best_fuse(local, us_per_step, *, kmax=8, **kw):
-    """The fuse depth minimizing total sharding overhead for a config —
-    recompute grows and comm shrinks with k, and ``GS_FUSE`` is a free
-    knob at launch time, so the projection reports the swept optimum."""
-    return max(
-        (project(local, k, us_per_step, **kw) for k in range(1, kmax + 1)),
-        key=lambda r: r["projected_weak_scaling_eff"],
-    )
-
-
-#: Single-chip fused-kernel cost at fuse=k relative to the fuse=5
-#: optimum, measured round-robin in one process at L=256 f32 noisy
-#: (k=1: ab_r3_fuse1v5; k=4,5,6: ab_r3_deepfuse medians). k=2,3 are
-#: a+b/k interpolations through the k=1 and k=4 anchors — marked so in
-#: the emitted rows.
-FUSE_COST_RATIO = {1: 1493.1 / 1023.9, 2: 1.174, 3: 1.079,
-                   4: 1077.0 / 1044.0, 5: 1.0, 6: 1069.3 / 1044.0}
-
-
-_PALLAS_STENCIL = None
-
-
-def _pallas_stencil():
-    """Import ``ops.pallas_stencil`` once, with the repo root on the
-    path and the v4/v5/v6 VMEM budget pinned so no device is dialed."""
-    global _PALLAS_STENCIL
-    if _PALLAS_STENCIL is None:
-        import os
-        import sys
-
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        if root not in sys.path:
-            sys.path.insert(0, root)
-        from grayscott_jl_tpu.ops import pallas_stencil as ps
-
-        ps._VMEM_BUDGET = ps._VMEM_BUDGETS[True]
-        _PALLAS_STENCIL = ps
-    return _PALLAS_STENCIL
-
-
-def _feasible_chain_depth(local, itemsize, kmax, sublane=8, ypad=True):
-    """Deepest chain depth the real Mosaic VMEM feasibility check
-    admits for this local shape (``pallas_stencil.max_feasible_fuse*``);
-    ``ypad`` selects the xy-chain form (y-extended operand) vs the 1D
-    x-chain."""
-    ps = _pallas_stencil()
-    if ypad:
-        return ps.max_feasible_fuse_ypad(*local, itemsize, kmax, sublane)
-    return ps.max_feasible_fuse(*local, itemsize, kmax)
-
-
-def band_cells_per_round(local, k):
-    """Output cells of the two z-side XLA band chains per k-step round
-    (``parallel/temporal.window_chain``): stage s shrinks the
-    (nx+2k, ny+2k, 3k) window by one cell per side."""
-    nx, ny, nz = local
-    cells = 0
-    for s in range(k):
-        cells += ((nx + 2 * (k - s) - 2) * (ny + 2 * (k - s) - 2)
-                  * (3 * k - 2 * s - 2))
-    return 2 * cells
-
-
-def project_chain(
-    dims,
-    L: int,
-    fuse: int,
-    base_us_full: float,
-    *,
-    itemsize: int = 4,
-    sublane: int = 8,
-    link_gbps: float = 90.0,
-    hop_us: float = 1.0,
-    overlap: float = 0.0,
-    xla_us_per_cell: float = None,
-) -> dict:
-    """Weak-scaling projection for the round-4 cross-shard fused chain
-    (``parallel/temporal.xy_chain``) on an (n, m, p) mesh.
-
-    Every sharded stage runs IN-KERNEL at the fused schedule (the 1.46x
-    single-step penalty of the retired round-3 design is gone); the
-    overheads are:
-
-    * ``FUSE_COST_RATIO[k]`` — in-kernel depth vs the k=5 optimum;
-    * y-plane growth — the operand carries a k-deep y halo rounded up
-      to the sublane tile, so every plane computes
-      (ny + 2k + align)/ny more rows;
-    * x ring recompute — mid-stage windows extend (k-1-s) planes per
-      side, 1 + (k-1)/nx extra volume (same as the 1D x-chain);
-    * z bands (p > 1 only) — two k-wide bands per round recomputed in
-      XLA at the measured big-grid XLA per-cell rate (conservative: the
-      band working set can be VMEM-resident, which XLA fuses faster);
-    * exposed comm — 4 slab ppermutes per round for (n, m, 1), 6 for
-      z-sharded, each face on its own torus link, serialization at the
-      largest face.
-
-    ``base_us_full`` is the fused single-chip µs/step for the WHOLE L^3
-    grid; per-shard compute is 1/(n*m*p) of it (throughput-flat,
-    conservative for big locals).
-    """
-    n, m, p = dims
-    local = (L // n, L // m, L // p)
-    nx, ny, nz = local
-    us_base = base_us_full / (n * m * p)
-    r = FUSE_COST_RATIO.get(fuse)
-    if r is None:
-        raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
-    k = fuse
-    ny_ext = ny + 2 * k
-    ny_ext += (-ny_ext) % sublane
-    y_over = ny_ext / ny if (m > 1 or p > 1) else 1.0
-    x_ring = 1.0 + (k - 1) / nx
-    compute_us = us_base * r * y_over * x_ring
-
-    if p > 1:
-        if xla_us_per_cell is None:
-            xla_us_per_cell = MEASURED_US[("XLA", 256)] / 256**3
-        band_us = band_cells_per_round(local, k) * xla_us_per_cell / k
-        # Frame faces span the padded extents (corner propagation).
-        zx, zy = nz + 2 * k, ny + 2 * k
-        face_bytes = max(
-            zy * zx, (nx + 2 * k) * zx, (nx + 2 * k) * zy
-        ) * itemsize * 2
-        n_faces = 6
-    else:
-        band_us = 0.0
-        face_bytes = max(ny_ext * nz, nx * nz) * itemsize * 2
-        n_faces = (2 if n > 1 else 0) + (2 if m > 1 else 0)
-    # k-wide slabs every k steps -> per-step bytes are k-independent;
-    # completion at the largest face's link.
-    ser_us = face_bytes / (link_gbps * 1e3)
-    lat_us = n_faces * hop_us / k
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
-
-    eff = us_base / (compute_us + band_us + comm_us)
-    return {
-        "mesh": f"{n},{m},{p}",
-        "local": list(local),
-        "fuse": k,
-        "fuse_cost_ratio": r,
-        "fuse_cost_ratio_interpolated": k in (2, 3),
-        "compute_us_per_step": round(us_base, 1),
-        "y_plane_overhead": round(y_over, 4),
-        "x_ring_recompute": round(x_ring, 4),
-        "z_band_us_per_step": round(band_us, 2),
-        "comm_us_per_step_exposed": round(comm_us, 2),
-        "link_gbps": link_gbps,
-        "overlap": overlap,
-        "projected_weak_scaling_eff": round(eff, 4),
-    }
-
-
-def _mesh_candidates(n_devices: int, L: int):
-    """All (n, m, p) ordered factorizations of ``n_devices`` whose dims
-    divide L — the mixed-mesh sweep space."""
-    out = []
-    for n in range(1, n_devices + 1):
-        if n_devices % n or L % n:
-            continue
-        rest = n_devices // n
-        for m in range(1, rest + 1):
-            if rest % m or L % m:
-                continue
-            p = rest // m
-            if L % p:
-                continue
-            out.append((n, m, p))
-    return out
-
-
-def best_chain(n_devices, L, base_us_full, *, itemsize=4, kmax=8, **kw):
-    """Sweep mesh factorization x feasible chain depth for the round-4
-    chain; returns the best row (the VERDICT-8 mixed-mesh sweep)."""
-    best = None
-    for dims in _mesh_candidates(n_devices, L):
-        local = tuple(L // d for d in dims)
-        if min(local) < 2:
-            continue
-        cap = min(kmax, local[0], local[1])
-        if dims[2] > 1:
-            cap = min(cap, local[2] // 2)
-        cap = _feasible_chain_depth(local, itemsize, cap)
-        for k in range(2, cap + 1):
-            if k not in FUSE_COST_RATIO:
-                continue
-            r = project_chain(dims, L, k, base_us_full,
-                              itemsize=itemsize, **kw)
-            if (best is None
-                    or r["projected_weak_scaling_eff"]
-                    > best["projected_weak_scaling_eff"]):
-                best = r
-    return best
-
-
-def project_1d(
-    n: int,
-    L: int,
-    fuse: int,
-    base_us_per_step: float,
-    *,
-    itemsize: int = 4,
-    link_gbps: float = 90.0,
-    hop_us: float = 1.0,
-    overlap: float = 0.0,
-) -> dict:
-    """Weak-scaling projection for the 1D x-sharded in-kernel fused
-    chain (``GS_TPU_MESH_DIMS=n,1,1``): each shard owns an
-    (L/n, L, L) slab, the only halo is a fuse-wide x-slab pair riding
-    2 torus links, and the kernel runs its in-kernel chain ACROSS the
-    shard boundary — so the per-stage cost is the fused single-chip
-    schedule scaled by the measured fuse-depth ratio, not the 1.46x
-    single-step penalty of the 3D mesh.
-
-    ``base_us_per_step`` is the fused single-chip time for the WHOLE
-    L^3 grid (the 1-chip baseline); per-shard compute is 1/n of it
-    (throughput-flat assumption, conservative: bigger blocks measure
-    closer to roofline).
-    """
-    nx = L // n
-    us_base = base_us_per_step / n
-    recompute = 1.0 + (fuse - 1) / nx  # ring grows only along x
-    r = FUSE_COST_RATIO.get(fuse)
-    if r is None:
-        raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
-    # k-wide slab each direction every k steps => per-step bytes are
-    # k-independent; each face rides its own x link.
-    ser_us = L * L * itemsize * 2 / (link_gbps * 1e3)
-    lat_us = 2 * hop_us / fuse
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
-    eff = us_base / (us_base * r * recompute + comm_us)
-    return {
-        "mesh": f"{n},1,1",
-        "local": nx,
-        "fuse": fuse,
-        "fuse_cost_ratio": r,
-        "fuse_cost_ratio_interpolated": fuse in (2, 3),
-        "compute_us_per_step": round(us_base, 1),
-        "ring_recompute_ratio": round(recompute, 4),
-        "comm_us_per_step_exposed": round(comm_us, 2),
-        "link_gbps": link_gbps,
-        "overlap": overlap,
-        "projected_weak_scaling_eff": round(eff, 4),
-    }
-
-
-def best_fuse_1d(n, L, base_us, *, itemsize=4, **kw):
-    # Only depths whose slab scratch actually fits Mosaic's VMEM budget
-    # count — the dispatch caps infeasible depths (advisor finding r3),
-    # so projecting them would promise an unobtainable schedule.
-    cap = _feasible_chain_depth(
-        (L // n, L, L), itemsize, max(2, L // n), ypad=False
-    )
-    ks = [k for k in FUSE_COST_RATIO if k <= cap]
-    return max(
-        (project_1d(n, L, k, base_us, **kw) for k in ks),
-        key=lambda r: r["projected_weak_scaling_eff"],
-    )
-
-
-#: Measured single-chip f32 noisy µs/step by (kernel language, local
-#: side) — BASELINE.md v5e table, fast-window best-of; the throttled
-#: state scales compute and comm denominators together, so efficiency
-#: is roughly state-invariant. The Pallas numbers are the FUSED
-#: (in-kernel k=4/5) single-chip path — the honest baseline a 1-chip
-#: user gets; its sharded stages pay STAGE_RATIO on top (see project).
-MEASURED_US = {
-    ("Pallas", 128): 396.0,
-    ("Pallas", 256): 727.6,
-    ("Pallas", 512): 3618.2,
-    ("XLA", 128): 738.7,
-    ("XLA", 256): 1828.3,
-    ("XLA", 512): 16073.1,
-}
-
-#: Sharded per-stage cost over the fused single-chip step for the
-#: Pallas language: fuse=1 vs fuse=5 measured round-robin in ONE
-#: process (benchmarks/results/ab_r3_fuse1v5_2026-07-30.jsonl:
-#: 1493.1 vs 1023.9 us/step best, medians agree). The XLA language is
-#: stepwise on a single chip too, so its ratio is 1.0 by construction.
-STAGE_RATIO = {"Pallas": FUSE_COST_RATIO[1], "XLA": 1.0}
+__all__ = [
+    "FUSE_COST_RATIO", "MEASURED_US", "STAGE_RATIO", "best_chain",
+    "best_fuse", "best_fuse_1d", "project", "main",
+]
 
 
 def main() -> int:
+    # Pin the v4/v5/v6 VMEM budget so the feasibility checks inside the
+    # sweeps never dial a device (the tunnel blocks when wedged).
+    pin_big_vmem()
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--local", type=int, default=None)
     ap.add_argument("--fuse", type=int, default=5)
@@ -476,6 +163,14 @@ def main() -> int:
                 base = base * (L / base_key[1]) ** 3
             r = best_chain(n_dev, L, base, link_gbps=bw,
                            hop_us=args.hop_us, overlap=args.overlap)
+            if r is None:
+                # No mesh factorization admits a feasible chain depth
+                # >= 2 (VMEM check or FUSE_COST_RATIO miss) — skip the
+                # config rather than crash; the XLA row above still
+                # covers it.
+                print(f"# {name}: no feasible chain config, skipped",
+                      file=sys.stderr)
+                continue
             r["config"] = name
             r["kernel"] = "Pallas-chain"
             rows.append(r)
@@ -498,6 +193,10 @@ def main() -> int:
                 base = base * (L / base_key[1]) ** 3
             r = best_fuse_1d(n, L, base, link_gbps=bw,
                              hop_us=args.hop_us, overlap=args.overlap)
+            if r is None:
+                print(f"# {name}: no feasible 1D chain depth, skipped",
+                      file=sys.stderr)
+                continue
             r["config"] = name
             r["kernel"] = "Pallas-1D-xchain"
             rows.append(r)

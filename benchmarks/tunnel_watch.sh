@@ -31,11 +31,26 @@ while [ ! -e "$STOP_FILE" ]; do
 x=float(jnp.ones((8,8)).sum()); print('GSPROBE', d.platform, x)" 2>/dev/null)
     case "$out" in
         *"GSPROBE tpu"*)
-            echo "$(date -u +%FT%TZ) tunnel up — launching hunter"
-            # One instance only: the hunter has no lock of its own, so
-            # guard here (this watcher is the only launcher); shared
-            # self-excluding /proc scan in proc_lib.sh.
-            if ! hunter_running tunnel_watch; then
+            echo "$(date -u +%FT%TZ) tunnel up"
+            # GS_WATCH_ON_UP: optional command to run on recovery
+            # (e.g. benchmarks/hw_queue.sh, which ends by launching
+            # the hunter itself). Without it, launch the hunter here.
+            if [ -n "${GS_WATCH_ON_UP:-}" ]; then
+                # sh -c: the hook may be a multi-word command; a failed
+                # hook must NOT consume the one-shot recovery event
+                # (wedges recur on an hours timescale) — fall back to
+                # the hunter so the window still produces samples.
+                echo "running on-up hook: $GS_WATCH_ON_UP"
+                if ! sh -c "$GS_WATCH_ON_UP"; then
+                    echo "on-up hook failed; launching hunter instead"
+                    if ! hunter_running tunnel_watch; then
+                        launch_hunter
+                    fi
+                fi
+            elif ! hunter_running tunnel_watch; then
+                # One instance only: the hunter has no lock of its own,
+                # so guard here (this watcher is the only launcher);
+                # shared self-excluding /proc scan in proc_lib.sh.
                 launch_hunter
             fi
             exit 0

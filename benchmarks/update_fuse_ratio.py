@@ -5,9 +5,10 @@ Reads an ``ab_probe`` JSONL (the ``hw_queue.sh`` stage-2 output: one
 row per ``fuse=K`` case with ``median_us_per_step``/``best_us_per_step``),
 computes each depth's cost ratio relative to the fastest measured depth,
 and — with ``--apply`` — rewrites ``FUSE_COST_RATIO`` in
-``benchmarks/ici_model.py`` in place (the k=2,3 entries are currently
-a+b/k interpolations; this replaces interpolation with measurement, the
-BASELINE.md round-4 queue's step 2). Ratios use the MEDIAN by default:
+``grayscott_jl_tpu/parallel/icimodel.py`` in place (the k=2,3 entries
+are currently a+b/k interpolations; this replaces interpolation with
+measurement, the BASELINE.md round-4 queue's step 2). Ratios use the
+MEDIAN by default:
 the round-robin A/B shares clock state within a round, and the median
 is the state-robust statistic (BASELINE.md "artifact hygiene").
 
@@ -79,7 +80,8 @@ def main() -> int:
     ap.add_argument("--stat", default="median_us_per_step",
                     choices=["median_us_per_step", "best_us_per_step"])
     ap.add_argument("--apply", action="store_true",
-                    help="rewrite FUSE_COST_RATIO in benchmarks/ici_model.py")
+                    help="rewrite FUSE_COST_RATIO in "
+                    "grayscott_jl_tpu/parallel/icimodel.py")
     args = ap.parse_args()
 
     ratios = load_ratios(args.artifact, args.stat)
@@ -88,13 +90,14 @@ def main() -> int:
     if args.apply:
         import os
 
-        model = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "ici_model.py")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        model = os.path.join(root, "grayscott_jl_tpu", "parallel",
+                             "icimodel.py")
         body = apply_to_model(ratios, model)
         print(f"updated FUSE_COST_RATIO = {{{body}}} in {model}",
               file=sys.stderr)
         print("re-run: python benchmarks/ici_model.py --out "
-              "benchmarks/results/ici_projection_r4_measured.jsonl",
+              "benchmarks/results/ici_projection_measured.jsonl",
               file=sys.stderr)
     return 0
 

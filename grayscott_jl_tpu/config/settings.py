@@ -91,6 +91,11 @@ KERNEL_LANGUAGES: Dict[str, str] = {
     "kernelabstractions": "xla",
     "xla": "xla",
     "pallas": "pallas",
+    # Auto: resolved at Simulation construction by the ICI cost model
+    # (parallel/icimodel.select_kernel) for the actual mesh/L/dtype —
+    # the XLA-vs-Pallas choice at pod scale stops being operator
+    # knowledge buried in pod scripts.
+    "auto": "auto",
 }
 
 

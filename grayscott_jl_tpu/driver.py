@@ -100,6 +100,9 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
             and sim.domain.padded else None
         ),
         "kernel_language": sim.kernel_language,
+        # Auto-dispatch provenance: which kernel the ICI model picked
+        # and why (None for an explicitly pinned language).
+        "kernel_selection": sim.kernel_selection,
         "precision": settings.precision,
         "n_devices": sim.domain.n_blocks,
         "n_processes": nprocs,

@@ -6,8 +6,10 @@ reference's single engine is the ADIOS2 C++ library, ``IO.jl``):
 * real ADIOS2 (``io/adios.py``) — genuine ``.bp`` output, used
   automatically when the ``adios2`` wheel is importable (single-writer
   stores, including restart-append via BP4 Append mode; rollback-append
-  — step truncation — stays BP-lite); ADIOS2/Fides/ParaView tooling
-  opens it exactly as it opens the reference's output;
+  — step truncation, which BP4 cannot express — routes post-rollback
+  steps to a BP-lite sidecar merged back at read time,
+  ``io/sidecar.py``); ADIOS2/Fides/ParaView tooling opens it exactly
+  as it opens the reference's output;
 * native BP-lite (``csrc/libbplite.so`` via ``io/native.py``) — C++,
   async step pipeline with background write/fsync/publish; default when
   built;
@@ -96,12 +98,18 @@ def count_steps_upto(path: str, sim_step: int):
     if _real_bp_evidence(path):
         # Real-ADIOS2 store: countable only through the bindings. The
         # None return for a wheel-less process keeps the old behavior
-        # (the loud append gate in open_writer catches it).
-        from . import adios
+        # (the loud append gate in open_writer catches it). A rollback
+        # sidecar, when present, is part of the step sequence.
+        from . import adios, sidecar
 
         if not adios.available():
             return None
         r = adios.Adios2Reader(path)
+        keep_base = sidecar.read_keep_base(path)
+        if keep_base is not None:
+            r = sidecar.MergedReader(
+                r, sidecar.sidecar_reader(path), keep_base
+            )
         try:
             return count_leading(r)
         finally:
@@ -121,6 +129,24 @@ def count_steps_upto(path: str, sim_step: int):
     return k
 
 
+def _bplite_writer(path, *, writer_id, nwriters, append, keep_steps):
+    """The BP-lite engine chain (native C++ if built, else Python)."""
+    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
+        from . import native
+
+        if native.available():
+            return native.NativeBpWriter(
+                path, writer_id=writer_id, nwriters=nwriters, append=append,
+                keep_steps=keep_steps,
+            )
+    from .bplite import BpWriter
+
+    return BpWriter(
+        path, writer_id=writer_id, nwriters=nwriters, append=append,
+        keep_steps=keep_steps,
+    )
+
+
 def open_writer(
     path: str,
     *,
@@ -138,10 +164,19 @@ def open_writer(
     BP-lite engine, then pure-Python BP-lite. The BP-lite engines
     implement the full multi-writer layout (``nwriters > 1``, one writer
     per JAX process, private ``data.<w>`` payload + per-writer metadata,
-    reader-side merge) and rollback-append (``keep_steps`` truncation —
-    BP4 cannot truncate steps, so a rollback restart stays on BP-lite);
-    pod-scale runs get the async native engine.
+    reader-side merge) and rollback-append (``keep_steps`` truncation).
+    BP4 cannot truncate steps, so a rollback restart onto a real BP
+    store routes post-rollback steps to a BP-lite **sidecar** merged
+    back at read time (``io/sidecar.py``); pod-scale runs get the async
+    native engine.
     """
+    from . import sidecar
+
+    if not append:
+        # Fresh write: a leftover rollback sidecar from a previous run
+        # at this path would otherwise graft the OLD run's tail onto
+        # the NEW store at read time.
+        sidecar.remove_sidecar(path)
     if (
         prefer_adios2
         and os.environ.get("GS_TPU_ADIOS2", "1") != "0"
@@ -165,12 +200,25 @@ def open_writer(
                 return adios.Adios2Writer(path, writer_id=writer_id,
                                           nwriters=nwriters)
             if _real_bp_evidence(path) or not os.path.exists(path):
-                # Restart-append: continue an existing real-BP store (or
-                # start fresh) in BP4 Append mode. BP4 cannot TRUNCATE,
-                # so a rollback (keep_steps below the store's step
-                # count: the abandoned trajectory's tail must be
-                # DROPPED) is refused loudly rather than silently
-                # appending a duplicate trajectory.
+                keep_base = sidecar.read_keep_base(path)
+                if keep_base is not None:
+                    # A rollback sidecar already exists: ALL further
+                    # appends go there (base steps written after
+                    # sidecar steps would break the merged order). A
+                    # deeper rollback lowers keep_base; a shallower one
+                    # truncates within the sidecar.
+                    if keep_steps is None:
+                        inner_keep = None
+                    elif keep_steps <= keep_base:
+                        sidecar.write_keep_base(path, keep_steps)
+                        inner_keep = 0
+                    else:
+                        inner_keep = keep_steps - keep_base
+                    return _bplite_writer(
+                        sidecar.sidecar_path(path), writer_id=writer_id,
+                        nwriters=nwriters, append=True,
+                        keep_steps=inner_keep,
+                    )
                 if keep_steps is not None and _real_bp_evidence(path):
                     r = adios.Adios2Reader(path)
                     try:
@@ -178,14 +226,17 @@ def open_writer(
                     finally:
                         r.close()
                     if keep_steps < total:
-                        raise RuntimeError(
-                            f"{path} is a real ADIOS2 BP store holding "
-                            f"{total} steps, but the rollback restart "
-                            f"keeps only {keep_steps}: BP4 cannot "
-                            "truncate steps. Point the restart at a "
-                            "fresh output path, or rerun the original "
-                            "run with GS_TPU_ADIOS2=0 (BP-lite supports "
-                            "rollback-append)"
+                        # Rollback-append onto a real BP store: BP4
+                        # cannot TRUNCATE, so the first keep_steps base
+                        # steps stay live (recorded in the sidecar
+                        # marker) and every post-rollback step goes to
+                        # a fresh BP-lite sidecar; open_reader serves
+                        # the merged sequence.
+                        sidecar.write_keep_base(path, keep_steps)
+                        return _bplite_writer(
+                            sidecar.sidecar_path(path),
+                            writer_id=writer_id, nwriters=nwriters,
+                            append=False, keep_steps=None,
                         )
                 return adios.Adios2Writer(path, writer_id=writer_id,
                                           nwriters=nwriters, append=True)
@@ -218,31 +269,16 @@ def open_writer(
                     "a real ADIOS2 BP store but GS_TPU_ADIOS2=0 disables "
                     "the adios2 engine; unset it to append to this store"
                 )
-            else:
-                why = (
-                    "a real ADIOS2 BP store and this restart needs "
-                    "rollback (step truncation), which BP4 cannot do"
-                )
+            else:  # pragma: no cover — rollback now goes to the sidecar
+                why = "a real ADIOS2 BP store in an unexpected state"
         raise RuntimeError(
             f"cannot append to {path}: it is {why}. Point the restart at "
             "a fresh output path, or keep output stores on BP-lite "
             "(GS_TPU_ADIOS2=0 from the first run) where multi-writer and "
             "rollback-append are implemented"
         )
-    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
-        from . import native
-
-        if native.available():
-            return native.NativeBpWriter(
-                path, writer_id=writer_id, nwriters=nwriters, append=append,
-                keep_steps=keep_steps,
-            )
-    from .bplite import BpWriter
-
-    return BpWriter(
-        path, writer_id=writer_id, nwriters=nwriters, append=append,
-        keep_steps=keep_steps,
-    )
+    return _bplite_writer(path, writer_id=writer_id, nwriters=nwriters,
+                          append=append, keep_steps=keep_steps)
 
 
 def open_reader(path: str, *, live: bool = False):
@@ -265,10 +301,25 @@ def open_reader(path: str, *, live: bool = False):
     from .bplite import BpReader
 
     if _real_bp_evidence(path):
-        from . import adios
+        from . import adios, sidecar
 
         if adios.available():
-            return adios.Adios2Reader(path)
+            base = adios.Adios2Reader(path)
+            keep_base = sidecar.read_keep_base(path)
+            if keep_base is not None:
+                # Rollback sidecar present: serve base[0:keep_base] +
+                # sidecar as one step sequence (io/sidecar.py). Live
+                # consumers keep retrying the sidecar attach — its
+                # first metadata flush may not have landed yet.
+                return sidecar.MergedReader(
+                    base, sidecar.sidecar_reader(path, live=live),
+                    keep_base,
+                    reattach=(
+                        (lambda: sidecar.sidecar_reader(path, live=True))
+                        if live else None
+                    ),
+                )
+            return base
         raise RuntimeError(
             f"{path} is not a BP-lite store and the adios2 bindings are "
             "not importable to read it as a real BP store"
@@ -303,9 +354,22 @@ class _LiveReader:
         from .bplite import BpReader, _md_path
 
         if _real_bp_evidence(self.path):
-            from . import adios
+            from . import adios, sidecar
 
             self._inner = adios.Adios2Reader(self.path)
+            keep_base = sidecar.read_keep_base(self.path)
+            if keep_base is not None:
+                path = self.path
+                self._inner = sidecar.MergedReader(
+                    self._inner,
+                    sidecar.sidecar_reader(path, live=True),
+                    keep_base,
+                    # The sidecar's first metadata flush may land after
+                    # this live attach; keep retrying in begin_step.
+                    reattach=lambda: sidecar.sidecar_reader(
+                        path, live=True
+                    ),
+                )
         elif os.path.isfile(_md_path(self.path)):
             self._inner = BpReader(self.path, wait_for_writer=True)
         return self._inner
@@ -326,9 +390,17 @@ class _LiveReader:
             timeout=max(0.0, deadline - time.monotonic())
         )
 
+    def close(self):
+        # Explicit so the give-up path (begin_step never returned OK,
+        # e.g. pdfcalc's max_not_ready bound) can close gracefully
+        # instead of tripping the __getattr__ not-attached error.
+        if self._inner is not None:
+            self._inner.close()
+
     def __getattr__(self, name):
-        # Everything except begin_step requires an attached store; the
-        # streaming protocol guarantees callers begin_step first.
+        # Everything except begin_step/close requires an attached
+        # store; the streaming protocol guarantees callers begin_step
+        # first.
         if self._inner is None:
             raise RuntimeError(
                 f"store {self.path} has not appeared yet; call "

@@ -104,7 +104,11 @@ class Adios2Writer:
 
         self._adios = adios2.Adios()
         self._io = self._adios.declare_io(io_name)
-        self._io.set_engine("BP4")  # the reference's engine (IO.jl:41)
+        # The reference never calls set_engine (ADIOS2.jl lacks it —
+        # IO.jl has a TODO to that effect) and so gets ADIOS2's default
+        # engine, which was BP4 in its era; pin BP4 here explicitly for
+        # byte-compatibility with that output.
+        self._io.set_engine("BP4")
         # Append: BP4 continues the step sequence of an existing store —
         # the restart-append path (VERDICT r3 weak #5: a restarted run
         # can keep writing its original real-BP output store instead of

@@ -1,0 +1,204 @@
+"""Rollback sidecar for real-ADIOS2 BP stores.
+
+BP4 can append steps but never truncate them (no ADIOS2 API removes
+committed steps), so a rollback restart — resume from a checkpoint
+earlier than the store's last step, dropping the abandoned trajectory's
+tail — cannot be expressed against a real BP store at all. Rather than
+forcing operators onto ``GS_TPU_ADIOS2=0`` from run one (the r4
+behavior: correct-and-loud refusal, VERDICT item 6), post-rollback
+steps go to a **BP-lite sidecar** next to the store:
+
+* ``<store>.sidecar/`` is a normal BP-lite store holding every step
+  written after the rollback, plus a ``sidecar.json`` marker recording
+  ``keep_base`` — how many leading steps of the base store are live;
+* ``open_writer`` creates/extends the sidecar transparently when a
+  rollback-append targets a real BP store (and routes ALL later
+  appends there — base steps after sidecar steps would break order);
+* ``open_reader`` returns a :class:`MergedReader` presenting
+  ``base[0:keep_base] + sidecar[*]`` as one step sequence, so pdfcalc
+  / gdsplot / restart counting see a single consistent store.
+
+The base store stays byte-valid for any external ADIOS2/Fides tool —
+such a tool just also shows the rolled-back tail (documented in
+docs/PARITY.md); tools going through this package see the truth.
+
+Reference anchor: the store contract being preserved is
+``/root/reference/src/simulation/IO.jl:37-70``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .bplite import StepStatus, _md_path
+
+_MARKER = "sidecar.json"
+
+
+def sidecar_path(path: str) -> str:
+    return path.rstrip("/") + ".sidecar"
+
+
+def read_keep_base(path: str) -> Optional[int]:
+    """``keep_base`` from the sidecar marker of store ``path``, or None
+    when no (valid) sidecar exists."""
+    try:
+        with open(os.path.join(sidecar_path(path), _MARKER),
+                  encoding="utf-8") as f:
+            return int(json.load(f)["keep_base"])
+    except (FileNotFoundError, NotADirectoryError, KeyError, ValueError):
+        return None
+
+
+def write_keep_base(path: str, keep_base: int) -> None:
+    """Atomically (re)write the sidecar marker for store ``path``."""
+    side = sidecar_path(path)
+    os.makedirs(side, exist_ok=True)
+    tmp = os.path.join(side, _MARKER + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"keep_base": int(keep_base), "base": os.path.basename(
+            path.rstrip("/"))}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(side, _MARKER))
+
+
+def remove_sidecar(path: str) -> None:
+    """Delete a stale sidecar (fresh non-append write at ``path``): a
+    leftover marker would otherwise graft the OLD run's rollback tail
+    onto the new store at read time. ``ignore_errors``: in a
+    multi-writer run every process calls open_writer(append=False) at
+    the same path concurrently, and rmtree does not tolerate a peer
+    deleting entries under it."""
+    import shutil
+
+    side = sidecar_path(path)
+    if os.path.isdir(side):
+        shutil.rmtree(side, ignore_errors=True)
+
+
+def sidecar_reader(path: str, *, live: bool = False):
+    """BP-lite reader for the sidecar of store ``path``, or None when
+    the sidecar holds no committed metadata yet (a marker written
+    moments before the writer's first flush)."""
+    from .bplite import BpReader
+
+    side = sidecar_path(path)
+    if not os.path.isfile(_md_path(side)):
+        return None
+    return BpReader(side, wait_for_writer=live)
+
+
+class MergedReader:
+    """Read-side merge of ``base[0:keep_base] + side[*]``.
+
+    Presents the same reader API as ``BpReader``/``Adios2Reader``
+    (streaming ``begin_step``/``end_step`` plus random-access
+    ``get(step=...)``), routing each step index to the store that owns
+    it. ``side`` may be None (marker exists, no committed sidecar
+    metadata yet): the merged store is then just the capped base —
+    the cap itself is load-bearing, it hides the rolled-back tail.
+    ``reattach`` (live coupling) is retried on each ``begin_step``
+    while ``side`` is None, so a reader that attached in the window
+    between the marker write and the sidecar writer's first metadata
+    flush still picks up the resumed run's steps (returning NOT_READY,
+    not END_OF_STREAM, in the meantime).
+    """
+
+    def __init__(self, base, side, keep_base: int, *, reattach=None):
+        self.base = base
+        self.side = side
+        self.keep_base = int(keep_base)
+        self._reattach = reattach
+        self._consumed = 0
+        self._in_step = False
+
+    # -- streaming ---------------------------------------------------------
+
+    def begin_step(self, timeout: float = 10.0) -> StepStatus:
+        if self._in_step:
+            raise RuntimeError("begin_step with a step already open")
+        if self._consumed < self.keep_base:
+            self._in_step = True
+            return StepStatus.OK
+        if self.side is None and self._reattach is not None:
+            self.side = self._reattach()
+        if self.side is None:
+            return (StepStatus.NOT_READY if self._reattach is not None
+                    else StepStatus.END_OF_STREAM)
+        st = self.side.begin_step(timeout=timeout)
+        if st == StepStatus.OK:
+            self._in_step = True
+        return st
+
+    def current_step(self) -> int:
+        return self._consumed
+
+    def end_step(self) -> None:
+        if not self._in_step:
+            raise RuntimeError("end_step without an open step")
+        if self._consumed >= self.keep_base:
+            self.side.end_step()
+        self._in_step = False
+        self._consumed += 1
+
+    # -- inquiry -----------------------------------------------------------
+
+    def attributes(self):
+        out = dict(self.base.attributes())
+        if self.side is not None:
+            out.update(self.side.attributes())
+        return out
+
+    def available_variables(self):
+        out = dict(self.base.available_variables())
+        if self.side is not None:
+            out.update(self.side.available_variables())
+        return out
+
+    def inquire_variable(self, name: str):
+        return self.available_variables().get(name)
+
+    def num_steps(self) -> int:
+        n = self.keep_base
+        if self.side is not None:
+            n += self.side.num_steps()
+        return n
+
+    def set_selection(self, name, start, count) -> None:
+        self.base.set_selection(name, start, count)
+        if self.side is not None:
+            self.side.set_selection(name, start, count)
+
+    # -- data --------------------------------------------------------------
+
+    def get(self, name: str, *, step: Optional[int] = None,
+            start=None, count=None):
+        if step is None:
+            if not self._in_step:
+                raise RuntimeError("get outside begin_step/end_step "
+                                   "(or pass step=...)")
+            if self._consumed < self.keep_base:
+                return self.base.get(name, step=self._consumed,
+                                     start=start, count=count)
+            # the side reader has its own open step
+            return self.side.get(name, start=start, count=count)
+        if not 0 <= step < self.num_steps():
+            raise IndexError(f"step {step} out of range")
+        if step < self.keep_base:
+            return self.base.get(name, step=step, start=start, count=count)
+        return self.side.get(name, step=step - self.keep_base,
+                             start=start, count=count)
+
+    def close(self) -> None:
+        self.base.close()
+        if self.side is not None:
+            self.side.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -27,7 +27,10 @@ def validate_kernel_language(lang: str) -> None:
     """Raise if ``lang`` is unknown or its kernel module cannot load."""
     if lang == "xla":
         return
-    if lang == "pallas":
+    if lang in ("pallas", "auto"):
+        # "auto" may resolve to the Pallas path, so its kernel module
+        # must load too — a broken install fails at construction either
+        # way, not at dispatch.
         from . import pallas_stencil  # noqa: F401 — import is the check
 
         return

@@ -44,6 +44,7 @@ from .ops import noise as noise_ops
 from .ops import stencil, validate_kernel_language
 from .parallel import halo, temporal
 from .parallel.domain import CartDomain
+from .utils.log import _is_primary
 
 AXIS_NAMES = ("x", "y", "z")
 
@@ -194,6 +195,68 @@ class Simulation:
 
         self.domain = CartDomain.create(len(devices), settings.L)
         self.sharded = len(devices) > 1
+        self._auto_fuse = None
+        if self.kernel_language == "auto":
+            # Resolve via the ICI cost model for the ACTUAL run config
+            # (mesh dims, L, dtype, device generation) — see
+            # parallel/icimodel.select_kernel for the policy. When the
+            # operator did not force a mesh, the chain is projected at
+            # its best swept factorization and the winning mesh/depth
+            # are adopted. The decision is logged once on process 0 and
+            # recorded in ``self.kernel_selection`` for the stats echo.
+            import os as _os
+
+            from .parallel import icimodel
+
+            try:
+                kind = devices[0].device_kind
+            except Exception:
+                kind = ""
+            mesh_forced = bool(_os.environ.get("GS_TPU_MESH_DIMS", ""))
+            self.kernel_language, self.kernel_selection = (
+                icimodel.select_kernel(
+                    self.domain.dims, settings.L, platform=backend,
+                    device_kind=kind,
+                    itemsize=np.dtype(self.dtype).itemsize,
+                    fuse=default_fuse(),
+                    sweep_mesh=self.sharded and not mesh_forced,
+                )
+            )
+            if self.sharded:
+                row = next(
+                    (r for r in self.kernel_selection.get("rows", [])
+                     if r["kernel"] == self.kernel_language), None,
+                )
+                if row is not None:
+                    if (self.kernel_language == "pallas"
+                            and not mesh_forced):
+                        picked = tuple(
+                            int(x) for x in row["mesh"].split(",")
+                        )
+                        if picked != self.domain.dims:
+                            self.domain = CartDomain(
+                                L=settings.L, dims=picked
+                            )
+                            self.kernel_selection["adopted_mesh"] = (
+                                list(picked)
+                            )
+                    if not _os.environ.get("GS_FUSE", ""):
+                        # Honor the winning row's swept depth for BOTH
+                        # languages — the projection that justified the
+                        # pick assumed it (still capped by the runner's
+                        # own feasibility checks).
+                        self._auto_fuse = int(row["fuse"])
+            if _is_primary():
+                import sys as _sys
+
+                print(
+                    "gray-scott: kernel_language=Auto resolved to "
+                    f"{self.kernel_language!r} "
+                    f"({self.kernel_selection.get('reason', '')})",
+                    file=_sys.stderr,
+                )
+        else:
+            self.kernel_selection = None
         self.params = grayscott.Params.from_settings(settings, self.dtype)
         self.use_noise = settings.noise != 0.0
         self.base_key = jax.random.PRNGKey(seed)
@@ -235,6 +298,14 @@ class Simulation:
             self.device = devices[0]
 
         self.u, self.v = self._init_fields()
+
+    def _fuse_base(self) -> int:
+        """Chain/temporal-blocking depth before the runner's own caps:
+        the Auto-swept depth when Auto adopted one (GS_FUSE unset),
+        else ``default_fuse()`` (GS_FUSE or the platform default)."""
+        if self._auto_fuse is not None:
+            return self._auto_fuse
+        return default_fuse()
 
     # ------------------------------------------------------------------ init
 
@@ -372,7 +443,7 @@ class Simulation:
                 # at higher counts the 1D surface/volume ratio loses to
                 # 3D, see BASELINE.md's ICI projection).
                 fuse = min(
-                    default_fuse(), max(nsteps, 1),
+                    self._fuse_base(), max(nsteps, 1),
                     self.domain.local_shape[0],
                 )
                 # The exchange width must match a chain depth the
@@ -433,7 +504,7 @@ class Simulation:
                 # Floor of 1: a cap of 0 (local nz == 1 on a z-sharded
                 # mesh) must degrade to the depth-1 12-face path, not
                 # divide by zero in run_chain_rounds.
-                fuse = max(1, min(default_fuse(), max(nsteps, 1), *cap))
+                fuse = max(1, min(self._fuse_base(), max(nsteps, 1), *cap))
                 sublane = 16 if self.dtype == jnp.bfloat16 else 8
                 feasible = pallas_stencil.max_feasible_fuse_ypad(
                     *block, jnp.dtype(self.dtype).itemsize, fuse, sublane,
@@ -479,7 +550,7 @@ class Simulation:
             # the v5e, so per-step time scales ~1/fuse); the noise stream
             # is keyed on absolute (step, cell), so fusion/chunking does
             # not change the trajectory.
-            fuse = min(default_fuse(), max(nsteps, 1))
+            fuse = min(self._fuse_base(), max(nsteps, 1))
 
             def body(i, carry):
                 u, v = carry
@@ -529,7 +600,7 @@ class Simulation:
         # shrinking ring doubles as the next stage's ghost shell. Cuts
         # the exchange count per step by k (the cost
         # ``communication.jl:138-199`` pays every step).
-        fuse = min(default_fuse(), nsteps, min(self.domain.local_shape))
+        fuse = min(self._fuse_base(), nsteps, min(self.domain.local_shape))
 
         def chain(u, v, step, depth):
             """``depth`` steps from one ``depth``-wide exchange."""

@@ -17,6 +17,12 @@ export TPU_NAME="${TPU_NAME:-gs-v5p-16}"
 export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-16"
 
+# The example TOML ships kernel_language = "Auto" (resolved per config
+# by the ICI model: efficiency objective -> the >=90% holder, which is
+# the XLA kernel here; GS_AUTO_OBJECTIVE=throughput -> the Pallas
+# xy-chain). The mesh/fuse exports below serve the Pallas choice and
+# are harmless for XLA.
+#
 # 2D (x,y)-sharded mesh: the round-4 xy-chain runs the in-kernel fused
 # schedule across BOTH sharded axes — local blocks 128x256x512, the
 # mixed-mesh sweep's best for kernel_language=Pallas at this config

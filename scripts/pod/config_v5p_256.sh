@@ -18,10 +18,14 @@ export ZONE="${ZONE:-us-east5-a}"
 export ACCELERATOR_TYPE="v5p-256"
 
 # Kernel-language mesh choice at 128 chips / L=1024 (the ici_model.py
-# r4 mixed-mesh sweep over all 128-chip factorizations):
+# r4 mixed-mesh sweep over all 128-chip factorizations). The example
+# TOML ships kernel_language = "Auto": the ICI model resolves the
+# language per config at construction (efficiency objective by
+# default -> the >=90% holder; GS_AUTO_OBJECTIVE=throughput -> the
+# fastest absolute chain). Pin a language in the TOML to override.
 #   * XLA kernel: leave GS_TPU_MESH_DIMS unset -> dims_create 8x4x4
 #     (projected weak-scaling 0.994 — the >=90% target holder at this
-#     exact config).
+#     exact config; what Auto's default picks).
 #   * Pallas kernel: export GS_TPU_MESH_DIMS=16,8,1 + GS_FUSE=4 — the
 #     xy-chain (in-kernel fused schedule across x AND y, z unsharded)
 #     projects 0.829, up from 0.68 for the retired per-stage design.

@@ -293,14 +293,28 @@ def test_restart_appends_to_adios2_output_store(tmp_path, fake_adios2):
     r.close()
 
     # Rollback onto the same adios2 store (restart_step=20 while the
-    # store holds steps through 80): refused loudly.
+    # store holds steps through 80): BP4 cannot truncate, so steps past
+    # 20 go to the BP-lite sidecar and the reader serves the merged
+    # sequence (io/sidecar.py; the r4 behavior was a loud refusal).
     cfg3 = write_config(
         part_dir, "phase3.toml", noise=0.1, steps=80, output="p1.bp",
         restart="true", restart_input="ckpt.bp", restart_step=20,
     )
     res = run_cli(part_dir, cfg3, extra_env=adios_env)
-    assert res.returncode != 0
-    assert "cannot truncate" in res.stderr
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    from grayscott_jl_tpu.io import sidecar
+
+    assert sidecar.read_keep_base(store) == 2  # base steps 10, 20 live
+    r = open_reader(store)
+    assert isinstance(r, sidecar.MergedReader)
+    steps_seen = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+    assert steps_seen == [10, 20, 30, 40, 50, 60, 70, 80]
+    # the re-run trajectory still bit-matches the uninterrupted run
+    np.testing.assert_array_equal(
+        r.get("U", step=7), full.get("U", step=full.num_steps() - 1)
+    )
+    r.close()
 
 
 def test_rollback_restart_truncates_stale_trajectory(tmp_path):

@@ -135,18 +135,146 @@ def test_restart_append_continues_real_bp_store(fake_adios2, tmp_path):
     r.close()
 
 
-def test_rollback_append_still_refuses_adios2_store(fake_adios2,
-                                                    tmp_path,
-                                                    monkeypatch):
-    """BP4 cannot truncate steps, so a rollback restart (keep_steps set)
-    onto a real-BP store must still fail loudly rather than corrupt or
-    silently duplicate the trajectory."""
-    from grayscott_jl_tpu.io import open_writer
+def test_rollback_append_routes_to_sidecar(fake_adios2, tmp_path):
+    """BP4 cannot truncate steps, so a rollback restart (keep_steps
+    below the store's step count) onto a real-BP store routes
+    post-rollback steps to a BP-lite sidecar (VERDICT r4 item 6 — the
+    r3/r4 behavior was a loud refusal forcing GS_TPU_ADIOS2=0 from run
+    one); the reader serves base[0:keep] + sidecar as one sequence."""
+    from grayscott_jl_tpu.io import (adios, count_steps_upto, open_reader,
+                                     open_writer, sidecar)
+    from grayscott_jl_tpu.io.bplite import StepStatus
+
+    path = str(tmp_path / "out.bp")
+    _write_store(path, steps=3, L=4)  # steps 0, 10, 20
+
+    w = open_writer(path, append=True, keep_steps=1)
+    assert not isinstance(w, adios.Adios2Writer)  # BP-lite sidecar
+    assert sidecar.read_keep_base(path) == 1
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (4, 4, 4))
+    for s in (10, 20):
+        w.begin_step()
+        w.put("step", np.int32(s + 1000))
+        w.put("U", np.full((4, 4, 4), float(s), np.float32))
+        w.end_step()
+    w.close()
+
+    r = open_reader(path)
+    assert isinstance(r, sidecar.MergedReader)
+    assert r.num_steps() == 3
+    assert [int(r.get("step", step=i)) for i in range(3)] == [
+        0, 1010, 1020,
+    ]
+    # base-region data reads through the adios2 reader, sidecar region
+    # through BP-lite; selections work in both
+    np.testing.assert_array_equal(
+        r.get("U", step=0), np.full((4, 4, 4), 0.0, np.float32)
+    )
+    box = r.get("U", step=2, start=(1, 0, 0), count=(2, 4, 4))
+    np.testing.assert_array_equal(
+        box, np.full((2, 4, 4), 20.0, np.float32)
+    )
+    # streaming walks the merged sequence to a clean end-of-stream
+    seen = []
+    while r.begin_step(timeout=2.0) == StepStatus.OK:
+        seen.append(int(r.get("step")))
+        r.end_step()
+    assert seen == [0, 1010, 1020]
+    r.close()
+
+    # rollback counting sees the merged sequence too
+    assert count_steps_upto(path, 1010) == 2
+
+
+def test_second_rollback_within_sidecar(fake_adios2, tmp_path):
+    """Re-rollbacks on a sidecar'd store: a shallower keep truncates
+    within the sidecar; a deeper one lowers keep_base and empties it."""
+    from grayscott_jl_tpu.io import open_reader, open_writer, sidecar
+
+    path = str(tmp_path / "out.bp")
+    _write_store(path, steps=3, L=4)  # base steps 0, 10, 20
+
+    def extend(keep, tags):
+        w = open_writer(path, append=True, keep_steps=keep)
+        w.define_variable("step", np.int32)
+        for t in tags:
+            w.begin_step()
+            w.put("step", np.int32(t))
+            w.end_step()
+        w.close()
+
+    extend(2, [30, 40])        # keep base 2, sidecar [30, 40]
+    extend(3, [50])            # keep sidecar's first entry: [30, 50]
+    r = open_reader(path)
+    assert [int(r.get("step", step=i)) for i in range(r.num_steps())] \
+        == [0, 10, 30, 50]
+    r.close()
+
+    extend(1, [60])            # deeper rollback: into the base region
+    assert sidecar.read_keep_base(path) == 1
+    r = open_reader(path)
+    assert [int(r.get("step", step=i)) for i in range(r.num_steps())] \
+        == [0, 60]
+    r.close()
+
+
+def test_live_reader_survives_sidecar_metadata_window(fake_adios2,
+                                                      tmp_path):
+    """A live consumer attaching between the sidecar marker write and
+    the sidecar writer's first metadata flush must see NOT_READY (and
+    later the resumed steps), not a terminal END_OF_STREAM (r5 review
+    finding: _LiveReader caches its inner reader exactly once)."""
+    from grayscott_jl_tpu.io import open_reader, sidecar
+    from grayscott_jl_tpu.io.bplite import BpWriter, StepStatus
+
+    path = str(tmp_path / "out.bp")
+    _write_store(path, steps=2, L=4)  # base steps 0, 10
+    # marker exists, sidecar metadata does NOT (the race window)
+    sidecar.write_keep_base(path, 1)
+
+    r = open_reader(path, live=True)
+    assert r.begin_step(timeout=2.0) == StepStatus.OK  # base step 0
+    assert int(r.get("step")) == 0
+    r.end_step()
+    assert r.begin_step(timeout=0.1) == StepStatus.NOT_READY
+
+    w = BpWriter(sidecar.sidecar_path(path))
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(77))
+    w.end_step()
+    w.close()
+
+    assert r.begin_step(timeout=5.0) == StepStatus.OK
+    assert int(r.get("step")) == 77
+    r.end_step()
+    assert r.begin_step(timeout=1.0) == StepStatus.END_OF_STREAM
+    r.close()
+
+
+def test_fresh_write_removes_stale_sidecar(fake_adios2, tmp_path):
+    """A non-append write at a path with a leftover sidecar must delete
+    it — the old marker would graft the previous run's rollback tail
+    onto the NEW store at read time."""
+    from grayscott_jl_tpu.io import open_reader, open_writer, sidecar
 
     path = str(tmp_path / "out.bp")
     _write_store(path, steps=3, L=4)
-    with pytest.raises(RuntimeError, match="rollback-append"):
-        open_writer(path, append=True, keep_steps=1)
+    w = open_writer(path, append=True, keep_steps=1)
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(99))
+    w.end_step()
+    w.close()
+    assert sidecar.read_keep_base(path) == 1
+
+    _write_store(path, steps=2, L=4)  # fresh run, same path
+    assert sidecar.read_keep_base(path) is None
+    r = open_reader(path)
+    assert not isinstance(r, sidecar.MergedReader)
+    assert r.num_steps() == 2
+    r.close()
 
 
 def test_live_reader_dispatches_to_adios2(fake_adios2, tmp_path):

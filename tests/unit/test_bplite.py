@@ -224,6 +224,18 @@ def test_live_reader_defers_engine_dispatch(tmp_path):
     assert int(r.get("step", step=0)) == 3
     r.end_step()
     assert r.begin_step(timeout=2.0) == StepStatus.END_OF_STREAM
+    r.close()
+
+
+def test_live_reader_close_before_attach_is_graceful(tmp_path):
+    """pdfcalc's bounded give-up path (max_not_ready exceeded) closes a
+    reader whose store never appeared; that must be a no-op, not the
+    __getattr__ not-attached RuntimeError (r4 advisor finding)."""
+    from grayscott_jl_tpu.io import _LiveReader
+
+    r = _LiveReader(_store(tmp_path, "never.bp"))
+    assert r.begin_step(timeout=0.05) == StepStatus.NOT_READY
+    r.close()
 
 
 def test_count_steps_upto_ignores_metadata_less_store(tmp_path):

@@ -85,8 +85,9 @@ def test_load_ratios_allows_faster_than_k5(tmp_path):
 
 def test_apply_rewrites_model_in_place(tmp_path):
     m = _load_module()
-    model = tmp_path / "ici_model.py"
-    shutil.copy(BENCH / "ici_model.py", model)
+    model = tmp_path / "icimodel.py"
+    shutil.copy(BENCH.parent / "grayscott_jl_tpu" / "parallel"
+                / "icimodel.py", model)
     ratios = {2: 1.21, 3: 1.09, 4: 1.03, 5: 1.0}
     m.apply_to_model(ratios, str(model))
 
