@@ -253,10 +253,25 @@ def _last_tpu_provenance():
                         best = r
                         captured = rec.get("t")
         if best is not None:
-            candidates.append((os.path.getmtime(p), p, best, captured))
+            # Rank by the record's own capture timestamp when it has
+            # one — file mtimes are checkout times on a fresh clone,
+            # which would claim a days-old measurement is minutes old.
+            when = os.path.getmtime(p)
+            age_source = "file_mtime"
+            if captured:
+                try:
+                    import datetime
+
+                    when = datetime.datetime.fromisoformat(
+                        captured.replace("Z", "+00:00")
+                    ).timestamp()
+                    age_source = "captured"
+                except ValueError:
+                    pass
+            candidates.append((when, p, best, captured, age_source))
     if not candidates:
         return None
-    mtime, path, rec, captured = max(candidates)
+    when, path, rec, captured, age_source = max(candidates)
     return {
         "path": os.path.relpath(path, here),
         "metric": rec.get("metric"),
@@ -264,7 +279,8 @@ def _last_tpu_provenance():
         "unit": rec.get("unit"),
         "kernel": rec.get("kernel"),
         "captured": captured,
-        "age_days": round((time.time() - mtime) / 86400.0, 2),
+        "age_days": round((time.time() - when) / 86400.0, 2),
+        "age_source": age_source,
     }
 
 

@@ -29,8 +29,11 @@ GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
     | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
 
 echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
+# k=6 re-measured alongside (the deep-chain lever, BASELINE r4 queue);
+# k=8 is excluded — it fails Mosaic compile (BASELINE.md Mosaic gates).
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=2 --case fuse=3 --case fuse=4 --case fuse=5 \
+    --case fuse=6 \
     --rounds 6 --out "benchmarks/results/ab_r5_fuseratio_${STAMP}.jsonl" \
     && python benchmarks/update_fuse_ratio.py --apply \
         "benchmarks/results/ab_r5_fuseratio_${STAMP}.jsonl" \

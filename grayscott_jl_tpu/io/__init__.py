@@ -201,6 +201,13 @@ def open_writer(
                                           nwriters=nwriters)
             if _real_bp_evidence(path) or not os.path.exists(path):
                 keep_base = sidecar.read_keep_base(path)
+                if keep_base is not None and not _real_bp_evidence(path):
+                    # Orphaned sidecar at a path whose base store is
+                    # gone (deleted between runs): routing steps there
+                    # would write output no reader looks at, and a new
+                    # base store would graft the stale tail back on.
+                    sidecar.remove_sidecar(path)
+                    keep_base = None
                 if keep_base is not None:
                     # A rollback sidecar already exists: ALL further
                     # appends go there (base steps written after

@@ -268,28 +268,60 @@ def _mesh_candidates(n_devices: int, L: int):
     return out
 
 
+def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
+                     kmin=2, kmax=8, **kw):
+    """Best feasible chain row for ONE mesh: routes (n,1,1) to the 1D
+    x-chain model and everything else to the xy-chain model, applying
+    the SAME feasibility gates the kernel dispatch applies (Mosaic's
+    128-lane tiling on the local z extent, VMEM slab fit, measured
+    fuse-ratio availability) so the model never promises a schedule
+    the kernel would silently decline. ``None`` when no depth in
+    [kmin, kmax] survives. ``local`` defaults to exact division;
+    callers with pad-and-mask storage pass their ceil blocks."""
+    n, m, p = dims
+    if local is None:
+        local = tuple(L // d for d in dims)
+    if itemsize == 8 or min(local) < 2 or local[2] % 128:
+        # f64: the Pallas kernel unconditionally runs its XLA fallback
+        # on TPU (pallas_stencil.fused_step), same as the 128-lane
+        # misalignment case — no chain schedule exists to project.
+        return None
+    sublane = 16 if itemsize == 2 else 8
+    if m == 1 and p == 1:
+        cap = _feasible_chain_depth(
+            local, itemsize, max(kmin, local[0]), ypad=False
+        )
+        ks = [k for k in FUSE_COST_RATIO if kmin <= k <= min(cap, kmax)]
+        rows = [project_1d(n, L, k, base_us_full, itemsize=itemsize, **kw)
+                for k in ks]
+    else:
+        cap = min(kmax, local[0], local[1])
+        if p > 1:
+            cap = min(cap, local[2] // 2)
+        cap = _feasible_chain_depth(local, itemsize, cap, sublane)
+        ks = [k for k in FUSE_COST_RATIO if kmin <= k <= cap]
+        rows = [project_chain(dims, L, k, base_us_full, itemsize=itemsize,
+                              sublane=sublane, **kw)
+                for k in ks]
+    if not rows:
+        return None
+    return max(rows, key=lambda r: r["projected_weak_scaling_eff"])
+
+
 def best_chain(n_devices, L, base_us_full, *, itemsize=4, kmax=8, **kw):
     """Sweep mesh factorization x feasible chain depth for the round-4
     chain; returns the best row (the VERDICT-8 mixed-mesh sweep), or
     ``None`` when no factorization admits a feasible depth >= 2."""
     best = None
     for dims in _mesh_candidates(n_devices, L):
-        local = tuple(L // d for d in dims)
-        if min(local) < 2:
-            continue
-        cap = min(kmax, local[0], local[1])
-        if dims[2] > 1:
-            cap = min(cap, local[2] // 2)
-        cap = _feasible_chain_depth(local, itemsize, cap)
-        for k in range(2, cap + 1):
-            if k not in FUSE_COST_RATIO:
-                continue
-            r = project_chain(dims, L, k, base_us_full,
-                              itemsize=itemsize, **kw)
-            if (best is None
-                    or r["projected_weak_scaling_eff"]
-                    > best["projected_weak_scaling_eff"]):
-                best = r
+        r = best_chain_depth(dims, L, base_us_full, itemsize=itemsize,
+                             kmax=kmax, **kw)
+        if r is not None and (
+            best is None
+            or r["projected_weak_scaling_eff"]
+            > best["projected_weak_scaling_eff"]
+        ):
+            best = r
     return best
 
 
@@ -345,19 +377,11 @@ def project_1d(
 
 
 def best_fuse_1d(n, L, base_us, *, itemsize=4, **kw):
-    # Only depths whose slab scratch actually fits Mosaic's VMEM budget
-    # count — the dispatch caps infeasible depths (advisor finding r3),
-    # so projecting them would promise an unobtainable schedule.
-    cap = _feasible_chain_depth(
-        (L // n, L, L), itemsize, max(2, L // n), ypad=False
-    )
-    ks = [k for k in FUSE_COST_RATIO if k <= cap]
-    if not ks:
-        return None
-    return max(
-        (project_1d(n, L, k, base_us, **kw) for k in ks),
-        key=lambda r: r["projected_weak_scaling_eff"],
-    )
+    """1D x-chain depth sweep including the depth-1 (unfused-exchange)
+    row — the CLI's explicit 1D comparison rows; feasibility gates
+    shared with the kernel dispatch via :func:`best_chain_depth`."""
+    return best_chain_depth((n, 1, 1), L, base_us, itemsize=itemsize,
+                            kmin=1, kmax=max(FUSE_COST_RATIO), **kw)
 
 
 # --------------------------------------------------------- Auto dispatch
@@ -458,6 +482,23 @@ def select_kernel(
         return "xla", info
 
     if n_devices == 1:
+        if itemsize == 8:
+            # The Pallas kernel runs its XLA fallback for f64 on TPU
+            # (pallas_stencil.fused_step); pick XLA openly.
+            info["reason"] = (
+                "single chip: float64 runs the Pallas kernel's XLA "
+                "fallback on TPU; XLA is the executing path"
+            )
+            return "xla", info
+        if L % 128:
+            # Mosaic's 128-lane tiling gate: the kernel would silently
+            # run its XLA fallback at this shape — pick XLA openly so
+            # the recorded language matches what executes.
+            info["reason"] = (
+                f"single chip: L={L} misses Mosaic's 128-lane "
+                "alignment; the Pallas kernel would fall back to XLA"
+            )
+            return "xla", info
         feasible = _feasible_chain_depth(
             (L, L, L), itemsize, max(fuse, 1), ypad=False
         )
@@ -494,26 +535,10 @@ def select_kernel(
     if sweep_mesh:
         chain_row = best_chain(n_devices, L, base_full,
                                itemsize=itemsize, kmax=max(fuse, 2), **kw)
-    elif m == 1 and p == 1:
-        cap = _feasible_chain_depth(local, itemsize, max(2, local[0]),
-                                    ypad=False)
-        ks = [k for k in FUSE_COST_RATIO if 2 <= k <= min(cap, fuse)]
-        chain_row = max(
-            (project_1d(n, L, k, base_full, itemsize=itemsize, **kw)
-             for k in ks),
-            key=lambda r: r["projected_weak_scaling_eff"],
-        ) if ks else None
     else:
-        cap = min(fuse, local[0], local[1])
-        if p > 1:
-            cap = min(cap, local[2] // 2)
-        cap = _feasible_chain_depth(local, itemsize, cap)
-        ks = [k for k in FUSE_COST_RATIO if 2 <= k <= cap]
-        chain_row = max(
-            (project_chain(dims, L, k, base_full, itemsize=itemsize, **kw)
-             for k in ks),
-            key=lambda r: r["projected_weak_scaling_eff"],
-        ) if ks else None
+        chain_row = best_chain_depth(dims, L, base_full, local=local,
+                                     itemsize=itemsize,
+                                     kmax=max(fuse, 2), **kw)
     if chain_row is not None:
         chain_row["kernel"] = "pallas"
 

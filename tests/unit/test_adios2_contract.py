@@ -219,6 +219,39 @@ def test_second_rollback_within_sidecar(fake_adios2, tmp_path):
     r.close()
 
 
+def test_append_to_missing_store_discards_orphaned_sidecar(fake_adios2,
+                                                           tmp_path):
+    """Append at a path whose base store is GONE but whose sidecar dir
+    survived must start a fresh base store, not silently route output
+    into the orphan (r5 review finding: no reader would ever look
+    there, and a new base store would graft the stale tail back on)."""
+    import shutil
+
+    from grayscott_jl_tpu.io import (_real_bp_evidence, adios,
+                                     open_reader, open_writer, sidecar)
+
+    path = str(tmp_path / "out.bp")
+    _write_store(path, steps=3, L=4)
+    w = open_writer(path, append=True, keep_steps=1)  # creates sidecar
+    w.close()
+    shutil.rmtree(path)  # base store deleted; orphaned sidecar remains
+    assert sidecar.read_keep_base(path) == 1
+
+    w = open_writer(path, append=True)
+    assert isinstance(w, adios.Adios2Writer)  # fresh base store
+    assert sidecar.read_keep_base(path) is None
+    w.define_variable("step", np.int32)
+    w.begin_step()
+    w.put("step", np.int32(5))
+    w.end_step()
+    w.close()
+    assert _real_bp_evidence(path)
+    r = open_reader(path)
+    assert not isinstance(r, sidecar.MergedReader)
+    assert r.num_steps() == 1
+    r.close()
+
+
 def test_live_reader_survives_sidecar_metadata_window(fake_adios2,
                                                       tmp_path):
     """A live consumer attaching between the sidecar marker write and

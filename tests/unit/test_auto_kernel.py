@@ -24,8 +24,14 @@ requires8 = pytest.mark.skipif(
 @pytest.fixture(autouse=True)
 def _big_vmem():
     # Model feasibility checks must not depend on which backend the
-    # test host happens to expose.
+    # test host happens to expose; restore the lazy budget after so
+    # later test modules resolve it from the real backend themselves.
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    prev = ps._VMEM_BUDGET
     icimodel.pin_big_vmem()
+    yield
+    ps._VMEM_BUDGET = prev
 
 
 def _settings(**kw):
@@ -47,6 +53,39 @@ def test_off_tpu_resolves_to_xla():
 def test_single_chip_tpu_resolves_to_pallas():
     lang, info = icimodel.select_kernel((1, 1, 1), 256, platform="tpu")
     assert lang == "pallas"
+
+
+def test_float64_resolves_to_xla():
+    """The Pallas kernel unconditionally runs its XLA fallback for f64
+    on TPU (pallas_stencil.fused_step), so Auto must pick XLA openly —
+    single chip and sharded (no phantom chain candidate either)."""
+    lang, info = icimodel.select_kernel((1, 1, 1), 256, platform="tpu",
+                                        itemsize=8)
+    assert lang == "xla"
+    assert "float64" in info["reason"]
+    lang, info = icimodel.select_kernel(
+        (2, 2, 2), 512, platform="tpu", device_kind="TPU v5p",
+        itemsize=8, objective="throughput",
+    )
+    assert lang == "xla"
+    assert [r["kernel"] for r in info["rows"]] == ["xla"]
+
+
+def test_lane_misaligned_shapes_resolve_to_xla():
+    """Mosaic's 128-lane tiling gate (pallas_stencil.fused_step): at
+    shapes where the kernel silently runs its XLA fallback on TPU,
+    Auto must pick XLA openly so the recorded language matches what
+    executes — single chip (L=64) and a forced mesh whose local z
+    extent misses alignment."""
+    lang, info = icimodel.select_kernel((1, 1, 1), 64, platform="tpu")
+    assert lang == "xla"
+    assert "128-lane" in info["reason"]
+    # forced (1,1,4) mesh at L=256: local z = 64, chain infeasible
+    lang, info = icimodel.select_kernel(
+        (1, 1, 4), 256, platform="tpu", device_kind="TPU v5p"
+    )
+    assert lang == "xla"
+    assert [r["kernel"] for r in info["rows"]] == ["xla"]
 
 
 def test_pod_scale_efficiency_objective_picks_the_90pct_holder():
