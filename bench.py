@@ -258,7 +258,7 @@ def _last_tpu_provenance():
             # which would claim a days-old measurement is minutes old.
             when = os.path.getmtime(p)
             age_source = "file_mtime"
-            if captured:
+            if isinstance(captured, str):
                 try:
                     import datetime
 

@@ -199,9 +199,10 @@ def open_writer(
                             os.remove(os.path.join(path, name))
                 return adios.Adios2Writer(path, writer_id=writer_id,
                                           nwriters=nwriters)
-            if _real_bp_evidence(path) or not os.path.exists(path):
+            has_bp = _real_bp_evidence(path)
+            if has_bp or not os.path.exists(path):
                 keep_base = sidecar.read_keep_base(path)
-                if keep_base is not None and not _real_bp_evidence(path):
+                if keep_base is not None and not has_bp:
                     # Orphaned sidecar at a path whose base store is
                     # gone (deleted between runs): routing steps there
                     # would write output no reader looks at, and a new
@@ -226,7 +227,7 @@ def open_writer(
                         nwriters=nwriters, append=True,
                         keep_steps=inner_keep,
                     )
-                if keep_steps is not None and _real_bp_evidence(path):
+                if keep_steps is not None and has_bp:
                     r = adios.Adios2Reader(path)
                     try:
                         total = r.num_steps()

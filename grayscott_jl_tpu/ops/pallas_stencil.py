@@ -210,6 +210,24 @@ def mid_itemsize_for(dtype) -> int:
     return jnp.dtype(_mid_store_dtype(dt, mid_bf16)).itemsize
 
 
+def mosaic_gate_reason(local, itemsize: int):
+    """Why this local block can NEVER run the fused kernel on TPU, or
+    None when it can (subject to the VMEM checks below). ONE statement
+    of the dispatch-level gates in :func:`fused_step` (f64 fallback,
+    128-lane tiling of the z extent) shared with the ICI model's Auto
+    dispatch (``parallel/icimodel.py``) — the model must never promise
+    a schedule the kernel would silently decline. The y-sublane gate is
+    not here: chain operands arrive y-extended and sublane-rounded, and
+    a 128-aligned cubic block satisfies it by construction."""
+    nz = local[2]
+    if itemsize == 8:
+        return "float64 runs the Pallas kernel's XLA fallback on TPU"
+    if nz % 128:
+        return (f"local z extent {nz} misses Mosaic's 128-lane "
+                "alignment; the Pallas kernel would fall back to XLA")
+    return None
+
+
 def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
                       fuse: int, mid_itemsize: int = None) -> int:
     """Deepest chain depth <= ``fuse`` whose slab scratch fits the VMEM

@@ -278,13 +278,14 @@ def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
     the kernel would silently decline. ``None`` when no depth in
     [kmin, kmax] survives. ``local`` defaults to exact division;
     callers with pad-and-mask storage pass their ceil blocks."""
+    from ..ops import pallas_stencil as ps
+
     n, m, p = dims
     if local is None:
         local = tuple(L // d for d in dims)
-    if itemsize == 8 or min(local) < 2 or local[2] % 128:
-        # f64: the Pallas kernel unconditionally runs its XLA fallback
-        # on TPU (pallas_stencil.fused_step), same as the 128-lane
-        # misalignment case — no chain schedule exists to project.
+    if min(local) < 2 or ps.mosaic_gate_reason(local, itemsize):
+        # Dispatch-level Mosaic gates (f64 fallback, 128-lane tiling)
+        # shared with the kernel — no chain schedule exists to project.
         return None
     sublane = 16 if itemsize == 2 else 8
     if m == 1 and p == 1:
@@ -482,22 +483,14 @@ def select_kernel(
         return "xla", info
 
     if n_devices == 1:
-        if itemsize == 8:
-            # The Pallas kernel runs its XLA fallback for f64 on TPU
-            # (pallas_stencil.fused_step); pick XLA openly.
-            info["reason"] = (
-                "single chip: float64 runs the Pallas kernel's XLA "
-                "fallback on TPU; XLA is the executing path"
-            )
-            return "xla", info
-        if L % 128:
-            # Mosaic's 128-lane tiling gate: the kernel would silently
-            # run its XLA fallback at this shape — pick XLA openly so
-            # the recorded language matches what executes.
-            info["reason"] = (
-                f"single chip: L={L} misses Mosaic's 128-lane "
-                "alignment; the Pallas kernel would fall back to XLA"
-            )
+        from ..ops import pallas_stencil as ps
+
+        gate = ps.mosaic_gate_reason((L, L, L), itemsize)
+        if gate is not None:
+            # The kernel would silently run its XLA fallback at this
+            # shape/dtype — pick XLA openly so the recorded language
+            # matches what executes.
+            info["reason"] = f"single chip: {gate}"
             return "xla", info
         feasible = _feasible_chain_depth(
             (L, L, L), itemsize, max(fuse, 1), ypad=False
@@ -532,13 +525,17 @@ def select_kernel(
     # x-chain, anything else the xy-chain (+ z bands when p > 1), at
     # the deepest VMEM-feasible depth <= the configured fuse.
     base_full = anchor_us("Pallas", L)
-    if sweep_mesh:
+    if fuse < 2:
+        # GS_FUSE=1 pins the unfused exchange: no chain schedule is
+        # available to the run, so projecting one would justify the
+        # pick with a schedule that cannot execute.
+        chain_row = None
+    elif sweep_mesh:
         chain_row = best_chain(n_devices, L, base_full,
-                               itemsize=itemsize, kmax=max(fuse, 2), **kw)
+                               itemsize=itemsize, kmax=fuse, **kw)
     else:
         chain_row = best_chain_depth(dims, L, base_full, local=local,
-                                     itemsize=itemsize,
-                                     kmax=max(fuse, 2), **kw)
+                                     itemsize=itemsize, kmax=fuse, **kw)
     if chain_row is not None:
         chain_row["kernel"] = "pallas"
 

@@ -139,6 +139,25 @@ def test_stats_json_written(tmp_path):
     assert cfg_echo["n_devices"] == 8
     assert cfg_echo["kernel_language"] == "xla"  # "Plain" normalizes
     assert cfg_echo["padded_storage"] is None  # divisible L
+    assert cfg_echo["kernel_selection"] is None  # explicitly pinned
+
+
+def test_stats_json_records_auto_selection(tmp_path):
+    """kernel_language = "Auto": the stats echo must carry the model's
+    decision record so a pod operator can audit which kernel ran and
+    why (r5; the resolved language is also in kernel_language)."""
+    import json
+
+    cfg = write_config(tmp_path, noise=0.1, kernel_language="Auto")
+    stats_path = tmp_path / "stats.json"
+    res = run_cli(tmp_path, cfg, extra_env={"GS_TPU_STATS": str(stats_path)})
+    assert res.returncode == 0, res.stderr + res.stdout
+    stats = json.loads(stats_path.read_text())
+    assert stats["config"]["kernel_language"] == "xla"  # CPU host
+    sel = stats["config"]["kernel_selection"]
+    assert sel["platform"] == "cpu"
+    assert "reason" in sel
+    assert "Auto resolved" in res.stderr
 
 
 def test_cli_rejects_bad_config(tmp_path):

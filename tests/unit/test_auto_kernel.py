@@ -115,6 +115,18 @@ def test_pod_scale_throughput_objective_picks_the_faster_chain():
     assert by["pallas"] < by["xla"]
 
 
+def test_fuse_1_suppresses_the_chain_candidate():
+    """GS_FUSE=1 pins the unfused exchange; Auto must not justify a
+    Pallas pick with a k>=2 chain projection the run cannot execute
+    (r5 review finding)."""
+    lang, info = icimodel.select_kernel(
+        (8, 1, 1), 256, platform="tpu", device_kind="TPU v5 lite",
+        fuse=1, objective="throughput",
+    )
+    assert lang == "xla"
+    assert [r["kernel"] for r in info["rows"]] == ["xla"]
+
+
 def test_bad_objective_raises():
     with pytest.raises(ValueError, match="GS_AUTO_OBJECTIVE"):
         icimodel.select_kernel((2, 2, 2), 16, platform="tpu",
