@@ -253,6 +253,52 @@ def test_pallas_matches_xla_on_tpu(noise):
 
 
 @requires_tpu
+def test_auto_dispatch_on_hardware():
+    """kernel_language = "Auto" on the real chip (r5): a 128-aligned
+    f32 single-chip config must resolve to the Pallas kernel (and run
+    it — agreement with the XLA kernel to f32 roundoff), a misaligned
+    or f64 config to XLA, openly."""
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    common = dict(L=128, noise=0.1, precision="Float32", backend="TPU",
+                  Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+    auto = Simulation(Settings(kernel_language="Auto", **common),
+                      n_devices=1)
+    assert auto.kernel_language == "pallas"
+    assert auto.kernel_selection["platform"] == "tpu"
+    auto.iterate(10)
+    ref = Simulation(Settings(kernel_language="Plain", **common),
+                     n_devices=1)
+    ref.iterate(10)
+    np.testing.assert_allclose(
+        np.asarray(auto.get_fields()[0]), np.asarray(ref.get_fields()[0]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # Mosaic gates resolve to XLA openly (the kernel would silently
+    # fall back at these configs; the label must match what executes).
+    mis = Simulation(
+        Settings(**{**common, "L": 64, "kernel_language": "Auto"}),
+        n_devices=1,
+    )
+    assert mis.kernel_language == "xla"
+    # resolve_precision flips the jax_enable_x64 global; restore it so
+    # the remaining hardware tests run in the same JAX mode they see
+    # when run alone.
+    prev_x64 = jax.config.jax_enable_x64
+    try:
+        f64 = Simulation(
+            Settings(**{**common, "precision": "Float64",
+                        "kernel_language": "Auto"}),
+            n_devices=1,
+        )
+        assert f64.kernel_language == "xla"
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+
+
+@requires_tpu
 def test_x_chain_kernel_on_hardware():
     """The Mosaic-compiled x-chain (fuse-wide x faces feeding the
     in-kernel temporal chain — the 1D-sharded mode's kernel) against
