@@ -1,0 +1,113 @@
+"""A/B the async output pipeline against the synchronous fallback.
+
+Runs the real CLI twice on an output-dominated CPU config (small L,
+tiny plotgap, checkpoints on) — once with ``GS_ASYNC_IO_DEPTH=0`` (the
+reference's synchronous flow) and once with the requested depth(s) —
+and reports driver wall time plus the RunStats overlap accounting
+(``io.hidden_s`` / ``io.exposed_s`` / ``queue_depth_hwm``), one JSON
+line per run.
+
+Usage::
+
+    python benchmarks/async_io_bench.py [--L 64] [--steps 40]
+        [--plotgap 2] [--ckpt-freq 10] [--depths 0,2] [--repeat 3]
+
+The figure of merit: with output dominating, wall time at depth>=1
+should drop toward the compute floor and ``io.hidden_s`` should absorb
+most of the write time the depth-0 run exposes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+CONFIG = """\
+L = {L}
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = {plotgap}
+steps = {steps}
+noise = 0.1
+output = "gs.bp"
+checkpoint = {checkpoint}
+checkpoint_freq = {ckpt_freq}
+checkpoint_output = "ckpt.bp"
+mesh_type = "image"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "Plain"
+verbose = false
+"""
+
+
+def run_once(args, depth: int) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        cfg = Path(td) / "config.toml"
+        cfg.write_text(CONFIG.format(
+            L=args.L, steps=args.steps, plotgap=args.plotgap,
+            checkpoint="true" if args.ckpt_freq > 0 else "false",
+            ckpt_freq=max(args.ckpt_freq, 1),
+        ))
+        stats_path = Path(td) / "stats.json"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["GS_ASYNC_IO_DEPTH"] = str(depth)
+        env["GS_TPU_STATS"] = str(stats_path)
+        t0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+            cwd=td, env=env, capture_output=True, text=True,
+        )
+        wall = time.perf_counter() - t0
+        if res.returncode != 0:
+            raise RuntimeError(res.stderr)
+        stats = json.loads(stats_path.read_text())
+    io = stats.get("io") or {}
+    return {
+        "depth": depth,
+        "process_wall_s": round(wall, 3),
+        "driver_wall_s": stats["wall_s"],
+        "compute_s": stats["phases_s"].get("compute"),
+        "io_hidden_s": round(sum(io.get("hidden_s", {}).values()), 6),
+        "io_exposed_s": round(sum(io.get("exposed_s", {}).values()), 6),
+        "queue_depth_hwm": io.get("queue_depth_hwm"),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--L", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--plotgap", type=int, default=2)
+    ap.add_argument("--ckpt-freq", type=int, default=10)
+    ap.add_argument("--depths", default="0,2")
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    for depth in (int(d) for d in args.depths.split(",")):
+        runs = [run_once(args, depth) for _ in range(args.repeat)]
+        best = min(runs, key=lambda r: r["driver_wall_s"])
+        best["driver_wall_s_median"] = round(
+            statistics.median(r["driver_wall_s"] for r in runs), 3
+        )
+        print(json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()

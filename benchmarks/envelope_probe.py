@@ -169,7 +169,7 @@ def main() -> int:
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=ps._COMPILER_PARAMS(
             vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
         ),
         interpret=interp,
@@ -409,7 +409,7 @@ def main() -> int:
                 pltpu.SemaphoreType.DMA((1, 2)),
                 pltpu.SemaphoreType.DMA((1, 2)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=ps._COMPILER_PARAMS(
                 vmem_limit_bytes=ps._vmem_budget() + 16 * 1024 * 1024,
             ),
             interpret=interp,

@@ -20,7 +20,12 @@ from .config.settings import (  # noqa: F401
     parse_settings_toml,
     resolve_precision,
 )
-from .simulation import Simulation, finalize, initialization  # noqa: F401
+from .simulation import (  # noqa: F401
+    FieldSnapshot,
+    Simulation,
+    finalize,
+    initialization,
+)
 
 __version__ = "0.2.0"
 

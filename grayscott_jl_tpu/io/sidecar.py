@@ -43,12 +43,18 @@ def sidecar_path(path: str) -> str:
 
 def read_keep_base(path: str) -> Optional[int]:
     """``keep_base`` from the sidecar marker of store ``path``, or None
-    when no (valid) sidecar exists."""
+    when no (valid) sidecar exists.
+
+    TypeError covers corrupt markers whose JSON parses but has the
+    wrong shape (top-level list, null ``keep_base``): a damaged sidecar
+    must degrade to the documented no-sidecar behavior, not raise out
+    of ``open_reader``/``open_writer``/``count_steps_upto``."""
     try:
         with open(os.path.join(sidecar_path(path), _MARKER),
                   encoding="utf-8") as f:
             return int(json.load(f)["keep_base"])
-    except (FileNotFoundError, NotADirectoryError, KeyError, ValueError):
+    except (FileNotFoundError, NotADirectoryError, KeyError, ValueError,
+            TypeError):
         return None
 
 

@@ -72,6 +72,48 @@ from jax.experimental.pallas import tpu as pltpu
 from . import stencil
 from .noise import _u32, block_bits, plane_seed, uniform_pm1_block
 
+# Name compat across jax releases: CompilerParams/InterpretParams are
+# the jax >= 0.6 spellings; older releases export TPUCompilerParams and
+# may lack the TPU-semantics interpreter entirely (``None`` here), in
+# which case interpret-mode kernels run on the generic HLO interpreter
+# and DMA/compute race detection is unavailable.
+_COMPILER_PARAMS = getattr(
+    pltpu, "CompilerParams", None
+) or pltpu.TPUCompilerParams
+_INTERPRET_PARAMS = getattr(pltpu, "InterpretParams", None) or getattr(
+    pltpu, "TPUInterpretParams", None
+)
+
+
+def interpret_supports_race_detection() -> bool:
+    """Whether this jax ships the TPU-semantics interpreter with the
+    DMA/compute race detector (``detect_races`` is silently meaningless
+    on the generic HLO interpreter, so callers gate on this)."""
+    import inspect
+
+    return (
+        _INTERPRET_PARAMS is not None
+        and "detect_races"
+        in inspect.signature(_INTERPRET_PARAMS).parameters
+    )
+
+
+def _interpret_arg(detect_races: bool):
+    """The ``pallas_call(interpret=...)`` value for interpret mode on
+    this jax: the TPU-semantics interpreter when available (eager DMA
+    so tests see deterministic copies), else plain ``True``."""
+    if _INTERPRET_PARAMS is None:
+        return True
+    import inspect
+
+    params = inspect.signature(_INTERPRET_PARAMS).parameters
+    kw = {}
+    if "dma_execution_mode" in params:
+        kw["dma_execution_mode"] = "eager"
+    if "detect_races" in params:
+        kw["detect_races"] = detect_races
+    return _INTERPRET_PARAMS(**kw)
+
 #: VMEM scratch budget for slab buffers, keyed on the device generation:
 #: v4/v5/v6 cores carry 128 MiB of VMEM — 96 lets fuse=4 keep bx=16
 #: (read amplification (bx+2k)/bx = 1.5 rather than 2 at bx=8) while
@@ -719,19 +761,14 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
         # Mosaic's default scoped-VMEM cap is well below the slab budget;
         # without an explicit limit L=256 f32 OOMs at kernel-stack
         # allocation even though the scratch fits physical VMEM.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             vmem_limit_bytes=_vmem_budget() + 16 * 1024 * 1024,
         ),
         # The TPU-semantics interpreter (not the generic HLO one) models
         # SMEM/semaphores/DMA on CPU for tests. ``detect_races`` is a
         # static jit argument so toggling it cannot be swallowed by the
         # jit cache (it is part of the cache key).
-        interpret=pltpu.InterpretParams(
-            dma_execution_mode="eager",
-            detect_races=detect_races,
-        )
-        if interpret
-        else False,
+        interpret=_interpret_arg(detect_races) if interpret else False,
     )(*operands)
 
 

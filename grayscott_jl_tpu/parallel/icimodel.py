@@ -167,8 +167,10 @@ def project_chain(
     fuse: int,
     base_us_full: float,
     *,
+    local=None,
     itemsize: int = 4,
     sublane: int = 8,
+    links: int = 6,
     link_gbps: float = 90.0,
     hop_us: float = 1.0,
     overlap: float = 0.0,
@@ -196,10 +198,20 @@ def project_chain(
 
     ``base_us_full`` is the fused single-chip µs/step for the WHOLE L^3
     grid; per-shard compute is 1/(n*m*p) of it (throughput-flat,
-    conservative for big locals).
+    conservative for big locals). ``local`` overrides the per-shard
+    block shape — callers with pad-and-mask storage (non-divisible L)
+    pass their ceil blocks so the projection describes the block shape
+    actually run, the one the feasibility gates were applied to;
+    the default is exact floor division. ``links`` is the number of
+    torus links the exchange can ride (``fabric_for``): with fewer
+    links than faces the serialization completes at the max-loaded
+    link carrying ceil(n_faces/links) faces, mirroring ``project()``'s
+    ``faces_per_link`` — a v5e/v6e 2D torus (4 links) pays 2 faces on
+    the shared links for a z-sharded chain.
     """
     n, m, p = dims
-    local = (L // n, L // m, L // p)
+    if local is None:
+        local = (L // n, L // m, L // p)
     nx, ny, nz = local
     us_base = base_us_full / (n * m * p)
     r = FUSE_COST_RATIO.get(fuse)
@@ -227,8 +239,10 @@ def project_chain(
         face_bytes = max(ny_ext * nz, nx * nz) * itemsize * 2
         n_faces = (2 if n > 1 else 0) + (2 if m > 1 else 0)
     # k-wide slabs every k steps -> per-step bytes are k-independent;
-    # completion at the largest face's link.
-    ser_us = face_bytes / (link_gbps * 1e3)
+    # completion at the MAX-loaded link: with fewer links than faces
+    # (v5e/v6e 2D torus) some links carry ceil(n_faces/links) faces.
+    faces_per_link = -(-n_faces // links) if n_faces else 0
+    ser_us = faces_per_link * face_bytes / (link_gbps * 1e3)
     lat_us = n_faces * hop_us / k
     comm_us = (ser_us + lat_us) * (1.0 - overlap)
 
@@ -244,6 +258,7 @@ def project_chain(
         "x_ring_recompute": round(x_ring, 4),
         "z_band_us_per_step": round(band_us, 2),
         "comm_us_per_step_exposed": round(comm_us, 2),
+        "links": links,
         "link_gbps": link_gbps,
         "overlap": overlap,
         "projected_weak_scaling_eff": round(eff, 4),
@@ -293,7 +308,11 @@ def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
             local, itemsize, max(kmin, local[0]), ypad=False
         )
         ks = [k for k in FUSE_COST_RATIO if kmin <= k <= min(cap, kmax)]
-        rows = [project_1d(n, L, k, base_us_full, itemsize=itemsize, **kw)
+        # The projection must describe the SAME block shape the gates
+        # above were applied to — pass ``local`` through instead of
+        # letting the model recompute it with floor division.
+        rows = [project_1d(n, L, k, base_us_full, local=local,
+                           itemsize=itemsize, **kw)
                 for k in ks]
     else:
         cap = min(kmax, local[0], local[1])
@@ -301,8 +320,8 @@ def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
             cap = min(cap, local[2] // 2)
         cap = _feasible_chain_depth(local, itemsize, cap, sublane)
         ks = [k for k in FUSE_COST_RATIO if kmin <= k <= cap]
-        rows = [project_chain(dims, L, k, base_us_full, itemsize=itemsize,
-                              sublane=sublane, **kw)
+        rows = [project_chain(dims, L, k, base_us_full, local=local,
+                              itemsize=itemsize, sublane=sublane, **kw)
                 for k in ks]
     if not rows:
         return None
@@ -332,7 +351,9 @@ def project_1d(
     fuse: int,
     base_us_per_step: float,
     *,
+    local=None,
     itemsize: int = 4,
+    links: int = 6,
     link_gbps: float = 90.0,
     hop_us: float = 1.0,
     overlap: float = 0.0,
@@ -348,17 +369,24 @@ def project_1d(
     ``base_us_per_step`` is the fused single-chip time for the WHOLE
     L^3 grid (the 1-chip baseline); per-shard compute is 1/n of it
     (throughput-flat assumption, conservative: bigger blocks measure
-    closer to roofline).
+    closer to roofline). ``local`` overrides the (nx, ny, nz) block
+    shape (pad-and-mask ceil blocks for non-divisible L; default is
+    floor division with full L x L slab faces); ``links`` caps how
+    many torus links the 2-face exchange can ride.
     """
-    nx = L // n
+    if local is None:
+        local = (L // n, L, L)
+    nx, ny, nz = local
     us_base = base_us_per_step / n
     recompute = 1.0 + (fuse - 1) / nx  # ring grows only along x
     r = FUSE_COST_RATIO.get(fuse)
     if r is None:
         raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
     # k-wide slab each direction every k steps => per-step bytes are
-    # k-independent; each face rides its own x link.
-    ser_us = L * L * itemsize * 2 / (link_gbps * 1e3)
+    # k-independent; with >= 2 usable links each face rides its own x
+    # link, else they serialize on the shared one.
+    faces_per_link = -(-2 // links)
+    ser_us = faces_per_link * ny * nz * itemsize * 2 / (link_gbps * 1e3)
     lat_us = 2 * hop_us / fuse
     comm_us = (ser_us + lat_us) * (1.0 - overlap)
     eff = us_base / (us_base * r * recompute + comm_us)
@@ -371,6 +399,7 @@ def project_1d(
         "compute_us_per_step": round(us_base, 1),
         "ring_recompute_ratio": round(recompute, 4),
         "comm_us_per_step_exposed": round(comm_us, 2),
+        "links": links,
         "link_gbps": link_gbps,
         "overlap": overlap,
         "projected_weak_scaling_eff": round(eff, 4),
@@ -508,7 +537,13 @@ def select_kernel(
 
     link_gbps, links = fabric_for(device_kind)
     info["link_gbps"], info["links"] = link_gbps, links
-    kw = dict(link_gbps=link_gbps, hop_us=hop_us, overlap=overlap)
+    # ``links`` rides along to BOTH languages' projections: the chain
+    # models share the serialization-at-the-max-loaded-link treatment
+    # with project(), so Auto's cross-language pick no longer
+    # underestimates z-sharded Pallas chain comm on 2D-torus fabrics
+    # (v5e/v6e: 6 faces on 4 links).
+    kw = dict(links=links, link_gbps=link_gbps, hop_us=hop_us,
+              overlap=overlap)
 
     # XLA language on the actual mesh: locals may be non-cubic; use the
     # cubic-equivalent side (the model's project() is cubic) — face
@@ -516,8 +551,7 @@ def select_kernel(
     local = tuple(-(-L // d) for d in dims)  # ceil: pad-and-mask storage
     side = round((local[0] * local[1] * local[2]) ** (1 / 3))
     xla_us = anchor_us("XLA", L) / n_devices
-    xla_row = best_fuse(side, xla_us, links=links, itemsize=itemsize,
-                        **kw)
+    xla_row = best_fuse(side, xla_us, itemsize=itemsize, **kw)
     xla_row["kernel"] = "xla"
 
     # Pallas chain: at the best swept mesh when the caller lets us pick

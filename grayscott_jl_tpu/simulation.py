@@ -37,6 +37,17 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+# The flag that skips the varying-mesh-axes/replication check was renamed
+# check_rep -> check_vma across jax versions; resolve the spelling this
+# jax actually takes so the sharded runner constructs on both.
+import inspect as _inspect
+
+_SHARD_MAP_CHECK_FLAG = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else "check_rep"
+)
+
 from .config import settings as config
 from .config.settings import Settings
 from .models import grayscott
@@ -166,6 +177,45 @@ def select_devices(platform: str):
     return devices
 
 
+class FieldSnapshot:
+    """A device-detached capture of (u, v) draining to the host.
+
+    Produced by :meth:`Simulation.snapshot_async`: the fields are copied
+    into fresh device buffers and every addressable shard has a
+    non-blocking device-to-host transfer in flight by the time the
+    constructor returns. :meth:`blocks` resolves (blocking only on the
+    remaining transfer time) to the ``local_blocks()`` format —
+    ``[(offsets, sizes, u_block, v_block), ...]`` — so a background
+    writer thread can serialize/write while the driver thread dispatches
+    the next compute chunk (``io/async_writer.py``).
+
+    Lifetime contract: the snapshot owns its device buffers outright —
+    it stays valid across later ``iterate`` calls even though those
+    donate (and thereby delete) the simulation's own field buffers.
+    """
+
+    def __init__(self, parts, step: int):
+        #: Simulation step the snapshot was taken at.
+        self.step = step
+        self._parts = parts  # [(offsets, true_sizes, u_dev, v_dev), ...]
+        self._blocks = None
+
+    def blocks(self):
+        """Host blocks ``[(offsets, sizes, u_block, v_block), ...]``,
+        clipped to the true domain; blocks until the in-flight D2H
+        transfers land (idempotent — resolved once, then cached)."""
+        if self._blocks is None:
+            out = []
+            for offsets, true, ud, vd in self._parts:
+                sl = tuple(slice(0, t) for t in true)
+                out.append(
+                    (offsets, true, np.asarray(ud)[sl], np.asarray(vd)[sl])
+                )
+            self._blocks = out
+            self._parts = None  # release the device buffers
+        return self._blocks
+
+
 class Simulation:
     """A running Gray-Scott simulation bound to a set of devices."""
 
@@ -262,6 +312,7 @@ class Simulation:
         self.base_key = jax.random.PRNGKey(seed)
         self.step = 0
         self._runners: Dict[int, object] = {}
+        self._snapshot_copy = None
 
         if self.sharded:
             if backend == "tpu":
@@ -649,8 +700,9 @@ class Simulation:
                 in_specs=(spec, spec, rep, rep, rep),
                 out_specs=(spec, spec),
                 # pallas_call outputs carry no varying-mesh-axes metadata;
-                # skip the vma check (shardings are fully explicit here).
-                check_vma=False,
+                # skip the vma/replication check (shardings are fully
+                # explicit here; flag spelling is version-dependent).
+                **{_SHARD_MAP_CHECK_FLAG: False},
             )
         else:
             fn = local
@@ -691,6 +743,65 @@ class Simulation:
         )
         self.step += nsteps
 
+    def _shard_parts(self, u, v):
+        """Per-addressable-shard ``(offsets, true_sizes, u_dev, v_dev)``
+        — the device-side half of the output path: each entry carries
+        the shard's global (start, count) box clipped to the true
+        domain (non-divisible L stores pad cells past L on the high
+        edge of the last block per axis; framework internals that never
+        leave the process) plus the single-device shard arrays."""
+        L = self.settings.L
+
+        def box(index):
+            # Slices are unhashable before py3.12, so shards are matched
+            # across u/v by their (start, count) box, not the raw index.
+            idx = index if isinstance(index, tuple) else (index,)
+            offsets = tuple(sl.start or 0 for sl in idx)
+            sizes = tuple(
+                (sl.stop or g) - (sl.start or 0)
+                for sl, g in zip(idx, u.shape)
+            )
+            return offsets, sizes
+
+        v_shards = {box(s.index): s for s in v.addressable_shards}
+        parts = []
+        for sh in u.addressable_shards:
+            offsets, sizes = box(sh.index)
+            true = tuple(min(L - o, s) for o, s in zip(offsets, sizes))
+            parts.append(
+                (offsets, true, sh.data, v_shards[(offsets, sizes)].data)
+            )
+        return parts
+
+    def snapshot_async(self) -> FieldSnapshot:
+        """Capture the current (u, v) for overlapped output: returns a
+        :class:`FieldSnapshot` with non-blocking D2H transfers already
+        in flight, so the caller can hand it to a background writer and
+        immediately dispatch the next compute chunk.
+
+        The fields are first copied into FRESH device buffers (one
+        asynchronously dispatched device-side pass): the next donated
+        ``iterate`` call aliases the current field buffers into its
+        outputs and marks them deleted, which invalidates every shard
+        view of them — holding a reference to the old arrays does NOT
+        protect the data. The copy is storage the runner never sees, so
+        the snapshot stays valid for as long as the consumer needs it.
+        """
+        if self._snapshot_copy is None:
+            self._snapshot_copy = jax.jit(
+                # +0 forces a real output buffer (no donation, so XLA
+                # never aliases inputs into outputs); sharding follows
+                # the inputs.
+                lambda u, v: (u + jnp.zeros((), u.dtype),
+                              v + jnp.zeros((), v.dtype))
+            )
+        uc, vc = self._snapshot_copy(self.u, self.v)
+        parts = self._shard_parts(uc, vc)
+        for _, _, ud, vd in parts:
+            ud.copy_to_host_async()
+            vd.copy_to_host_async()
+        return FieldSnapshot(parts, self.step)
+
     def local_blocks(self):
         """Per-addressable-shard ``(offsets, sizes, u_block, v_block)``.
 
@@ -698,37 +809,16 @@ class Simulation:
         owns, with their global (start, count) boxes — the ADIOS2
         per-rank-decomposition analog (``IO.jl:60-67``). Single device
         yields one whole-grid block.
+
+        Synchronous form: reads the live field buffers directly (no
+        device-side copy) and blocks until the values are on the host —
+        callers must consume the result before the next ``iterate``.
+        For output overlapped with compute use :meth:`snapshot_async`.
         """
         jax.block_until_ready((self.u, self.v))
-        L = self.settings.L
-        v_shards = {
-            tuple(s.index if isinstance(s.index, tuple) else (s.index,)):
-                s for s in self.v.addressable_shards
-        }
-        out = []
-        for sh in self.u.addressable_shards:
-            key = tuple(
-                sh.index if isinstance(sh.index, tuple) else (sh.index,)
-            )
-            offsets = tuple(sl.start or 0 for sl in sh.index)
-            sizes = tuple(
-                (sl.stop or g) - (sl.start or 0)
-                for sl, g in zip(sh.index, self.u.shape)
-            )
-            # Clip to the true domain: non-divisible L stores pad cells
-            # past L on the high edge of the last block per axis; they
-            # are framework internals and never leave the process.
-            true = tuple(min(L - o, s) for o, s in zip(offsets, sizes))
-            sl = tuple(slice(0, t) for t in true)
-            out.append(
-                (
-                    offsets,
-                    true,
-                    np.asarray(sh.data)[sl],
-                    np.asarray(v_shards[key].data)[sl],
-                )
-            )
-        return out
+        return FieldSnapshot(
+            self._shard_parts(self.u, self.v), self.step
+        ).blocks()
 
     def restore_from_reader(self, reader, step_index: int, step: int) -> None:
         """Restore state with per-shard selection reads — each process

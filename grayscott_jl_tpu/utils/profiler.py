@@ -40,6 +40,11 @@ class RunStats:
         self.config = dict(config or {})
         self.phases: Dict[str, float] = {}
         self.counters: Dict[str, int] = {}
+        #: Async-output overlap accounting (``io/async_writer.py``):
+        #: per-phase ``hidden_s`` (I/O that ran behind compute) vs
+        #: ``exposed_s`` (driver-blocked), plus queue-depth high-water
+        #: mark — how much I/O wall time the pipeline actually hid.
+        self.io: Optional[dict] = None
         self._t0 = time.perf_counter()
 
     @contextlib.contextmanager
@@ -55,6 +60,11 @@ class RunStats:
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
 
+    def record_io(self, overlap: Optional[dict]) -> None:
+        """Attach the async-writer overlap stats
+        (``AsyncStepWriter.overlap_stats()``) to the summary."""
+        self.io = dict(overlap) if overlap else None
+
     def summary(self) -> dict:
         total = time.perf_counter() - self._t0
         steps = self.counters.get("steps", 0)
@@ -67,6 +77,7 @@ class RunStats:
             "steps": steps,
             "wall_s": round(total, 6),
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
+            "io": self.io,
             "counters": dict(self.counters),
             "cell_updates_per_s": (
                 round(self.L**3 * steps / compute, 3) if compute > 0 else None

@@ -392,3 +392,27 @@ def test_simulation_output_through_adios2_engine(fake_adios2, tmp_path):
     attrs = r.attributes()
     assert "Fides_Data_Model" in attrs or "F" in attrs
     r.close()
+
+
+def test_corrupt_sidecar_marker_degrades_to_no_sidecar(tmp_path):
+    """ADVICE r5 low: a damaged ``sidecar.json`` (valid JSON of the
+    wrong shape included) must read as "no sidecar", not raise out of
+    open_reader/open_writer/count_steps_upto."""
+    import os
+
+    from grayscott_jl_tpu.io import sidecar
+
+    path = str(tmp_path / "out.bp")
+    side = sidecar.sidecar_path(path)
+    os.makedirs(side)
+    marker = os.path.join(side, "sidecar.json")
+    for corrupt in (
+        "[1, 2, 3]",               # top-level list -> TypeError
+        '{"keep_base": null}',     # null keep_base -> TypeError
+        '{"base": "out.bp"}',      # missing key -> KeyError
+        '{"keep_base": "soon"}',   # non-integer -> ValueError
+        "{nope",                   # not JSON -> ValueError
+    ):
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write(corrupt)
+        assert sidecar.read_keep_base(path) is None, corrupt

@@ -1,0 +1,265 @@
+"""Unit tests for the async output pipeline (``io/async_writer.py``).
+
+The pipeline's contract (strict step ordering, bounded backpressure,
+driver-thread error surfacing, drain-on-close durability, exact
+synchronous fallback) is exercised against fake snapshots/sinks — no
+JAX involved; the snapshot side is covered by ``test_sharded``'s
+simulation paths and the functional byte-identity test
+(``tests/functional/test_async_io.py``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from grayscott_jl_tpu.io.async_writer import (
+    AsyncIOError,
+    AsyncStepWriter,
+    resolve_depth,
+)
+
+
+class FakeSnapshot:
+    """Stands in for ``simulation.FieldSnapshot``: ``blocks()`` may
+    sleep (a D2H transfer still in flight) before resolving."""
+
+    def __init__(self, payload, delay=0.0):
+        self.payload = payload
+        self.delay = delay
+        self.resolved_on = None
+
+    def blocks(self):
+        if self.delay:
+            time.sleep(self.delay)
+        self.resolved_on = threading.current_thread()
+        return self.payload
+
+
+def make_sink(record):
+    def sink(step, blocks):
+        record.append((step, blocks, threading.current_thread()))
+
+    return sink
+
+
+# ----------------------------------------------------------- depth knob
+
+
+def test_depth_from_env(monkeypatch):
+    monkeypatch.setenv("GS_ASYNC_IO_DEPTH", "5")
+    assert resolve_depth() == 5
+    monkeypatch.setenv("GS_ASYNC_IO_DEPTH", "0")
+    assert resolve_depth() == 0
+    monkeypatch.delenv("GS_ASYNC_IO_DEPTH")
+    assert resolve_depth() == 2  # documented default: double buffering
+
+
+def test_bad_depth_rejected(monkeypatch):
+    monkeypatch.setenv("GS_ASYNC_IO_DEPTH", "two")
+    with pytest.raises(ValueError, match="GS_ASYNC_IO_DEPTH"):
+        resolve_depth()
+    with pytest.raises(ValueError, match="non-negative"):
+        AsyncStepWriter(depth=-1)
+
+
+# ------------------------------------------------------------- ordering
+
+
+def test_steps_written_in_submission_order_despite_slow_early_d2h():
+    """Step ordering is by submission, not by D2H completion: an early
+    snapshot whose transfer lands LATE must still be written first."""
+    record = []
+    w = AsyncStepWriter(depth=4)
+    w.submit(10, FakeSnapshot("a", delay=0.15), [("output", make_sink(record))])
+    w.submit(20, FakeSnapshot("b"), [("output", make_sink(record))])
+    w.submit(30, FakeSnapshot("c"), [("output", make_sink(record))])
+    w.close()
+    assert [(s, p) for s, p, _ in record] == [(10, "a"), (20, "b"), (30, "c")]
+    assert w.steps_written == 3
+
+
+def test_writes_happen_off_the_driver_thread():
+    record = []
+    snap = FakeSnapshot("x")
+    w = AsyncStepWriter(depth=2)
+    w.submit(1, snap, [("output", make_sink(record))])
+    w.close()
+    (step, _, wrote_on), = record
+    assert step == 1
+    assert wrote_on is not threading.main_thread()
+    assert snap.resolved_on is wrote_on  # D2H resolution also off-driver
+
+
+# --------------------------------------------------------- backpressure
+
+
+def test_backpressure_blocks_submit_at_depth():
+    """With depth=1 and the worker wedged, the (worker-held + queued)
+    budget is 2 items; the third submit must block until the worker
+    frees a slot."""
+    release = threading.Event()
+    record = []
+
+    def slow_sink(step, blocks):
+        release.wait(timeout=10)
+        record.append(step)
+
+    w = AsyncStepWriter(depth=1)
+    w.submit(1, FakeSnapshot("a"), [("output", slow_sink)])
+    w.submit(2, FakeSnapshot("b"), [("output", slow_sink)])  # fills queue
+
+    done = threading.Event()
+
+    def third():
+        w.submit(3, FakeSnapshot("c"), [("output", slow_sink)])
+        done.set()
+
+    t = threading.Thread(target=third, daemon=True)
+    t.start()
+    assert not done.wait(timeout=0.3), "submit #3 should be backpressured"
+    release.set()
+    assert done.wait(timeout=10)
+    w.close()
+    t.join(timeout=10)
+    assert record == [1, 2, 3]
+    assert w.overlap_stats()["queue_depth_hwm"] >= 1
+
+
+# ----------------------------------------------------- error propagation
+
+
+def test_writer_error_surfaces_on_next_submit_with_failing_step():
+    def bad(step, blocks):
+        raise OSError("disk gone")
+
+    w = AsyncStepWriter(depth=2)
+    w.submit(10, FakeSnapshot("a"), [("output", bad)])
+    with pytest.raises(AsyncIOError, match="step 10") as ei:
+        # the worker needs a moment to hit the failure; submit retries
+        # until the error is visible
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            w.submit(20, FakeSnapshot("b"), [("output", bad)])
+            time.sleep(0.01)
+    assert isinstance(ei.value.original, OSError)
+    assert ei.value.step == 10
+    # surfaced once: close() must not raise again (it would mask the
+    # driver's in-flight exception in a finally block)
+    w.close()
+    # ...but the pipeline stays dead-loud for further submissions
+    with pytest.raises(RuntimeError, match="already failed"):
+        w.submit(30, FakeSnapshot("c"), [("output", bad)])
+
+
+def test_writer_error_surfaces_at_close_and_discards_later_steps():
+    record = []
+
+    def bad_then_good(step, blocks):
+        if step == 1:
+            raise ValueError("boom")
+        record.append(step)
+
+    w = AsyncStepWriter(depth=4)
+    w.submit(1, FakeSnapshot("a"), [("output", bad_then_good)])
+    w.submit(2, FakeSnapshot("b"), [("output", bad_then_good)])
+    with pytest.raises(AsyncIOError, match="step 1"):
+        w.close()
+    # step 2 was discarded, not written after a hole
+    assert record == []
+
+
+def test_snapshot_resolution_error_also_propagates():
+    class BadSnapshot:
+        def blocks(self):
+            raise RuntimeError("transfer failed")
+
+    w = AsyncStepWriter(depth=2)
+    w.submit(5, BadSnapshot(), [("output", make_sink([]))])
+    with pytest.raises(AsyncIOError, match="step 5"):
+        w.close()
+
+
+# ------------------------------------------------------ drain-on-close
+
+
+def test_close_drains_every_accepted_step():
+    record = []
+
+    def slow_sink(step, blocks):
+        time.sleep(0.02)
+        record.append(step)
+
+    w = AsyncStepWriter(depth=3)
+    steps = list(range(8))
+    for s in steps:
+        w.submit(s, FakeSnapshot(s), [("output", slow_sink)])
+    w.close()  # must block until all 8 are durable
+    assert record == steps
+    st = w.overlap_stats()
+    assert st["steps_accepted"] == st["steps_written"] == 8
+    w.close()  # idempotent
+
+
+def test_context_manager_on_abort_drains_without_masking():
+    """An unrelated driver exception must propagate even if the writer
+    also failed (the writer error is swallowed by __exit__)."""
+
+    def bad(step, blocks):
+        raise OSError("writer died")
+
+    with pytest.raises(KeyError, match="driver bug"):
+        with AsyncStepWriter(depth=2) as w:
+            w.submit(1, FakeSnapshot("a"), [("output", bad)])
+            raise KeyError("driver bug")
+
+
+# -------------------------------------------------- synchronous fallback
+
+
+def test_depth_zero_writes_inline_on_driver_thread():
+    record = []
+    w = AsyncStepWriter(depth=0)
+    assert w.synchronous
+    snap = FakeSnapshot("x")
+    w.submit(1, snap, [("output", make_sink(record))])
+    (step, payload, wrote_on), = record
+    assert (step, payload) == (1, "x")
+    assert wrote_on is threading.current_thread()
+    assert snap.resolved_on is threading.current_thread()
+    w.close()
+    st = w.overlap_stats()
+    # synchronous: everything is exposed by construction
+    assert st["hidden_s"].get("output", 0.0) == 0.0
+    assert st["steps_written"] == 1
+
+
+def test_depth_zero_error_propagates_at_submit_directly():
+    def bad(step, blocks):
+        raise OSError("disk gone")
+
+    w = AsyncStepWriter(depth=0)
+    with pytest.raises(OSError, match="disk gone"):
+        w.submit(1, FakeSnapshot("a"), [("output", bad)])
+
+
+# ---------------------------------------------------- overlap accounting
+
+
+def test_overlap_stats_split_hidden_vs_exposed():
+    """Writes that drain while the driver is busy elsewhere count as
+    hidden; busy == hidden + exposed per phase."""
+    w = AsyncStepWriter(depth=4)
+    for s in range(3):
+        w.submit(s, FakeSnapshot(s),
+                 [("output", lambda *_: time.sleep(0.03))])
+    time.sleep(0.3)  # driver "computes" while the worker drains
+    w.close()
+    st = w.overlap_stats()
+    busy = st["busy_s"]["output"]
+    assert busy > 0
+    assert st["hidden_s"]["output"] == pytest.approx(
+        busy - st["exposed_s"]["output"], abs=1e-9
+    )
+    # the writes fully drained behind the sleep: nearly all hidden
+    assert st["hidden_s"]["output"] > 0

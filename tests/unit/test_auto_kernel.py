@@ -164,6 +164,57 @@ def test_sweep_mesh_finds_at_least_the_fixed_mesh():
     assert "mesh" in row_s and "fuse" in row_s
 
 
+def test_chain_projection_models_link_sharing():
+    """ADVICE r5 medium: a z-sharded chain's 6 faces on a 2D torus's 4
+    links serialize ceil(6/4)=2 faces at the max-loaded link — fewer
+    links must mean strictly more exposed comm, mirroring project()'s
+    faces_per_link treatment."""
+    base = icimodel.anchor_us("Pallas", 256)
+    r6 = icimodel.project_chain((2, 2, 2), 256, 4, base, links=6)
+    r4 = icimodel.project_chain((2, 2, 2), 256, 4, base, links=4)
+    assert (r4["links"], r6["links"]) == (4, 6)
+    assert (r4["comm_us_per_step_exposed"]
+            > r6["comm_us_per_step_exposed"])
+    assert (r4["projected_weak_scaling_eff"]
+            < r6["projected_weak_scaling_eff"])
+
+
+def test_select_kernel_threads_fabric_links_into_chain_rows():
+    """Auto's cross-language pick must project the Pallas chain on the
+    SAME fabric as the XLA row: on a v5e (4 links) the chain row
+    records links=4, not the 3D-torus default."""
+    _, info = icimodel.select_kernel(
+        (2, 2, 2), 256, platform="tpu", device_kind="TPU v5 lite",
+        objective="throughput",
+    )
+    for row in info["rows"]:
+        assert row["links"] == 4, row["kernel"]
+
+
+def test_1d_projection_accepts_links_and_local():
+    base = icimodel.anchor_us("Pallas", 256)
+    r1 = icimodel.project_1d(8, 256, 4, base, links=1)
+    r2 = icimodel.project_1d(8, 256, 4, base, links=2)
+    assert r1["comm_us_per_step_exposed"] > r2["comm_us_per_step_exposed"]
+    r = icimodel.project_1d(8, 256, 4, base, local=(32, 256, 260))
+    assert r["local"] == 32  # caller's block, not L//n recomputed
+
+
+def test_chain_projection_accepts_caller_local_block():
+    """ADVICE r5 low: forced non-divisible meshes gate feasibility on
+    ceil (pad-and-mask) blocks; the projection must describe that same
+    block shape, not a floor-division one."""
+    base = icimodel.anchor_us("Pallas", 260)
+    ceil_local = (-(-260 // 3), 130, 260)
+    r = icimodel.project_chain((3, 2, 1), 260, 3, base, local=ceil_local)
+    assert r["local"] == list(ceil_local)
+    rf = icimodel.project_chain((3, 2, 1), 260, 3, base)
+    assert rf["local"] == [260 // 3, 130, 260]
+    # the bigger true block computes more volume per step
+    assert r["compute_us_per_step"] == rf["compute_us_per_step"]
+    assert r["x_ring_recompute"] < rf["x_ring_recompute"]
+
+
 def test_1d_mesh_uses_xchain_projection():
     _, info = icimodel.select_kernel(
         (8, 1, 1), 256, platform="tpu", device_kind="TPU v5 lite",

@@ -8,6 +8,17 @@ configuration — a subsystem the reference does not have.
 """
 
 import numpy as np
+import pytest
+
+from grayscott_jl_tpu.ops import pallas_stencil as _ps
+
+# Without the TPU-semantics interpreter (older jax), detect_races is
+# silently meaningless — the kernels would run on the generic HLO
+# interpreter and "no race detected" would be vacuous.
+pytestmark = pytest.mark.skipif(
+    not _ps.interpret_supports_race_detection(),
+    reason="this jax lacks the TPU-semantics interpreter's race detector",
+)
 
 
 def test_pipelined_kernel_has_no_dma_races():
