@@ -56,6 +56,14 @@ class Settings:
     backend: str = "CPU"
     kernel_language: str = "Plain"
     verbose: bool = False
+    #: Resilience knobs (extension; resilience/ subsystem). Each has an
+    #: env override that wins over the TOML value — GS_SUPERVISE,
+    #: GS_MAX_RESTARTS, GS_HEALTH_POLICY, GS_FAULTS — so an operator
+    #: can arm supervision on an existing config without editing it.
+    supervise: bool = False
+    max_restarts: int = 3
+    health_policy: str = "abort"
+    faults: str = ""
 
 
 #: Keys accepted from the TOML file (reference ``Structs.jl:31-52``).

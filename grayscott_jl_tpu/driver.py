@@ -19,6 +19,16 @@ serialization/VTK/disk for step N drain while steps N+1.. compute.
 synchronous flow); the pipeline preserves step order, applies
 backpressure when full, surfaces writer errors on this thread, and is
 drained before the run is declared complete.
+
+Resilience (``resilience/``): :func:`main` is split into the supervision
+dispatch and :func:`run_once`, the single-attempt loop. ``GS_SUPERVISE``
+routes through ``resilience.supervisor.supervise`` — failure
+classification, backoff, checkpoint auto-resume, Pallas->XLA
+degradation. ``run_once`` itself hosts the boundary-time hooks: the
+deterministic fault plan (``GS_FAULTS``), the device-side health guard
+on the snapshot path (``GS_HEALTH_POLICY``), and a close-on-any-exit
+guarantee for the output/checkpoint stores (an async-writer re-raise
+must not leak open stores or a half-written rollback sidecar).
 """
 
 from __future__ import annotations
@@ -26,7 +36,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from .config.settings import get_settings
+from .config.settings import Settings, get_settings
 from .simulation import Simulation, finalize
 from .utils.log import Logger
 
@@ -63,11 +73,99 @@ def maybe_initialize_distributed() -> None:
 
 
 def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
-    """Run a full simulation from CLI args (reference ``GrayScott.main``)."""
+    """Run a full simulation from CLI args (reference ``GrayScott.main``).
+
+    With supervision armed (``GS_SUPERVISE`` / ``supervise`` TOML key)
+    the run goes through the restart loop; otherwise a single open-loop
+    :func:`run_once` — the reference's behavior, plus guaranteed store
+    closure on failure.
+    """
     settings = get_settings(list(args))
     maybe_initialize_distributed()
 
+    from .resilience import supervisor
+
+    if supervisor.supervision_enabled(settings):
+        import jax
+
+        if jax.process_count() > 1:
+            # Restarting one rank of a collective leaves the others
+            # wedged in ppermutes; pods need an external restarter that
+            # relaunches all ranks together (docs/RESILIENCE.md).
+            raise RuntimeError(
+                "GS_SUPERVISE is per-process and cannot supervise a "
+                f"{jax.process_count()}-process run; use an external "
+                "restarter that relaunches all ranks together"
+            )
+        return supervisor.supervise(settings, n_devices=n_devices, seed=seed)
+    return run_once(settings, n_devices=n_devices, seed=seed)
+
+
+def _close_quietly(store) -> None:
+    """Best-effort close on the failure path: the store may hold an
+    open step from a writer-thread death — a secondary close error must
+    never mask the exception already in flight."""
+    try:
+        store.close()
+    except Exception:  # noqa: BLE001 — deliberately swallowed
+        pass
+
+
+def _with_io_fault(plan, journal, fn):
+    """Wrap an ``AsyncStepWriter`` target so a due ``io_error`` fault
+    raises inside it — surfacing on the driver thread as a transient
+    ``AsyncIOError``, exactly the path a real disk hiccup takes.
+    Runs on the writer's worker thread; plan/journal are thread-safe.
+    """
+    from .resilience.faults import InjectedIOError
+
+    def wrapped(step, blocks):
+        fault = plan.take("io_error", step)
+        if fault is not None:
+            journal.record(
+                event="injected", kind="io_error", step=step,
+                planned_step=fault.step,
+            )
+            raise InjectedIOError(
+                f"injected transient I/O error at step {step} "
+                f"(planned step {fault.step})"
+            )
+        return fn(step, blocks)
+
+    return wrapped
+
+
+def run_once(
+    settings: Settings,
+    *,
+    n_devices: Optional[int] = None,
+    seed: int = 0,
+    context=None,
+):
+    """One supervised-or-not simulation attempt.
+
+    ``context`` is the supervisor's
+    :class:`~.resilience.supervisor.SupervisorContext` (shared fault
+    plan + journal across attempts, degradation provenance); standalone
+    runs build their own from the environment. Raises on failure —
+    classification and recovery live in the supervisor, not here.
+    """
     import jax
+
+    from .resilience.faults import (
+        FaultPlan,
+        InjectedKernelError,
+        PreemptionError,
+    )
+    from .resilience.health import HealthGuard
+    from .resilience.supervisor import FaultJournal
+
+    if context is not None:
+        plan, journal = context.plan, context.journal
+    else:
+        plan = FaultPlan.from_env(settings)
+        journal = FaultJournal.from_env(settings)
+    guard = HealthGuard.from_env(settings)
 
     sim = Simulation(settings, n_devices=n_devices, seed=seed)
     log = Logger(verbose=settings.verbose)
@@ -103,6 +201,12 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     from .io.async_writer import AsyncStepWriter
     from .utils.profiler import RunStats, trace
 
+    # Auto-dispatch provenance: which kernel the ICI model picked and
+    # why (None for an explicitly pinned language); after a supervisor
+    # degradation, also which language the run fell back FROM.
+    selection = sim.kernel_selection
+    if context is not None and context.degraded is not None:
+        selection = {**(selection or {}), **context.degraded}
     stats = RunStats(settings.L, config={
         "mesh_dims": list(sim.domain.dims),
         "padded_storage": (
@@ -110,9 +214,7 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
             and sim.domain.padded else None
         ),
         "kernel_language": sim.kernel_language,
-        # Auto-dispatch provenance: which kernel the ICI model picked
-        # and why (None for an explicitly pinned language).
-        "kernel_selection": sim.kernel_selection,
+        "kernel_selection": selection,
         "precision": settings.precision,
         "n_devices": sim.domain.n_blocks,
         "n_processes": nprocs,
@@ -121,72 +223,136 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     stats.config["async_io_depth"] = pipe.depth
     step = restart_step
     t0 = time.perf_counter()
-    with trace(), pipe:
-        while step < settings.steps:
-            boundary = min(
-                _next_boundary(step, settings.plotgap, settings.steps),
-                _next_boundary(
-                    step,
-                    settings.checkpoint_freq if ckpt is not None else 0,
-                    settings.steps,
-                ),
-            )
-            with stats.phase("compute"):
-                sim.iterate(boundary - step)
-                # iterate() only dispatches; block so the phase measures
-                # device execution, not async enqueue time.
-                sim.block_until_ready()
-            stats.count("steps", boundary - step)
-            step = boundary
-
-            at_plot = settings.plotgap > 0 and step % settings.plotgap == 0
-            at_ckpt = (
-                ckpt is not None
-                and settings.checkpoint_freq > 0
-                and step % settings.checkpoint_freq == 0
-            )
-            if not (at_plot or at_ckpt):
-                continue
-            targets = []
-            if at_plot:
-                log.info(
-                    f"Simulation at step {step} writing output step "
-                    f"{step // settings.plotgap}"
+    try:
+        with trace(), pipe:
+            while step < settings.steps:
+                boundary = min(
+                    _next_boundary(step, settings.plotgap, settings.steps),
+                    _next_boundary(
+                        step,
+                        settings.checkpoint_freq if ckpt is not None else 0,
+                        settings.steps,
+                    ),
                 )
-                targets.append(("output", stream.write_step))
-            if at_ckpt:
-                targets.append(("checkpoint", ckpt.save))
-            with stats.phase("device_to_host"):
-                snap = sim.snapshot_async()
-                if pipe.synchronous:
-                    # Depth 0 reproduces the reference's flow exactly:
-                    # D2H resolves here, writes run inline in submit.
-                    snap.blocks()
-            pipe.submit(step, snap, targets)
-            if at_plot:
-                stats.count("output_steps")
-            if at_ckpt:
-                stats.count("checkpoints")
-                log.info(f"Checkpoint accepted at step {step}")
+                if sim.kernel_language == "pallas":
+                    # Planned Mosaic runtime failure: armed only while
+                    # Pallas is the resolved language (the supervisor's
+                    # recovery degrades to XLA, where it cannot recur).
+                    fault = plan.take("kernel", boundary)
+                    if fault is not None:
+                        journal.record(
+                            event="injected", kind="kernel",
+                            step=boundary, planned_step=fault.step,
+                        )
+                        raise InjectedKernelError(fault.step)
+                with stats.phase("compute"):
+                    sim.iterate(boundary - step)
+                    # iterate() only dispatches; block so the phase
+                    # measures device execution, not async enqueue time.
+                    sim.block_until_ready()
+                stats.count("steps", boundary - step)
+                step = boundary
 
-        # Drain INSIDE the timed region: the run is complete only once
-        # every accepted step is durable (close re-raises a writer
-        # failure with the failing step identified).
-        pipe.close()
+                fault = plan.take("nan", step)
+                if fault is not None:
+                    journal.record(
+                        event="injected", kind="nan", step=step,
+                        planned_step=fault.step,
+                    )
+                    sim.poison_nan()
+                fault = plan.take("preempt", step)
+                if fault is not None:
+                    # Fires BEFORE this boundary's writes: the
+                    # SIGTERM-mid-compute shape. Steps already accepted
+                    # by the pipeline still drain durably on the abort
+                    # path (AsyncStepWriter.__exit__), like a
+                    # grace-window shutdown.
+                    journal.record(
+                        event="injected", kind="preempt", step=step,
+                        planned_step=fault.step,
+                    )
+                    raise PreemptionError(
+                        f"injected preemption at step {step} "
+                        f"(planned step {fault.step})"
+                    )
 
-    elapsed = time.perf_counter() - t0
-    cells = settings.L**3 * (settings.steps - restart_step)
-    log.info(
-        f"Completed {settings.steps - restart_step} steps in {elapsed:.3f}s "
-        f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
-    )
-    stats.record_io(pipe.overlap_stats())
-    stats.maybe_write()
-    if settings.verbose:
-        log.info(f"run stats: {stats.summary()}")
+                at_plot = (
+                    settings.plotgap > 0 and step % settings.plotgap == 0
+                )
+                at_ckpt = (
+                    ckpt is not None
+                    and settings.checkpoint_freq > 0
+                    and step % settings.checkpoint_freq == 0
+                )
+                if not (at_plot or at_ckpt):
+                    continue
+                targets = []
+                if at_plot:
+                    log.info(
+                        f"Simulation at step {step} writing output step "
+                        f"{step // settings.plotgap}"
+                    )
+                    targets.append(("output", stream.write_step))
+                if at_ckpt:
+                    targets.append(("checkpoint", ckpt.save))
+                if plan.pending("io_error"):
+                    targets = [
+                        (phase, _with_io_fault(plan, journal, fn))
+                        for phase, fn in targets
+                    ]
+                with stats.phase("device_to_host"):
+                    snap = sim.snapshot_async(health=guard.enabled)
+                    if pipe.synchronous:
+                        # Depth 0 reproduces the reference's flow
+                        # exactly: D2H resolves here, writes run inline
+                        # in submit.
+                        snap.blocks()
+                if guard.enabled:
+                    # Unhealthy + abort/rollback raises BEFORE the
+                    # poisoned step is submitted — it never reaches the
+                    # stores; warn records and writes anyway.
+                    event = guard.check(step, snap.health_report(), log=log)
+                    if event is not None:
+                        journal.record(**event)
+                pipe.submit(step, snap, targets)
+                if at_plot:
+                    stats.count("output_steps")
+                if at_ckpt:
+                    stats.count("checkpoints")
+                    log.info(f"Checkpoint accepted at step {step}")
 
-    stream.close()
-    if ckpt is not None:
-        ckpt.close()
+            # Drain INSIDE the timed region: the run is complete only
+            # once every accepted step is durable (close re-raises a
+            # writer failure with the failing step identified).
+            pipe.close()
+
+        elapsed = time.perf_counter() - t0
+        cells = settings.L**3 * (settings.steps - restart_step)
+        log.info(
+            f"Completed {settings.steps - restart_step} steps in "
+            f"{elapsed:.3f}s "
+            f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
+        )
+        stats.record_io(pipe.overlap_stats())
+        if journal.events:
+            stats.record_faults(journal.events)
+        stats.maybe_write()
+        if settings.verbose:
+            log.info(f"run stats: {stats.summary()}")
+
+        stream.close()
+        if ckpt is not None:
+            ckpt.close()
+    except BaseException:
+        # Failure path (async-writer re-raise, preemption, health trip,
+        # injected kernel error, KeyboardInterrupt): the stores MUST
+        # still be closed — an open store leaks file handles and, after
+        # a rollback, leaves the sidecar marker pointing at steps that
+        # were never committed. Best-effort: never mask the in-flight
+        # exception with a secondary close error.
+        _close_quietly(stream)
+        if ckpt is not None:
+            _close_quietly(ckpt)
+        raise
     finalize()
     return sim

@@ -66,6 +66,20 @@ class AsyncIOError(RuntimeError):
         #: The exception raised by the write target.
         self.original = original
 
+    @property
+    def transient(self) -> bool:
+        """Is the wrapped failure an OS-level I/O error — the class of
+        failure a supervisor may retry (full/flaky disk, NFS hiccup,
+        injected ``io_error`` fault)? Value/Key/Runtime errors out of a
+        write target are programming or format errors: retrying those
+        would re-fail or, worse, corrupt the store.
+
+        Classified here, where the failing write's exception is still
+        first-hand, so the supervisor (``resilience/supervisor.py``)
+        never guesses from a formatted message.
+        """
+        return isinstance(self.original, OSError)
+
 
 def resolve_depth(depth: Optional[int] = None) -> int:
     """Pipeline depth: the argument, else ``GS_ASYNC_IO_DEPTH``

@@ -40,6 +40,15 @@ On-disk layout of ``name.bp`` (a directory, like BP4/BP5)::
     }
 
 Scalars are zero-dim variables with ``start=count=[]``.
+
+Durability: the reader validates every step entry against the payload
+file sizes and exposes only *complete* steps (a crash between
+``begin_step`` and a durable ``end_step`` — or a filesystem losing the
+tail — never yields a readable torn step); the writer's append path
+truncates the payload to the metadata-durable end, so rollback-resumed
+stores are byte-identical to uninterrupted ones. Both are load-bearing
+for the resilience subsystem's "latest durable checkpoint"
+(``resilience/supervisor.py``).
 """
 
 from __future__ import annotations
@@ -67,6 +76,81 @@ def _md_path(path: str) -> str:
     return os.path.join(path, "md.json")
 
 
+def _block_nbytes(variables: dict, name: str, block: dict) -> Optional[int]:
+    """Byte length of one block's payload, or None when the metadata is
+    too damaged to tell (unknown variable/dtype)."""
+    var = variables.get(name)
+    if var is None:
+        return None
+    try:
+        itemsize = np.dtype(var["dtype"]).itemsize
+    except (KeyError, TypeError):
+        return None
+    n = 1
+    for c in block.get("count", []):
+        n *= int(c)
+    return n * itemsize
+
+
+def durable_step_count(md: dict, dirpath: str) -> int:
+    """Number of leading step entries whose every payload block lies
+    fully inside its data file.
+
+    A crash (or an injected fault) between ``begin_step`` and a durable
+    ``end_step`` can leave a final step entry whose bytes never landed
+    — e.g. metadata replicated before the payload reached disk, or a
+    payload file truncated by the filesystem. Reads of such a step
+    would raise mid-restore or return garbage; capping the visible step
+    count here is what makes "latest durable checkpoint" well-defined
+    for the supervisor (``resilience/supervisor.py``). Unverifiable
+    metadata (unknown variable/dtype) is treated as non-durable.
+    """
+    variables = md.get("variables", {})
+    sizes: Dict[str, int] = {}
+    steps = md.get("steps", [])
+    for i, step_blocks in enumerate(steps):
+        for name, blocks in step_blocks.items():
+            for b in blocks:
+                nbytes = _block_nbytes(variables, name, b)
+                if nbytes is None:
+                    return i
+                fname = b.get("file")
+                if fname not in sizes:
+                    try:
+                        sizes[fname] = os.path.getsize(
+                            os.path.join(dirpath, fname)
+                        )
+                    except (OSError, TypeError):
+                        sizes[fname] = -1
+                if sizes[fname] < int(b.get("offset", 0)) + nbytes:
+                    return i
+    return len(steps)
+
+
+def data_end_offset(md: dict, data_file: str) -> Optional[int]:
+    """End offset of the last payload byte ``data_file`` owns across
+    every step entry of ``md``, or None when the metadata cannot be
+    verified. ``0`` for a store whose steps never touched the file.
+
+    The writer's rollback path truncates its append-only payload here:
+    entries past ``keep_steps`` (and any torn tail from a crashed
+    step) vanish from the *bytes*, not just the metadata, so a resumed
+    run's store is byte-identical to an uninterrupted one.
+    """
+    variables = md.get("variables", {})
+    end = 0
+    for step_blocks in md.get("steps", []):
+        for name, blocks in step_blocks.items():
+            for b in blocks:
+                if b.get("file") != data_file:
+                    continue
+                nbytes = _block_nbytes(variables, name, b)
+                if nbytes is None:
+                    return None
+                end = max(end, int(b.get("offset", 0)) + nbytes)
+    return end
+
+
 class BpWriter:
     """Step-based writer engine (``ADIOS2.open(io, name, mode_write)``).
 
@@ -92,8 +176,9 @@ class BpWriter:
         """``keep_steps`` (append mode): keep only the first N existing
         step entries — the rollback path, dropping the abandoned
         trajectory's steps past a ``restart_step`` so the resumed run
-        does not append duplicates after them. Orphaned payload bytes
-        stay in the data file (harmless; offsets are absolute)."""
+        does not append duplicates after them. The payload is truncated
+        to the kept entries' end (``data_end_offset``), so the resumed
+        store is byte-identical to one that never rolled back."""
         self.path = path
         self.writer_id = writer_id
         self.nwriters = nwriters
@@ -117,6 +202,18 @@ class BpWriter:
                 if os.path.exists(self._data_path)
                 else 0
             )
+            # Trim the payload to the metadata-durable end: rolled-back
+            # entries and any torn tail from a crashed step are removed
+            # from the bytes too, so the resumed store stays
+            # byte-identical to an uninterrupted run's. Unverifiable
+            # metadata falls back to plain append (absolute offsets
+            # keep orphan bytes harmless, as before).
+            cut = data_end_offset(
+                self._md, os.path.basename(self._data_path)
+            )
+            if cut is not None and cut < self._offset:
+                os.truncate(self._data_path, cut)
+                self._offset = cut
         else:
             self._md = {
                 "format": FORMAT_NAME,
@@ -304,11 +401,15 @@ class BpReader:
             return
         nwriters = int(md0.get("nwriters", 1))
         if nwriters == 1:
+            # Publish only durable steps: a torn final entry (crash
+            # between begin_step and a durable end_step) must not be
+            # readable — it would raise mid-restore or return garbage.
+            md0["steps"] = md0["steps"][:durable_step_count(md0, self.path)]
             self._md = md0
             return
         # Multi-writer store: merge. A step is visible only once EVERY
-        # writer has committed it; the stream is complete when all writers
-        # closed and no unmerged steps remain.
+        # writer has committed it durably; the stream is complete when all
+        # writers closed and no unmerged steps remain.
         mds = [md0]
         for w in range(1, nwriters):
             md_w = self._load_one(
@@ -317,6 +418,16 @@ class BpReader:
             if md_w is None:  # writer not started yet: nothing visible
                 md_w = {"complete": False, "steps": []}
             mds.append(md_w)
+        for m in mds:
+            # Peer metadata normally carries its own variables table; a
+            # (corrupt) one without falls back to writer 0's.
+            checked = (
+                m if m.get("variables")
+                else dict(m, variables=md0.get("variables", {}))
+            )
+            m["steps"] = m.get("steps", [])[
+                :durable_step_count(checked, self.path)
+            ]
         n_steps = min(len(m["steps"]) for m in mds)
         steps = []
         for i in range(n_steps):

@@ -125,6 +125,26 @@ class NativeBpWriter:
                 prior = json.load(f)
             for name, v in prior.get("variables", {}).items():
                 self._vars[name] = (v["dtype"], tuple(v["shape"]))
+            # Trim the payload to the end of the steps being kept BEFORE
+            # the native open (which fstat's the file size as its append
+            # offset): rolled-back entries and torn crash tails vanish
+            # from the bytes, keeping resumed stores byte-identical to
+            # uninterrupted ones — same semantics as the Python engine.
+            data_name = f"data.{writer_id}"
+            kept = prior.get("steps", [])
+            if keep_steps is not None:
+                kept = kept[:keep_steps]
+            cut = _py.data_end_offset(
+                {"variables": prior.get("variables", {}), "steps": kept},
+                data_name,
+            )
+            data_path = os.path.join(path, data_name)
+            if (
+                cut is not None
+                and os.path.exists(data_path)
+                and cut < os.path.getsize(data_path)
+            ):
+                os.truncate(data_path, cut)
         self._h = lib.bpw_open(
             path.encode(), writer_id, nwriters, 1 if append else 0
         )

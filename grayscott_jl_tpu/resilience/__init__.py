@@ -1,0 +1,38 @@
+"""Resilience subsystem: supervised runs that survive their failures.
+
+Three cooperating parts (see docs/RESILIENCE.md for the operator view):
+
+* :mod:`.faults` — a deterministic, replayable fault-injection plan
+  (``GS_FAULTS``): transient I/O errors, NaN poisoning, preemption,
+  Pallas kernel failure, each fired once at a chosen step;
+* :mod:`.health` — a fused device-side ``isfinite``/range probe on the
+  snapshot path with an ``abort`` / ``rollback`` / ``warn`` policy
+  (``GS_HEALTH_POLICY``);
+* :mod:`.supervisor` — ``supervise(settings)`` wraps
+  ``driver.run_once`` with failure classification, exponential backoff
+  with deterministic jitter, checkpoint auto-resume, Pallas->XLA
+  degradation, and a JSONL fault journal merged into ``RunStats``.
+"""
+
+from .faults import (  # noqa: F401
+    FAULT_KINDS,
+    Fault,
+    FaultPlan,
+    InjectedIOError,
+    InjectedKernelError,
+    PreemptionError,
+)
+from .health import (  # noqa: F401
+    HealthError,
+    HealthGuard,
+    HealthReport,
+    resolve_policy,
+)
+from .supervisor import (  # noqa: F401
+    FaultJournal,
+    SupervisorContext,
+    classify_failure,
+    latest_durable_checkpoint,
+    supervise,
+    supervision_enabled,
+)

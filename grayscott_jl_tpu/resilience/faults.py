@@ -1,0 +1,188 @@
+"""Deterministic fault injection for supervised runs.
+
+Long campaigns on shared accelerators die to a small set of recurring
+failure shapes — transient I/O errors under the async writer, NaN
+blow-ups from bad parameter regions, preemption of the chip grant, and
+kernel-runtime regressions (arXiv:2309.10292 §5, arXiv:2404.02218) —
+and a recovery path that is never exercised is a recovery path that
+does not work. This module turns those shapes into a *replayable plan*:
+``GS_FAULTS`` (or the ``faults`` TOML key) names exactly which fault
+fires at which simulation step, e.g. ::
+
+    GS_FAULTS="step=120:kind=io_error;step=300:kind=nan;step=500:kind=preempt"
+
+The driver consumes the plan at its boundary loop (``driver.run_once``):
+a fault fires at the first plot/checkpoint boundary at-or-after its
+step, exactly once per plan instance. The supervisor
+(``resilience/supervisor.py``) holds ONE plan across restart attempts,
+so a fault that already fired does not re-fire on the resumed run —
+which is what makes a chaos run deterministic end to end.
+
+Fault kinds:
+
+``io_error``
+    Raises :class:`InjectedIOError` (an ``OSError``) inside the
+    ``AsyncStepWriter`` write target for the due boundary — the fault
+    surfaces on the driver thread as a *transient* ``AsyncIOError``,
+    the same path a real disk/NFS hiccup takes.
+``nan``
+    Poisons one cell of the ``u`` field with NaN
+    (``Simulation.poison_nan``) so the health guard
+    (``resilience/health.py``) trips at the same boundary.
+``preempt``
+    Raises :class:`PreemptionError` at the boundary *before* its writes
+    are submitted — the SIGTERM-mid-compute shape. Already-accepted
+    async steps still drain durably on the abort path
+    (``AsyncStepWriter.__exit__``), like a grace-window shutdown.
+``kernel``
+    Raises :class:`InjectedKernelError` (message carries ``Mosaic`` so
+    it classifies like a real Pallas runtime failure) inside the
+    compute phase. Only armed while the resolved kernel language is
+    ``pallas`` — the supervisor's recovery is to degrade to XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+__all__ = [
+    "FAULT_KINDS",
+    "Fault",
+    "FaultPlan",
+    "InjectedIOError",
+    "InjectedKernelError",
+    "PreemptionError",
+]
+
+FAULT_KINDS = ("io_error", "nan", "preempt", "kernel")
+
+
+class InjectedIOError(OSError):
+    """Planned transient I/O failure (fires inside a write target)."""
+
+
+class PreemptionError(RuntimeError):
+    """The run lost its chip grant / received SIGTERM at a boundary."""
+
+
+class InjectedKernelError(RuntimeError):
+    """Planned Pallas runtime failure; classifies like a real Mosaic
+    error (the message carries the marker the classifier matches)."""
+
+    def __init__(self, step: int):
+        super().__init__(
+            f"injected Mosaic kernel runtime failure at step {step}"
+        )
+        self.step = step
+
+
+@dataclasses.dataclass
+class Fault:
+    """One planned fault: fires at the first boundary >= ``step``."""
+
+    step: int
+    kind: str
+    fired: bool = False
+
+    def describe(self) -> dict:
+        return {"step": self.step, "kind": self.kind, "fired": self.fired}
+
+
+class FaultPlan:
+    """An ordered, consume-once set of planned faults.
+
+    ``take`` is called from the driver thread for nan/preempt/kernel
+    faults and from the async writer's worker thread for io_error
+    faults; the fired flag is a plain attribute write (GIL-atomic, and
+    each kind is only ever polled from one thread).
+    """
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = sorted(faults or [], key=lambda f: (f.step, f.kind))
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse ``step=N:kind=K[;step=N:kind=K...]`` into a plan.
+
+        Unknown kinds, missing fields, and malformed entries raise
+        ``ValueError`` naming the offending entry — a mistyped chaos
+        plan must fail at startup, not silently inject nothing.
+        """
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            fields = {}
+            for part in entry.split(":"):
+                if "=" not in part:
+                    raise ValueError(
+                        f"GS_FAULTS entry {entry!r}: field {part!r} is not "
+                        "key=value"
+                    )
+                k, v = part.split("=", 1)
+                fields[k.strip()] = v.strip()
+            unknown = set(fields) - {"step", "kind"}
+            if unknown:
+                raise ValueError(
+                    f"GS_FAULTS entry {entry!r}: unknown field(s) "
+                    f"{sorted(unknown)}"
+                )
+            if "step" not in fields or "kind" not in fields:
+                raise ValueError(
+                    f"GS_FAULTS entry {entry!r} needs both step= and kind="
+                )
+            try:
+                step = int(fields["step"])
+            except ValueError as e:
+                raise ValueError(
+                    f"GS_FAULTS entry {entry!r}: step must be an integer"
+                ) from e
+            if step < 0:
+                raise ValueError(
+                    f"GS_FAULTS entry {entry!r}: step must be >= 0"
+                )
+            kind = fields["kind"]
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"GS_FAULTS entry {entry!r}: unknown kind {kind!r} "
+                    f"(supported: {', '.join(FAULT_KINDS)})"
+                )
+            faults.append(Fault(step=step, kind=kind))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls, settings=None) -> "FaultPlan":
+        """Plan from ``GS_FAULTS``, falling back to the ``faults`` TOML
+        key (empty plan when neither is set)."""
+        spec = os.environ.get("GS_FAULTS")
+        if spec is None and settings is not None:
+            spec = getattr(settings, "faults", "")
+        return cls.parse(spec or "")
+
+    def take(self, kind: str, step: int) -> Optional[Fault]:
+        """The earliest unfired fault of ``kind`` due at-or-before
+        ``step``, marked fired — or None. Consume-once: a restarted
+        attempt sharing this plan never replays a fired fault."""
+        for f in self.faults:
+            if f.kind == kind and not f.fired and f.step <= step:
+                f.fired = True
+                return f
+        return None
+
+    def pending(self, kind: Optional[str] = None) -> List[Fault]:
+        return [
+            f for f in self.faults
+            if not f.fired and (kind is None or f.kind == kind)
+        ]
+
+    def describe(self) -> List[dict]:
+        return [f.describe() for f in self.faults]

@@ -1,0 +1,147 @@
+"""Device-side field health guard.
+
+A NaN blow-up (too-large ``dt``, bad parameter region, or a kernel
+regression) silently corrupts every output step written after it; on a
+long campaign that is hours of wasted accelerator time plus a poisoned
+store. The guard is a cheap fused reduction — ``isfinite`` AND-reduce
+plus min/max of both fields — evaluated on the *snapshot path* at
+plot/checkpoint boundaries (``Simulation.snapshot_async(health=True)``
+fuses it into the same jitted program as the snapshot's device copy, so
+the scalars ride the boundary's existing D2H and no extra HBM pass is
+spent between boundaries).
+
+Policy (``GS_HEALTH_POLICY`` / ``health_policy`` TOML key):
+
+``abort`` (default)
+    Raise :class:`HealthError` at the boundary — the poisoned step is
+    never written, the run stops loudly.
+``rollback``
+    Raise :class:`HealthError` classified for the supervisor
+    (``resilience/supervisor.py``): under ``GS_SUPERVISE`` the run
+    resumes from the latest durable checkpoint instead of dying.
+``warn``
+    Log and record the event, keep running (the reference's implicit
+    behavior, made visible).
+``off``
+    No probe is evaluated at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+__all__ = [
+    "POLICIES",
+    "HealthError",
+    "HealthGuard",
+    "HealthReport",
+    "device_probe",
+    "resolve_policy",
+]
+
+POLICIES = ("abort", "rollback", "warn", "off")
+
+
+class HealthReport(NamedTuple):
+    """Resolved (host-side) probe result for one boundary."""
+
+    finite: bool
+    u_min: float
+    u_max: float
+    v_min: float
+    v_max: float
+
+    def describe(self) -> dict:
+        return {
+            "finite": self.finite,
+            "u_range": [self.u_min, self.u_max],
+            "v_range": [self.v_min, self.v_max],
+        }
+
+
+class HealthError(RuntimeError):
+    """A field failed the health check at a boundary."""
+
+    def __init__(self, step: int, report: HealthReport, policy: str):
+        super().__init__(
+            f"field health check failed at step {step} "
+            f"(finite={report.finite}, u in [{report.u_min}, "
+            f"{report.u_max}], v in [{report.v_min}, {report.v_max}]); "
+            f"policy={policy}"
+        )
+        self.step = step
+        self.report = report
+        self.policy = policy
+
+
+def device_probe(u, v):
+    """The fused device-side reduction: ``(finite, u_min, u_max, v_min,
+    v_max)`` as 0-d device arrays. Traced inside the snapshot-copy jit
+    (``Simulation.snapshot_async``) so XLA fuses it with the copy's HBM
+    read — the fields are touched once for both."""
+    import jax.numpy as jnp
+
+    finite = jnp.isfinite(u).all() & jnp.isfinite(v).all()
+    return finite, u.min(), u.max(), v.min(), v.max()
+
+
+def resolve_policy(settings=None) -> str:
+    """``GS_HEALTH_POLICY`` env, else the ``health_policy`` TOML key,
+    else ``abort``; unknown values raise at startup."""
+    policy = os.environ.get("GS_HEALTH_POLICY")
+    if policy is None and settings is not None:
+        policy = getattr(settings, "health_policy", "")
+    policy = (policy or "abort").lower()
+    if policy not in POLICIES:
+        raise ValueError(
+            f"Unsupported health policy: {policy!r}. "
+            f"Supported: {', '.join(POLICIES)}"
+        )
+    return policy
+
+
+class HealthGuard:
+    """Boundary-time policy enforcement over resolved probe reports."""
+
+    def __init__(self, policy: str = "abort"):
+        if policy not in POLICIES:
+            raise ValueError(f"Unsupported health policy: {policy!r}")
+        self.policy = policy
+
+    @classmethod
+    def from_env(cls, settings=None) -> "HealthGuard":
+        return cls(resolve_policy(settings))
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy != "off"
+
+    def check(
+        self, step: int, report: Optional[HealthReport], *, log=None
+    ) -> Optional[dict]:
+        """Enforce the policy on one boundary's report.
+
+        Healthy (or disabled) returns None. Unhealthy: ``warn`` logs
+        and returns a journal-able event dict; ``abort``/``rollback``
+        raise :class:`HealthError` (the supervisor maps the policy to
+        its recovery action).
+        """
+        if not self.enabled or report is None or report.finite:
+            return None
+        if self.policy == "warn":
+            event = {
+                "event": "health",
+                "kind": "health",
+                "step": step,
+                "policy": "warn",
+                "action": "continued",
+                **report.describe(),
+            }
+            if log is not None:
+                log.info(
+                    f"WARNING: field health check failed at step {step} "
+                    f"(non-finite values); policy=warn, continuing"
+                )
+            return event
+        raise HealthError(step, report, self.policy)

@@ -1,0 +1,322 @@
+"""Run supervision: classify failures, back off, auto-resume.
+
+The open-loop driver dies on the first async-writer error, NaN blow-up,
+preemption, or Mosaic regression — with whatever the checkpoint store
+happened to hold. ``supervise(settings)`` closes the loop around a
+refactored ``driver.run_once``; it is the preemption-safe-loop shape
+shared with long-training stacks (arXiv:2309.10292 §5 runs the same
+checkpoint/restart discipline on Frontier; arXiv:2404.02218 argues the
+runtime layer, not user code, must absorb these):
+
+* **classify** the failure — ``transient-io`` (an ``AsyncIOError``
+  whose original is an OS-level error, or a bare ``OSError``),
+  ``preemption`` (:class:`~.faults.PreemptionError`), ``health``
+  (:class:`~.health.HealthError` under the ``rollback`` policy), or
+  ``kernel`` (a Mosaic/Pallas runtime failure). Anything else — a
+  config error, a programming bug — re-raises immediately: retrying an
+  unclassified failure just burns accelerator time.
+* **retry** with exponential backoff (base ``GS_RESTART_BACKOFF_S``,
+  default 0.5 s, cap 30 s) plus deterministic jitter (crc32 of the
+  attempt/kind, not a live RNG — replayable), up to ``GS_MAX_RESTARTS``.
+* **auto-resume**: before each retry the latest *durable* checkpoint is
+  located (``bplite.BpReader`` exposes only complete steps, so a crash
+  mid-checkpoint never resumes from a torn entry) and the settings are
+  rewritten to ``restart=true`` pointing at ``checkpoint_output``. No
+  checkpoint yet means a from-scratch restart.
+* **degrade** ``kernel_language`` Pallas->XLA on a kernel-runtime
+  failure, recording the degradation in the ``kernel_selection``
+  provenance of the final ``RunStats`` — the run finishes slower
+  rather than not at all, and the stats say why.
+* **journal** every failure and recovery action as JSONL
+  (:class:`FaultJournal`); the completing attempt merges the full
+  journal into ``RunStats`` as its ``faults`` section.
+
+Supervision is per-process: multi-host runs (``jax.process_count() >
+1``) need an external restarter that relaunches all ranks together, so
+``driver.main`` refuses to supervise them (see docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import zlib
+from typing import List, Optional
+
+from .faults import FaultPlan, InjectedKernelError, PreemptionError
+from .health import HealthError
+
+__all__ = [
+    "FaultJournal",
+    "SupervisorContext",
+    "classify_failure",
+    "latest_durable_checkpoint",
+    "restart_backoff",
+    "resolve_max_restarts",
+    "supervise",
+    "supervision_enabled",
+]
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off"}
+
+
+def supervision_enabled(settings=None) -> bool:
+    """``GS_SUPERVISE`` env, else the ``supervise`` TOML key."""
+    raw = os.environ.get("GS_SUPERVISE")
+    if raw is not None:
+        val = raw.strip().lower()
+        if val in _TRUTHY:
+            return True
+        if val in _FALSY:
+            return False
+        raise ValueError(
+            f"GS_SUPERVISE must be a boolean (0/1/true/false), got {raw!r}"
+        )
+    return bool(getattr(settings, "supervise", False))
+
+
+def resolve_max_restarts(settings=None) -> int:
+    """``GS_MAX_RESTARTS`` env, else the ``max_restarts`` TOML key."""
+    raw = os.environ.get("GS_MAX_RESTARTS")
+    if raw is not None:
+        try:
+            n = int(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"GS_MAX_RESTARTS must be an integer, got {raw!r}"
+            ) from e
+    else:
+        n = int(getattr(settings, "max_restarts", 3))
+    if n < 0:
+        raise ValueError(f"max restarts must be >= 0, got {n}")
+    return n
+
+
+def restart_backoff(attempt: int, kind: str) -> float:
+    """Exponential backoff with deterministic jitter.
+
+    ``base * 2**attempt`` capped at 30 s, plus up to 25% jitter derived
+    from crc32(attempt:kind) — spread-out restarts without an RNG, so a
+    replayed chaos run sleeps the same schedule every time.
+    """
+    base = float(os.environ.get("GS_RESTART_BACKOFF_S", "0.5"))
+    if base < 0:
+        raise ValueError(
+            f"GS_RESTART_BACKOFF_S must be >= 0, got {base}"
+        )
+    delay = min(base * (2 ** attempt), 30.0)
+    frac = (zlib.crc32(f"{attempt}:{kind}".encode()) % 1000) / 1000.0
+    return delay * (1.0 + 0.25 * frac)
+
+
+class FaultJournal:
+    """Append-only fault/recovery event log, mirrored to JSONL.
+
+    Events are plain dicts; ``record`` is called from the driver thread
+    (nan/preempt/health/recovery events) and from the async writer's
+    worker thread (fired io_error injections), so the file append is
+    lock-guarded. The journal object outlives run attempts — the
+    completing attempt merges ``events`` into ``RunStats``.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        import threading
+
+        self.path = path
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, settings=None) -> "FaultJournal":
+        """Journal at ``GS_FAULT_JOURNAL``; default ``<output>.faults.jsonl``
+        under supervision, in-memory only otherwise."""
+        path = os.environ.get("GS_FAULT_JOURNAL")
+        if not path and settings is not None and supervision_enabled(settings):
+            path = settings.output + ".faults.jsonl"
+        return cls(path or None)
+
+    def record(self, **event) -> dict:
+        import json
+
+        event.setdefault("t", round(time.time(), 3))
+        with self._lock:
+            self.events.append(event)
+            if self.path:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(event) + "\n")
+        return event
+
+
+@dataclasses.dataclass
+class SupervisorContext:
+    """Per-attempt state the supervisor threads through ``run_once``."""
+
+    plan: FaultPlan
+    journal: FaultJournal
+    attempt: int = 0
+    #: kernel_selection provenance patch after a Pallas->XLA degrade.
+    degraded: Optional[dict] = None
+
+
+#: Message fragments that identify a kernel-runtime failure raised by
+#: the TPU compiler/runtime stack (vs our injected marker, which
+#: carries "Mosaic" too).
+_KERNEL_MARKERS = ("mosaic", "pallas")
+
+
+def classify_failure(exc: BaseException) -> Optional[str]:
+    """Map a run failure onto the recovery taxonomy, or None (fatal).
+
+    The classification deliberately whitelists: only failure shapes
+    with a known recovery action are retried. ``AsyncIOError`` is
+    unwrapped to its original exception (``io/async_writer.py`` tags
+    transience there, where the failing write happened).
+    """
+    from ..io.async_writer import AsyncIOError
+
+    if isinstance(exc, PreemptionError):
+        return "preemption"
+    if isinstance(exc, HealthError):
+        # abort policy means abort: only rollback is recoverable.
+        return "health" if exc.policy == "rollback" else None
+    if isinstance(exc, InjectedKernelError):
+        return "kernel"
+    if isinstance(exc, AsyncIOError):
+        return "transient-io" if exc.transient else None
+    if isinstance(exc, OSError):
+        return "transient-io"
+    # Real Mosaic/Pallas runtime failures surface as XLA runtime errors
+    # whose type lives in jaxlib; match on the message rather than
+    # importing a version-dependent exception type.
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "InternalError"):
+        msg = str(exc).lower()
+        if any(m in msg for m in _KERNEL_MARKERS):
+            return "kernel"
+    return None
+
+
+def latest_durable_checkpoint(settings) -> Optional[int]:
+    """Simulation step of the latest *complete* checkpoint entry, or
+    None. Checkpoints are always BP-lite stores
+    (``io/checkpoint.py`` pins ``prefer_adios2=False``), and the
+    reader's durability validation (``io/bplite.py``) already hides a
+    torn final entry — so whatever this returns is safe to resume from.
+    """
+    if not settings.checkpoint:
+        return None
+    from ..io.bplite import BpReader
+
+    try:
+        r = BpReader(settings.checkpoint_output)
+    except FileNotFoundError:
+        return None
+    try:
+        n = r.num_steps()
+        if n == 0:
+            return None
+        return int(r.get("step", step=n - 1))
+    finally:
+        r.close()
+
+
+def _resolved_language(settings) -> str:
+    from ..config.settings import KERNEL_LANGUAGES
+
+    return KERNEL_LANGUAGES.get(
+        settings.kernel_language.lower(), settings.kernel_language.lower()
+    )
+
+
+def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
+    """Run ``driver.run_once`` under the restart loop; returns the
+    completed attempt's :class:`~..simulation.Simulation`.
+
+    ``settings`` is mutated across attempts (restart target, degraded
+    kernel language) — the supervisor owns the run's lifecycle, and the
+    final settings describe how the run actually finished.
+    """
+    from ..driver import run_once
+    from ..utils.log import Logger
+
+    log = Logger(verbose=True)
+    plan = FaultPlan.from_env(settings)
+    journal = FaultJournal.from_env(settings)
+    limit = resolve_max_restarts(settings)
+    attempt = 0
+    degraded: Optional[dict] = None
+
+    while True:
+        ctx = SupervisorContext(
+            plan=plan, journal=journal, attempt=attempt, degraded=degraded
+        )
+        try:
+            return run_once(
+                settings, n_devices=n_devices, seed=seed, context=ctx
+            )
+        except BaseException as exc:  # noqa: BLE001 — classify, then re-raise
+            kind = classify_failure(exc)
+            if kind is None or attempt >= limit:
+                journal.record(
+                    event="gave_up",
+                    kind=kind or "fatal",
+                    attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                raise
+
+            actions = []
+            if kind == "kernel":
+                lang = _resolved_language(settings)
+                if lang in ("pallas", "auto"):
+                    degraded = {
+                        "degraded_from": lang,
+                        "degraded_reason": f"{type(exc).__name__}: {exc}",
+                        "degraded_at_attempt": attempt,
+                    }
+                    settings.kernel_language = "XLA"
+                    actions.append("degraded_pallas_to_xla")
+                else:
+                    # Already on XLA: a kernel failure there has no
+                    # softer language to fall back to.
+                    journal.record(
+                        event="gave_up", kind=kind, attempt=attempt,
+                        error=f"{type(exc).__name__}: {exc}",
+                        reason="kernel failure with no degradation left",
+                    )
+                    raise
+
+            resume = latest_durable_checkpoint(settings)
+            if resume is not None:
+                settings.restart = True
+                settings.restart_input = settings.checkpoint_output
+                settings.restart_step = resume
+                actions.append(f"resumed_from_checkpoint_step_{resume}")
+            else:
+                # No durable checkpoint: restart the trajectory from
+                # scratch (unless the operator's own restart settings
+                # already point somewhere — leave those alone).
+                if not settings.restart:
+                    actions.append("restarted_from_scratch")
+                else:
+                    actions.append("restarted_from_configured_checkpoint")
+
+            delay = restart_backoff(attempt, kind)
+            journal.record(
+                event="recovery",
+                kind=kind,
+                attempt=attempt,
+                error=f"{type(exc).__name__}: {exc}",
+                action=";".join(actions),
+                backoff_s=round(delay, 3),
+            )
+            log.info(
+                f"supervisor: {kind} failure "
+                f"({type(exc).__name__}: {exc}); attempt "
+                f"{attempt + 1}/{limit} recovers with "
+                f"[{', '.join(actions)}] after {delay:.2f}s"
+            )
+            time.sleep(delay)
+            attempt += 1

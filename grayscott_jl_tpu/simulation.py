@@ -194,11 +194,28 @@ class FieldSnapshot:
     donate (and thereby delete) the simulation's own field buffers.
     """
 
-    def __init__(self, parts, step: int):
+    def __init__(self, parts, step: int, health=None):
         #: Simulation step the snapshot was taken at.
         self.step = step
         self._parts = parts  # [(offsets, true_sizes, u_dev, v_dev), ...]
         self._blocks = None
+        #: Device scalars of the fused health probe
+        #: (``resilience/health.device_probe``) when the snapshot was
+        #: taken with ``health=True``; resolved by :meth:`health_report`.
+        self._health = health
+
+    def health_report(self):
+        """Resolved :class:`~.resilience.health.HealthReport` for this
+        snapshot, or None when no probe was requested. Blocks only on
+        the probe's five scalars — the block D2H stays in flight."""
+        if self._health is None:
+            return None
+        from .resilience.health import HealthReport
+
+        finite, umin, umax, vmin, vmax = self._health
+        return HealthReport(
+            bool(finite), float(umin), float(umax), float(vmin), float(vmax)
+        )
 
     def blocks(self):
         """Host blocks ``[(offsets, sizes, u_block, v_block), ...]``,
@@ -312,7 +329,7 @@ class Simulation:
         self.base_key = jax.random.PRNGKey(seed)
         self.step = 0
         self._runners: Dict[int, object] = {}
-        self._snapshot_copy = None
+        self._snapshot_fns: Dict[bool, object] = {}
 
         if self.sharded:
             if backend == "tpu":
@@ -773,7 +790,7 @@ class Simulation:
             )
         return parts
 
-    def snapshot_async(self) -> FieldSnapshot:
+    def snapshot_async(self, *, health: bool = False) -> FieldSnapshot:
         """Capture the current (u, v) for overlapped output: returns a
         :class:`FieldSnapshot` with non-blocking D2H transfers already
         in flight, so the caller can hand it to a background writer and
@@ -786,21 +803,50 @@ class Simulation:
         view of them — holding a reference to the old arrays does NOT
         protect the data. The copy is storage the runner never sees, so
         the snapshot stays valid for as long as the consumer needs it.
+
+        ``health=True`` additionally evaluates the fused
+        ``isfinite``/range probe (``resilience/health.device_probe``)
+        inside the SAME jitted program — the fields are read from HBM
+        once for both copy and probe, and the five scalars ride the
+        boundary's existing D2H (``FieldSnapshot.health_report``).
         """
-        if self._snapshot_copy is None:
-            self._snapshot_copy = jax.jit(
-                # +0 forces a real output buffer (no donation, so XLA
-                # never aliases inputs into outputs); sharding follows
-                # the inputs.
-                lambda u, v: (u + jnp.zeros((), u.dtype),
-                              v + jnp.zeros((), v.dtype))
-            )
-        uc, vc = self._snapshot_copy(self.u, self.v)
+        fn = self._snapshot_fns.get(health)
+        if fn is None:
+            # +0 forces a real output buffer (no donation, so XLA never
+            # aliases inputs into outputs); sharding follows the inputs.
+            if health:
+                from .resilience.health import device_probe
+
+                def copy(u, v):
+                    return (u + jnp.zeros((), u.dtype),
+                            v + jnp.zeros((), v.dtype),
+                            device_probe(u, v))
+            else:
+                def copy(u, v):
+                    return (u + jnp.zeros((), u.dtype),
+                            v + jnp.zeros((), v.dtype))
+            fn = self._snapshot_fns[health] = jax.jit(copy)
+        if health:
+            uc, vc, probe = fn(self.u, self.v)
+        else:
+            uc, vc = fn(self.u, self.v)
+            probe = None
         parts = self._shard_parts(uc, vc)
         for _, _, ud, vd in parts:
             ud.copy_to_host_async()
             vd.copy_to_host_async()
-        return FieldSnapshot(parts, self.step)
+        return FieldSnapshot(parts, self.step, health=probe)
+
+    def poison_nan(self, field: str = "u") -> None:
+        """Chaos/testing hook (``resilience/faults.py`` kind ``nan``):
+        set one cell of ``field`` to NaN, modelling a numerical blow-up
+        the health guard must catch at the next boundary. A scatter on
+        the live buffers; sharding is preserved."""
+        arr = getattr(self, field)
+        setattr(
+            self, field,
+            arr.at[(0,) * arr.ndim].set(jnp.asarray(float("nan"), arr.dtype)),
+        )
 
     def local_blocks(self):
         """Per-addressable-shard ``(offsets, sizes, u_block, v_block)``.

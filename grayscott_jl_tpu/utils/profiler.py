@@ -45,6 +45,12 @@ class RunStats:
         #: ``exposed_s`` (driver-blocked), plus queue-depth high-water
         #: mark — how much I/O wall time the pipeline actually hid.
         self.io: Optional[dict] = None
+        #: Fault/recovery events (``resilience/supervisor.FaultJournal``):
+        #: every injected fault, health trip, and supervisor recovery
+        #: action of the whole supervised run — the completing attempt
+        #: merges the journal here, so one stats file tells the full
+        #: story of how the run survived.
+        self.faults: Optional[list] = None
         self._t0 = time.perf_counter()
 
     @contextlib.contextmanager
@@ -65,6 +71,11 @@ class RunStats:
         (``AsyncStepWriter.overlap_stats()``) to the summary."""
         self.io = dict(overlap) if overlap else None
 
+    def record_faults(self, events: Optional[list]) -> None:
+        """Attach the run's fault journal (injected faults, health
+        trips, recovery actions) to the summary."""
+        self.faults = [dict(e) for e in events] if events else None
+
     def summary(self) -> dict:
         total = time.perf_counter() - self._t0
         steps = self.counters.get("steps", 0)
@@ -78,6 +89,7 @@ class RunStats:
             "wall_s": round(total, 6),
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
             "io": self.io,
+            "faults": self.faults,
             "counters": dict(self.counters),
             "cell_updates_per_s": (
                 round(self.L**3 * steps / compute, 3) if compute > 0 else None

@@ -1,0 +1,245 @@
+"""Functional: the resilience subsystem end to end (``resilience/``).
+
+The chaos contract: injected faults change WHEN the run computes and
+writes, never WHAT ends up in the stores — a supervised run that eats a
+transient I/O error, a preemption, a NaN blow-up, or a Mosaic kernel
+failure must finish with stores byte-identical to an uninterrupted
+run's, and its ``RunStats`` must say exactly which faults fired and how
+each was recovered. ``scripts/chaos_smoke.sh`` runs the same scenario
+with a seeded pseudo-random preemption step; this is the fast
+deterministic tier-1 variant.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import run_cli, write_config
+
+from grayscott_jl_tpu.io.bplite import BpReader
+
+#: One config for every supervised scenario: boundaries every 10 steps,
+#: checkpoints every 20, faults land strictly between recoveries so each
+#: gets its own classify/backoff/resume cycle.
+STEPS = 60
+
+
+@pytest.fixture(scope="module")
+def uninterrupted(tmp_path_factory):
+    """The fault-free reference run every chaos scenario is compared
+    against (module-scoped: one baseline, many comparisons)."""
+    d = tmp_path_factory.mktemp("uninterrupted")
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg)
+    assert res.returncode == 0, res.stderr + res.stdout
+    return d
+
+
+def _supervised(tmp_path, name, faults, extra_env=None, **config_kw):
+    d = tmp_path / name
+    d.mkdir()
+    kw = dict(
+        noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    kw.update(config_kw)
+    cfg = write_config(d, **kw)
+    stats = d / "stats.json"
+    env = {
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": faults,
+        "GS_TPU_STATS": str(stats),
+    }
+    env.update(extra_env or {})
+    res = run_cli(d, cfg, extra_env=env)
+    return d, res, stats
+
+
+def test_chaos_io_error_and_preemption_byte_identical(
+    tmp_path, uninterrupted
+):
+    """The acceptance scenario: one transient I/O error and one
+    preemption mid-run; the supervised run completes, every store it
+    produces is byte-identical to the uninterrupted run's, and RunStats
+    records both fault events with their recovery actions."""
+    d, res, stats_path = _supervised(
+        tmp_path, "chaos", "step=25:kind=io_error;step=45:kind=preempt"
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(uninterrupted / store, d / store)
+
+    stats = json.loads(stats_path.read_text())
+    events = stats["faults"]
+    injected = {e["kind"] for e in events if e["event"] == "injected"}
+    assert injected == {"io_error", "preempt"}
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    assert [e["kind"] for e in recoveries] == ["transient-io", "preemption"]
+    for e in recoveries:
+        assert e["action"].startswith("resumed_from_checkpoint_step_")
+        assert e["backoff_s"] > 0
+    # the journal is also on disk as JSONL next to the output store
+    journal = (d / "gs.bp.faults.jsonl").read_text().splitlines()
+    assert [json.loads(line)["event"] for line in journal] == [
+        e["event"] for e in events
+    ]
+
+
+def test_health_rollback_resumes_and_matches(tmp_path, uninterrupted):
+    """A NaN blow-up under GS_HEALTH_POLICY=rollback: the guard trips at
+    the boundary BEFORE the poisoned step reaches the stores, the
+    supervisor resumes from the last durable checkpoint, and the final
+    stores bit-match the uninterrupted run."""
+    d, res, stats_path = _supervised(
+        tmp_path, "nan", "step=25:kind=nan",
+        extra_env={"GS_HEALTH_POLICY": "rollback"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    _assert_trees_byte_identical(uninterrupted / "gs.bp", d / "gs.bp")
+
+    events = json.loads(stats_path.read_text())["faults"]
+    kinds = [(e["event"], e["kind"]) for e in events]
+    assert ("injected", "nan") in kinds
+    assert ("recovery", "health") in kinds
+
+
+def test_health_abort_is_fatal(tmp_path):
+    """Default policy: a NaN blow-up kills the run loudly (no silent
+    poisoned stores), supervised or not — abort means abort."""
+    d, res, _ = _supervised(
+        tmp_path, "abort", "step=25:kind=nan",
+    )
+    assert res.returncode == 1
+    assert "health check failed" in res.stderr
+    # satellite guarantee: the failure path still closed the stores
+    # (the old driver leaked them open on any loop exception)
+    md = json.loads((d / "gs.bp" / "md.json").read_text())
+    assert md["complete"] is True
+    # only durable steps are visible; nothing after the trip boundary
+    r = BpReader(str(d / "gs.bp"))
+    assert [int(r.get("step", step=i)) for i in range(r.num_steps())] == [
+        10, 20,
+    ]
+
+
+def test_health_warn_records_and_continues(tmp_path):
+    """GS_HEALTH_POLICY=warn: the run completes (the reference's
+    implicit behavior), but the event is logged and journaled."""
+    d, res, stats_path = _supervised(
+        tmp_path, "warn", "step=25:kind=nan",
+        extra_env={"GS_HEALTH_POLICY": "warn"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "health check failed" in res.stdout  # warn goes to the log
+    events = json.loads(stats_path.read_text())["faults"]
+    warns = [e for e in events if e["kind"] == "health"]
+    assert warns and warns[0]["action"] == "continued"
+    assert warns[0]["finite"] is False
+
+
+def test_kernel_failure_degrades_pallas_to_xla(tmp_path, uninterrupted):
+    """A Mosaic runtime failure on a Pallas run: the supervisor degrades
+    to the XLA kernel language and finishes; the degradation is recorded
+    in the kernel_selection provenance, and — because the two languages
+    are bit-identical — the stores still match the uninterrupted run."""
+    d, res, stats_path = _supervised(
+        tmp_path, "kern", "step=15:kind=kernel",
+        kernel_language="Pallas",
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    _assert_trees_byte_identical(uninterrupted / "gs.bp", d / "gs.bp")
+
+    stats = json.loads(stats_path.read_text())
+    assert stats["config"]["kernel_language"] == "xla"
+    sel = stats["config"]["kernel_selection"]
+    assert sel["degraded_from"] == "pallas"
+    assert "Mosaic" in sel["degraded_reason"]
+    recoveries = [
+        e for e in stats["faults"] if e["event"] == "recovery"
+    ]
+    assert recoveries[0]["kind"] == "kernel"
+    assert "degraded_pallas_to_xla" in recoveries[0]["action"]
+
+
+def test_supervisor_gives_up_past_max_restarts(tmp_path):
+    """More classified failures than GS_MAX_RESTARTS: the run fails
+    (exit 1) and the journal records the give-up — supervision bounds
+    retries, it does not loop forever."""
+    d, res, _ = _supervised(
+        tmp_path, "giveup", "step=5:kind=preempt;step=6:kind=preempt",
+        extra_env={"GS_MAX_RESTARTS": "1"},
+    )
+    assert res.returncode == 1
+    journal = [
+        json.loads(line)
+        for line in (d / "gs.bp.faults.jsonl").read_text().splitlines()
+    ]
+    assert journal[-1]["event"] == "gave_up"
+    assert journal[-1]["kind"] == "preemption"
+
+
+def test_unsupervised_failure_closes_stores(tmp_path):
+    """Without GS_SUPERVISE a preemption is fatal — but the stores must
+    still close (try/finally in run_once): the checkpoint store is
+    `complete` and readable, so a manual restart works."""
+    d = tmp_path / "open"
+    d.mkdir()
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg, extra_env={"GS_FAULTS": "step=45:kind=preempt"})
+    assert res.returncode == 1
+    assert "injected preemption" in res.stderr
+    for store in ("gs.bp", "ckpt.bp"):
+        md = json.loads((d / store / "md.json").read_text())
+        assert md["complete"] is True, store
+    ck = BpReader(str(d / "ckpt.bp"))
+    assert [int(ck.get("step", step=i)) for i in range(ck.num_steps())] == [
+        20, 40,
+    ]
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_restart_determinism_across_async_depth(tmp_path, depth):
+    """Resuming at step k reproduces the uninterrupted trajectory
+    bit-exactly through the async output pipeline — the per-absolute-
+    step noise-key fold in models/grayscott.py, asserted for both the
+    synchronous fallback (depth 0) and the double-buffered default
+    (depth 2)."""
+    env = {"GS_ASYNC_IO_DEPTH": str(depth)}
+
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    cfg = write_config(full_dir, noise=0.1, output="full.bp")
+    assert run_cli(full_dir, cfg, extra_env=env).returncode == 0
+
+    part_dir = tmp_path / "part"
+    part_dir.mkdir()
+    cfg1 = write_config(
+        part_dir, "phase1.toml", noise=0.1, output="p1.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(part_dir, cfg1, extra_env=env).returncode == 0
+    cfg2 = write_config(
+        part_dir, "phase2.toml", noise=0.1, output="p2.bp",
+        restart="true", restart_input="ckpt.bp", restart_step=20,
+    )
+    res = run_cli(part_dir, cfg2, extra_env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    full = BpReader(str(full_dir / "full.bp"))
+    resumed = BpReader(str(part_dir / "p2.bp"))
+    for var in ("U", "V"):
+        np.testing.assert_array_equal(
+            full.get(var, step=full.num_steps() - 1),
+            resumed.get(var, step=resumed.num_steps() - 1),
+        )

@@ -321,3 +321,112 @@ def test_randomized_multiwriter_block_merge(tmp_path):
         for s in range(2):
             np.testing.assert_array_equal(r.get("U", step=s), vol[s])
         r.close()
+
+
+# ------------------------------------------------- durability validation
+
+
+def _filled_store(tmp_path, nsteps=3, name="dur.bp"):
+    path = _store(tmp_path, name)
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (4, 4, 4))
+    for s in range(nsteps):
+        w.begin_step()
+        w.put("step", np.int32((s + 1) * 10))
+        w.put("U", np.full((4, 4, 4), s, np.float32))
+        w.end_step()
+    w.close()
+    return path
+
+
+def test_reader_hides_truncated_final_step(tmp_path):
+    """A final step whose payload never fully landed (crash between
+    begin_step and a durable end_step, or a filesystem losing the tail)
+    must not be visible: num_steps() exposes only complete steps, so
+    "latest durable checkpoint" is well-defined for the supervisor."""
+    import os
+
+    path = _filled_store(tmp_path)
+    assert BpReader(path).num_steps() == 3
+    data = os.path.join(path, "data.0")
+    os.truncate(data, os.path.getsize(data) - 8)
+
+    r = BpReader(path)
+    assert r.num_steps() == 2
+    # the surviving steps read back intact
+    assert int(r.get("step", step=1)) == 20
+    np.testing.assert_array_equal(
+        r.get("U", step=1), np.full((4, 4, 4), 1, np.float32)
+    )
+    # streaming sees END_OF_STREAM after the durable prefix, not garbage
+    assert r.begin_step(timeout=0) == StepStatus.OK
+    r.end_step()
+    assert r.begin_step(timeout=0) == StepStatus.OK
+    r.end_step()
+    assert r.begin_step(timeout=0) == StepStatus.END_OF_STREAM
+
+
+def test_reader_hides_step_missing_its_whole_payload_file(tmp_path):
+    import os
+
+    path = _filled_store(tmp_path)
+    os.remove(os.path.join(path, "data.0"))
+    assert BpReader(path).num_steps() == 0
+
+
+def test_append_trims_rolled_back_payload_bytes(tmp_path):
+    """Rollback-append (keep_steps) removes the abandoned trajectory
+    from the payload BYTES, not just the metadata index — a resumed
+    store ends up byte-identical to one that never rolled back."""
+    import filecmp
+    import os
+
+    path = _filled_store(tmp_path, name="rolled.bp")
+    size3 = os.path.getsize(os.path.join(path, "data.0"))
+
+    w = BpWriter(path, append=True, keep_steps=2)
+    data_size = os.path.getsize(os.path.join(path, "data.0"))
+    assert data_size < size3
+    # re-write step 3 with the same content the original had
+    w.begin_step()
+    w.put("step", np.int32(30))
+    w.put("U", np.full((4, 4, 4), 2, np.float32))
+    w.end_step()
+    w.close()
+
+    fresh = _filled_store(tmp_path, name="fresh.bp")
+    assert filecmp.cmp(
+        os.path.join(path, "data.0"), os.path.join(fresh, "data.0"),
+        shallow=False,
+    )
+    r = BpReader(path)
+    assert [int(r.get("step", step=i)) for i in range(r.num_steps())] == [
+        10, 20, 30,
+    ]
+
+
+def test_append_trims_torn_crash_tail(tmp_path):
+    """Plain append (no rollback) after a crash mid-step: the torn tail
+    beyond the metadata-durable end is discarded so new steps land at
+    the offsets an uninterrupted run would have used."""
+    import os
+
+    path = _filled_store(tmp_path)
+    data = os.path.join(path, "data.0")
+    durable = os.path.getsize(data)
+    with open(data, "ab") as f:
+        f.write(b"\x00" * 37)  # a put() that never reached end_step
+
+    w = BpWriter(path, append=True)
+    assert os.path.getsize(data) == durable
+    w.begin_step()
+    w.put("step", np.int32(40))
+    w.put("U", np.full((4, 4, 4), 3, np.float32))
+    w.end_step()
+    w.close()
+    r = BpReader(path)
+    assert r.num_steps() == 4
+    np.testing.assert_array_equal(
+        r.get("U", step=3), np.full((4, 4, 4), 3, np.float32)
+    )

@@ -1,0 +1,259 @@
+"""Unit tests for the resilience subsystem (``resilience/``).
+
+Plan parsing, consume-once fault semantics, failure classification,
+deterministic backoff, health policies, the JSONL journal, and durable-
+checkpoint location — all host-side, no JAX. The end-to-end recovery
+behavior is covered by ``tests/functional/test_supervisor.py``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.io.async_writer import AsyncIOError
+from grayscott_jl_tpu.io.bplite import BpWriter
+from grayscott_jl_tpu.resilience import (
+    FaultJournal,
+    FaultPlan,
+    HealthError,
+    HealthGuard,
+    HealthReport,
+    InjectedIOError,
+    InjectedKernelError,
+    PreemptionError,
+    classify_failure,
+    latest_durable_checkpoint,
+    supervision_enabled,
+)
+from grayscott_jl_tpu.resilience.health import resolve_policy
+from grayscott_jl_tpu.resilience.supervisor import (
+    resolve_max_restarts,
+    restart_backoff,
+)
+
+# ---------------------------------------------------------------- fault plan
+
+
+def test_fault_plan_parse_roundtrip():
+    plan = FaultPlan.parse(
+        "step=120:kind=io_error;step=300:kind=nan; step=500:kind=preempt ;"
+        "kind=kernel:step=50"
+    )
+    assert len(plan) == 4
+    assert [(f.step, f.kind) for f in plan.faults] == [
+        (50, "kernel"), (120, "io_error"), (300, "nan"), (500, "preempt"),
+    ]
+    assert not FaultPlan.parse("")
+    assert not FaultPlan.parse("  ;  ")
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "step=10",  # missing kind
+        "kind=nan",  # missing step
+        "step=10:kind=meteor",  # unknown kind
+        "step=ten:kind=nan",  # non-integer step
+        "step=-1:kind=nan",  # negative step
+        "step=10:kind=nan:color=red",  # unknown field
+        "step=10,kind=nan",  # malformed field
+    ],
+)
+def test_fault_plan_rejects_malformed_specs(spec):
+    with pytest.raises(ValueError, match="GS_FAULTS"):
+        FaultPlan.parse(spec)
+
+
+def test_fault_plan_take_is_consume_once_and_kind_scoped():
+    plan = FaultPlan.parse("step=20:kind=nan;step=40:kind=nan")
+    assert plan.take("nan", 10) is None  # not due yet
+    assert plan.take("preempt", 100) is None  # wrong kind
+    first = plan.take("nan", 25)
+    assert first.step == 20 and first.fired
+    # a restart replaying steps 0..25 does not re-fire the same fault
+    assert plan.take("nan", 25) is None
+    second = plan.take("nan", 40)
+    assert second.step == 40
+    assert plan.take("nan", 1000) is None
+    assert plan.pending() == []
+
+
+def test_fault_plan_from_env_and_settings(monkeypatch):
+    s = Settings(faults="step=5:kind=nan")
+    monkeypatch.delenv("GS_FAULTS", raising=False)
+    assert len(FaultPlan.from_env(s)) == 1  # TOML fallback
+    monkeypatch.setenv("GS_FAULTS", "step=1:kind=preempt;step=2:kind=nan")
+    assert len(FaultPlan.from_env(s)) == 2  # env wins
+    monkeypatch.setenv("GS_FAULTS", "")
+    assert not FaultPlan.from_env(s)
+
+
+# ------------------------------------------------------------ classification
+
+
+def test_classify_failure_taxonomy():
+    assert classify_failure(PreemptionError("gone")) == "preemption"
+    assert classify_failure(InjectedKernelError(7)) == "kernel"
+    assert classify_failure(OSError("disk full")) == "transient-io"
+    report = HealthReport(False, 0.0, 1.0, 0.0, 1.0)
+    assert classify_failure(HealthError(10, report, "rollback")) == "health"
+    # abort means abort — not retryable
+    assert classify_failure(HealthError(10, report, "abort")) is None
+    # config/programming errors are fatal
+    assert classify_failure(ValueError("bad config")) is None
+    assert classify_failure(KeyError("bug")) is None
+
+
+def test_classify_unwraps_async_io_error():
+    transient = AsyncIOError(30, InjectedIOError("injected"))
+    assert transient.transient
+    assert classify_failure(transient) == "transient-io"
+    bug = AsyncIOError(30, ValueError("shape mismatch"))
+    assert not bug.transient
+    assert classify_failure(bug) is None
+
+
+def test_classify_matches_real_mosaic_runtime_errors():
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert (
+        classify_failure(XlaRuntimeError("INTERNAL: Mosaic failed to "
+                                         "compile kernel")) == "kernel"
+    )
+    assert classify_failure(XlaRuntimeError("RESOURCE_EXHAUSTED")) is None
+
+
+# ----------------------------------------------------------------- backoff
+
+
+def test_backoff_is_deterministic_exponential_and_capped(monkeypatch):
+    monkeypatch.setenv("GS_RESTART_BACKOFF_S", "0.5")
+    seq = [restart_backoff(a, "preemption") for a in range(3)]
+    assert seq == [restart_backoff(a, "preemption") for a in range(3)]
+    base = [0.5, 1.0, 2.0]
+    for got, b in zip(seq, base):
+        assert b <= got <= b * 1.25  # jitter is bounded and non-negative
+    assert restart_backoff(20, "preemption") <= 30.0 * 1.25  # capped
+    monkeypatch.setenv("GS_RESTART_BACKOFF_S", "-1")
+    with pytest.raises(ValueError, match="GS_RESTART_BACKOFF_S"):
+        restart_backoff(0, "preemption")
+
+
+# ------------------------------------------------------------------- health
+
+
+def test_health_guard_policies():
+    healthy = HealthReport(True, 0.0, 1.0, 0.0, 1.0)
+    sick = HealthReport(False, float("nan"), 1.0, 0.0, 1.0)
+
+    assert HealthGuard("abort").check(10, healthy) is None
+    with pytest.raises(HealthError, match="step 10"):
+        HealthGuard("abort").check(10, sick)
+    with pytest.raises(HealthError) as ei:
+        HealthGuard("rollback").check(10, sick)
+    assert ei.value.policy == "rollback"
+
+    event = HealthGuard("warn").check(10, sick)
+    assert event["kind"] == "health" and event["action"] == "continued"
+
+    off = HealthGuard("off")
+    assert not off.enabled
+    assert off.check(10, sick) is None
+    assert HealthGuard("abort").check(10, None) is None  # no probe taken
+
+    with pytest.raises(ValueError, match="health policy"):
+        HealthGuard("explode")
+
+
+def test_resolve_policy_env_over_settings(monkeypatch):
+    monkeypatch.delenv("GS_HEALTH_POLICY", raising=False)
+    assert resolve_policy(Settings()) == "abort"  # documented default
+    assert resolve_policy(Settings(health_policy="warn")) == "warn"
+    monkeypatch.setenv("GS_HEALTH_POLICY", "ROLLBACK")
+    assert resolve_policy(Settings(health_policy="warn")) == "rollback"
+    monkeypatch.setenv("GS_HEALTH_POLICY", "sideways")
+    with pytest.raises(ValueError, match="health policy"):
+        resolve_policy()
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_fault_journal_appends_jsonl(tmp_path):
+    path = tmp_path / "faults.jsonl"
+    j = FaultJournal(str(path))
+    j.record(event="injected", kind="nan", step=30)
+    j.record(event="recovery", kind="health", attempt=0, action="resumed")
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [e["event"] for e in lines] == ["injected", "recovery"]
+    assert lines == j.events
+    assert all("t" in e for e in lines)
+    # in-memory-only journal still accumulates
+    mem = FaultJournal(None)
+    mem.record(event="injected", kind="preempt", step=1)
+    assert len(mem.events) == 1
+
+
+# ------------------------------------------------------------------ knobs
+
+
+def test_supervision_enabled_env_and_settings(monkeypatch):
+    monkeypatch.delenv("GS_SUPERVISE", raising=False)
+    assert not supervision_enabled(Settings())
+    assert supervision_enabled(Settings(supervise=True))
+    monkeypatch.setenv("GS_SUPERVISE", "0")
+    assert not supervision_enabled(Settings(supervise=True))  # env wins
+    monkeypatch.setenv("GS_SUPERVISE", "true")
+    assert supervision_enabled(Settings())
+    monkeypatch.setenv("GS_SUPERVISE", "maybe")
+    with pytest.raises(ValueError, match="GS_SUPERVISE"):
+        supervision_enabled(Settings())
+
+
+def test_max_restarts_env_and_settings(monkeypatch):
+    monkeypatch.delenv("GS_MAX_RESTARTS", raising=False)
+    assert resolve_max_restarts(Settings()) == 3
+    assert resolve_max_restarts(Settings(max_restarts=7)) == 7
+    monkeypatch.setenv("GS_MAX_RESTARTS", "0")
+    assert resolve_max_restarts(Settings(max_restarts=7)) == 0
+    monkeypatch.setenv("GS_MAX_RESTARTS", "many")
+    with pytest.raises(ValueError, match="GS_MAX_RESTARTS"):
+        resolve_max_restarts()
+
+
+# -------------------------------------------------- durable checkpoint scan
+
+
+def _write_checkpoints(path, sim_steps, L=4):
+    w = BpWriter(str(path))
+    w.define_attribute("L", L)
+    w.define_variable("step", np.int32)
+    w.define_variable("u", "float32", (L, L, L))
+    for s in sim_steps:
+        w.begin_step()
+        w.put("step", np.int32(s))
+        w.put("u", np.full((L, L, L), float(s), np.float32))
+        w.end_step()
+    w.close()
+    return path
+
+
+def test_latest_durable_checkpoint(tmp_path):
+    s = Settings(
+        checkpoint=True, checkpoint_output=str(tmp_path / "ckpt.bp")
+    )
+    assert latest_durable_checkpoint(s) is None  # no store yet
+    _write_checkpoints(tmp_path / "ckpt.bp", [20, 40, 60])
+    assert latest_durable_checkpoint(s) == 60
+    assert latest_durable_checkpoint(Settings(checkpoint=False)) is None
+
+
+def test_latest_durable_checkpoint_skips_torn_final_entry(tmp_path):
+    """A crash mid-checkpoint leaves a final entry whose payload never
+    fully landed; the supervisor must resume from the previous one."""
+    store = _write_checkpoints(tmp_path / "ckpt.bp", [20, 40, 60])
+    data = store / "data.0"
+    data.write_bytes(data.read_bytes()[:-8])  # tear the last payload
+    s = Settings(checkpoint=True, checkpoint_output=str(store))
+    assert latest_durable_checkpoint(s) == 40
