@@ -313,7 +313,7 @@ def emit(result, error=None) -> None:
         for k in ("rounds_us_per_step", "median_us_per_step",
                   "median_cell_updates_per_s", "sustained_us_per_step",
                   "sustained_cell_updates_per_s", "late_probe_recovery_s",
-                  "provisional"):
+                  "provisional", "comm"):
             if k in result:
                 payload[k] = result[k]
     if error:

@@ -44,6 +44,19 @@ def main() -> int:
         "amortization claim as numbers (6 ppermutes per chunk, so "
         "collectives per STEP scale 6/k)",
     )
+    ap.add_argument(
+        "--ab", action="store_true",
+        help="split-phase A/B: time the sharded run with GS_COMM_OVERLAP "
+        "on vs off (plus the single-device equivalent), report the "
+        "measured overlap fraction, and append the row to --out for "
+        "benchmarks/update_overlap.py to calibrate the ICI model's "
+        "OVERLAP_EFFICIENCY",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="JSONL artifact path for --ab rows (default "
+        "benchmarks/results/overlap_ab_<platform>_<date>.jsonl)",
+    )
     args = ap.parse_args()
 
     kside = round(args.devices ** (1 / 3))
@@ -92,6 +105,75 @@ def main() -> int:
                 "collectives_per_chunk": n_perm,
                 "collectives_per_step": round(n_perm / k, 2),
             }))
+        return 0
+
+    if args.ab:
+        import datetime
+
+        from grayscott_jl_tpu.parallel import icimodel
+
+        # The A/B pins each side via the Settings key; a stray env
+        # override would silently make both sides identical.
+        os.environ.pop("GS_COMM_OVERLAP", None)
+        # Same compiled chain depth, two exchange schedules, plus the
+        # single-device equivalent that anchors the comm attribution.
+        on = Simulation(
+            Settings(L=L_global, comm_overlap="on", **base),
+            n_devices=args.devices,
+        )
+        t_on = time_sim(on, args.steps, args.rounds)
+        off = Simulation(
+            Settings(L=L_global, comm_overlap="off", **base),
+            n_devices=args.devices,
+        )
+        t_off = time_sim(off, args.steps, args.rounds)
+        single = Simulation(Settings(L=args.local, **base), n_devices=1)
+        t_single = time_sim(single, args.steps, args.rounds)
+
+        comm_off = max(t_off - t_single, 0.0)
+        comm_on = max(t_on - t_single, 0.0)
+        # Exposed-comm reduction; the split-phase band recompute cost
+        # lands in comm_on, so this is the NET fraction hidden.
+        measured = (
+            max(0.0, min(1.0, 1.0 - comm_on / comm_off))
+            if comm_off > 0 else 0.0
+        )
+        ideal = (
+            min(1.0, t_single / comm_off) if comm_off > 0 else 0.0
+        )
+        row = {
+            "ab": "comm_overlap",
+            "t": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+            "platform": backend.lower(),
+            "devices": args.devices,
+            "mesh": list(on.domain.dims),
+            "L_global": L_global,
+            "local_block": [L_global // d for d in on.domain.dims],
+            "kernel": args.kernel,
+            "overlap_engaged": bool(on.overlap_applied),
+            "us_per_step_overlap_on": round(t_on * 1e6, 1),
+            "us_per_step_overlap_off": round(t_off * 1e6, 1),
+            "us_per_step_single_equivalent": round(t_single * 1e6, 1),
+            "comm_us_overlap_on": round(comm_on * 1e6, 1),
+            "comm_us_overlap_off": round(comm_off * 1e6, 1),
+            "measured_overlap_fraction": round(measured, 4),
+            "model_ideal_overlap": round(ideal, 4),
+            "model_comm": icimodel.comm_report(on),
+        }
+        line = json.dumps(row)
+        print(line)
+        out = args.out
+        if out is None:
+            here = os.path.dirname(os.path.abspath(__file__))
+            out = os.path.join(
+                here, "results",
+                f"overlap_ab_{backend.lower()}_"
+                f"{datetime.date.today().isoformat()}.jsonl",
+            )
+        with open(out, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+        print(f"# appended to {out}", file=sys.stderr)
         return 0
 
     sharded = Simulation(

@@ -91,12 +91,22 @@ def main() -> int:
     ap.add_argument("--links", type=int, default=6)
     ap.add_argument("--link-gbps", type=float, default=90.0)
     ap.add_argument("--hop-us", type=float, default=1.0)
-    ap.add_argument("--overlap", type=float, default=0.0)
+    ap.add_argument(
+        "--overlap", default="0.0",
+        help="comm fraction hidden behind compute: a number in [0, 1), "
+        "or 'auto' for the calibrated split-phase projection "
+        "(OVERLAP_EFFICIENCY x min(1, compute/comm) per config)",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    if not 0.0 <= args.overlap < 1.0:
-        ap.error("--overlap must be in [0, 1)")
+    if args.overlap != "auto":
+        try:
+            args.overlap = float(args.overlap)
+        except ValueError:
+            ap.error("--overlap must be a number or 'auto'")
+        if not 0.0 <= args.overlap < 1.0:
+            ap.error("--overlap must be in [0, 1) (or 'auto')")
     if args.local is not None:
         us = (args.us_per_step if args.us_per_step is not None
               else MEASURED_US.get(("Pallas", args.local)))
@@ -208,8 +218,8 @@ def main() -> int:
             for r in rows:
                 f.write(json.dumps(r) + "\n")
 
-    print("\n| config | kernel | local | best k | comm µs/step | "
-          "eff (0 overlap) |", file=sys.stderr)
+    print(f"\n| config | kernel | local | best k | comm µs/step | "
+          f"eff (overlap={args.overlap}) |", file=sys.stderr)
     print("|---|---|---|---|---|---|", file=sys.stderr)
     for r in rows:
         if isinstance(r["local"], list):

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Fold a measured split-phase overlap A/B artifact into the ICI model.
+
+Reads a ``halo_bench.py --ab`` JSONL artifact (one row per config with
+``measured_overlap_fraction`` — the net exposed-comm reduction of
+GS_COMM_OVERLAP on vs off — and ``model_ideal_overlap`` — the dataflow
+bound min(1, interior_compute/comm) measured from the same timings),
+computes the realized efficiency ``measured / ideal`` per row, and —
+with ``--apply`` — rewrites the ``OVERLAP_EFFICIENCY`` literal in
+``grayscott_jl_tpu/parallel/icimodel.py`` with the median (the same
+measurement-replaces-default loop as ``update_fuse_ratio.py``; median
+because the tunnel chip's clock state spreads identical configs,
+BASELINE.md "artifact hygiene").
+
+Rows where overlap never engaged (``overlap_engaged: false`` — the
+geometry had no comm-independent interior) or where the fused run
+exposed no measurable comm (``model_ideal_overlap`` 0) carry no signal
+and are skipped.
+
+    python benchmarks/update_overlap.py benchmarks/results/overlap_ab_*.jsonl
+    python benchmarks/update_overlap.py --apply <artifact.jsonl>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import statistics
+import sys
+
+
+def load_efficiency(path: str) -> dict:
+    """Per-row realized overlap efficiencies from an --ab artifact,
+    plus their median. Raises SystemExit when no row carries signal."""
+    rows = [json.loads(ln) for ln in open(path, encoding="utf-8")
+            if ln.strip()]
+    effs = []
+    skipped = 0
+    for r in rows:
+        if r.get("ab") != "comm_overlap":
+            continue
+        ideal = float(r.get("model_ideal_overlap", 0.0))
+        if not r.get("overlap_engaged", True) or ideal <= 0:
+            skipped += 1
+            continue
+        measured = float(r.get("measured_overlap_fraction", 0.0))
+        effs.append(max(0.0, min(1.0, measured / ideal)))
+    if not effs:
+        raise SystemExit(
+            f"no usable comm_overlap A/B rows in {path} "
+            f"({skipped} rows without signal)"
+        )
+    return {
+        "efficiencies": [round(e, 4) for e in effs],
+        "median": round(statistics.median(effs), 4),
+        "skipped": skipped,
+    }
+
+
+def apply_to_model(efficiency: float, model_path: str) -> None:
+    """Rewrite the ``OVERLAP_EFFICIENCY`` literal in place (the model
+    keeps its docstring; only the number changes)."""
+    src = open(model_path, encoding="utf-8").read()
+    m = re.search(r"OVERLAP_EFFICIENCY = [0-9.]+", src)
+    if m is None:
+        raise SystemExit(
+            f"OVERLAP_EFFICIENCY literal not found in {model_path}"
+        )
+    new_src = (src[:m.start()]
+               + f"OVERLAP_EFFICIENCY = {round(efficiency, 4)}"
+               + src[m.end():])
+    open(model_path, "w", encoding="utf-8").write(new_src)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifact",
+                    help="halo_bench --ab JSONL with comm_overlap rows")
+    ap.add_argument("--apply", action="store_true",
+                    help="rewrite OVERLAP_EFFICIENCY in "
+                    "grayscott_jl_tpu/parallel/icimodel.py")
+    args = ap.parse_args()
+
+    result = load_efficiency(args.artifact)
+    print(json.dumps({"measured_overlap_efficiency": result["median"],
+                      "rows": result["efficiencies"],
+                      "skipped_rows": result["skipped"],
+                      "artifact": args.artifact}))
+    if args.apply:
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        model = os.path.join(root, "grayscott_jl_tpu", "parallel",
+                             "icimodel.py")
+        apply_to_model(result["median"], model)
+        print(f"updated OVERLAP_EFFICIENCY = {result['median']} in {model}",
+              file=sys.stderr)
+        print("re-run: python benchmarks/ici_model.py --out "
+              "benchmarks/results/ici_projection_overlap.jsonl",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,21 @@ class Settings:
     max_restarts: int = 3
     health_policy: str = "abort"
     faults: str = ""
+    #: Split-phase halo exchange (extension; docs/OVERLAP.md): issue the
+    #: boundary ppermutes first and let XLA's async collective-permute
+    #: machinery schedule the ICI transfer under the interior compute,
+    #: stitching the thin boundary bands from the arrived halos.
+    #: "auto" (default) = on for sharded runs, "on"/"off" force it;
+    #: GS_COMM_OVERLAP env wins. "off" reproduces the fused
+    #: exchange-then-compute flow bit-for-bit (the trajectories are
+    #: bitwise identical either way — overlap only reorders dataflow).
+    comm_overlap: str = "auto"
+    #: JAX persistent compilation cache directory (extension): ""
+    #: resolves to a default user-cache dir when supervision is armed
+    #: (restart attempts and repeated bench invocations skip recompiles)
+    #: and to disabled otherwise; "off" disables explicitly.
+    #: GS_COMPILE_CACHE env wins (path, or ""/off/0 to disable).
+    compile_cache: str = ""
 
 
 #: Keys accepted from the TOML file (reference ``Structs.jl:31-52``).
@@ -209,6 +224,62 @@ def load_backend_and_lang(settings: Settings) -> Tuple[str, str]:
             f"Supported: {sorted(KERNEL_LANGUAGES)}"
         )
     return BACKENDS[b], KERNEL_LANGUAGES[l]
+
+
+def resolve_comm_overlap(settings: Settings) -> str:
+    """Normalized split-phase-exchange mode: ``"on"``, ``"off"``, or
+    ``"auto"`` (= on for sharded runs). ``GS_COMM_OVERLAP`` wins over the
+    ``comm_overlap`` TOML key, mirroring the resilience knobs."""
+    import os
+
+    raw = os.environ.get("GS_COMM_OVERLAP")
+    if raw is None:
+        raw = settings.comm_overlap or "auto"
+    v = raw.strip().lower()
+    v = {"1": "on", "true": "on", "yes": "on",
+         "0": "off", "false": "off", "no": "off", "": "auto"}.get(v, v)
+    if v not in ("on", "off", "auto"):
+        raise ValueError(
+            f"comm_overlap / GS_COMM_OVERLAP must be on/off/auto, "
+            f"got {raw!r}"
+        )
+    return v
+
+
+def resolve_compile_cache(settings: Settings) -> Any:
+    """Resolved JAX persistent-compilation-cache directory, or ``None``
+    when disabled.
+
+    Precedence: ``GS_COMPILE_CACHE`` env (a path, or ``""``/``off``/``0``
+    to disable) > the ``compile_cache`` TOML key (path, or ``off``) >
+    default: a shared user-cache directory when supervision is armed
+    (``resilience/supervisor``: every restart attempt re-jits the same
+    programs, and without the cache each attempt pays full recompiles),
+    else disabled.
+    """
+    import os
+
+    raw = os.environ.get("GS_COMPILE_CACHE")
+    if raw is None:
+        raw = settings.compile_cache or ""
+    v = raw.strip()
+    if v.lower() in ("off", "0", "false", "no"):
+        return None
+    if v:
+        return os.path.expanduser(v)
+    # Unset: default on under supervision (mirror supervisor's env-wins
+    # semantics without importing resilience — config stays leaf-level).
+    sup = os.environ.get("GS_SUPERVISE")
+    if sup is not None:
+        armed = sup.strip().lower() in ("1", "true", "yes", "on")
+    else:
+        armed = bool(settings.supervise)
+    if armed:
+        return os.path.join(
+            os.path.expanduser("~"), ".cache", "grayscott_jl_tpu",
+            "compile",
+        )
+    return None
 
 
 def resolve_precision(settings: Settings) -> Any:

@@ -81,6 +81,19 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     closure on failure.
     """
     settings = get_settings(list(args))
+
+    # Split-phase exchange support flags (async collective-permute +
+    # latency-hiding scheduler) must reach XLA before the backend
+    # initializes; TPU-only flags, and pointless when the operator
+    # pinned the fused exchange.
+    from .config import settings as config_mod
+
+    backend, _lang = config_mod.load_backend_and_lang(settings)
+    if backend == "tpu" and config_mod.resolve_comm_overlap(settings) != "off":
+        from .utils.benchmark import inject_overlap_xla_flags
+
+        inject_overlap_xla_flags()
+
     maybe_initialize_distributed()
 
     from .resilience import supervisor
@@ -218,7 +231,12 @@ def run_once(
         "precision": settings.precision,
         "n_devices": sim.domain.n_blocks,
         "n_processes": nprocs,
+        "comm_overlap": sim.comm_overlap,
+        "compile_cache": sim.compile_cache_dir,
     })
+    from .parallel import icimodel
+
+    stats.record_comm(icimodel.comm_report(sim))
     pipe = AsyncStepWriter(stats=stats)
     stats.config["async_io_depth"] = pipe.depth
     step = restart_step

@@ -17,6 +17,19 @@ Corner/edge ghost cells are left at boundary values — the 7-point stencil
 never reads them (the reference's sequential xy/xz/yz exchange also leaves
 them unsynchronized in a different but equally-unread state).
 
+Split-phase exchange (round 6, docs/OVERLAP.md): the fused helpers above
+produce data the *whole* kernel pass depends on, which serializes
+ppermute latency in front of the compute. :func:`start_exchange` /
+:class:`PendingExchange` issue the same ppermutes with NO consumer on the
+interior compute's dataflow path, and :func:`frozen_frame` /
+:func:`frozen_slabs` build the constant stand-ins the interior pass reads
+instead — so XLA's async collective-permute + latency-hiding scheduler
+can run the ICI transfer under the interior work, and the arrived halos
+feed only the thin boundary-band recompute that is stitched afterwards
+(``parallel/temporal.stitch_bands_from_frame``). Under JAX there is no
+imperative wait: "start" means *issued with no dependency on the interior
+pass*, and ``finish()`` means *first consumed by the band stitch*.
+
 All functions here must be called *inside* ``shard_map``.
 """
 
@@ -224,6 +237,78 @@ def exchange_slabs(
     carry the y corner data the in-kernel ring recompute needs. Must be
     called inside ``shard_map``."""
     return _exchange_dim(list(arrays), boundary_values, dim, ax, n, width)
+
+
+def frozen_slabs(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    dim: int,
+    width: int,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Constant (lo, hi) ``width``-thick slabs at the frozen boundary
+    value for each array — the shape-compatible stand-in the split-phase
+    interior pass consumes instead of exchanged slabs (identical to what
+    an edge shard, or a single-shard axis, resolves to)."""
+    out = []
+    for a, bv in zip(arrays, boundary_values):
+        shape = list(a.shape)
+        shape[dim] = width
+        f = jnp.full(shape, bv, a.dtype)
+        out.append((f, f))
+    return out
+
+
+def frozen_frame(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    width: int,
+) -> Tuple[jnp.ndarray, ...]:
+    """Each array ghost-padded ``width`` deep with the frozen boundary
+    constant on every side — the :func:`halo_pad_wide` stand-in for the
+    split-phase interior pass (as if every shard were a global-edge
+    shard on every axis)."""
+    return tuple(
+        jnp.pad(a, width, mode="constant", constant_values=bv)
+        for a, bv in zip(arrays, boundary_values)
+    )
+
+
+class PendingExchange:
+    """An in-flight corner-propagated wide halo exchange.
+
+    Holds the exchanged frames (``halo_pad_wide`` results). In JAX's
+    dataflow model the ppermutes are already issued — *pending* means no
+    op on the interior-compute path consumes them, so the scheduler is
+    free to run the transfer underneath; :meth:`finish` hands the frames
+    to the boundary-band stitch, the only consumer.
+    """
+
+    def __init__(self, frames: Tuple[jnp.ndarray, ...], width: int):
+        self.frames = frames
+        self.width = width
+
+    def finish(self) -> Tuple[jnp.ndarray, ...]:
+        """The exchanged frames (first consumption point)."""
+        return self.frames
+
+
+def start_exchange(
+    arrays: Sequence[jnp.ndarray],
+    boundary_values: Sequence[float],
+    axis_names: Tuple[str, str, str],
+    axis_sizes: Tuple[int, int, int],
+    width: int,
+) -> PendingExchange:
+    """Issue the corner-propagated ``width``-deep exchange of
+    :func:`halo_pad_wide` without tying it into the caller's compute:
+    the same ppermutes, in the same per-axis order (so the fused and
+    split-phase lowerings carry the SAME collective count), returned as
+    a :class:`PendingExchange` consumed only by the band stitch."""
+    return PendingExchange(
+        halo_pad_wide(arrays, boundary_values, axis_names, axis_sizes,
+                      width),
+        width,
+    )
 
 
 def exchange_faces(

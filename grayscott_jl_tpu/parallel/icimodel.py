@@ -54,6 +54,36 @@ MEASURED_US = {
 #: stepwise on a single chip too, so its ratio is 1.0 by construction.
 STAGE_RATIO = {"Pallas": FUSE_COST_RATIO[1], "XLA": 1.0}
 
+#: Fraction of the *ideally hideable* communication the split-phase
+#: exchange (GS_COMM_OVERLAP, docs/OVERLAP.md) actually hides behind
+#: interior compute: realized_overlap = OVERLAP_EFFICIENCY *
+#: min(1, interior_compute / comm). The ideal bound comes from dataflow
+#: (comm can hide only under compute that does not consume it); the
+#: efficiency discounts scheduler imperfection — async collective-
+#: permute issue latency, band-stitch cost, LHS scheduling slack — and
+#: is the default until ``benchmarks/update_overlap.py --apply``
+#: rewrites this literal from a measured ``halo_bench.py --ab``
+#: artifact (the same calibration loop as FUSE_COST_RATIO).
+OVERLAP_EFFICIENCY = 0.85
+
+
+def overlap_fraction(compute_us: float, comm_us: float,
+                     efficiency: float = None) -> float:
+    """Calibrated overlap fraction for a config: the share of raw comm
+    hidden behind ``compute_us`` of comm-independent interior work."""
+    if comm_us <= 0 or compute_us <= 0:
+        return 0.0
+    eff = OVERLAP_EFFICIENCY if efficiency is None else efficiency
+    return min(1.0, eff * compute_us / comm_us)
+
+
+def _resolve_overlap(overlap, compute_us: float, raw_comm_us: float):
+    """Projection-row overlap: an explicit fraction, or ``"auto"`` for
+    the calibrated ``overlap_fraction`` of this config."""
+    if overlap == "auto":
+        return overlap_fraction(compute_us, raw_comm_us)
+    return float(overlap)
+
 
 def anchor_us(lang: str, L: int) -> float:
     """Single-chip µs/step for a full L^3 grid: the measured anchor with
@@ -99,10 +129,14 @@ def project(
     faces_per_link = -(-6 // links)  # ceil
     ser_us = faces_per_link * face_bytes / (link_gbps * 1e3) / fuse
     lat_us = 6 * hop_us / fuse  # one exchange round per k steps
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
+    raw_us = ser_us + lat_us
     recompute = sum(
         (local + 2 * (fuse - 1 - s)) ** 3 for s in range(fuse)
     ) / (fuse * local**3)
+    ov = _resolve_overlap(
+        overlap, us_per_step * stage_ratio * recompute, raw_us
+    )
+    comm_us = raw_us * (1.0 - ov)
     eff = us_per_step / (us_per_step * stage_ratio * recompute + comm_us)
     return {
         "local": local,
@@ -112,9 +146,10 @@ def project(
         "ring_recompute_ratio": round(recompute, 4),
         "halo_bytes_per_round": total_bytes,
         "comm_us_per_step_exposed": round(comm_us, 2),
+        "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
         "links": links,
         "link_gbps": link_gbps,
-        "overlap": overlap,
+        "overlap": round(ov, 4),
         "projected_weak_scaling_eff": round(eff, 4),
     }
 
@@ -244,7 +279,12 @@ def project_chain(
     faces_per_link = -(-n_faces // links) if n_faces else 0
     ser_us = faces_per_link * face_bytes / (link_gbps * 1e3)
     lat_us = n_faces * hop_us / k
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
+    raw_us = ser_us + lat_us
+    # Only the kernel pass is comm-independent dataflow in the split-
+    # phase round; the band recomputes consume the exchange, so they
+    # are not part of the hiding budget.
+    ov = _resolve_overlap(overlap, compute_us, raw_us)
+    comm_us = raw_us * (1.0 - ov)
 
     eff = us_base / (compute_us + band_us + comm_us)
     return {
@@ -258,9 +298,10 @@ def project_chain(
         "x_ring_recompute": round(x_ring, 4),
         "z_band_us_per_step": round(band_us, 2),
         "comm_us_per_step_exposed": round(comm_us, 2),
+        "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
         "links": links,
         "link_gbps": link_gbps,
-        "overlap": overlap,
+        "overlap": round(ov, 4),
         "projected_weak_scaling_eff": round(eff, 4),
     }
 
@@ -388,7 +429,9 @@ def project_1d(
     faces_per_link = -(-2 // links)
     ser_us = faces_per_link * ny * nz * itemsize * 2 / (link_gbps * 1e3)
     lat_us = 2 * hop_us / fuse
-    comm_us = (ser_us + lat_us) * (1.0 - overlap)
+    raw_us = ser_us + lat_us
+    ov = _resolve_overlap(overlap, us_base * r * recompute, raw_us)
+    comm_us = raw_us * (1.0 - ov)
     eff = us_base / (us_base * r * recompute + comm_us)
     return {
         "mesh": f"{n},1,1",
@@ -399,9 +442,10 @@ def project_1d(
         "compute_us_per_step": round(us_base, 1),
         "ring_recompute_ratio": round(recompute, 4),
         "comm_us_per_step_exposed": round(comm_us, 2),
+        "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
         "links": links,
         "link_gbps": link_gbps,
-        "overlap": overlap,
+        "overlap": round(ov, 4),
         "projected_weak_scaling_eff": round(eff, 4),
     }
 
@@ -454,7 +498,7 @@ def select_kernel(
     fuse: int = 5,
     eff_target: float = 0.90,
     objective: str = None,
-    overlap: float = 0.0,
+    overlap="auto",
     hop_us: float = 1.0,
     sweep_mesh: bool = False,
 ):
@@ -486,6 +530,13 @@ def select_kernel(
         projected absolute step time, efficiency be damned — the
         Pallas chain's single-chip base is 2.3-4.4x the XLA kernel's,
         so it can lose the efficiency race while winning wall-clock.
+
+    ``overlap``: the comm-hiding assumption threaded into every
+    projection row. The default ``"auto"`` applies the calibrated
+    split-phase overlap (``overlap_fraction`` — the runtime default is
+    split-phase ON for sharded runs); pass ``0.0`` when the run has
+    ``GS_COMM_OVERLAP=off`` so the pick reflects fully-exposed comm,
+    or any explicit fraction for sensitivity studies.
     """
     import os
 
@@ -605,3 +656,82 @@ def select_kernel(
         else:
             info["reason"] = "fastest projected absolute step time"
     return pick["kernel"], info
+
+
+def comm_report(sim) -> dict:
+    """Per-step communication budget of a constructed ``Simulation`` —
+    the ``comm`` section of RunStats (``utils/profiler.py``), mirroring
+    the ``io`` overlap section: how many µs/step of halo exchange the
+    ICI model projects for this exact config, and how much of it the
+    split-phase schedule hides vs exposes.
+
+    This is a MODEL projection (single-chip anchors + fabric figures,
+    same machinery as Auto dispatch), not a measurement — host wall
+    clock cannot attribute device-side comm, and a CPU-mesh run has no
+    ICI at all. The section says so (``"model"``) and records the
+    knobs, so a stats reader can recompute or recalibrate
+    (``benchmarks/update_overlap.py``).
+    """
+    import numpy as np
+
+    if not sim.sharded:
+        return {
+            "model": "ici-projection",
+            "mode": "single-device",
+            "comm_us_per_step": 0.0,
+            "hidden_us": 0.0,
+            "exposed_us": 0.0,
+            "overlap": 0.0,
+        }
+    dims = sim.domain.dims
+    L = sim.settings.L
+    itemsize = int(np.dtype(sim.dtype).itemsize)
+    try:
+        kind = sim.mesh.devices.flat[0].device_kind
+    except Exception:  # noqa: BLE001 — virtual meshes have no kind
+        kind = ""
+    link_gbps, links = fabric_for(kind)
+    overlap_on = bool(getattr(sim, "comm_overlap", False))
+    ov_arg = "auto" if overlap_on else 0.0
+    fuse = max(1, int(sim._fuse_base()))
+    local = tuple(-(-L // d) for d in dims)
+    lang = "Pallas" if sim.kernel_language == "pallas" else "XLA"
+    kw = dict(itemsize=itemsize, links=links, link_gbps=link_gbps,
+              overlap=ov_arg)
+    row = None
+    if lang == "Pallas" and fuse >= 2:
+        k = min(fuse, max(FUSE_COST_RATIO))
+        k = k if k in FUSE_COST_RATIO else max(
+            f for f in FUSE_COST_RATIO if f <= k
+        )
+        base_full = anchor_us("Pallas", L)
+        try:
+            if dims[1] == 1 and dims[2] == 1:
+                row = project_1d(dims[0], L, k, base_full, local=local,
+                                 **kw)
+            else:
+                row = project_chain(dims, L, k, base_full, local=local,
+                                    **kw)
+        except ValueError:
+            row = None
+    if row is None:
+        side = max(2, round(
+            (local[0] * local[1] * local[2]) ** (1 / 3)
+        ))
+        n_dev = dims[0] * dims[1] * dims[2]
+        row = project(side, fuse, anchor_us("XLA", L) / n_dev, **kw)
+    exposed = row["comm_us_per_step_exposed"]
+    hidden = row.get("comm_us_per_step_hidden", 0.0)
+    return {
+        "model": "ici-projection",
+        "mode": "overlap" if overlap_on else "fused",
+        "device_kind": kind or None,
+        "kernel": lang,
+        "fuse": row.get("fuse", fuse),
+        "links": links,
+        "link_gbps": link_gbps,
+        "comm_us_per_step": round(exposed + hidden, 2),
+        "hidden_us": hidden,
+        "exposed_us": exposed,
+        "overlap": row["overlap"],
+    }

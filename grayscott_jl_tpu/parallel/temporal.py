@@ -67,7 +67,7 @@ def pin_out_of_domain(arr, bv, origin, row):
 
 def window_chain(
     u_w, v_w, params, *, depth, step, origin, row, use_noise, unit_noise,
-    boundaries: Sequence[float],
+    boundaries: Sequence[float], final_pin: bool = True,
 ):
     """``depth`` XLA steps on a ghost-inclusive window, shrinking one
     cell per side per stage; returns the (shape - 2*depth) core.
@@ -78,7 +78,12 @@ def window_chain(
     global-coordinate masks. Same op order and position-keyed noise
     as every other path — bitwise-exact against the stepwise
     trajectory, so a band it computes can be stitched next to
-    kernel-computed cells seamlessly."""
+    kernel-computed cells seamlessly.
+
+    ``final_pin=False`` skips the last stage's pin masks — legal only
+    when the caller knows every output cell is in-domain (a divisible-L
+    block-shaped result), where the pin is a provably-all-true mask.
+    Mid-stage pins always run: the shrinking ring reads them back."""
     from ..ops import stencil
 
     u_bv, v_bv = boundaries
@@ -91,15 +96,78 @@ def window_chain(
         else:
             nzf = jnp.asarray(0.0, u_w.dtype)
         u_w, v_w = stencil.reaction_update(u_w, v_w, nzf, params)
-        u_w = pin_out_of_domain(u_w, u_bv, o, row)
-        v_w = pin_out_of_domain(v_w, v_bv, o, row)
+        if s + 1 < depth or final_pin:
+            u_w = pin_out_of_domain(u_w, u_bv, o, row)
+            v_w = pin_out_of_domain(v_w, v_bv, o, row)
     return u_w, v_w
+
+
+def stitch_bands_from_frame(
+    u_i, v_i, u_w, v_w, params, *, depth, step, offs, row, axis_sizes,
+    use_noise, unit_noise, boundaries: Sequence[float],
+    dims_to_stitch: Sequence[int] = (0, 1, 2),
+):
+    """Overwrite the ``depth``-thick boundary bands of a block-shaped
+    result with :func:`window_chain` recomputes from the exchanged
+    corner-propagated frame ``(u_w, v_w)`` (``halo.halo_pad_wide``
+    width ``depth``).
+
+    The split-phase stitch: ``(u_i, v_i)`` came from an interior pass
+    that saw frozen-constant ghosts, so every cell within ``depth``
+    cells of a sharded face is contaminated (one cell per stage). Each
+    such band is recomputed from a 3k-deep frame window spanning the
+    FULL frame extent on the other axes — so corner cells land in two
+    (or three) bands, each recomputing bitwise-identical values from
+    the same frame, and sequential overwrites are safe. Axes with a
+    single shard (or excluded via ``dims_to_stitch``) are skipped:
+    their frozen ghosts were already the truth.
+
+    ``offs`` (int32[3]) is the block's global origin. Must be called
+    inside ``shard_map``.
+    """
+    k = depth
+    offs = jnp.asarray(offs, jnp.int32)
+    base = offs - k  # global origin of the frame
+    for dim in range(3):
+        if axis_sizes[dim] == 1 or dim not in dims_to_stitch:
+            continue
+        n_d = u_i.shape[dim]
+        m = u_w.shape[dim]  # n_d + 2k
+        for d0, w0 in ((0, 0), (n_d - k, m - 3 * k)):
+            sl = [slice(None)] * 3
+            sl[dim] = slice(w0, w0 + 3 * k)
+            sl = tuple(sl)
+            bu, bv = window_chain(
+                u_w[sl], v_w[sl], params, depth=k, step=step,
+                origin=base.at[dim].add(w0), row=row,
+                use_noise=use_noise, unit_noise=unit_noise,
+                boundaries=boundaries,
+            )
+            pos = [0, 0, 0]
+            pos[dim] = d0
+            u_i = lax.dynamic_update_slice(u_i, bu, tuple(pos))
+            v_i = lax.dynamic_update_slice(v_i, bv, tuple(pos))
+    return u_i, v_i
+
+
+def xy_overlap_feasible(local, dims, depth) -> bool:
+    """Whether the split-phase form of :func:`xy_chain` applies at this
+    geometry. The z-sharded (frame) form always does — its bands come
+    from one corner-propagated frame and may overlap-write identical
+    values. The slab form (p == 1) builds band windows from 2k-deep
+    owned slices, so every sharded slab axis must be >= 2k deep (a
+    shallower block has no comm-independent interior anyway)."""
+    if dims[2] > 1:
+        return True
+    k = depth
+    return not ((dims[0] > 1 and local[0] < 2 * k) or local[1] < 2 * k)
 
 
 def xy_chain(
     u, v, params, *, depth, step, offs, chain_kernel: Callable,
     use_noise, unit_noise, row, axis_names, axis_sizes,
     boundaries: Sequence[float], sublane: int = 8,
+    overlap: bool = False, band_kernel: Callable = None,
 ):
     """``depth`` fused steps on an (n, m, p) sharded block: in-kernel
     chain across x and y shard boundaries, XLA band correction on
@@ -109,37 +177,170 @@ def xy_chain(
     kernel (or its bitwise XLA fallback) at ``fuse=depth`` on the
     y-extended operand; ``unit_noise(step_idx, origin, shape)`` draws
     from the shared position-keyed stream. Must be called inside
-    ``shard_map``."""
+    ``shard_map``.
+
+    ``overlap=True`` is the split-phase form (docs/OVERLAP.md): the
+    SAME exchange is issued first, but the kernel consumes frozen
+    boundary constants instead — so it has no data dependency on the
+    ppermutes and XLA can hide the ICI transfer under it — and the
+    exchanged slabs/frame feed only the k-thick x/y (and z) boundary
+    bands recomputed afterwards and stitched in. x/y bands run
+    ``band_kernel`` — the x-chain XLA reference program
+    (``pallas_stencil._xla_xchain_fallback``) on a thin body — NOT a
+    different chain formulation: structural identity with the fused
+    kernel's own fallback is what keeps the recomputed band bitwise
+    equal under XLA's shape-sensitive codegen (FMA contraction). z
+    bands keep the fused path's :func:`window_chain` recompute, which
+    is identical in both modes. Slab-mode (p == 1) overlap needs every
+    sharded slab axis to be at least 2k deep (otherwise there is no
+    interior to hide behind); shallower blocks silently take the fused
+    round, which is bitwise identical anyway.
+    """
     nx, ny, nz = u.shape
     dims = axis_sizes
     k = depth
     u_bv, v_bv = boundaries
     z_sharded = dims[2] > 1
+    if overlap and not xy_overlap_feasible(u.shape, dims, k):
+        overlap = False  # no comm-independent interior: fused round
+    if overlap and band_kernel is None:
+        raise ValueError("xy_chain overlap=True requires band_kernel")
+
+    # ((body_u, body_v), faces4, offsets, out_row_slice, position) jobs
+    # for the split-phase x/y band recompute, built beside the exchange.
+    band_jobs = []
+
+    def const_faces(shape_nyz):
+        return tuple(
+            jnp.full((k,) + shape_nyz, bv, u.dtype)
+            for bv in (u_bv, u_bv, v_bv, v_bv)
+        )
 
     if z_sharded:
         # One corner-propagated k-deep frame serves the kernel operand,
-        # its x faces, AND the z-band windows (6 ppermutes total).
+        # its x faces, AND the band windows (6 ppermutes total).
         u_w, v_w = halo.halo_pad_wide(
             (u, v), boundaries, axis_names, dims, k
         )
-        u_p = u_w[k:k + nx, :, k:k + nz]
-        v_p = v_w[k:k + nx, :, k:k + nz]
-        faces = (
-            u_w[0:k, :, k:k + nz], u_w[k + nx:, :, k:k + nz],
-            v_w[0:k, :, k:k + nz], v_w[k + nx:, :, k:k + nz],
-        )
+        if overlap:
+            # Split phase: the kernel sees frozen constants everywhere,
+            # so the frame has NO consumer on the kernel's dataflow
+            # path; bands for every sharded axis are stitched after.
+            u_p = jnp.pad(u, ((0, 0), (k, k), (0, 0)),
+                          constant_values=u_bv)
+            v_p = jnp.pad(v, ((0, 0), (k, k), (0, 0)),
+                          constant_values=v_bv)
+            faces = const_faces((ny + 2 * k, nz))
+            m_y = ny + 2 * k
+
+            def fr(x0, x1, ys):
+                """Frame windows of (u, v) at frame x range [x0, x1)
+                and y range ``ys``, z clipped to the owned planes."""
+                return (u_w[x0:x1, ys, k:k + nz],
+                        v_w[x0:x1, ys, k:k + nz])
+
+            if dims[1] > 1:
+                # y bands: body rows are the frame's [arrived y slab |
+                # 2k owned rows]; x faces are the frame's x ghosts
+                # clipped to the same rows (corner-propagated, so the
+                # band's x corners carry real neighbor data exactly as
+                # the fused kernel's do).
+                for ys, o_y, d_y in (
+                    (slice(0, 3 * k), -k, 0),
+                    (slice(m_y - 3 * k, m_y), ny - 2 * k, ny - k),
+                ):
+                    xlo_u, xlo_v = fr(0, k, ys)
+                    xhi_u, xhi_v = fr(k + nx, nx + 2 * k, ys)
+                    band_jobs.append((
+                        fr(k, k + nx, ys),
+                        (xlo_u, xhi_u, xlo_v, xhi_v),
+                        jnp.stack([offs[0], offs[1] + o_y, offs[2]]),
+                        slice(k, 2 * k), (0, d_y, 0),
+                    ))
+            if dims[0] > 1:
+                # x bands: a k-plane body whose x faces come from the
+                # frame — the arrived x ghost on the outside, adjacent
+                # owned planes on the inside; full frame y extent.
+                ally = slice(None)
+                for xs, fl, fh, o_x, d_x in (
+                    (slice(k, 2 * k), slice(0, k), slice(2 * k, 3 * k),
+                     0, 0),
+                    (slice(nx, k + nx), slice(nx - k, nx),
+                     slice(k + nx, nx + 2 * k), nx - k, nx - k),
+                ):
+                    flo_u, flo_v = fr(fl.start, fl.stop, ally)
+                    fhi_u, fhi_v = fr(fh.start, fh.stop, ally)
+                    band_jobs.append((
+                        fr(xs.start, xs.stop, ally),
+                        (flo_u, fhi_u, flo_v, fhi_v),
+                        jnp.stack([offs[0] + o_x, offs[1] - k,
+                                   offs[2]]),
+                        slice(k, k + ny), (d_x, 0, 0),
+                    ))
+        else:
+            u_p = u_w[k:k + nx, :, k:k + nz]
+            v_p = v_w[k:k + nx, :, k:k + nz]
+            faces = (
+                u_w[0:k, :, k:k + nz], u_w[k + nx:, :, k:k + nz],
+                v_w[0:k, :, k:k + nz], v_w[k + nx:, :, k:k + nz],
+            )
     else:
         # Lean 4-ppermute build: k-wide y slabs first, then x slabs of
         # the y-padded fields so the x faces carry y corner data.
         (u_ylo, u_yhi), (v_ylo, v_yhi) = halo.exchange_slabs(
             [u, v], boundaries, 1, axis_names[1], dims[1], k
         )
-        u_p = jnp.concatenate([u_ylo, u, u_yhi], axis=1)
-        v_p = jnp.concatenate([v_ylo, v, v_yhi], axis=1)
+        u_pr = jnp.concatenate([u_ylo, u, u_yhi], axis=1)
+        v_pr = jnp.concatenate([v_ylo, v, v_yhi], axis=1)
         pairs = halo.exchange_slabs(
-            [u_p, v_p], boundaries, 0, axis_names[0], dims[0], k
+            [u_pr, v_pr], boundaries, 0, axis_names[0], dims[0], k
         )
-        faces = (pairs[0][0], pairs[0][1], pairs[1][0], pairs[1][1])
+        (xp_ulo, xp_uhi), (xp_vlo, xp_vhi) = pairs
+        if overlap:
+            u_p = jnp.pad(u, ((0, 0), (k, k), (0, 0)),
+                          constant_values=u_bv)
+            v_p = jnp.pad(v, ((0, 0), (k, k), (0, 0)),
+                          constant_values=v_bv)
+            faces = const_faces((ny + 2 * k, nz))
+            m_y = ny + 2 * k
+            if dims[1] > 1:
+                # y bands: body rows are [arrived y slab | 2k owned
+                # rows] of the y-padded fields; the x faces are the
+                # arrived x slabs clipped to the same rows, so the
+                # band's x corners carry real neighbor data exactly as
+                # the fused kernel's do.
+                for ys, o_y, d_y in (
+                    (slice(0, 3 * k), -k, 0),
+                    (slice(m_y - 3 * k, m_y), ny - 2 * k, ny - k),
+                ):
+                    band_jobs.append((
+                        (u_pr[:, ys, :], v_pr[:, ys, :]),
+                        (xp_ulo[:, ys, :], xp_uhi[:, ys, :],
+                         xp_vlo[:, ys, :], xp_vhi[:, ys, :]),
+                        jnp.stack([offs[0], offs[1] + o_y, offs[2]]),
+                        slice(k, 2 * k), (0, d_y, 0),
+                    ))
+            if dims[0] > 1:
+                # x bands: a k-plane body whose x faces are the arrived
+                # slab and the adjacent owned planes (both y-padded).
+                for body, faces_b, o_x, d_x in (
+                    ((u_pr[:k], v_pr[:k]),
+                     (xp_ulo, u_pr[k:2 * k], xp_vlo, v_pr[k:2 * k]),
+                     0, 0),
+                    ((u_pr[nx - k:], v_pr[nx - k:]),
+                     (u_pr[nx - 2 * k:nx - k], xp_uhi,
+                      v_pr[nx - 2 * k:nx - k], xp_vhi),
+                     nx - k, nx - k),
+                ):
+                    band_jobs.append((
+                        body, faces_b,
+                        jnp.stack([offs[0] + o_x, offs[1] - k,
+                                   offs[2]]),
+                        slice(k, k + ny), (d_x, 0, 0),
+                    ))
+        else:
+            u_p, v_p = u_pr, v_pr
+            faces = (pairs[0][0], pairs[0][1], pairs[1][0], pairs[1][1])
 
     # Round the y extent up to the sublane tile with boundary-constant
     # filler rows at the high end — Mosaic needs sublane-aligned planes,
@@ -161,21 +362,24 @@ def xy_chain(
     u_o = u_o[:, k:k + ny, :]
     v_o = v_o[:, k:k + ny, :]
 
+    # Split-phase x/y bands first (they reproduce the fused kernel's
+    # values, including each other's corners), then the z bands, which
+    # overwrite the z shell in BOTH modes with identical values.
+    for body, faces_b, offs_b, out_rows, pos in band_jobs:
+        bu, bv_ = band_kernel(body[0], body[1], faces_b, step, offs_b)
+        u_o = lax.dynamic_update_slice(u_o, bu[:, out_rows, :], pos)
+        v_o = lax.dynamic_update_slice(v_o, bv_[:, out_rows, :], pos)
+
     if z_sharded:
         # The kernel ran with frozen z edges: its outermost k z-cells
         # are stale wherever a z neighbor exists (and exactly correct
         # on global z edges). Recompute both k-wide bands from the
         # frame — bitwise the same values, so overwriting
         # unconditionally is correct on edge shards too.
-        base = jnp.stack([offs[0] - k, offs[1] - k, offs[2]])
-        for z0, dz in ((0, -k), (nz - k, nz - 2 * k)):
-            bu, bv_ = window_chain(
-                u_w[:, :, z0:z0 + 3 * k], v_w[:, :, z0:z0 + 3 * k],
-                params, depth=k, step=step,
-                origin=base.at[2].add(dz), row=row,
-                use_noise=use_noise, unit_noise=unit_noise,
-                boundaries=boundaries,
-            )
-            u_o = lax.dynamic_update_slice(u_o, bu, (0, 0, z0))
-            v_o = lax.dynamic_update_slice(v_o, bv_, (0, 0, z0))
+        u_o, v_o = stitch_bands_from_frame(
+            u_o, v_o, u_w, v_w, params, depth=k, step=step, offs=offs,
+            row=row, axis_sizes=dims, use_noise=use_noise,
+            unit_noise=unit_noise, boundaries=boundaries,
+            dims_to_stitch=(2,),
+        )
     return u_o, v_o

@@ -87,6 +87,50 @@ def default_fuse() -> int:
 #: bounded subprocess probe on subsequent Simulation constructions.
 _reached_platforms: set = set()
 
+#: Cache dirs already pointed at jax's persistent compilation cache —
+#: makes :func:`_enable_compile_cache` idempotent per path.
+_compile_cache_armed: set = set()
+
+
+def _enable_compile_cache(path: str) -> None:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    Armed at Simulation construction (before the first jit) when
+    ``config.resolve_compile_cache`` yields a directory — supervisor
+    restart attempts and repeated bench invocations then load compiled
+    executables from disk instead of re-lowering the same runners. The
+    floors are dropped to zero so the small programs of tests and smoke
+    runs are cached too (the runner cache key includes the full program,
+    so correctness is unaffected). Best-effort: a jax without the config
+    knobs degrades to uncached compiles with a warning, not a failure.
+    """
+    import os
+
+    if path in _compile_cache_armed:
+        return
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # The cache object initializes lazily at the FIRST compile and
+        # then pins its directory; a process that already jitted
+        # anything (warmups, earlier Simulations) must reset it or the
+        # new directory silently never receives entries.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # pragma: no cover — jax version drift
+        import sys
+
+        print(
+            f"gray-scott: warning: persistent compilation cache "
+            f"unavailable ({e}); compiles will not be reused",
+            file=sys.stderr,
+        )
+        return
+    _compile_cache_armed.add(path)
+
 
 def _bounded_tpu_probe(timeout: float) -> Optional[str]:
     """Probe TPU reachability in a subprocess with a hard wall-clock
@@ -251,6 +295,35 @@ class Simulation:
         validate_kernel_language(self.kernel_language)
         self.dtype = config.resolve_precision(settings)
 
+        # Persistent compilation cache (GS_COMPILE_CACHE / compile_cache
+        # key; default on under supervision) — must be armed before the
+        # first jit below. CPU is refused: this jax's CPU executable
+        # serialization does not round-trip faithfully (measured: a
+        # cache-loaded sharded runner corrupted 8 cells by O(1) and
+        # tripped the NaN health guard on a supervised restart), and a
+        # cache that can change a trajectory is worse than recompiling.
+        # GS_COMPILE_CACHE_FORCE=1 overrides for cache-wiring tests.
+        import os as _os
+
+        self.compile_cache_dir = config.resolve_compile_cache(settings)
+        if self.compile_cache_dir and backend == "cpu" and (
+            _os.environ.get("GS_COMPILE_CACHE_FORCE") != "1"
+        ):
+            if _os.environ.get("GS_COMPILE_CACHE") or settings.compile_cache:
+                # Explicitly requested — refuse loudly, not silently.
+                import sys as _sys
+
+                print(
+                    "gray-scott: warning: persistent compilation cache "
+                    "disabled on the CPU backend (executable "
+                    "serialization does not round-trip bitwise on this "
+                    "jax; set GS_COMPILE_CACHE_FORCE=1 to override)",
+                    file=_sys.stderr,
+                )
+            self.compile_cache_dir = None
+        if self.compile_cache_dir:
+            _enable_compile_cache(self.compile_cache_dir)
+
         devices = select_devices(backend)
         if n_devices is not None:
             if n_devices > len(devices):
@@ -262,6 +335,19 @@ class Simulation:
 
         self.domain = CartDomain.create(len(devices), settings.L)
         self.sharded = len(devices) > 1
+        #: Split-phase halo exchange (GS_COMM_OVERLAP / comm_overlap
+        #: key; docs/OVERLAP.md): "auto" = on for sharded runs. The
+        #: trajectory is bitwise identical either way — overlap only
+        #: removes the data dependency between the exchange and the
+        #: interior compute so XLA can hide the ICI transfer.
+        self.comm_overlap = (
+            self.sharded
+            and config.resolve_comm_overlap(settings) != "off"
+        )
+        #: True once a runner trace actually built a split-phase round
+        #: (degenerate geometries fall back to the fused round even
+        #: with overlap armed) — introspection for tests and stats.
+        self.overlap_applied = False
         self._auto_fuse = None
         if self.kernel_language == "auto":
             # Resolve via the ICI cost model for the ACTUAL run config
@@ -287,6 +373,11 @@ class Simulation:
                     itemsize=np.dtype(self.dtype).itemsize,
                     fuse=default_fuse(),
                     sweep_mesh=self.sharded and not mesh_forced,
+                    # Auto's pick must reflect the comm this run will
+                    # actually expose: the calibrated overlap when the
+                    # split-phase exchange is armed, fully-exposed
+                    # otherwise.
+                    overlap="auto" if self.comm_overlap else 0.0,
                 )
             )
             if self.sharded:
@@ -443,6 +534,7 @@ class Simulation:
             offs = jnp.zeros((3,), jnp.int32)
 
         padded = sharded and self.domain.padded
+        overlap_on = self.comm_overlap
 
         def pin_block(u, v):
             """Re-pin the block's pad cells (global coords >= L) to the
@@ -545,6 +637,62 @@ class Simulation:
                     pairs = halo.exchange_x_slabs(
                         (u, v), boundaries, AXIS_NAMES[0], dims[0], depth
                     )
+                    if overlap_on and u.shape[0] >= 2 * depth:
+                        # Split-phase round (docs/OVERLAP.md): the same
+                        # 2-ppermute slab exchange is issued first, but
+                        # the kernel chains on frozen-constant x faces
+                        # — no data dependency on the collectives — and
+                        # the arrived slabs feed only the two k-thick x
+                        # bands stitched afterwards. Each band is the
+                        # SAME chain program (the x-chain XLA reference,
+                        # ``_xla_xchain_fallback``) on a k-plane body
+                        # whose x faces are the arrived slab and the
+                        # adjacent owned planes — same structure, same
+                        # per-cell op order, so XLA's codegen cannot
+                        # drift a ulp between the fused and split
+                        # lowerings. Blocks shallower than 2k have no
+                        # interior to hide behind and take the fused
+                        # round below.
+                        self.overlap_applied = True
+                        k = depth
+                        nx = u.shape[0]
+                        faces4 = tuple(
+                            f for fs in halo.frozen_slabs(
+                                (u, v), boundaries, 0, k
+                            ) for f in fs
+                        )
+                        u_i, v_i = pallas_stencil.fused_step(
+                            u, v, params, step_seeds(step), faces4,
+                            use_noise=use_noise,
+                            allow_interpret=allow_interpret,
+                            fuse=k, offsets=offs, row=L,
+                        )
+                        (u_lo, u_hi), (v_lo, v_hi) = pairs
+                        jobs = (
+                            ((u[:k], v[:k]),
+                             (u_lo, u[k:2 * k], v_lo, v[k:2 * k]),
+                             0),
+                            ((u[nx - k:], v[nx - k:]),
+                             (u[nx - 2 * k:nx - k], u_hi,
+                              v[nx - 2 * k:nx - k], v_hi),
+                             nx - k),
+                        )
+                        for (b_u, b_v), faces_b, d_x in jobs:
+                            bu, bv_ = pallas_stencil._xla_xchain_fallback(
+                                b_u, b_v, params, step_seeds(step),
+                                faces_b, fuse=k, use_noise=use_noise,
+                                offsets=jnp.stack([
+                                    offs[0] + d_x, offs[1], offs[2],
+                                ]),
+                                row=L,
+                            )
+                            u_i = lax.dynamic_update_slice(
+                                u_i, bu, (d_x, 0, 0)
+                            )
+                            v_i = lax.dynamic_update_slice(
+                                v_i, bv_, (d_x, 0, 0)
+                            )
+                        return pin_block(u_i, v_i)
                     faces4 = (pairs[0][0], pairs[0][1],
                               pairs[1][0], pairs[1][1])
                     return pin_block(*pallas_stencil.fused_step(
@@ -603,12 +751,28 @@ class Simulation:
                             fuse=depth, offsets=offs_p, row=L,
                         )
 
+                    def band_kernel(u_b, v_b, faces_b, stp, offs_b):
+                        # The x-chain XLA reference — the SAME program
+                        # structure as the fused kernel's own fallback,
+                        # which keeps recomputed bands bitwise equal.
+                        return pallas_stencil._xla_xchain_fallback(
+                            u_b, v_b, params, step_seeds(stp), faces_b,
+                            fuse=depth, use_noise=use_noise,
+                            offsets=offs_b, row=L,
+                        )
+
+                    ov = overlap_on and temporal.xy_overlap_feasible(
+                        block, dims, depth
+                    )
+                    if ov:
+                        self.overlap_applied = True
                     return pin_block(*temporal.xy_chain(
                         u, v, params, depth=depth, step=step, offs=offs,
                         chain_kernel=chain_kernel, use_noise=use_noise,
                         unit_noise=unit_noise, row=L,
                         axis_names=AXIS_NAMES, axis_sizes=dims,
                         boundaries=boundaries, sublane=sublane,
+                        overlap=ov, band_kernel=band_kernel,
                     ))
 
                 return run_chain_rounds(chain, fuse, u, v)
@@ -657,7 +821,20 @@ class Simulation:
                 *stencil.reaction_update(u_pad, v_pad, nz, params)
             )
 
-        if not sharded or nsteps < 2:
+        # Split-phase gate for the XLA window mode: only band windows
+        # thin along the LEADING (x) axis are codegen-stable on XLA:CPU
+        # — shrinking a trailing extent is exactly the shape change its
+        # FP-contraction decisions key on (measured: x-thin frame
+        # windows reproduce the full window chain bitwise through k=4;
+        # y- and z-thin windows drift 1 ulp at some shapes). So the
+        # window mode overlaps 1D x-sharded meshes; multi-axis meshes
+        # take the fused round here and get their overlap through the
+        # Pallas chains, whose band recomputes share the kernel
+        # fallback's structure (and whose z bands are identical in both
+        # modes). docs/OVERLAP.md "Bitwise-identity guarantee".
+        overlap_xla = overlap_on and dims[1] == 1 and dims[2] == 1
+
+        if not sharded or (nsteps < 2 and not overlap_xla):
             return lax.fori_loop(0, nsteps, single_step, (u, v))
 
         # Sharded temporal blocking: ONE width-k halo exchange feeds k
@@ -667,37 +844,53 @@ class Simulation:
         # corner-propagated halo, same position-keyed noise), and the
         # shrinking ring doubles as the next stage's ghost shell. Cuts
         # the exchange count per step by k (the cost
-        # ``communication.jl:138-199`` pays every step).
+        # ``communication.jl:138-199`` pays every step). The chain body
+        # is ``temporal.window_chain`` on the exchanged frame — the same
+        # shrinking-window program the band recomputes use, which is
+        # what makes the split-phase stitch bitwise.
         fuse = min(self._fuse_base(), nsteps, min(self.domain.local_shape))
 
         def chain(u, v, step, depth):
             """``depth`` steps from one ``depth``-wide exchange."""
+            if overlap_xla:
+                # Split-phase round (docs/OVERLAP.md): issue the same
+                # corner-propagated exchange with no consumer on the
+                # interior chain's dataflow path, run the chain on a
+                # frozen-constant frame, then stitch the k-thick
+                # sharded-face bands recomputed from the arrived frame
+                # — bitwise the same values.
+                self.overlap_applied = True
+                pending = halo.start_exchange(
+                    (u, v), boundaries, AXIS_NAMES, dims, depth
+                )
+                u_c, v_c = halo.frozen_frame((u, v), boundaries, depth)
+                u_i, v_i = temporal.window_chain(
+                    u_c, v_c, params, depth=depth, step=step,
+                    origin=offs - depth, row=L, use_noise=use_noise,
+                    unit_noise=unit_noise, boundaries=boundaries,
+                    final_pin=padded,
+                )
+                u_w, v_w = pending.finish()
+                return temporal.stitch_bands_from_frame(
+                    u_i, v_i, u_w, v_w, params, depth=depth, step=step,
+                    offs=offs, row=L, axis_sizes=dims,
+                    use_noise=use_noise, unit_noise=unit_noise,
+                    boundaries=boundaries,
+                )
             u_w, v_w = halo.halo_pad_wide(
                 (u, v), boundaries, AXIS_NAMES, dims, depth
             )
-            for s in range(depth):
-                m_out = depth - 1 - s
-                out_shape = tuple(d + 2 * m_out for d in u.shape)
-                if use_noise:
-                    nz = params.noise * unit_noise(
-                        step + s, offs - m_out, out_shape
-                    )
-                else:
-                    nz = jnp.asarray(0.0, u.dtype)
-                u_w, v_w = stencil.reaction_update(u_w, v_w, nz, params)
-                # Global-coordinate pinning: ring cells outside the
-                # domain AND, for non-divisible L, pad cells inside the
-                # block — both must read back as the frozen ghost. The
-                # final stage (m_out == 0) has no ring, so divisible-L
-                # runs skip its provably-all-true mask.
-                if m_out or padded:
-                    u_w = temporal.pin_out_of_domain(
-                        u_w, stencil.U_BOUNDARY, offs - m_out, L
-                    )
-                    v_w = temporal.pin_out_of_domain(
-                        v_w, stencil.V_BOUNDARY, offs - m_out, L
-                    )
-            return u_w, v_w
+            # Global-coordinate pinning per stage: ring cells outside
+            # the domain AND, for non-divisible L, pad cells inside the
+            # block — both must read back as the frozen ghost. The
+            # final stage (m_out == 0) has no ring, so divisible-L runs
+            # skip its provably-all-true mask (final_pin).
+            return temporal.window_chain(
+                u_w, v_w, params, depth=depth, step=step,
+                origin=offs - depth, row=L, use_noise=use_noise,
+                unit_noise=unit_noise, boundaries=boundaries,
+                final_pin=padded,
+            )
 
         return run_chain_rounds(chain, fuse, u, v)
 

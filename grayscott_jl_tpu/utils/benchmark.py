@@ -11,13 +11,40 @@ import time
 from typing import Dict
 
 
+#: XLA flags that let the split-phase halo exchange actually overlap on
+#: hardware (docs/OVERLAP.md): async collective-permute turns each
+#: ppermute into a start/done pair, and the latency-hiding scheduler
+#: moves the done past the comm-independent interior compute. TPU-only
+#: flags — injecting them for a CPU backend just produces unknown-flag
+#: warnings, so callers gate on the target platform.
+OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_collective_permute=true",
+)
+
+
+def inject_overlap_xla_flags() -> None:
+    """Append :data:`OVERLAP_XLA_FLAGS` to ``XLA_FLAGS`` (idempotent:
+    a flag whose name is already present — either spelling — is left
+    alone so operator overrides win). Must run before the first backend
+    initialization; later calls are harmless no-ops at the XLA level."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    add = [f for f in OVERLAP_XLA_FLAGS if f.split("=")[0] not in flags]
+    if add:
+        os.environ["XLA_FLAGS"] = " ".join([flags] + add).strip()
+
+
 def setup_platform(cpu: bool, devices: int = 1) -> str:
     """Benchmark-script platform bring-up, shared by ``benchmarks/``.
 
     With ``cpu``: inject the virtual-device XLA flag (before any backend
     init) and pin the CPU platform via jax.config (the axon sitecustomize
     hook re-pins platforms after import, so the env var alone is not
-    enough). Returns the Settings ``backend`` string for the platform.
+    enough). Without ``cpu`` (an accelerator run) the split-phase
+    overlap flags are injected too, unless ``GS_COMM_OVERLAP=off``.
+    Returns the Settings ``backend`` string for the platform.
     """
     import os
 
@@ -30,6 +57,11 @@ def setup_platform(cpu: bool, devices: int = 1) -> str:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        if os.environ.get("GS_COMM_OVERLAP", "").strip().lower() not in (
+            "off", "0", "false", "no"
+        ):
+            inject_overlap_xla_flags()
     import jax
 
     platform = jax.devices()[0].platform
@@ -137,6 +169,8 @@ def bench_one(
     sim = Simulation(settings, n_devices=1)
     t = time_sim_rounds(sim, steps, rounds, sustain_seconds=sustain_seconds,
                         round_sleep=round_sleep)
+    from ..parallel import icimodel
+
     out = {
         "L": L,
         "precision": precision,
@@ -150,6 +184,10 @@ def bench_one(
         ],
         "median_us_per_step": round(t["median"] * 1e6, 1),
         "median_cell_updates_per_s": round(L**3 / t["median"], 1),
+        # Comm-exposure accounting (RunStats `comm` mirror): zero for
+        # this single-device measurement, but carried so BENCH_r*
+        # artifacts keep a uniform schema with sharded runs.
+        "comm": icimodel.comm_report(sim),
     }
     if "sustained" in t:
         out["sustained_us_per_step"] = round(t["sustained"] * 1e6, 1)

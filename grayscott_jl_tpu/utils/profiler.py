@@ -51,6 +51,12 @@ class RunStats:
         #: merges the journal here, so one stats file tells the full
         #: story of how the run survived.
         self.faults: Optional[list] = None
+        #: Halo-exchange budget (``parallel/icimodel.comm_report``):
+        #: model-projected per-step ``hidden_us``/``exposed_us`` under
+        #: the run's split-phase setting — the comm analog of the
+        #: ``io`` overlap section (how much ICI time the split-phase
+        #: exchange hides behind interior compute).
+        self.comm: Optional[dict] = None
         self._t0 = time.perf_counter()
 
     @contextlib.contextmanager
@@ -76,6 +82,11 @@ class RunStats:
         trips, recovery actions) to the summary."""
         self.faults = [dict(e) for e in events] if events else None
 
+    def record_comm(self, report: Optional[dict]) -> None:
+        """Attach the halo-exchange budget
+        (``parallel/icimodel.comm_report``) to the summary."""
+        self.comm = dict(report) if report else None
+
     def summary(self) -> dict:
         total = time.perf_counter() - self._t0
         steps = self.counters.get("steps", 0)
@@ -89,6 +100,7 @@ class RunStats:
             "wall_s": round(total, 6),
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
             "io": self.io,
+            "comm": self.comm,
             "faults": self.faults,
             "counters": dict(self.counters),
             "cell_updates_per_s": (

@@ -1,0 +1,138 @@
+"""Persistent compilation cache wiring (GS_COMPILE_CACHE satellite).
+
+Supervisor restart attempts and repeated bench invocations re-jit the
+same step runners; with the cache armed, the second compile of any
+program loads from disk. The resolver's precedence (env > TOML >
+supervise default > off) is pure config logic; the end-to-end test
+asserts a second ``Simulation`` construction produces NO new cache
+entries — every program it compiles hits the entries the first one
+wrote.
+"""
+
+import os
+
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config import settings as config
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.simulation import Simulation
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+
+def _settings(**kw):
+    return Settings(
+        L=8, noise=0.0, precision="Float32", backend="CPU",
+        kernel_language="Plain", **{**PARAMS, **kw},
+    )
+
+
+def test_resolver_env_wins(monkeypatch):
+    monkeypatch.setenv("GS_COMPILE_CACHE", "/tmp/somewhere")
+    assert config.resolve_compile_cache(
+        _settings(compile_cache="/elsewhere")
+    ) == "/tmp/somewhere"
+    monkeypatch.setenv("GS_COMPILE_CACHE", "off")
+    assert config.resolve_compile_cache(
+        _settings(compile_cache="/elsewhere")
+    ) is None
+    monkeypatch.setenv("GS_COMPILE_CACHE", "")
+    assert config.resolve_compile_cache(
+        _settings(compile_cache="/elsewhere")
+    ) is None
+
+
+def test_resolver_toml_key_and_off(monkeypatch):
+    monkeypatch.delenv("GS_COMPILE_CACHE", raising=False)
+    assert config.resolve_compile_cache(
+        _settings(compile_cache="/a/b")
+    ) == "/a/b"
+    assert config.resolve_compile_cache(
+        _settings(compile_cache="off")
+    ) is None
+    assert config.resolve_compile_cache(_settings()) is None
+
+
+def test_resolver_defaults_on_under_supervision(monkeypatch):
+    monkeypatch.delenv("GS_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("GS_SUPERVISE", raising=False)
+    path = config.resolve_compile_cache(_settings(supervise=True))
+    assert path is not None and ".cache" in path
+    # env supervision arms it too, and env off disarms the TOML key
+    monkeypatch.setenv("GS_SUPERVISE", "1")
+    assert config.resolve_compile_cache(_settings()) is not None
+    monkeypatch.setenv("GS_SUPERVISE", "0")
+    assert config.resolve_compile_cache(
+        _settings(supervise=True)
+    ) is None
+
+
+def test_toml_key_parses():
+    s = config.parse_settings_toml('compile_cache = "/x/y"\nL = 16\n')
+    assert s.compile_cache == "/x/y"
+
+
+@pytest.fixture
+def _cache_reset():
+    """Restore the process-global jax cache config after the test —
+    leaving it pointed at a deleted tmp dir would make every later
+    compile in this process pay cache-write syscalls for nothing."""
+    yield
+    from grayscott_jl_tpu import simulation
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    simulation._compile_cache_armed.clear()
+    try:
+        from jax._src import compilation_cache as cc
+
+        cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API, best-effort
+        pass
+
+
+def _cache_files(root):
+    return {
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(root) for f in fs
+    }
+
+
+def test_cpu_backend_refuses_cache(tmp_path, monkeypatch):
+    """CPU executable serialization does not round-trip bitwise on this
+    jax (a cache-loaded sharded runner corrupted cells and tripped the
+    NaN health guard — see simulation.py) — the cache must stay
+    disarmed on CPU unless GS_COMPILE_CACHE_FORCE=1 accepts the risk."""
+    cache = tmp_path / "refused"
+    monkeypatch.setenv("GS_COMPILE_CACHE", str(cache))
+    monkeypatch.delenv("GS_COMPILE_CACHE_FORCE", raising=False)
+    sim = Simulation(_settings(), n_devices=1)
+    assert sim.compile_cache_dir is None
+    sim.iterate(1)
+    assert not cache.exists() or not _cache_files(cache)
+
+
+def test_second_construction_hits_cache(tmp_path, monkeypatch,
+                                        _cache_reset):
+    cache = tmp_path / "xla-cache"
+    monkeypatch.setenv("GS_COMPILE_CACHE", str(cache))
+    # The container's only backend is CPU; force past the CPU refusal —
+    # this test asserts the cache WIRING (entries written, second
+    # construction adds none), not trajectory-level soundness.
+    monkeypatch.setenv("GS_COMPILE_CACHE_FORCE", "1")
+
+    sim = Simulation(_settings(), n_devices=1)
+    assert sim.compile_cache_dir == str(cache)
+    sim.iterate(2)
+    sim.block_until_ready()
+    first = _cache_files(cache)
+    assert first, "first construction wrote no cache entries"
+
+    sim2 = Simulation(_settings(), n_devices=1)
+    sim2.iterate(2)
+    sim2.block_until_ready()
+    second = _cache_files(cache)
+    # A cache hit loads the executable instead of compiling: the same
+    # programs must map to the same keys, so no new entries appear.
+    assert second == first

@@ -1,0 +1,328 @@
+"""Split-phase halo exchange (GS_COMM_OVERLAP, docs/OVERLAP.md).
+
+The tentpole guarantee: the split-phase schedule — exchange issued
+first with no consumer on the interior compute's dataflow path,
+boundary bands recomputed from the arrived halos and stitched after —
+produces the SAME u/v trajectory bit for bit as the fused
+exchange-then-compute flow, for every sharded step path (1D x-chain,
+xy-chain slab and frame forms, XLA window chain) including
+non-divisible-L pad-and-mask storage and position-keyed noise. Overlap
+only reorders dataflow; it must never change a value.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config import settings as config
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.parallel import icimodel, temporal
+from grayscott_jl_tpu.simulation import Simulation
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _settings(L=16, noise=0.1, **kw):
+    return Settings(
+        L=L, noise=noise, precision="Float32", backend="CPU",
+        **{**PARAMS, **kw},
+    )
+
+
+def _pair(mesh, lang, fuse, L, n_devices, monkeypatch, seed=7):
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+    monkeypatch.setenv("GS_FUSE", str(fuse))
+    monkeypatch.delenv("GS_COMM_OVERLAP", raising=False)
+    on = Simulation(
+        _settings(L=L, kernel_language=lang, comm_overlap="on"),
+        n_devices=n_devices, seed=seed,
+    )
+    off = Simulation(
+        _settings(L=L, kernel_language=lang, comm_overlap="off"),
+        n_devices=n_devices, seed=seed,
+    )
+    return on, off
+
+
+#: (mesh, lang, fuse, L, n_devices) covering every sharded step path:
+#: the Pallas 1D x-chain, the xy-chain's 4-ppermute slab form, the
+#: xy-chain's corner-propagated frame form with z bands, the XLA
+#: window chain, and two non-divisible-L pad-and-mask meshes (slab and
+#: window forms).
+MODES = [
+    pytest.param("8,1,1", "Pallas", 2, 32, 8, id="x-chain"),
+    pytest.param("4,2,1", "Pallas", 2, 16, 8, id="xy-slab"),
+    pytest.param("2,2,2", "Pallas", 2, 16, 8, id="xy-frame-zbands"),
+    pytest.param("8,1,1", "Plain", 2, 32, 8, id="window-chain"),
+    pytest.param("2,2,1", "Pallas", 2, 22, 4, id="xy-slab-uneven-L"),
+    pytest.param("8,1,1", "Plain", 2, 44, 8, id="window-uneven-L"),
+]
+
+
+@requires8
+@pytest.mark.parametrize("mesh,lang,fuse,L,n_devices", MODES)
+def test_overlap_matches_fused_bitwise(mesh, lang, fuse, L, n_devices,
+                                       monkeypatch):
+    """Three full chain rounds plus a remainder, noise on: overlap
+    on/off trajectories must be bitwise identical, and the on side
+    must actually have built split-phase rounds (the geometry gates
+    did not silently fall back)."""
+    on, off = _pair(mesh, lang, fuse, L, n_devices, monkeypatch)
+    nsteps = 3 * fuse + 1
+    on.iterate(nsteps)
+    off.iterate(nsteps)
+    assert on.overlap_applied, "split-phase round never engaged"
+    assert not off.overlap_applied
+    u_on, v_on = on.get_fields()
+    u_off, v_off = off.get_fields()
+    np.testing.assert_array_equal(u_on, u_off)
+    np.testing.assert_array_equal(v_on, v_off)
+
+
+@requires8
+@pytest.mark.parametrize("mesh", ["2,2,2", "2,4,1"])
+def test_window_mode_multi_axis_falls_back_to_fused(mesh, monkeypatch):
+    """XLA window mode on a multi-axis mesh: y-/z-thin band windows are
+    not codegen-stable on XLA:CPU (trailing-axis extents change the
+    compiled FP contraction — measured 1-ulp drift at k=4), so the
+    split phase must decline and take the fused round; multi-axis
+    meshes get overlap through the Pallas chains instead."""
+    on, off = _pair(mesh, "Plain", 2, 16, 8, monkeypatch)
+    on.iterate(5)
+    off.iterate(5)
+    assert not on.overlap_applied
+    np.testing.assert_array_equal(on.get_fields()[0], off.get_fields()[0])
+    np.testing.assert_array_equal(on.get_fields()[1], off.get_fields()[1])
+
+
+@requires8
+def test_degenerate_geometry_falls_back_to_fused(monkeypatch):
+    """A slab-axis block shallower than 2k has no comm-independent
+    interior: overlap must silently take the fused round (bitwise
+    anyway), not produce garbage bands. L=22 on (8,1,1) gives 3-plane
+    blocks at k=2."""
+    on, off = _pair("8,1,1", "Pallas", 2, 22, 8, monkeypatch)
+    on.iterate(5)
+    off.iterate(5)
+    assert not on.overlap_applied  # gate: nx=3 < 2k=4
+    np.testing.assert_array_equal(on.get_fields()[0], off.get_fields()[0])
+    np.testing.assert_array_equal(on.get_fields()[1], off.get_fields()[1])
+
+
+@requires8
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh,lang,fuse,L", [
+    ("4,2,1", "Pallas", 3, 32),
+    ("2,4,1", "Pallas", 3, 32),
+    ("1,2,4", "Pallas", 3, 32),
+    ("2,2,2", "Pallas", 4, 32),
+    ("4,2,1", "Pallas", 4, 32),
+    ("8,1,1", "Plain", 4, 32),
+    ("8,1,1", "Pallas", 4, 64),
+])
+def test_overlap_equality_sweep(mesh, lang, fuse, L, monkeypatch):
+    """Slow sweep variant: deeper chains, bigger grids, longer
+    horizons — the divergence test for XLA's shape-sensitive codegen
+    (a structurally different band recompute shows up here as a 1-ulp
+    drift after a few rounds)."""
+    on, off = _pair(mesh, lang, fuse, L, 8, monkeypatch)
+    for _ in range(4):
+        on.iterate(fuse)
+        off.iterate(fuse)
+    assert on.overlap_applied
+    np.testing.assert_array_equal(on.get_fields()[0], off.get_fields()[0])
+    np.testing.assert_array_equal(on.get_fields()[1], off.get_fields()[1])
+
+
+# ------------------------------------------------------ mode resolution
+
+def test_comm_overlap_resolution_env_wins(monkeypatch):
+    s = _settings(comm_overlap="off")
+    monkeypatch.setenv("GS_COMM_OVERLAP", "on")
+    assert config.resolve_comm_overlap(s) == "on"
+    monkeypatch.setenv("GS_COMM_OVERLAP", "0")
+    assert config.resolve_comm_overlap(s) == "off"
+    monkeypatch.delenv("GS_COMM_OVERLAP")
+    assert config.resolve_comm_overlap(s) == "off"
+    assert config.resolve_comm_overlap(_settings()) == "auto"
+
+
+def test_comm_overlap_bad_value_raises(monkeypatch):
+    monkeypatch.setenv("GS_COMM_OVERLAP", "sideways")
+    with pytest.raises(ValueError, match="GS_COMM_OVERLAP"):
+        config.resolve_comm_overlap(_settings())
+
+
+def test_comm_overlap_toml_key_accepted():
+    s = config.parse_settings_toml('comm_overlap = "off"\nL = 16\n')
+    assert s.comm_overlap == "off"
+
+
+def test_single_device_never_overlaps():
+    sim = Simulation(
+        _settings(L=8, kernel_language="Plain", comm_overlap="on"),
+        n_devices=1,
+    )
+    assert not sim.comm_overlap
+    sim.iterate(2)  # and the unsharded path still runs
+
+
+def test_xy_overlap_feasible_gates():
+    # frame form (z sharded): always feasible
+    assert temporal.xy_overlap_feasible((3, 3, 8), (2, 2, 2), 3)
+    # slab form: every sharded slab axis needs >= 2k depth
+    assert temporal.xy_overlap_feasible((8, 8, 16), (2, 2, 1), 2)
+    assert not temporal.xy_overlap_feasible((3, 8, 16), (2, 2, 1), 2)
+    assert not temporal.xy_overlap_feasible((8, 3, 16), (2, 2, 1), 2)
+    # unsharded x is exempt from the x gate
+    assert temporal.xy_overlap_feasible((3, 8, 16), (1, 2, 1), 2)
+
+
+# ------------------------------------------------- calibrated ICI model
+
+def test_overlap_fraction_bounds():
+    assert icimodel.overlap_fraction(0.0, 10.0) == 0.0
+    assert icimodel.overlap_fraction(10.0, 0.0) == 0.0
+    assert icimodel.overlap_fraction(1e9, 1.0) == 1.0  # capped at 1
+    # scales with the calibrated efficiency below the cap
+    lo = icimodel.overlap_fraction(1.0, 10.0, efficiency=0.5)
+    hi = icimodel.overlap_fraction(1.0, 10.0, efficiency=1.0)
+    assert lo == pytest.approx(hi / 2)
+
+
+def test_projections_thread_auto_overlap():
+    """overlap="auto" must reduce exposed comm, report the hidden
+    share, and never change the raw comm total — in all three
+    projection shapes."""
+    base = icimodel.anchor_us("Pallas", 256)
+    for make in (
+        lambda ov: icimodel.project(128, 4, 1000.0, overlap=ov),
+        lambda ov: icimodel.project_chain((2, 2, 2), 256, 4, base,
+                                          overlap=ov),
+        lambda ov: icimodel.project_1d(8, 256, 4, base, overlap=ov),
+    ):
+        off = make(0.0)
+        on = make("auto")
+        assert off["overlap"] == 0.0
+        assert off["comm_us_per_step_hidden"] == 0.0
+        assert on["overlap"] > 0.0
+        assert (on["comm_us_per_step_exposed"]
+                < off["comm_us_per_step_exposed"])
+        total_on = (on["comm_us_per_step_exposed"]
+                    + on["comm_us_per_step_hidden"])
+        assert total_on == pytest.approx(
+            off["comm_us_per_step_exposed"], abs=0.02
+        )
+        assert (on["projected_weak_scaling_eff"]
+                > off["projected_weak_scaling_eff"])
+
+
+def test_select_kernel_rows_carry_calibrated_overlap():
+    """Auto dispatch must project with the calibrated (non-zero)
+    overlap by default — the knob the runtime actually realizes — and
+    with 0.0 when the caller pins the fused exchange."""
+    kw = dict(platform="tpu", device_kind="TPU v5 lite")
+    _, info = icimodel.select_kernel((2, 2, 2), 256, **kw)
+    assert all(r["overlap"] > 0.0 for r in info["rows"])
+    _, info_off = icimodel.select_kernel((2, 2, 2), 256, overlap=0.0,
+                                         **kw)
+    assert all(r["overlap"] == 0.0 for r in info_off["rows"])
+
+
+@requires8
+def test_comm_report_modes():
+    sharded_on = Simulation(
+        _settings(kernel_language="Plain", comm_overlap="on"),
+        n_devices=8,
+    )
+    r = icimodel.comm_report(sharded_on)
+    assert r["mode"] == "overlap"
+    assert r["hidden_us"] + r["exposed_us"] == pytest.approx(
+        r["comm_us_per_step"], abs=0.02
+    )
+    sharded_off = Simulation(
+        _settings(kernel_language="Plain", comm_overlap="off"),
+        n_devices=8,
+    )
+    r_off = icimodel.comm_report(sharded_off)
+    assert r_off["mode"] == "fused"
+    assert r_off["hidden_us"] == 0.0
+    single = Simulation(_settings(kernel_language="Plain"), n_devices=1)
+    assert icimodel.comm_report(single)["mode"] == "single-device"
+
+
+# --------------------------------------------------- calibrator plumbing
+
+def _load_update_overlap():
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parents[2] / "benchmarks"
+            / "update_overlap.py")
+    spec = importlib.util.spec_from_file_location("update_overlap", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ab_row(**kw):
+    row = {
+        "ab": "comm_overlap", "overlap_engaged": True,
+        "measured_overlap_fraction": 0.6, "model_ideal_overlap": 0.8,
+    }
+    row.update(kw)
+    return row
+
+
+def test_update_overlap_load_efficiency(tmp_path):
+    import json
+
+    update_overlap = _load_update_overlap()
+    p = tmp_path / "ab.jsonl"
+    rows = [
+        _ab_row(),                                     # eff 0.75
+        _ab_row(measured_overlap_fraction=0.8),        # eff 1.0
+        _ab_row(overlap_engaged=False),                # no signal
+        _ab_row(model_ideal_overlap=0.0),              # no signal
+        {"ab": "something-else"},                      # foreign row
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    out = update_overlap.load_efficiency(str(p))
+    assert out["efficiencies"] == [0.75, 1.0]
+    assert out["median"] == pytest.approx(0.875)
+    assert out["skipped"] == 2
+
+
+def test_update_overlap_apply_rewrites_literal(tmp_path):
+    update_overlap = _load_update_overlap()
+    model = tmp_path / "icimodel.py"
+    model.write_text(
+        "# calibrated by update_overlap.py\nOVERLAP_EFFICIENCY = 0.85\n"
+        "X = 1\n"
+    )
+    update_overlap.apply_to_model(0.6125, str(model))
+    text = model.read_text()
+    assert "OVERLAP_EFFICIENCY = 0.6125" in text
+    assert "X = 1" in text
+    other = tmp_path / "other.py"
+    other.write_text("Y = 2\n")
+    with pytest.raises(SystemExit, match="literal not found"):
+        update_overlap.apply_to_model(0.5, str(other))
+
+
+def test_live_model_has_calibratable_literal():
+    """The calibrator's regex must keep matching the real model file —
+    if someone renames the literal, --apply would silently stop
+    working."""
+    import pathlib
+    import re
+
+    path = (pathlib.Path(__file__).resolve().parents[2]
+            / "grayscott_jl_tpu" / "parallel" / "icimodel.py")
+    src = path.read_text(encoding="utf-8")
+    assert re.search(r"OVERLAP_EFFICIENCY = [0-9.]+", src)

@@ -360,6 +360,50 @@ def test_xy_chain_collective_count_is_four_per_k_steps(monkeypatch):
 
 
 @requires8
+@pytest.mark.parametrize("mesh,lang,L,expected", [
+    ("8,1,1", "Plain", 32, 2),    # XLA window chain, 1D frame
+    ("2,2,2", "Pallas", 16, 6),   # xy-chain frame form
+    ("4,2,1", "Pallas", 16, 4),   # xy-chain slab form
+    ("8,1,1", "Pallas", 32, 2),   # 1D x-chain
+])
+def test_split_phase_ppermute_count_matches_fused(mesh, lang, L,
+                                                  expected, monkeypatch):
+    """The split-phase restructure (GS_COMM_OVERLAP, docs/OVERLAP.md)
+    must not change WHAT is exchanged — only when the compute may run
+    relative to it. Compiled invariant: the overlapped lowering carries
+    exactly the fused path's collective count for every face mode, and
+    any async collective-permute-start has a matching -done (on TPU the
+    async-pair form is what the latency-hiding scheduler reorders; the
+    CPU backend may lower the same program synchronously)."""
+    import re
+
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+    monkeypatch.setenv("GS_FUSE", "2")
+    for mode in ("on", "off"):
+        monkeypatch.setenv("GS_COMM_OVERLAP", mode)
+        sim = Simulation(
+            _settings(L=L, noise=0.1, kernel_language=lang), n_devices=8
+        )
+        runner = sim._runner(4)  # 2 chain rounds of k=2
+        txt = runner.lower(
+            sim.u, sim.v, sim.base_key, jnp.int32(0), sim.params
+        ).compile().as_text()
+        n_perm = len(re.findall(r"collective-permute(?:-start)?\(", txt))
+        assert n_perm == expected, (
+            f"{mesh} {lang} overlap={mode}: expected {expected} "
+            f"collective-permutes, found {n_perm}"
+        )
+        starts = len(re.findall(r"collective-permute-start", txt))
+        dones = len(re.findall(r"collective-permute-done", txt))
+        assert starts == dones, (
+            f"{mesh} {lang} overlap={mode}: unpaired async "
+            f"collective-permute ({starts} starts, {dones} dones)"
+        )
+
+
+@requires8
 def test_1d_xchain_collective_count_is_two_per_k_steps(monkeypatch):
     """The 1D x-chain's halo amortization as a compiled invariant: one
     2-ppermute slab exchange per k steps — the chain-round fori_loop
