@@ -1,0 +1,73 @@
+"""Shared JSONL artifact helpers for the benchmarks/ tooling.
+
+One implementation of the read/append/naming conventions that
+``update_overlap.py``, ``update_fuse_ratio.py``, ``halo_bench.py`` and
+``tune_sweep.py`` share, so record parsing cannot drift between the
+calibrators and the tools that produce their inputs. The record schema
+itself is one-JSON-object-per-line with:
+
+* ``"ab"`` — the experiment family (``comm_overlap``, ``autotune``, a
+  fuse case has none but carries ``"fuse"``),
+* ``"t"`` — UTC capture timestamp (``utc_stamp``),
+* measurement fields using the repo-wide ``*_us_per_step`` spellings
+  (``median_us_per_step``/``best_us_per_step``/``rounds_us_per_step``)
+  so any artifact with per-depth rows is directly consumable by
+  ``update_fuse_ratio.load_ratios``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+from typing import List, Optional
+
+
+def read_rows(path: str, *, skip_corrupt: bool = False) -> List[dict]:
+    """All JSON rows of a JSONL artifact (blank lines ignored).
+
+    ``skip_corrupt`` tolerates truncated lines — artifacts on the
+    benchmark hosts are routinely cut short by timeouts and tunnel
+    wedges; calibrators that must not silently drop data leave it
+    False and let the decode error surface."""
+    rows = []
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if not skip_corrupt:
+                    raise
+    return rows
+
+
+def append_row(path: str, row: dict) -> str:
+    """Append one record to a JSONL artifact, creating parent dirs."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+    return path
+
+
+def utc_stamp() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
+def results_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results")
+
+
+def default_out(prefix: str, platform: str,
+                date: Optional[str] = None) -> str:
+    """Committed-artifact naming convention:
+    ``benchmarks/results/<prefix>_<platform>_<ISO date>.jsonl``."""
+    date = datetime.date.today().isoformat() if date is None else date
+    return os.path.join(results_dir(),
+                        f"{prefix}_{platform.lower()}_{date}.jsonl")

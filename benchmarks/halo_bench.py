@@ -108,7 +108,8 @@ def main() -> int:
         return 0
 
     if args.ab:
-        import datetime
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import artifacts
 
         from grayscott_jl_tpu.parallel import icimodel
 
@@ -143,8 +144,7 @@ def main() -> int:
         )
         row = {
             "ab": "comm_overlap",
-            "t": datetime.datetime.now(datetime.timezone.utc)
-            .isoformat(timespec="seconds"),
+            "t": artifacts.utc_stamp(),
             "platform": backend.lower(),
             "devices": args.devices,
             "mesh": list(on.domain.dims),
@@ -161,18 +161,11 @@ def main() -> int:
             "model_ideal_overlap": round(ideal, 4),
             "model_comm": icimodel.comm_report(on),
         }
-        line = json.dumps(row)
-        print(line)
+        print(json.dumps(row))
         out = args.out
         if out is None:
-            here = os.path.dirname(os.path.abspath(__file__))
-            out = os.path.join(
-                here, "results",
-                f"overlap_ab_{backend.lower()}_"
-                f"{datetime.date.today().isoformat()}.jsonl",
-            )
-        with open(out, "a", encoding="utf-8") as f:
-            f.write(line + "\n")
+            out = artifacts.default_out("overlap_ab", backend)
+        artifacts.append_row(out, row)
         print(f"# appended to {out}", file=sys.stderr)
         return 0
 

@@ -1,0 +1,241 @@
+#!/usr/bin/env python3
+"""Autotune sweep: pre-warm tuning caches and emit the A/B artifact.
+
+For each requested grid side this tool constructs an Auto-dispatch
+``Simulation`` with the measured autotuner forced into ``quick`` or
+``full`` mode — the construction itself runs (or cache-hits) the
+tuning round — then writes every candidate measurement plus a
+model-pick-vs-measured-pick summary row to a JSONL artifact in the
+shared ``benchmarks/artifacts.py`` record schema. Per-candidate rows
+carry ``fuse`` + ``median_us_per_step``/``best_us_per_step``, so a
+TPU sweep's artifact is *directly* consumable by
+``update_fuse_ratio.py`` — and with ``--calibrate`` this tool closes
+the loop itself: it measures the halo-bench-style overlap A/B at the
+winning config, emits ``comm_overlap`` rows, and runs both updaters
+(``--apply`` rewrites the icimodel literals), replacing the manual
+two-tool calibration flow with one command.
+
+    # CPU smoke (virtual 8-device mesh), committed A/B artifact:
+    python benchmarks/tune_sweep.py --cpu --devices 8 --L 32 \
+        --out benchmarks/results/tune_ab_cpu_$(date -I).jsonl
+
+    # TPU slice: warm the cache, recalibrate the model from measurement
+    python benchmarks/tune_sweep.py --devices 8 --L 256 --mode full \
+        --calibrate --apply
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+
+def _base_row(backend: str, sim, L: int) -> dict:
+    return {
+        "t": artifacts.utc_stamp(),
+        "platform": backend.lower(),
+        "devices": sim.domain.n_blocks,
+        "mesh": list(sim.domain.dims),
+        "L": L,
+    }
+
+
+def emit_tuning_rows(out: str, backend: str, sim, L: int) -> dict:
+    """Per-candidate measurement rows + the summary row for one tuned
+    config; returns the summary row."""
+    prov = (sim.kernel_selection or {}).get("autotune") or {}
+    record = {}
+    path = prov.get("cache_path")
+    if path and os.path.isfile(path):
+        with open(path, encoding="utf-8") as f:
+            record = json.load(f)
+    base = _base_row(backend, sim, L)
+    for m in record.get("measurements", []):
+        cand = m.get("candidate", {})
+        row = dict(base, ab="autotune", **{
+            k: cand.get(k)
+            for k in ("kernel", "fuse", "comm_overlap", "bx",
+                      "analytic", "projected_step_us")
+        })
+        for k in ("median_us_per_step", "best_us_per_step",
+                  "rounds_us_per_step", "error"):
+            if k in m:
+                row[k] = m[k]
+        artifacts.append_row(out, row)
+    summary = dict(base, ab="autotune_summary", **{
+        k: prov.get(k)
+        for k in ("mode", "source", "cache", "candidates_timed",
+                  "candidates_skipped", "candidates_errored",
+                  "tuning_s", "winner", "model_pick",
+                  "model_pick_us", "measured_pick_us",
+                  "model_vs_measured_speedup")
+    })
+    summary["us_per_step_model_pick"] = prov.get("model_pick_us")
+    summary["us_per_step_measured_pick"] = prov.get("measured_pick_us")
+    artifacts.append_row(out, summary)
+    print(json.dumps(summary))
+    return summary
+
+
+def overlap_ab_row(out: str, backend: str, settings, sim, L: int,
+                   steps: int, rounds: int):
+    """halo_bench-style overlap A/B at the tuned winner config — the
+    row ``update_overlap.py`` calibrates OVERLAP_EFFICIENCY from.
+    Needs a cubic local block for the single-device comm anchor; other
+    meshes skip with a note."""
+    import dataclasses
+
+    from grayscott_jl_tpu.parallel import icimodel
+    from grayscott_jl_tpu.simulation import Simulation
+    from grayscott_jl_tpu.utils.benchmark import time_sim
+
+    dims = sim.domain.dims
+    locals_ = [L // d for d in dims]
+    if len(set(locals_)) != 1 or any(L % d for d in dims):
+        print(f"# overlap A/B skipped: mesh {dims} at L={L} has no "
+              "cubic local block for the single-device anchor",
+              file=sys.stderr)
+        return
+    lang = "Pallas" if sim.kernel_language == "pallas" else "Plain"
+    base = dataclasses.replace(settings, kernel_language=lang)
+    os.environ.pop("GS_COMM_OVERLAP", None)
+    on = Simulation(dataclasses.replace(base, comm_overlap="on"),
+                    n_devices=sim.domain.n_blocks)
+    t_on = time_sim(on, steps, rounds)
+    off = Simulation(dataclasses.replace(base, comm_overlap="off"),
+                     n_devices=sim.domain.n_blocks)
+    t_off = time_sim(off, steps, rounds)
+    single = Simulation(dataclasses.replace(base, L=locals_[0]),
+                        n_devices=1)
+    t_single = time_sim(single, steps, rounds)
+    comm_off = max(t_off - t_single, 0.0)
+    comm_on = max(t_on - t_single, 0.0)
+    measured = (max(0.0, min(1.0, 1.0 - comm_on / comm_off))
+                if comm_off > 0 else 0.0)
+    ideal = min(1.0, t_single / comm_off) if comm_off > 0 else 0.0
+    row = {
+        "ab": "comm_overlap",
+        "t": artifacts.utc_stamp(),
+        "platform": backend.lower(),
+        "devices": sim.domain.n_blocks,
+        "mesh": list(dims),
+        "L_global": L,
+        "local_block": locals_,
+        "kernel": lang,
+        "overlap_engaged": bool(on.overlap_applied),
+        "us_per_step_overlap_on": round(t_on * 1e6, 1),
+        "us_per_step_overlap_off": round(t_off * 1e6, 1),
+        "us_per_step_single_equivalent": round(t_single * 1e6, 1),
+        "comm_us_overlap_on": round(comm_on * 1e6, 1),
+        "comm_us_overlap_off": round(comm_off * 1e6, 1),
+        "measured_overlap_fraction": round(measured, 4),
+        "model_ideal_overlap": round(ideal, 4),
+        "model_comm": icimodel.comm_report(on),
+    }
+    artifacts.append_row(out, row)
+    print(json.dumps(row))
+
+
+def calibrate(out: str, apply: bool) -> None:
+    """Fold the sweep's measurements back into the icimodel literals —
+    the measured-ground-truth replacement for running
+    update_fuse_ratio.py / update_overlap.py by hand. Each calibrator
+    runs only when the artifact carries its kind of signal."""
+    import update_fuse_ratio
+    import update_overlap
+
+    model = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "grayscott_jl_tpu", "parallel", "icimodel.py",
+    )
+    try:
+        ratios = update_fuse_ratio.load_ratios(out)
+        print(json.dumps({"measured_fuse_cost_ratio": ratios,
+                          "artifact": out}))
+        if apply:
+            update_fuse_ratio.apply_to_model(ratios, model)
+            print(f"# updated FUSE_COST_RATIO in {model}",
+                  file=sys.stderr)
+    except SystemExit as e:
+        print(f"# fuse-ratio calibration skipped: {e}", file=sys.stderr)
+    try:
+        eff = update_overlap.load_efficiency(out)
+        print(json.dumps({"measured_overlap_efficiency": eff["median"],
+                          "rows": eff["efficiencies"],
+                          "artifact": out}))
+        if apply:
+            update_overlap.apply_to_model(eff["median"], model)
+            print(f"# updated OVERLAP_EFFICIENCY in {model}",
+                  file=sys.stderr)
+    except SystemExit as e:
+        print(f"# overlap calibration skipped: {e}", file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--L", default="32",
+                    help="comma-separated grid sides to tune")
+    ap.add_argument("--mode", default="quick",
+                    choices=["quick", "full"])
+    ap.add_argument("--steps", type=int, default=10,
+                    help="steps per timing round (GS_AUTOTUNE_STEPS)")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--budget", type=float, default=120.0,
+                    help="per-config tuning budget (GS_AUTOTUNE_BUDGET_S)")
+    ap.add_argument("--noise", type=float, default=0.1)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="JSONL artifact (default "
+                    "benchmarks/results/tune_ab_<platform>_<date>.jsonl)")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="also measure the overlap A/B at each winner "
+                    "and run the fuse/overlap calibrators on the "
+                    "artifact")
+    ap.add_argument("--apply", action="store_true",
+                    help="with --calibrate: rewrite the icimodel "
+                    "literals from the measured ratios")
+    args = ap.parse_args()
+
+    from grayscott_jl_tpu.utils.benchmark import setup_platform
+
+    backend = setup_platform(args.cpu, args.devices)
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    os.environ["GS_AUTOTUNE"] = args.mode
+    os.environ["GS_AUTOTUNE_BUDGET_S"] = str(args.budget)
+    os.environ["GS_AUTOTUNE_STEPS"] = str(args.steps)
+    os.environ["GS_AUTOTUNE_ROUNDS"] = str(args.rounds)
+
+    out = args.out
+    if out is None:
+        out = artifacts.default_out("tune_ab", backend)
+
+    for L in (int(s) for s in args.L.split(",")):
+        settings = Settings(
+            L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0,
+            noise=args.noise, precision="Float32", backend=backend,
+            kernel_language="Auto",
+        )
+        sim = Simulation(settings, n_devices=args.devices)
+        emit_tuning_rows(out, backend, sim, L)
+        if args.calibrate:
+            overlap_ab_row(out, backend, settings, sim, L,
+                           args.steps, args.rounds)
+    print(f"# appended to {out}", file=sys.stderr)
+    if args.calibrate:
+        calibrate(out, args.apply)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,13 +20,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
 
 def load_ratios(path: str, stat: str = "median_us_per_step") -> dict:
-    rows = [json.loads(ln) for ln in open(path, encoding="utf-8")
-            if ln.strip()]
+    rows = artifacts.read_rows(path)
     by_k = {}
     for r in rows:
         if "fuse" not in r or stat not in r:

@@ -25,16 +25,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import statistics
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
 
 
 def load_efficiency(path: str) -> dict:
     """Per-row realized overlap efficiencies from an --ab artifact,
     plus their median. Raises SystemExit when no row carries signal."""
-    rows = [json.loads(ln) for ln in open(path, encoding="utf-8")
-            if ln.strip()]
+    rows = artifacts.read_rows(path)
     effs = []
     skipped = 0
     for r in rows:

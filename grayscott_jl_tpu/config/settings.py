@@ -79,6 +79,13 @@ class Settings:
     #: and to disabled otherwise; "off" disables explicitly.
     #: GS_COMPILE_CACHE env wins (path, or ""/off/0 to disable).
     compile_cache: str = ""
+    #: Measured autotuner mode behind ``kernel_language = "Auto"``
+    #: (extension; docs/TUNING.md): off | cached | quick | full.
+    #: "" resolves to "cached" — a tuning-cache hit applies the
+    #: measured winner, a miss falls back to the analytic ICI-model
+    #: pick unchanged (bit-identical to "off" on a fresh machine).
+    #: GS_AUTOTUNE env wins, mirroring the other knobs.
+    autotune: str = ""
 
 
 #: Keys accepted from the TOML file (reference ``Structs.jl:31-52``).
@@ -242,6 +249,32 @@ def resolve_comm_overlap(settings: Settings) -> str:
         raise ValueError(
             f"comm_overlap / GS_COMM_OVERLAP must be on/off/auto, "
             f"got {raw!r}"
+        )
+    return v
+
+
+#: Valid autotune modes (docs/TUNING.md); shared with
+#: ``tune/autotuner.resolve_mode``.
+AUTOTUNE_MODES = ("off", "cached", "quick", "full")
+
+
+def resolve_autotune(settings: Settings) -> str:
+    """Normalized measured-autotuner mode: ``off``, ``cached``,
+    ``quick``, or ``full``. ``GS_AUTOTUNE`` wins over the ``autotune``
+    TOML key; unset resolves to ``cached`` (zero-measurement default —
+    see docs/TUNING.md)."""
+    import os
+
+    raw = os.environ.get("GS_AUTOTUNE")
+    if raw is None:
+        raw = getattr(settings, "autotune", "") or ""
+    v = raw.strip().lower()
+    if v == "":
+        return "cached"
+    if v not in AUTOTUNE_MODES:
+        raise ValueError(
+            f"autotune / GS_AUTOTUNE must be one of "
+            f"{'|'.join(AUTOTUNE_MODES)}, got {raw!r}"
         )
     return v
 

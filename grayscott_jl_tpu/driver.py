@@ -20,6 +20,14 @@ synchronous flow); the pipeline preserves step order, applies
 backpressure when full, surfaces writer errors on this thread, and is
 drained before the run is declared complete.
 
+Kernel scheduling (``tune/``, docs/TUNING.md): with
+``kernel_language = "Auto"`` the Simulation constructor consults the
+measured autotuner (``GS_AUTOTUNE`` / ``autotune`` TOML key) behind the
+analytic ICI-model dispatch; the decision provenance (mode, cache
+hit/miss, candidates timed, tuning seconds) lands in the RunStats
+``kernel_selection`` section below, next to the supervisor's
+degradation provenance.
+
 Resilience (``resilience/``): :func:`main` is split into the supervision
 dispatch and :func:`run_once`, the single-attempt loop. ``GS_SUPERVISE``
 routes through ``resilience.supervisor.supervise`` — failure
@@ -220,6 +228,8 @@ def run_once(
     selection = sim.kernel_selection
     if context is not None and context.degraded is not None:
         selection = {**(selection or {}), **context.degraded}
+    from .config.settings import resolve_autotune
+
     stats = RunStats(settings.L, config={
         "mesh_dims": list(sim.domain.dims),
         "padded_storage": (
@@ -233,6 +243,10 @@ def run_once(
         "n_processes": nprocs,
         "comm_overlap": sim.comm_overlap,
         "compile_cache": sim.compile_cache_dir,
+        # The resolved tuner mode rides in the config echo even for
+        # explicitly-pinned kernel languages (where no tuning runs):
+        # a stats reader can tell "not tuned" from "tuner off".
+        "autotune_mode": resolve_autotune(settings),
     })
     from .parallel import icimodel
 

@@ -182,6 +182,47 @@ def _mid_store_dtype(dtype, mid_bf16: bool):
     return _compute_dtype(dtype)
 
 
+def _slab_fits(bx: int, nx: int, ny: int, nz: int, itemsize: int,
+               fuse: int, mid_itemsize: int, budget: int) -> bool:
+    """ONE statement of the slab-depth VMEM feasibility gate, shared by
+    the dispatch pick (:func:`pick_block_planes`) and the autotuner's
+    candidate enumeration (:func:`feasible_block_planes`)."""
+    if nx % bx:
+        return False
+    if bx < nx and bx < fuse:
+        # Interior slabs read [b*bx - fuse, b*bx + bx + fuse); with
+        # bx < halo the slab next to the boundary would read out of
+        # bounds. (Single-block nx == bx has no interior slabs.)
+        return False
+    # A whole-block slab (nblocks == 1) only ever touches buffer
+    # slot 0 — no double buffering to charge for.
+    nio = 1 if bx == nx else 2
+    in_bytes = 2 * nio * (bx + 2 * fuse) * ny * nz * itemsize
+    nbuf, mid_planes = _mid_layout(bx, fuse)
+    mid_bytes = 2 * nbuf * mid_planes * ny * nz * mid_itemsize
+    out_bytes = 2 * nio * bx * ny * nz * itemsize
+    return in_bytes + mid_bytes + out_bytes <= budget
+
+
+def feasible_block_planes(
+    nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1,
+    mid_itemsize: int = None,
+) -> list:
+    """EVERY slab depth BX the VMEM gate admits for this shape, largest
+    first — the ``bx`` axis of the measured autotuner's candidate space
+    (``tune/candidates``). :func:`pick_block_planes` picks one of these
+    by a fixed preference order; which one actually runs fastest is a
+    DMA-pipeline question the analytic gate cannot answer, so the tuner
+    measures the alternatives (``GS_BX`` pins the winner)."""
+    budget = _vmem_budget()
+    if mid_itemsize is None:
+        mid_itemsize = max(itemsize, 4)
+    out = [bx for bx in range(nx, 0, -1)
+           if _slab_fits(bx, nx, ny, nz, itemsize, fuse, mid_itemsize,
+                         budget)]
+    return out
+
+
 def pick_block_planes(
     nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1,
     mid_itemsize: int = None,
@@ -198,21 +239,8 @@ def pick_block_planes(
         mid_itemsize = max(itemsize, 4)
 
     def fits(bx: int) -> bool:
-        if nx % bx:
-            return False
-        if bx < nx and bx < fuse:
-            # Interior slabs read [b*bx - fuse, b*bx + bx + fuse); with
-            # bx < halo the slab next to the boundary would read out of
-            # bounds. (Single-block nx == bx has no interior slabs.)
-            return False
-        # A whole-block slab (nblocks == 1) only ever touches buffer
-        # slot 0 — no double buffering to charge for.
-        nio = 1 if bx == nx else 2
-        in_bytes = 2 * nio * (bx + 2 * fuse) * ny * nz * itemsize
-        nbuf, mid_planes = _mid_layout(bx, fuse)
-        mid_bytes = 2 * nbuf * mid_planes * ny * nz * mid_itemsize
-        out_bytes = 2 * nio * bx * ny * nz * itemsize
-        return in_bytes + mid_bytes + out_bytes <= budget
+        return _slab_fits(bx, nx, ny, nz, itemsize, fuse, mid_itemsize,
+                          budget)
 
     import os
 

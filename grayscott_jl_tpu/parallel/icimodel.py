@@ -23,6 +23,8 @@ per-step cost at every scale.
 
 from __future__ import annotations
 
+from typing import Optional
+
 #: Single-chip fused-kernel cost at fuse=k relative to the k=5 optimum,
 #: measured round-robin in one process at L=256 f32 noisy (k=1:
 #: ab_r3_fuse1v5; k=4,5,6: ab_r3_deepfuse medians). k=2,3 are a+b/k
@@ -656,6 +658,61 @@ def select_kernel(
         else:
             info["reason"] = "fastest projected absolute step time"
     return pick["kernel"], info
+
+
+def projected_step_us(
+    lang: str,
+    dims,
+    L: int,
+    fuse: int,
+    *,
+    itemsize: int = 4,
+    links: int = 6,
+    link_gbps: float = 90.0,
+    hop_us: float = 1.0,
+    overlap="auto",
+    local=None,
+) -> Optional[float]:
+    """Model-projected µs/step for ONE concrete (language, mesh, depth)
+    config — the scalar the measured autotuner (``tune/candidates``)
+    ranks its shortlist by. Routes to the same projection the Auto
+    dispatch uses for that shape (cubic :func:`project` for the XLA
+    language, :func:`project_1d`/:func:`project_chain` for the Pallas
+    chains, the single-chip anchors for one device) and converts
+    efficiency back to absolute time against the language's own base.
+    ``None`` when the model has nothing to say (no measured fuse ratio,
+    no chain at this depth) — unscored candidates rank last, they are
+    not excluded."""
+    n, m, p = dims
+    ndev = n * m * p
+    if local is None:
+        local = tuple(-(-L // d) for d in dims)
+    if lang == "xla":
+        base = anchor_us("XLA", L) / ndev
+        if ndev == 1:
+            return base
+        side = max(2, round((local[0] * local[1] * local[2]) ** (1 / 3)))
+        row = project(side, max(1, fuse), base, itemsize=itemsize,
+                      links=links, link_gbps=link_gbps, hop_us=hop_us,
+                      overlap=overlap)
+        return base / row["projected_weak_scaling_eff"]
+    base_full = anchor_us("Pallas", L)
+    r = FUSE_COST_RATIO.get(fuse)
+    if ndev == 1:
+        return None if r is None else base_full * r
+    if fuse < 2 or r is None:
+        return None
+    kw = dict(local=local, itemsize=itemsize, links=links,
+              link_gbps=link_gbps, hop_us=hop_us, overlap=overlap)
+    try:
+        if m == 1 and p == 1:
+            row = project_1d(n, L, fuse, base_full, **kw)
+        else:
+            row = project_chain(dims, L, fuse, base_full,
+                                sublane=16 if itemsize == 2 else 8, **kw)
+    except ValueError:
+        return None
+    return (base_full / ndev) / row["projected_weak_scaling_eff"]
 
 
 def comm_report(sim) -> dict:

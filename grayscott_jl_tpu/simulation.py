@@ -404,13 +404,61 @@ class Simulation:
                         # pick assumed it (still capped by the runner's
                         # own feasibility checks).
                         self._auto_fuse = int(row["fuse"])
+            # Measured autotuner (tune/, docs/TUNING.md), consulted
+            # AFTER the analytic decision and mesh adoption settled so
+            # the tuning-cache key describes the mesh this run actually
+            # uses. Modes: off/cached leave the analytic pick untouched
+            # (cached applies a prior measured winner on a cache hit,
+            # with zero measurement); quick/full measure the model's
+            # shortlist on the real step function here, within
+            # GS_AUTOTUNE_BUDGET_S.
+            from . import tune
+
+            link_gbps, links = icimodel.fabric_for(kind)
+            decision = tune.autotune(
+                settings,
+                dims=self.domain.dims, L=settings.L, platform=backend,
+                device_kind=kind, dtype=str(np.dtype(self.dtype)),
+                noise=float(settings.noise),
+                itemsize=int(np.dtype(self.dtype).itemsize),
+                n_devices=n_devices, seed=seed,
+                analytic_kernel=self.kernel_language,
+                analytic_fuse=max(1, int(self._fuse_base())),
+                comm_overlap=self.comm_overlap,
+                overlap_toggle=(
+                    self.sharded
+                    and config.resolve_comm_overlap(settings) == "auto"
+                ),
+                link_gbps=link_gbps, links=links,
+            )
+            self.kernel_selection["autotune"] = decision.provenance
+            if decision.provenance.get("source") in ("cache", "measured"):
+                self.kernel_language = decision.kernel
+                if decision.fuse is not None and not _os.environ.get(
+                        "GS_FUSE", ""):
+                    self._auto_fuse = decision.fuse
+                if (decision.comm_overlap is not None and self.sharded
+                        and config.resolve_comm_overlap(settings)
+                        == "auto"):
+                    self.comm_overlap = decision.comm_overlap
+                if decision.bx is not None and not _os.environ.get(
+                        "GS_BX", ""):
+                    # GS_BX is read at kernel-trace time; an env pin is
+                    # the one channel that reaches it. Process-wide by
+                    # nature — recorded in the provenance, and an
+                    # operator's own GS_BX always wins.
+                    _os.environ["GS_BX"] = str(decision.bx)
+                    decision.provenance["bx_env_pinned"] = True
             if _is_primary():
                 import sys as _sys
 
+                _prov = decision.provenance
                 print(
                     "gray-scott: kernel_language=Auto resolved to "
                     f"{self.kernel_language!r} "
-                    f"({self.kernel_selection.get('reason', '')})",
+                    f"({self.kernel_selection.get('reason', '')}; "
+                    f"autotune {_prov['mode']}, "
+                    f"{_prov.get('source', 'analytic')} pick)",
                     file=_sys.stderr,
                 )
         else:

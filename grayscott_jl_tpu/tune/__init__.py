@@ -1,0 +1,30 @@
+"""Measured autotuner behind ``kernel_language = "Auto"`` dispatch.
+
+The analytic ICI model (``parallel/icimodel.select_kernel``) projects a
+kernel schedule from hand-calibrated constants; this package *measures*
+the shortlist of plausible schedules on the real step function and
+remembers the winner (docs/TUNING.md):
+
+* :mod:`~.candidates` — top-N config shortlist (kernel mode x block
+  planes x chain depth x comm_overlap) from the icimodel's projections,
+  pruned by the SAME Mosaic feasibility gates the kernel dispatch
+  applies;
+* :mod:`~.measure` — compile-and-time each candidate with the repo's
+  one timing discipline (``utils/benchmark.time_sim_rounds``: warmup
+  chunk, completion sync, median-of-rounds) under a
+  ``GS_AUTOTUNE_BUDGET_S`` wall budget;
+* :mod:`~.cache` — persistent, versioned, atomically-written tuning
+  cache keyed by (schema, device kind, platform, mesh, L, dtype,
+  noise, jax version);
+* :mod:`~.autotuner` — the mode knob (``GS_AUTOTUNE`` /
+  ``autotune`` TOML key: off | cached | quick | full) and the decision
+  record that lands in RunStats ``kernel_selection`` provenance.
+
+Default mode is ``cached``: a cache hit applies the measured winner
+with zero measurement; a miss falls back to the analytic pick
+*unchanged*, so default behavior is bit-identical to a tuner-less
+build (asserted in tests/unit/test_autotune.py).
+"""
+
+from .autotuner import TuneDecision, autotune, resolve_budget_s  # noqa: F401
+from .cache import SCHEMA_VERSION, cache_key  # noqa: F401

@@ -1,0 +1,234 @@
+"""Mode knob + decision logic for the measured autotuner.
+
+``GS_AUTOTUNE`` env (wins) / ``autotune`` TOML key:
+
+* ``off``    — the analytic ICI-model pick, untouched; the tuner does
+  not even read the cache. Bit-identical to a tuner-less build.
+* ``cached`` — (default) cache hit applies the measured winner with
+  ZERO measurement; miss falls back to the analytic pick *unchanged*.
+  Default behavior on a fresh machine is therefore bit-identical to
+  ``off``; machines that ran a sweep get the measured schedule for
+  free.
+* ``quick``  — on miss, measure the model's top-N shortlist (small
+  N, short rounds) within ``GS_AUTOTUNE_BUDGET_S`` and persist the
+  winner.
+* ``full``   — wider shortlist including Pallas ``bx`` slab variants;
+  same budget discipline.
+
+The decision provenance (mode, cache hit/miss, candidates timed,
+tuning seconds, model-vs-measured delta) rides in the RunStats
+``kernel_selection`` section and the bench JSON, so every artifact says
+whether its schedule was projected or measured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Optional
+
+from . import cache, candidates, measure
+
+MODES = ("off", "cached", "quick", "full")
+
+#: Shortlist width per mode; env-overridable for sweeps.
+_TOP_N = {"quick": 3, "full": 8}
+
+
+def resolve_mode(settings=None) -> str:
+    """``GS_AUTOTUNE`` env > ``autotune`` TOML key > ``"cached"`` —
+    one resolution, owned by the config layer."""
+    from ..config.settings import resolve_autotune
+
+    return resolve_autotune(settings)
+
+
+def resolve_budget_s() -> float:
+    """Wall budget for one tuning round (``GS_AUTOTUNE_BUDGET_S``,
+    default 120 s). The budget bounds when candidates *start*; a
+    started compile runs to completion."""
+    raw = os.environ.get("GS_AUTOTUNE_BUDGET_S", "120")
+    try:
+        v = float(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"GS_AUTOTUNE_BUDGET_S must be a number, got {raw!r}"
+        ) from e
+    if v <= 0:
+        raise ValueError(f"GS_AUTOTUNE_BUDGET_S must be > 0, got {v}")
+    return v
+
+
+def _top_n(mode: str) -> int:
+    raw = os.environ.get("GS_AUTOTUNE_TOPN", "")
+    if raw:
+        return max(1, int(raw))
+    return _TOP_N[mode]
+
+
+@dataclasses.dataclass
+class TuneDecision:
+    """What the run should actually do, plus the story of why."""
+
+    kernel: str
+    fuse: Optional[int]  # None: leave the analytic/default depth alone
+    comm_overlap: Optional[bool]  # None: leave the resolved value alone
+    bx: Optional[int]
+    provenance: dict
+
+
+def _analytic_decision(mode: str, analytic_kernel: str,
+                       extra: Optional[dict] = None) -> TuneDecision:
+    prov = {"mode": mode, "source": "analytic", "cache": None,
+            "candidates_timed": 0, "tuning_s": 0.0}
+    if extra:
+        prov.update(extra)
+    return TuneDecision(kernel=analytic_kernel, fuse=None,
+                        comm_overlap=None, bx=None, provenance=prov)
+
+
+def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
+    return TuneDecision(
+        kernel=winner["kernel"],
+        fuse=int(winner["fuse"]),
+        comm_overlap=bool(winner["comm_overlap"]),
+        bx=winner.get("bx"),
+        provenance=prov,
+    )
+
+
+def autotune(
+    settings,
+    *,
+    dims,
+    L: int,
+    platform: str,
+    device_kind: str,
+    dtype: str,
+    noise: float,
+    itemsize: int,
+    n_devices: Optional[int],
+    seed: int,
+    analytic_kernel: str,
+    analytic_fuse: int,
+    comm_overlap: bool,
+    overlap_toggle: bool,
+    link_gbps: float = 90.0,
+    links: int = 6,
+    timer: Optional[Callable] = None,
+) -> TuneDecision:
+    """Resolve the measured schedule for one run config.
+
+    Called from ``Simulation.__init__`` AFTER the analytic Auto
+    dispatch (and its mesh adoption) settled, so ``dims`` is the mesh
+    the run will actually use and the cache key describes the real
+    config. ``timer`` is the test seam — a fake with the
+    ``time_sim_rounds`` contract makes the whole quick path
+    deterministic and measurement-free.
+    """
+    import jax
+
+    mode = resolve_mode(settings)
+    if mode == "off":
+        return _analytic_decision(mode, analytic_kernel)
+
+    key = cache.cache_key(
+        device_kind=device_kind, platform=platform, dims=dims, L=L,
+        dtype=dtype, noise=noise, jax_version=jax.__version__,
+    )
+    rec = cache.load(key)
+    if rec is not None:
+        try:
+            winner = dict(rec["winner"])
+            prov = {
+                "mode": mode, "source": "cache", "cache": "hit",
+                "candidates_timed": 0, "tuning_s": 0.0,
+                "winner": winner,
+                "cache_created": rec.get("created"),
+                "cache_path": cache.entry_path(key),
+            }
+            return _winner_decision(mode, winner, prov)
+        except (KeyError, TypeError, ValueError) as e:
+            # A verified-schema record with an unusable winner shape —
+            # same degradation contract as a corrupt file.
+            import sys
+
+            print(f"gray-scott: warning: tuning cache winner unusable "
+                  f"({e}); falling back to the analytic pick",
+                  file=sys.stderr)
+
+    if mode == "cached":
+        # The zero-measurement contract: a miss changes NOTHING about
+        # the run — the analytic pick goes through untouched.
+        return _analytic_decision(mode, analytic_kernel,
+                                  {"cache": "miss"})
+
+    # quick | full: measure the shortlist within the budget.
+    budget_s = resolve_budget_s()
+    t0 = time.monotonic()
+    cands = candidates.generate(
+        dims=dims, L=L, platform=platform, itemsize=itemsize,
+        fuse_cap=max(analytic_fuse, 1), analytic_kernel=analytic_kernel,
+        analytic_fuse=analytic_fuse, comm_overlap=comm_overlap,
+        overlap_toggle=overlap_toggle, link_gbps=link_gbps, links=links,
+        top_n=_top_n(mode),
+        bx_variants=2 if mode == "full" else 0,
+    )
+    steps = int(os.environ.get("GS_AUTOTUNE_STEPS", "20"))
+    rounds = int(os.environ.get("GS_AUTOTUNE_ROUNDS",
+                                "2" if mode == "quick" else "3"))
+    ms, skipped = measure.measure_candidates(
+        settings, cands, dims=dims, n_devices=n_devices, seed=seed,
+        deadline=t0 + budget_s, steps=steps, rounds=rounds, timer=timer,
+    )
+    tuning_s = round(time.monotonic() - t0, 3)
+    win = measure.best(ms)
+    model = next((m for m in ms if m.candidate.analytic), None)
+    prov = {
+        "mode": mode, "cache": "miss",
+        "candidates_timed": sum(1 for m in ms if m.ok()),
+        "candidates_skipped": skipped,
+        "candidates_errored": sum(1 for m in ms if not m.ok()),
+        "tuning_s": tuning_s,
+        "budget_s": budget_s,
+    }
+    if win is None:
+        prov.update({"source": "analytic",
+                     "reason": "no candidate measured successfully"})
+        return _analytic_decision(mode, analytic_kernel, prov)
+
+    winner = dict(win.candidate.as_dict())
+    winner["median_us_per_step"] = win.median_us_per_step
+    prov.update({
+        "source": "measured",
+        "winner": winner,
+        "model_pick": (model.candidate.as_dict() if model else None),
+        "model_pick_us": (model.median_us_per_step
+                          if model and model.ok() else None),
+        "measured_pick_us": win.median_us_per_step,
+    })
+    if model is not None and model.ok() and model.median_us_per_step:
+        prov["model_vs_measured_speedup"] = round(
+            model.median_us_per_step / win.median_us_per_step, 4
+        )
+    try:
+        import datetime
+
+        path = cache.store(key, {
+            "winner": winner,
+            "measurements": [m.as_dict() for m in ms],
+            "provenance": {k: prov[k] for k in
+                           ("mode", "candidates_timed", "tuning_s",
+                            "budget_s")},
+            "created": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+        })
+        prov["cache_path"] = path
+    except OSError as e:
+        import sys
+
+        print(f"gray-scott: warning: could not persist tuning cache "
+              f"({e}); this round's winner applies to this run only",
+              file=sys.stderr)
+    return _winner_decision(mode, winner, prov)

@@ -1,0 +1,138 @@
+"""Persistent tuning cache: one JSON file per tuning key.
+
+Layout: ``<cache_dir>/v<SCHEMA_VERSION>/<digest>.json`` where the
+digest is a sha1 of the canonical key JSON. The key carries every knob
+that changes what a measurement means — device kind, platform, mesh
+dims, L, dtype, noise, jax version, schema version — so a config drift
+is a cache *miss*, never a wrong hit; bumping :data:`SCHEMA_VERSION`
+orphans every old entry at once (stale-key invalidation is structural:
+old entries live under the old ``v<N>/`` directory and are simply
+never consulted).
+
+Failure containment mirrors ``io/sidecar.read_keep_base``: a corrupt,
+truncated, or wrong-shape cache file degrades to a documented miss
+with a one-line warning — tuning state must never be able to crash a
+run. Writes are atomic (same-directory temp file + ``os.replace``), so
+a crash mid-store leaves either the old entry or a ``*.tmp*`` orphan
+that readers never look at.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Optional
+
+#: Bump when the record layout or the meaning of a measurement changes;
+#: every existing cache entry becomes invisible (they live under the
+#: old version's subdirectory).
+SCHEMA_VERSION = 1
+
+
+def cache_dir() -> str:
+    """Cache root: ``GS_AUTOTUNE_CACHE`` env, else
+    ``~/.cache/grayscott_tune``."""
+    raw = os.environ.get("GS_AUTOTUNE_CACHE", "").strip()
+    if raw:
+        return os.path.expanduser(raw)
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "grayscott_tune")
+
+
+def cache_key(
+    *,
+    device_kind: str,
+    platform: str,
+    dims,
+    L: int,
+    dtype: str,
+    noise: float,
+    jax_version: str,
+) -> dict:
+    """The canonical tuning key. Every field participates in the
+    digest; adding a field is a schema bump (old digests stop
+    matching)."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "device_kind": str(device_kind or ""),
+        "platform": str(platform),
+        "dims": [int(d) for d in dims],
+        "L": int(L),
+        "dtype": str(dtype),
+        "noise": float(noise),
+        "jax_version": str(jax_version),
+    }
+
+
+def key_digest(key: dict) -> str:
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def entry_path(key: dict, root: Optional[str] = None) -> str:
+    root = cache_dir() if root is None else root
+    return os.path.join(
+        root, f"v{key.get('schema', SCHEMA_VERSION)}",
+        key_digest(key) + ".json",
+    )
+
+
+def _warn(msg: str) -> None:
+    print(f"gray-scott: warning: {msg}", file=sys.stderr)
+
+
+def load(key: dict, root: Optional[str] = None) -> Optional[dict]:
+    """The cached record for ``key``, or None on miss.
+
+    A readable-but-invalid file (truncated JSON, wrong shape, digest
+    collision with a different key, foreign schema) is a WARNED miss —
+    the caller degrades to the analytic pick, exactly like a corrupt
+    rollback sidecar degrades to no-sidecar."""
+    path = entry_path(key, root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    except (OSError, json.JSONDecodeError) as e:
+        _warn(f"tuning cache entry {path} unreadable ({e}); "
+              "falling back to the analytic pick")
+        return None
+    if not isinstance(rec, dict) or rec.get("schema") != key["schema"] \
+            or rec.get("key") != key or "winner" not in rec:
+        _warn(f"tuning cache entry {path} is stale or malformed; "
+              "falling back to the analytic pick")
+        return None
+    return rec
+
+
+def store(key: dict, record: dict, root: Optional[str] = None) -> str:
+    """Atomically write ``record`` for ``key``; returns the entry path.
+
+    The record is stamped with the schema and the full key so ``load``
+    can verify it independently of the filename. The temp file lives in
+    the same directory (``os.replace`` must not cross filesystems); a
+    crash between write and replace leaves a ``*.tmp.<pid>`` orphan the
+    readers never consult."""
+    path = entry_path(key, root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rec = dict(record)
+    rec["schema"] = key["schema"]
+    rec["key"] = dict(key)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return path

@@ -1,0 +1,191 @@
+"""Candidate shortlist for the measured autotuner.
+
+The search space is every knob the runner resolves at construction
+time: kernel mode (Pallas chain vs XLA window chain), chain/fuse depth
+(``GS_FUSE``), split-phase exchange on/off (``GS_COMM_OVERLAP``), and —
+for the Pallas kernel — the DMA slab depth (``GS_BX``). Enumerating it
+raw would be hundreds of compiles, so candidates are (a) pruned by the
+SAME Mosaic feasibility gates the kernel dispatch applies
+(``pallas_stencil.mosaic_gate_reason`` / ``max_feasible_fuse*`` /
+``feasible_block_planes`` — the tuner must never time a schedule the
+kernel would silently decline into its fallback) and (b) ranked by the
+analytic ICI model (``icimodel.projected_step_us``) so the measured
+top-N starts from the model's best guesses. The analytic pick itself is
+ALWAYS in the shortlist: the measured-vs-model delta in the provenance
+is only meaningful when both were timed under the same conditions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..parallel import icimodel
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One concrete schedule the tuner can pin and time."""
+
+    kernel: str  # "pallas" | "xla"
+    fuse: int  # chain / temporal-blocking depth (GS_FUSE)
+    comm_overlap: bool  # split-phase exchange armed (GS_COMM_OVERLAP)
+    bx: Optional[int] = None  # Pallas slab depth (GS_BX); None = auto
+    projected_step_us: Optional[float] = None  # model rank, None = unscored
+    analytic: bool = False  # this is the model's own pick
+
+    def label(self) -> str:
+        parts = [self.kernel, f"fuse={self.fuse}",
+                 "overlap" if self.comm_overlap else "fused"]
+        if self.bx is not None:
+            parts.append(f"bx={self.bx}")
+        return "/".join(parts)
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d["projected_step_us"] is not None:
+            d["projected_step_us"] = round(d["projected_step_us"], 1)
+        return d
+
+
+def from_dict(d: dict) -> Candidate:
+    """Inverse of :meth:`Candidate.as_dict` for cache records; unknown
+    keys (a newer writer) are dropped rather than rejected."""
+    fields = {f.name for f in dataclasses.fields(Candidate)}
+    return Candidate(**{k: v for k, v in d.items() if k in fields})
+
+
+def _pallas_depths(local, itemsize: int, dims, kmax: int) -> List[int]:
+    """Chain depths the Mosaic gates admit for this block on this mesh
+    — mirrors the caps the runner itself applies (``simulation.py``
+    x-chain / xy-chain dispatch), restricted to depths the cost model
+    can rank (measured fuse ratios)."""
+    from ..ops import pallas_stencil as ps
+
+    if min(local) < 2 or ps.mosaic_gate_reason(local, itemsize):
+        return []
+    n, m, p = dims
+    sharded = n * m * p > 1
+    if not sharded:
+        cap = ps.max_feasible_fuse(*local, itemsize,
+                                   max(icimodel.FUSE_COST_RATIO))
+        lo = 1
+    elif m == 1 and p == 1:
+        cap = min(kmax, local[0])
+        cap = ps.max_feasible_fuse(*local, itemsize, max(cap, 1))
+        lo = 2
+    else:
+        cap = min(kmax, local[0], local[1])
+        if p > 1:
+            cap = min(cap, local[2] // 2)
+        sublane = 16 if itemsize == 2 else 8
+        cap = ps.max_feasible_fuse_ypad(*local, itemsize, max(cap, 1),
+                                        sublane)
+        lo = 2
+    return [k for k in sorted(icimodel.FUSE_COST_RATIO)
+            if lo <= k <= cap]
+
+
+def _xla_depths(local, dims, kmax: int) -> List[int]:
+    n, m, p = dims
+    if n * m * p == 1:
+        # The single-device XLA path is a plain per-step loop; depth is
+        # not a knob there.
+        return [1]
+    return list(range(1, max(1, min(kmax, min(local))) + 1))
+
+
+def generate(
+    *,
+    dims,
+    L: int,
+    platform: str,
+    itemsize: int,
+    fuse_cap: int,
+    analytic_kernel: str,
+    analytic_fuse: int,
+    comm_overlap: bool,
+    overlap_toggle: bool,
+    link_gbps: float = 90.0,
+    links: int = 6,
+    top_n: int = 4,
+    bx_variants: int = 0,
+) -> List[Candidate]:
+    """The ranked measurement shortlist for one run config.
+
+    ``overlap_toggle`` widens the search across the split-phase knob
+    (only when the operator left ``comm_overlap = "auto"`` — a pinned
+    setting is respected, not searched). ``bx_variants`` adds up to
+    that many alternative Pallas slab depths per surviving Pallas
+    candidate (full mode only — each one is an extra compile).
+    Off-TPU the Pallas rows are excluded outright: the interpret-mode
+    path is a correctness tool ~1000x off, and timing it would burn the
+    whole budget saying so.
+    """
+    n, m, p = dims
+    sharded = n * m * p > 1
+    local = tuple(-(-L // d) for d in dims)
+    overlaps = [comm_overlap]
+    if sharded and overlap_toggle:
+        overlaps.append(not comm_overlap)
+
+    langs = {"xla": _xla_depths(local, dims, fuse_cap)}
+    if platform == "tpu":
+        depths = _pallas_depths(local, itemsize, dims, fuse_cap)
+        if depths:
+            langs["pallas"] = depths
+
+    def score(kernel, fuse, ov):
+        return icimodel.projected_step_us(
+            kernel, dims, L, fuse, itemsize=itemsize, links=links,
+            link_gbps=link_gbps, local=local,
+            overlap="auto" if ov else 0.0,
+        )
+
+    out = []
+    for kernel, depths in langs.items():
+        for fuse in depths:
+            for ov in overlaps if sharded else [False]:
+                out.append(Candidate(
+                    kernel=kernel, fuse=fuse, comm_overlap=ov,
+                    projected_step_us=score(kernel, fuse, ov),
+                    analytic=(kernel == analytic_kernel
+                              and fuse == analytic_fuse
+                              and ov == comm_overlap),
+                ))
+    if not any(c.analytic for c in out):
+        # The analytic pick fell outside the enumerable space (e.g. a
+        # depth with no measured ratio): still measure it — the
+        # model-vs-measured delta is the point of the exercise.
+        out.append(Candidate(
+            kernel=analytic_kernel, fuse=analytic_fuse,
+            comm_overlap=comm_overlap if sharded else False,
+            projected_step_us=score(
+                analytic_kernel, analytic_fuse,
+                comm_overlap if sharded else False),
+            analytic=True,
+        ))
+
+    big = float("inf")
+    out.sort(key=lambda c: (not c.analytic,
+                            c.projected_step_us
+                            if c.projected_step_us is not None else big))
+    short = out[:max(top_n, 1)]
+
+    if bx_variants > 0:
+        from ..ops import pallas_stencil as ps
+
+        extra = []
+        for c in [c for c in short if c.kernel == "pallas"]:
+            opts = ps.feasible_block_planes(
+                *local, itemsize, c.fuse,
+                mid_itemsize=ps.mid_itemsize_for("float32"
+                                                 if itemsize == 4
+                                                 else "bfloat16"),
+            )
+            auto = ps.pick_block_planes(*local, itemsize, c.fuse)
+            for bx in [b for b in opts if b != auto][:bx_variants]:
+                extra.append(dataclasses.replace(
+                    c, bx=bx, analytic=False))
+        short += extra
+    return short

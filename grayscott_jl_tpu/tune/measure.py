@@ -1,0 +1,172 @@
+"""Micro-bench harness: compile and time candidates on the REAL step
+function.
+
+Each candidate is pinned exactly the way an operator would pin it — an
+explicit ``kernel_language``, the ``comm_overlap`` Settings key, and
+the ``GS_FUSE``/``GS_BX`` env overrides — then run through a fresh
+``Simulation`` and the repo's one timing discipline
+(``utils/benchmark.time_sim_rounds``: untimed compile-triggering
+warmup chunk, completion sync, median-of-rounds). Measuring the real
+runner is the whole point: the BENCH_r05 postmortem showed the analytic
+model off by large factors away from its calibrated anchors, and no
+model refinement beats running the actual program.
+
+Budgeting: ``deadline`` is a wall-clock instant; a candidate is only
+*started* while there is time left, and a started candidate finishes
+its (short) rounds — compiles are the dominant cost and cannot be
+interrupted mid-flight anyway. Skipped candidates are reported, never
+silently dropped. A candidate that fails to build or time records its
+error and the sweep continues: one infeasible schedule must not void
+the whole tuning round.
+
+Tests inject ``timer=`` (a fake with the ``time_sim_rounds`` contract)
+so tier-1 exercises the full quick-mode path with zero real
+measurement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .candidates import Candidate
+
+
+@dataclasses.dataclass
+class Measurement:
+    """Timing outcome for one candidate."""
+
+    candidate: Candidate
+    median_us_per_step: Optional[float] = None
+    best_us_per_step: Optional[float] = None
+    rounds_us_per_step: Optional[list] = None
+    error: Optional[str] = None
+
+    def ok(self) -> bool:
+        return self.error is None and self.median_us_per_step is not None
+
+    def as_dict(self) -> dict:
+        d = {"candidate": self.candidate.as_dict()}
+        for k in ("median_us_per_step", "best_us_per_step",
+                  "rounds_us_per_step", "error"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+
+def pinned_settings(settings, candidate: Candidate):
+    """A Settings copy with the candidate's kernel/overlap pinned the
+    way an operator would pin them (explicit language strings, so the
+    measurement Simulation never re-enters Auto dispatch or the
+    tuner)."""
+    import dataclasses as dc
+
+    return dc.replace(
+        settings,
+        kernel_language="Pallas" if candidate.kernel == "pallas"
+        else "Plain",
+        comm_overlap="on" if candidate.comm_overlap else "off",
+        # Tuning is a construction-time concern; the pinned probe sims
+        # must not arm supervision, restart, or checkpoint machinery.
+        supervise=False, restart=False, checkpoint=False,
+    )
+
+
+class _env_pins:
+    """Scoped env overrides (GS_FUSE/GS_BX read at trace time) restored
+    on exit even when the candidate build throws."""
+
+    def __init__(self, pins: dict):
+        self.pins = {k: v for k, v in pins.items() if v is not None}
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self.pins.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, prior in self._saved.items():
+            if prior is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prior
+
+
+def default_timer(sim, steps: int, rounds: int, deadline: float) -> dict:
+    """The production timer: ``utils/benchmark.time_sim_rounds`` with
+    the tuner's deadline threaded through so a slow config stops
+    spending rounds once the budget is gone."""
+    from ..utils.benchmark import time_sim_rounds
+
+    return time_sim_rounds(sim, steps, rounds, deadline=deadline)
+
+
+def measure_candidates(
+    settings,
+    cands: List[Candidate],
+    *,
+    dims,
+    n_devices: Optional[int],
+    seed: int = 0,
+    deadline: float,
+    steps: int,
+    rounds: int,
+    timer: Optional[Callable] = None,
+) -> Tuple[List[Measurement], int]:
+    """Time each candidate in shortlist order until the deadline.
+
+    ``dims`` is the mesh of the run being tuned: the probe sims pin it
+    via ``GS_TPU_MESH_DIMS`` so a measurement describes the SAME mesh
+    the cache key does (an Auto run may have adopted a swept mesh the
+    default factorization would not reproduce). Returns
+    ``(measurements, skipped)`` — measurements for every candidate that
+    was started (successful or errored), and the count of candidates
+    never started because the budget ran out.
+    """
+    from ..simulation import Simulation
+
+    timer = default_timer if timer is None else timer
+    out: List[Measurement] = []
+    skipped = 0
+    for i, cand in enumerate(cands):
+        if out and time.monotonic() >= deadline:
+            skipped = len(cands) - i
+            break
+        pins = {"GS_FUSE": cand.fuse, "GS_BX": cand.bx,
+                "GS_TPU_MESH_DIMS": ",".join(str(d) for d in dims),
+                # The Settings pin below would lose to a stray
+                # GS_COMM_OVERLAP=auto in the environment.
+                "GS_COMM_OVERLAP": "on" if cand.comm_overlap else "off",
+                # A probe sim must never consult or write the tuning
+                # cache itself.
+                "GS_AUTOTUNE": "off"}
+        try:
+            with _env_pins(pins):
+                sim = Simulation(pinned_settings(settings, cand),
+                                 n_devices=n_devices, seed=seed)
+                t = timer(sim, steps, rounds, deadline)
+            out.append(Measurement(
+                candidate=cand,
+                median_us_per_step=round(t["median"] * 1e6, 1),
+                best_us_per_step=round(t["best"] * 1e6, 1),
+                rounds_us_per_step=[round(s * 1e6, 1)
+                                    for s in t["rounds_s_per_step"]],
+            ))
+        except Exception as e:  # noqa: BLE001 — one bad schedule
+            # must not void the sweep
+            out.append(Measurement(candidate=cand,
+                                   error=f"{type(e).__name__}: {e}"))
+    return out, skipped
+
+
+def best(measurements: List[Measurement]) -> Optional[Measurement]:
+    """The fastest successful measurement by median, or None."""
+    ok = [m for m in measurements if m.ok()]
+    if not ok:
+        return None
+    return min(ok, key=lambda m: m.median_us_per_step)

@@ -70,7 +70,7 @@ def setup_platform(cpu: bool, devices: int = 1) -> str:
 
 def time_sim_rounds(
     sim, steps: int, rounds: int, sustain_seconds: float = 0.0,
-    round_sleep: float = 0.0,
+    round_sleep: float = 0.0, deadline: float = None,
 ) -> Dict[str, object]:
     """Per-round seconds-per-step of ``steps`` fused simulation steps
     (after a compile-triggering warmup chunk), plus an optional
@@ -91,6 +91,12 @@ def time_sim_rounds(
     steady-state number). ``round_sleep`` spaces the rounds out in
     wall-clock so they sample more clock states (fast windows appear
     opportunistically; idle time costs nothing on a shared chip).
+
+    ``deadline`` (a ``time.monotonic()`` instant) is the autotuner's
+    wall budget (``tune/measure.py``): rounds after the first stop
+    being added once it passes, so one slow candidate cannot eat the
+    whole tuning budget — the first round always completes, because a
+    measurement with zero rounds is no measurement at all.
     """
     import statistics
 
@@ -111,6 +117,8 @@ def time_sim_rounds(
     sync()
     per_round = []
     for i in range(rounds):
+        if i and deadline is not None and time.monotonic() >= deadline:
+            break
         if i and round_sleep > 0:
             time.sleep(round_sleep)
         t0 = time.perf_counter()
@@ -189,6 +197,14 @@ def bench_one(
         # artifacts keep a uniform schema with sharded runs.
         "comm": icimodel.comm_report(sim),
     }
+    if sim.kernel_selection is not None:
+        # Auto-dispatch runs (GS_BENCH_KERNEL=Auto) carry the tuner
+        # provenance (RunStats `kernel_selection.autotune` mirror):
+        # the artifact says whether its schedule was projected or
+        # measured, and what the tuning cost.
+        out["kernel_resolved"] = sim.kernel_language
+        if sim.kernel_selection.get("autotune") is not None:
+            out["autotune"] = sim.kernel_selection["autotune"]
     if "sustained" in t:
         out["sustained_us_per_step"] = round(t["sustained"] * 1e6, 1)
         out["sustained_cell_updates_per_s"] = round(

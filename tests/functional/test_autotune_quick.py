@@ -1,0 +1,179 @@
+"""Functional: quick-mode autotuning through the real driver.
+
+The fast tier-1 variant injects a deterministic fake timer
+(``tune/measure.default_timer`` is the seam), so the full quick path —
+candidate generation, measurement loop, cache persist, provenance into
+RunStats, replay on the supervised-restart shape — runs with zero real
+measurement. The real-measurement smoke (budget compliance on CPU)
+rides behind ``-m slow``; the committed A/B artifact comes from
+``benchmarks/tune_sweep.py``.
+"""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+import jax
+
+from test_end_to_end import run_cli, write_config
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.tune import cache as tune_cache
+
+REPO = Path(__file__).resolve().parents[2]
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _settings(tmp_path, **kw):
+    base = dict(
+        L=16, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=0.1,
+        steps=4, plotgap=2, output=str(tmp_path / "gs.bp"),
+        precision="Float32", backend="CPU", kernel_language="Auto",
+    )
+    base.update(kw)
+    return Settings(**base)
+
+
+@requires8
+def test_quick_mode_smoke_through_run_once(tmp_path, monkeypatch):
+    """driver.run_once with GS_AUTOTUNE=quick: tuning happens at
+    Simulation construction, the winner is cached, and the RunStats
+    kernel_selection section carries the full tuner provenance."""
+    from grayscott_jl_tpu import driver
+    from grayscott_jl_tpu.tune import measure
+
+    def fake_timer(sim, steps, rounds, deadline):
+        us = 500.0 if not sim.comm_overlap else 800.0
+        return {"median": us / 1e6, "best": us / 1e6,
+                "rounds_s_per_step": [us / 1e6] * rounds}
+
+    monkeypatch.setattr(measure, "default_timer", fake_timer)
+    monkeypatch.setenv("GS_AUTOTUNE", "quick")
+    monkeypatch.setenv("GS_AUTOTUNE_CACHE", str(tmp_path / "tc"))
+    monkeypatch.setenv("GS_TPU_STATS", str(tmp_path / "stats.json"))
+
+    driver.run_once(_settings(tmp_path), n_devices=8)
+
+    stats = json.loads((tmp_path / "stats.json").read_text())
+    assert stats["config"]["autotune_mode"] == "quick"
+    prov = stats["config"]["kernel_selection"]["autotune"]
+    assert prov["mode"] == "quick"
+    assert prov["cache"] == "miss"
+    assert prov["source"] == "measured"
+    assert prov["candidates_timed"] >= 2
+    assert prov["tuning_s"] >= 0
+    assert prov["winner"]["comm_overlap"] is False  # the fake's winner
+    assert prov["measured_pick_us"] == 500.0
+    # the winner is on disk for the next run
+    assert os.path.isfile(prov["cache_path"])
+
+    # second run: cache hit, zero candidates timed, same winner
+    monkeypatch.setenv("GS_TPU_STATS", str(tmp_path / "stats2.json"))
+    s2 = _settings(tmp_path, output=str(tmp_path / "gs2.bp"))
+    driver.run_once(s2, n_devices=8)
+    prov2 = json.loads((tmp_path / "stats2.json").read_text())[
+        "config"]["kernel_selection"]["autotune"]
+    assert prov2["cache"] == "hit"
+    assert prov2["candidates_timed"] == 0
+    assert prov2["winner"] == prov["winner"]
+
+
+@requires8
+def test_supervised_restart_records_pick_identically(tmp_path):
+    """The supervise-path determinism contract: with a pre-warmed cache
+    fixture, a supervised run that eats a preemption and restarts must
+    record the same autotune provenance as an unfaulted supervised run,
+    and both must hit the cache (no re-measurement across attempts)."""
+    kind = jax.devices()[0].device_kind
+    cache_dir = tmp_path / "tc"
+    key = tune_cache.cache_key(
+        device_kind=kind, platform="cpu", dims=(2, 2, 2), L=32,
+        dtype="float32", noise=0.1, jax_version=jax.__version__,
+    )
+    tune_cache.store(key, {
+        "winner": {"kernel": "xla", "fuse": 2, "comm_overlap": True,
+                   "bx": None},
+        "created": "2026-08-04T00:00:00+00:00",
+    }, root=str(cache_dir))
+
+    provs = {}
+    for name, faults in (("clean", ""), ("faulted", "step=25:kind=preempt")):
+        d = tmp_path / name
+        d.mkdir()
+        cfg = write_config(
+            d, noise=0.1, steps=40, output="gs.bp",
+            checkpoint="true", checkpoint_freq=20,
+            kernel_language="Auto",
+        )
+        stats = d / "stats.json"
+        env = {
+            "GS_SUPERVISE": "1",
+            "GS_MAX_RESTARTS": "3",
+            "GS_RESTART_BACKOFF_S": "0.01",
+            "GS_AUTOTUNE": "cached",
+            "GS_AUTOTUNE_CACHE": str(cache_dir),
+            "GS_TPU_STATS": str(stats),
+        }
+        if faults:
+            env["GS_FAULTS"] = faults
+        res = run_cli(d, cfg, extra_env=env)
+        assert res.returncode == 0, res.stderr + res.stdout
+        provs[name] = json.loads(stats.read_text())[
+            "config"]["kernel_selection"]["autotune"]
+
+    assert provs["faulted"]["cache"] == "hit"
+    assert provs["faulted"] == provs["clean"]
+
+
+@requires8
+def test_cached_miss_cli_matches_off_cli(tmp_path):
+    """End-to-end bit-identity through the CLI: an Auto run in the
+    default cached mode with an empty cache writes byte-identical
+    stores to GS_AUTOTUNE=off (the pre-tuner behavior)."""
+    from test_async_io import _assert_trees_byte_identical
+
+    dirs = {}
+    for mode in ("cached", "off"):
+        d = tmp_path / mode
+        d.mkdir()
+        cfg = write_config(d, noise=0.1, steps=20, output="gs.bp",
+                           kernel_language="Auto")
+        res = run_cli(d, cfg, extra_env={
+            "GS_AUTOTUNE": mode,
+            "GS_AUTOTUNE_CACHE": str(d / "empty_cache"),
+        })
+        assert res.returncode == 0, res.stderr + res.stdout
+        dirs[mode] = d
+    _assert_trees_byte_identical(dirs["cached"] / "gs.bp",
+                                 dirs["off"] / "gs.bp")
+
+
+@requires8
+@pytest.mark.slow
+def test_quick_mode_real_measurement_fits_budget(tmp_path, monkeypatch):
+    """GS_AUTOTUNE=quick with REAL measurement on the CPU mesh
+    completes inside GS_AUTOTUNE_BUDGET_S plus compile slack (the
+    budget bounds when candidates start, not the last compile), and
+    produces a usable cached winner."""
+    from grayscott_jl_tpu.simulation import Simulation
+
+    budget = 60.0
+    monkeypatch.setenv("GS_AUTOTUNE", "quick")
+    monkeypatch.setenv("GS_AUTOTUNE_BUDGET_S", str(budget))
+    monkeypatch.setenv("GS_AUTOTUNE_STEPS", "5")
+    monkeypatch.setenv("GS_AUTOTUNE_CACHE", str(tmp_path / "tc"))
+    t0 = time.monotonic()
+    sim = Simulation(_settings(tmp_path), n_devices=8)
+    elapsed = time.monotonic() - t0
+    prov = sim.kernel_selection["autotune"]
+    assert prov["source"] == "measured"
+    assert prov["candidates_timed"] >= 1
+    assert prov["tuning_s"] <= budget + 30.0
+    assert elapsed <= budget + 60.0
+    sim.iterate(2)

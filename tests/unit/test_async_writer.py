@@ -258,8 +258,11 @@ def test_overlap_stats_split_hidden_vs_exposed():
     st = w.overlap_stats()
     busy = st["busy_s"]["output"]
     assert busy > 0
+    # busy/hidden/exposed are each independently rounded to 6 decimals
+    # in overlap_stats, so the identity holds only to the rounding
+    # quantum (1e-9 here flaked whenever the thirds rounded apart).
     assert st["hidden_s"]["output"] == pytest.approx(
-        busy - st["exposed_s"]["output"], abs=1e-9
+        busy - st["exposed_s"]["output"], abs=2e-6
     )
     # the writes fully drained behind the sleep: nearly all hidden
     assert st["hidden_s"]["output"] > 0

@@ -1,0 +1,446 @@
+"""Measured autotuner (``grayscott_jl_tpu/tune/``, ISSUE 4).
+
+Tier-1 contract, all with an injected fake timer (no real measurement
+here — real sweeps live in ``benchmarks/tune_sweep.py`` and behind
+``-m slow``):
+
+* cache: schema-version bump invalidates, key-field mismatch misses,
+  corrupt/truncated/wrong-shape entries degrade to the analytic pick
+  with a warning (the ``sidecar.py`` corrupt-marker discipline), and
+  writes are atomic (a simulated crash leaves no partial entry);
+* decision: ``GS_AUTOTUNE=off`` and the cached-miss path leave the
+  analytic ``select_kernel`` pick untouched — bit-identical trajectory
+  asserted against the Auto path with the tuner disabled;
+* quick mode: measures the gated shortlist, persists the winner,
+  replays it as a zero-measurement cache hit with identical provenance
+  across constructions (the restart-determinism contract).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config.settings import Settings, resolve_autotune
+from grayscott_jl_tpu.parallel import icimodel
+from grayscott_jl_tpu.simulation import Simulation
+from grayscott_jl_tpu.tune import autotuner, cache, candidates, measure
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+@pytest.fixture(autouse=True)
+def _tmp_cache(tmp_path, monkeypatch):
+    """Every test gets its own tuning-cache root; leaked state between
+    tests would make cache hits nondeterministic."""
+    root = tmp_path / "tune_cache"
+    monkeypatch.setenv("GS_AUTOTUNE_CACHE", str(root))
+    monkeypatch.delenv("GS_AUTOTUNE", raising=False)
+    yield root
+
+
+def _settings(**kw):
+    return Settings(
+        L=kw.pop("L", 16), Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0,
+        noise=kw.pop("noise", 0.1), precision="Float32", backend="CPU",
+        kernel_language=kw.pop("kernel_language", "Auto"), **kw,
+    )
+
+
+def _key(**kw):
+    base = dict(device_kind="TPU v5e", platform="tpu", dims=(2, 2, 2),
+                L=256, dtype="float32", noise=0.1,
+                jax_version=jax.__version__)
+    base.update(kw)
+    return cache.cache_key(**base)
+
+
+def _winner(**kw):
+    w = dict(kernel="xla", fuse=2, comm_overlap=True, bx=None)
+    w.update(kw)
+    return w
+
+
+def _fake_timer(us_by_label):
+    """A timer with the time_sim_rounds contract whose result depends
+    only on the candidate pinned into the probe sim's settings+env."""
+
+    def timer(sim, steps, rounds, deadline):
+        label = (
+            f"{sim.kernel_language}/fuse={os.environ['GS_FUSE']}/"
+            f"{'overlap' if sim.comm_overlap else 'fused'}"
+        )
+        us = us_by_label.get(label, 999999.0)
+        s = us / 1e6
+        return {"median": s, "best": s, "rounds_s_per_step": [s] * rounds}
+
+    return timer
+
+
+# ------------------------------------------------------- mode resolution
+
+def test_mode_resolution_env_wins_and_validates(monkeypatch):
+    assert resolve_autotune(_settings()) == "cached"
+    assert resolve_autotune(_settings(autotune="full")) == "full"
+    monkeypatch.setenv("GS_AUTOTUNE", "quick")
+    assert resolve_autotune(_settings(autotune="full")) == "quick"
+    monkeypatch.setenv("GS_AUTOTUNE", "vibes")
+    with pytest.raises(ValueError, match="GS_AUTOTUNE"):
+        resolve_autotune(_settings())
+
+
+def test_budget_resolution(monkeypatch):
+    assert autotuner.resolve_budget_s() == 120.0
+    monkeypatch.setenv("GS_AUTOTUNE_BUDGET_S", "7.5")
+    assert autotuner.resolve_budget_s() == 7.5
+    monkeypatch.setenv("GS_AUTOTUNE_BUDGET_S", "0")
+    with pytest.raises(ValueError, match="GS_AUTOTUNE_BUDGET_S"):
+        autotuner.resolve_budget_s()
+
+
+# --------------------------------------------------------- cache contract
+
+def test_cache_roundtrip_hit():
+    key = _key()
+    cache.store(key, {"winner": _winner()})
+    rec = cache.load(key)
+    assert rec is not None
+    assert rec["winner"]["fuse"] == 2
+    assert rec["key"] == key  # self-describing entry
+
+
+@pytest.mark.parametrize("field,value", [
+    ("L", 512), ("dims", (4, 2, 1)), ("dtype", "bfloat16"),
+    ("device_kind", "TPU v5p"), ("platform", "cpu"), ("noise", 0.0),
+    ("jax_version", "999.0"),
+])
+def test_cache_key_field_mismatch_misses(field, value):
+    cache.store(_key(), {"winner": _winner()})
+    assert cache.load(_key(**{field: value})) is None
+
+
+def test_schema_version_bump_invalidates(monkeypatch):
+    key = _key()
+    cache.store(key, {"winner": _winner()})
+    monkeypatch.setattr(cache, "SCHEMA_VERSION", cache.SCHEMA_VERSION + 1)
+    assert cache.load(_key()) is None  # new-schema key: structural miss
+    # and a forged new-schema filename still fails record verification
+    forged = _key()
+    old_entry = cache.entry_path(key)
+    new_entry = cache.entry_path(forged)
+    os.makedirs(os.path.dirname(new_entry), exist_ok=True)
+    import shutil
+
+    shutil.copy(old_entry, new_entry)
+    assert cache.load(forged) is None
+
+
+def test_corrupt_cache_degrades_with_warning(capsys):
+    key = _key()
+    path = cache.entry_path(key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"winner": {"kernel"')  # truncated mid-write
+    assert cache.load(key) is None
+    assert "tuning cache" in capsys.readouterr().err
+    # wrong shape (parses, but is not a record) degrades the same way
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(["not", "a", "record"], f)
+    assert cache.load(key) is None
+    assert "stale or malformed" in capsys.readouterr().err
+
+
+def test_atomic_write_survives_simulated_crash(monkeypatch):
+    key = _key()
+    path = cache.entry_path(key)
+    # a partial temp file from a crashed writer is never consulted
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path + ".tmp.12345", "w", encoding="utf-8") as f:
+        f.write('{"half a reco')
+    assert cache.load(key) is None
+    # a crash mid-serialization must leave no entry at all
+    real_dump = json.dump
+
+    def exploding_dump(obj, fp, **kw):
+        fp.write('{"winner": {')
+        raise OSError("disk full")
+
+    monkeypatch.setattr(json, "dump", exploding_dump)
+    with pytest.raises(OSError):
+        cache.store(key, {"winner": _winner()})
+    monkeypatch.setattr(json, "dump", real_dump)
+    assert not os.path.exists(path)
+    assert cache.load(key) is None
+    # and a later successful store wins cleanly
+    cache.store(key, {"winner": _winner()})
+    assert cache.load(key)["winner"] == _winner()
+
+
+# ------------------------------------------------------ candidate gating
+
+@pytest.fixture
+def _big_vmem():
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    prev = ps._VMEM_BUDGET
+    icimodel.pin_big_vmem()
+    yield
+    ps._VMEM_BUDGET = prev
+
+
+def _generate(**kw):
+    base = dict(dims=(2, 2, 2), L=256, platform="tpu", itemsize=4,
+                fuse_cap=5, analytic_kernel="xla", analytic_fuse=5,
+                comm_overlap=True, overlap_toggle=True, top_n=50)
+    base.update(kw)
+    return candidates.generate(**base)
+
+
+def test_candidates_off_tpu_excludes_pallas(_big_vmem):
+    cands = _generate(platform="cpu")
+    assert cands and all(c.kernel == "xla" for c in cands)
+    assert any(c.analytic for c in cands)
+    # overlap toggle doubles the sharded space
+    assert {c.comm_overlap for c in cands} == {True, False}
+
+
+def test_candidates_respect_pinned_overlap(_big_vmem):
+    cands = _generate(platform="cpu", overlap_toggle=False,
+                      comm_overlap=False)
+    assert {c.comm_overlap for c in cands} == {False}
+
+
+def test_candidates_tpu_include_gated_pallas_depths(_big_vmem):
+    cands = _generate()
+    pallas = [c for c in cands if c.kernel == "pallas"]
+    assert pallas, "Mosaic-feasible shape must yield Pallas candidates"
+    assert all(c.fuse >= 2 for c in pallas)  # sharded chain needs k>=2
+    assert all(c.fuse in icimodel.FUSE_COST_RATIO for c in pallas)
+
+
+def test_candidates_lane_misaligned_shape_excludes_pallas(_big_vmem):
+    # L=64 over (1,1,1): local z extent 64 misses the 128-lane tiling
+    cands = _generate(dims=(1, 1, 1), L=64, analytic_fuse=2)
+    assert all(c.kernel == "xla" for c in cands)
+
+
+def test_candidates_analytic_pick_always_present(_big_vmem):
+    cands = _generate(top_n=1)
+    assert sum(1 for c in cands if c.analytic) == 1
+    assert cands[0].analytic  # shortlist leads with the model's pick
+
+
+def test_candidate_dict_roundtrip():
+    c = candidates.Candidate(kernel="pallas", fuse=4, comm_overlap=True,
+                             bx=8, projected_step_us=123.456)
+    d = c.as_dict()
+    assert d["projected_step_us"] == 123.5
+    rt = candidates.from_dict(dict(d, future_field="ignored"))
+    assert rt.kernel == "pallas" and rt.bx == 8
+
+
+# --------------------------------------------- decision paths (fake timer)
+
+def _autotune(settings, mode, timer=None, dims=(2, 2, 2), **kw):
+    base = dict(
+        dims=dims, L=settings.L, platform="cpu", device_kind="cpu",
+        dtype="float32", noise=settings.noise, itemsize=4,
+        n_devices=8, seed=0, analytic_kernel="xla", analytic_fuse=2,
+        comm_overlap=True, overlap_toggle=True,
+    )
+    base.update(kw)
+    os.environ["GS_AUTOTUNE"] = mode
+    try:
+        return autotuner.autotune(settings, timer=timer, **base)
+    finally:
+        os.environ.pop("GS_AUTOTUNE", None)
+
+
+def test_off_and_cached_miss_keep_the_analytic_pick():
+    s = _settings()
+    off = _autotune(s, "off")
+    miss = _autotune(s, "cached")
+    for d in (off, miss):
+        assert d.kernel == "xla"
+        assert d.fuse is None and d.comm_overlap is None and d.bx is None
+        assert d.provenance["source"] == "analytic"
+        assert d.provenance["candidates_timed"] == 0
+    assert off.provenance["cache"] is None  # off never even reads it
+    assert miss.provenance["cache"] == "miss"
+
+
+def test_quick_mode_measures_persists_and_replays():
+    s = _settings()
+    timer = _fake_timer({
+        "xla/fuse=2/overlap": 900.0,  # the analytic pick
+        "xla/fuse=2/fused": 700.0,    # the measured winner
+        "xla/fuse=1/overlap": 950.0,
+    })
+    d = _autotune(s, "quick", timer=timer)
+    assert d.provenance["source"] == "measured"
+    assert d.provenance["cache"] == "miss"
+    assert d.provenance["candidates_timed"] >= 2
+    assert d.provenance["tuning_s"] >= 0
+    assert (d.kernel, d.fuse, d.comm_overlap) == ("xla", 2, False)
+    assert d.provenance["model_pick_us"] == 900.0
+    assert d.provenance["measured_pick_us"] == 700.0
+    assert d.provenance["model_vs_measured_speedup"] == pytest.approx(
+        900.0 / 700.0, abs=1e-3
+    )
+
+    # replay: zero measurement, same decision, stable provenance
+    hits = [_autotune(s, "cached"), _autotune(s, "cached")]
+    for h in hits:
+        assert h.provenance["cache"] == "hit"
+        assert h.provenance["candidates_timed"] == 0
+        assert h.provenance["tuning_s"] == 0.0
+        assert (h.kernel, h.fuse, h.comm_overlap) == ("xla", 2, False)
+    assert hits[0].provenance == hits[1].provenance  # restart-identical
+
+
+def test_quick_mode_budget_exhaustion_reports_skips():
+    s = _settings()
+
+    def slow_timer(sim, steps, rounds, deadline):
+        import time
+
+        time.sleep(0.05)
+        return {"median": 1e-3, "best": 1e-3,
+                "rounds_s_per_step": [1e-3]}
+
+    os.environ["GS_AUTOTUNE_BUDGET_S"] = "0.01"
+    try:
+        d = _autotune(s, "quick", timer=slow_timer)
+    finally:
+        os.environ.pop("GS_AUTOTUNE_BUDGET_S", None)
+    # the first candidate always completes; the rest are budget-skipped
+    assert d.provenance["candidates_timed"] == 1
+    assert d.provenance["candidates_skipped"] >= 1
+    assert d.provenance["source"] == "measured"
+
+
+def test_quick_mode_all_failures_degrade_to_analytic():
+    s = _settings()
+
+    def broken_timer(sim, steps, rounds, deadline):
+        raise RuntimeError("no backend today")
+
+    d = _autotune(s, "quick", timer=broken_timer)
+    assert d.provenance["source"] == "analytic"
+    assert d.kernel == "xla"
+    assert d.provenance["candidates_errored"] >= 1
+    assert d.provenance["candidates_timed"] == 0
+
+
+def test_cached_mode_corrupt_entry_degrades_to_analytic(capsys):
+    s = _settings()
+    key = cache.cache_key(
+        device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=s.L,
+        dtype="float32", noise=s.noise, jax_version=jax.__version__,
+    )
+    path = cache.entry_path(key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("{corrupt")
+    d = _autotune(s, "cached")
+    assert d.provenance["source"] == "analytic"
+    assert "tuning cache" in capsys.readouterr().err
+
+
+# ------------------------------------------- Simulation-level determinism
+
+@requires8
+def test_cached_miss_trajectory_bit_identical_to_off(monkeypatch):
+    """The acceptance bit: with an empty cache, the default (cached)
+    mode must produce the SAME pick and a byte-identical trajectory to
+    GS_AUTOTUNE=off — i.e. to pre-tuner HEAD behavior."""
+    runs = {}
+    for mode in ("cached", "off"):
+        monkeypatch.setenv("GS_AUTOTUNE", mode)
+        sim = Simulation(_settings(), n_devices=8, seed=3)
+        sim.iterate(4)
+        runs[mode] = (sim.kernel_language, sim._fuse_base(),
+                      sim.comm_overlap, sim.get_fields())
+    assert runs["cached"][:3] == runs["off"][:3]
+    for a, b in zip(runs["cached"][3], runs["off"][3]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@requires8
+def test_cache_fixture_hit_applies_winner_and_is_restart_stable(
+    monkeypatch,
+):
+    """A committed-cache-style fixture whose winner coincides with the
+    analytic config: the hit run must be byte-identical to off, and
+    two constructions (the supervisor-restart shape) must record the
+    pick identically."""
+    s = _settings()
+    kind = jax.devices()[0].device_kind
+    key = cache.cache_key(
+        device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
+        dtype="float32", noise=s.noise, jax_version=jax.__version__,
+    )
+    # the analytic config on this mesh: xla, depth 2 (CPU default),
+    # split-phase on (sharded default)
+    cache.store(key, {"winner": _winner(fuse=2, comm_overlap=True),
+                      "created": "2026-08-04T00:00:00+00:00"})
+
+    monkeypatch.setenv("GS_AUTOTUNE", "cached")
+    hit = Simulation(s, n_devices=8, seed=3)
+    assert hit.kernel_selection["autotune"]["cache"] == "hit"
+    assert hit.kernel_language == "xla"
+    assert hit._fuse_base() == 2 and hit.comm_overlap is True
+    hit.iterate(4)
+
+    monkeypatch.setenv("GS_AUTOTUNE", "off")
+    ref = Simulation(s, n_devices=8, seed=3)
+    ref.iterate(4)
+    for a, b in zip(hit.get_fields(), ref.get_fields()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    monkeypatch.setenv("GS_AUTOTUNE", "cached")
+    again = Simulation(s, n_devices=8, seed=3)
+    assert (again.kernel_selection["autotune"]
+            == hit.kernel_selection["autotune"])
+
+
+@requires8
+def test_cache_hit_overrides_toward_measured_winner(monkeypatch):
+    """A winner that differs from the analytic pick (overlap off,
+    depth 1) must actually steer the constructed run."""
+    s = _settings()
+    kind = jax.devices()[0].device_kind
+    key = cache.cache_key(
+        device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
+        dtype="float32", noise=s.noise, jax_version=jax.__version__,
+    )
+    cache.store(key, {"winner": _winner(fuse=1, comm_overlap=False)})
+    monkeypatch.setenv("GS_AUTOTUNE", "cached")
+    sim = Simulation(s, n_devices=8, seed=3)
+    assert sim._fuse_base() == 1
+    assert sim.comm_overlap is False
+    sim.iterate(2)  # and the steered config actually runs
+    assert np.isfinite(sim.get_fields()[0]).all()
+
+
+@requires8
+def test_operator_pins_beat_the_cache(monkeypatch):
+    """GS_FUSE and a pinned comm_overlap setting are operator
+    decisions; a cache hit must not override them."""
+    s = _settings(comm_overlap="on")
+    kind = jax.devices()[0].device_kind
+    key = cache.cache_key(
+        device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
+        dtype="float32", noise=s.noise, jax_version=jax.__version__,
+    )
+    cache.store(key, {"winner": _winner(fuse=1, comm_overlap=False)})
+    monkeypatch.setenv("GS_AUTOTUNE", "cached")
+    monkeypatch.setenv("GS_FUSE", "3")
+    sim = Simulation(s, n_devices=8, seed=3)
+    assert sim._fuse_base() == 3  # GS_FUSE wins
+    assert sim.comm_overlap is True  # pinned setting wins
