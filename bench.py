@@ -444,46 +444,96 @@ def main() -> None:
     # non-accelerator platform, or when the horizon is disabled.
     reprobes = 0
     if will_reprobe:
-        loop_t0 = time.monotonic()
-        while time.monotonic() - t0 < TPU_HORIZON:
-            if time.monotonic() - loop_t0 >= PROBE_BUDGET:
-                # The late-probe loop has its own wall cap
-                # (GS_BENCH_PROBE_BUDGET): riding the full horizon is
-                # only worth it while probing is cheap — a wedged
-                # tunnel makes every dial cost the probe timeout.
-                print(
-                    f"bench: late-probe budget "
-                    f"({PROBE_BUDGET:.0f}s) exhausted after "
-                    f"{reprobes} probes",
-                    file=sys.stderr,
-                )
-                break
-            wait = min(REPROBE_DELAY,
-                       max(0.0, TPU_HORIZON - (time.monotonic() - t0)))
-            if wait <= 0:
-                break
-            time.sleep(wait)
-            plat, _perr = probe_once()
-            reprobes += 1
-            print(
-                f"bench: late probe {reprobes}: "
-                f"{plat or 'down'} at t+{time.monotonic() - t0:.0f}s",
-                file=sys.stderr,
+        # Hang watchdog over the whole late-probe loop (the package's
+        # resilience watchdog — jax-free, so the no-jax-in-parent rule
+        # holds): the in-loop budget check below bounds the loop
+        # BETWEEN dials, but a single wedged dial can stall inside
+        # subprocess plumbing past every timeout (r05 burned 19+ min
+        # that way). On expiry the monitor journals the event with
+        # all-thread stacks and interrupts this loop, which abandons
+        # probing with the error recorded instead of silently stalling
+        # the artifact run.
+        from grayscott_jl_tpu.resilience.supervisor import FaultJournal
+        from grayscott_jl_tpu.resilience.watchdog import Watchdog
+
+        journal = FaultJournal(os.environ.get("GS_FAULT_JOURNAL"))
+        wd = Watchdog(
+            {"probe_loop": PROBE_BUDGET + PROBE_TIMEOUT},
+            journal=journal, grace_s=0,
+        ).start()
+        wd.heartbeat("probe_loop")
+        try:
+            reprobes = _late_probe_loop(t0, measure_accelerator, errors, wd)
+        except KeyboardInterrupt:
+            if not wd.expired:
+                raise
+            errors.append(
+                "probe loop abandoned by watchdog after "
+                f"{PROBE_BUDGET + PROBE_TIMEOUT:.0f}s (wedged dial; "
+                "stacks in the fault journal)"
             )
-            if plat in ("tpu", "gpu"):
-                result, errs, wedged = measure_accelerator(plat)
-                errors += errs
-                if result is not None:
-                    result["late_probe_recovery_s"] = round(
-                        time.monotonic() - t0, 1
-                    )
-                    emit(result, error="; ".join(errors) if errors else None)
-                    return
-                if wedged:
-                    break  # mid-run wedge: stop dialing entirely
+            print(f"bench: {errors[-1]}", file=sys.stderr)
+        else:
+            if reprobes < 0:  # accelerator success already emitted
+                return
+        finally:
+            wd.stop()
     if reprobes:
         errors.append(f"tpu still unavailable after {reprobes} late probes")
     emit(cpu_result, error="; ".join(errors))
+
+
+def _late_probe_loop(t0, measure_accelerator, errors, wd) -> int:
+    """The bounded late-probe loop; returns the probe count, or -1 when
+    an accelerator measurement succeeded (and was emitted). ``wd`` is
+    the probe-loop watchdog: each completed dial re-arms it (touch), so
+    only a dial wedged past GS_BENCH_PROBE_BUDGET + the probe timeout
+    trips it."""
+    reprobes = 0
+    loop_t0 = time.monotonic()
+    while time.monotonic() - t0 < TPU_HORIZON:
+        if time.monotonic() - loop_t0 >= PROBE_BUDGET:
+            # The late-probe loop has its own wall cap
+            # (GS_BENCH_PROBE_BUDGET): riding the full horizon is
+            # only worth it while probing is cheap — a wedged
+            # tunnel makes every dial cost the probe timeout.
+            print(
+                f"bench: late-probe budget "
+                f"({PROBE_BUDGET:.0f}s) exhausted after "
+                f"{reprobes} probes",
+                file=sys.stderr,
+            )
+            break
+        wait = min(REPROBE_DELAY,
+                   max(0.0, TPU_HORIZON - (time.monotonic() - t0)))
+        if wait <= 0:
+            break
+        time.sleep(wait)
+        plat, _perr = probe_once()
+        reprobes += 1
+        wd.touch("probe_loop", reprobes)
+        print(
+            f"bench: late probe {reprobes}: "
+            f"{plat or 'down'} at t+{time.monotonic() - t0:.0f}s",
+            file=sys.stderr,
+        )
+        if plat in ("tpu", "gpu"):
+            # The measurement has its own hard subprocess bound
+            # (GS_BENCH_RUN_TIMEOUT) and may legitimately outlast the
+            # probe-loop deadline — disarm for its duration.
+            wd.disarm()
+            result, errs, wedged = measure_accelerator(plat)
+            wd.heartbeat("probe_loop", reprobes)
+            errors += errs
+            if result is not None:
+                result["late_probe_recovery_s"] = round(
+                    time.monotonic() - t0, 1
+                )
+                emit(result, error="; ".join(errors) if errors else None)
+                return -1
+            if wedged:
+                break  # mid-run wedge: stop dialing entirely
+    return reprobes
 
 
 if __name__ == "__main__":

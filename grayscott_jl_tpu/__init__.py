@@ -39,13 +39,29 @@ def main(args):
 
 def julia_main(args=None) -> int:
     """Exit-code wrapper (reference ``GrayScott.julia_main``,
-    ``src/GrayScott.jl:40-48``)."""
+    ``src/GrayScott.jl:40-48``).
+
+    Extension beyond the reference's 0/1: a preemption-aware graceful
+    shutdown (SIGTERM/SIGINT -> boundary checkpoint -> drain,
+    ``resilience/faults.GracefulShutdown``) exits with the distinct
+    ``EXIT_PREEMPTED`` code so a relauncher can tell "resume me" from
+    "failed" (docs/RESILIENCE.md).
+    """
     import sys
     import traceback
 
     try:
         main(sys.argv[1:] if args is None else args)
-    except Exception:  # noqa: BLE001 — mirror reference catch-all
+    except Exception as e:  # noqa: BLE001 — mirror reference catch-all
+        from .resilience.faults import EXIT_PREEMPTED, GracefulShutdown
+
+        if isinstance(e, GracefulShutdown):
+            print(
+                f"gray-scott: {e}; exiting {EXIT_PREEMPTED} "
+                "(rerun under GS_SUPERVISE=1 to auto-resume)",
+                file=sys.stderr,
+            )
+            return EXIT_PREEMPTED
         traceback.print_exc()
         return 1
     return 0

@@ -64,6 +64,20 @@ class Settings:
     max_restarts: int = 3
     health_policy: str = "abort"
     faults: str = ""
+    #: Hang watchdog (extension; resilience/watchdog.py): "auto"
+    #: (default) arms it exactly when supervision is armed, "on"/"off"
+    #: force it; GS_WATCHDOG env wins. watchdog_deadline_s overrides
+    #: every per-phase deadline at once (0 = built-in per-phase
+    #: defaults); GS_WATCHDOG_DEADLINE_S / GS_WATCHDOG_<PHASE>_S win.
+    watchdog: str = "auto"
+    watchdog_deadline_s: float = 0.0
+    #: Preemption-aware graceful shutdown (extension; docs/RESILIENCE.md):
+    #: SIGTERM/SIGINT request a checkpoint at the next boundary, drain
+    #: the async writer, close the stores, and exit with the distinct
+    #: preemption code (75) for relauncher auto-resume. A second signal
+    #: forces the old immediate-kill behavior. GS_GRACEFUL_SHUTDOWN
+    #: env wins.
+    graceful_shutdown: bool = True
     #: Split-phase halo exchange (extension; docs/OVERLAP.md): issue the
     #: boundary ppermutes first and let XLA's async collective-permute
     #: machinery schedule the ICI transfer under the interior compute,

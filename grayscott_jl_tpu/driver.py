@@ -107,17 +107,10 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     from .resilience import supervisor
 
     if supervisor.supervision_enabled(settings):
-        import jax
-
-        if jax.process_count() > 1:
-            # Restarting one rank of a collective leaves the others
-            # wedged in ppermutes; pods need an external restarter that
-            # relaunches all ranks together (docs/RESILIENCE.md).
-            raise RuntimeError(
-                "GS_SUPERVISE is per-process and cannot supervise a "
-                f"{jax.process_count()}-process run; use an external "
-                "restarter that relaunches all ranks together"
-            )
+        # Multi-host runs are supervised for real (the old per-process
+        # refusal is gone): classified failures rendezvous on a quorum
+        # restart step so all ranks restart together
+        # (resilience/rendezvous.py, docs/RESILIENCE.md).
         return supervisor.supervise(settings, n_devices=n_devices, seed=seed)
     return run_once(settings, n_devices=n_devices, seed=seed)
 
@@ -171,15 +164,14 @@ def run_once(
     runs build their own from the environment. Raises on failure —
     classification and recovery live in the supervisor, not here.
     """
-    import jax
-
     from .resilience.faults import (
         FaultPlan,
-        InjectedKernelError,
-        PreemptionError,
+        ShutdownListener,
+        resolve_graceful_shutdown,
     )
     from .resilience.health import HealthGuard
     from .resilience.supervisor import FaultJournal
+    from .resilience.watchdog import Watchdog, resolve_watchdog
 
     if context is not None:
         plan, journal = context.plan, context.journal
@@ -188,6 +180,59 @@ def run_once(
         journal = FaultJournal.from_env(settings)
     guard = HealthGuard.from_env(settings)
 
+    # Hang watchdog + graceful-shutdown listener bracket the whole
+    # attempt: the watchdog's "compile" deadline must already be armed
+    # while the Simulation constructor jits (and autotunes), and a
+    # SIGTERM during compile should still exit through the graceful
+    # path at the first boundary.
+    deadlines = resolve_watchdog(settings)
+    wd = Watchdog(deadlines, journal=journal).start() if deadlines else None
+    shutdown = ShutdownListener(
+        enabled=resolve_graceful_shutdown(settings), watchdog=wd
+    ).install()
+    try:
+        return _run_once_inner(
+            settings, n_devices=n_devices, seed=seed, context=context,
+            plan=plan, journal=journal, guard=guard, wd=wd,
+            shutdown=shutdown,
+        )
+    except BaseException as exc:
+        # A watchdog expiry unwinds as KeyboardInterrupt (the monitor's
+        # interrupt_main, possibly re-raised through the shutdown
+        # listener); surface it as the classified hang it is.
+        if (wd is not None and wd.expired is not None
+                and isinstance(exc, KeyboardInterrupt)):
+            wd.check()  # raises HangError with the expired phase/step
+        raise
+    finally:
+        shutdown.uninstall()
+        if wd is not None:
+            wd.stop()
+
+
+def _run_once_inner(
+    settings: Settings,
+    *,
+    n_devices: Optional[int],
+    seed: int,
+    context,
+    plan,
+    journal,
+    guard,
+    wd,
+    shutdown,
+):
+    import jax
+
+    from .resilience.faults import (
+        GracefulShutdown,
+        InjectedKernelError,
+        PreemptionError,
+        injected_hang_wait,
+    )
+
+    if wd is not None:
+        wd.heartbeat("compile")
     sim = Simulation(settings, n_devices=n_devices, seed=seed)
     log = Logger(verbose=settings.verbose)
     proc, nprocs = jax.process_index(), jax.process_count()
@@ -247,17 +292,65 @@ def run_once(
         # explicitly-pinned kernel languages (where no tuning runs):
         # a stats reader can tell "not tuned" from "tuner off".
         "autotune_mode": resolve_autotune(settings),
+        "process_index": proc,
     })
     from .parallel import icimodel
 
     stats.record_comm(icimodel.comm_report(sim))
-    pipe = AsyncStepWriter(stats=stats)
+    stats.record_watchdog(
+        wd.describe() if wd is not None else {"enabled": False}
+    )
+    # The watchdog's drain heartbeat: while close() drains K queued
+    # steps, each completed write re-arms the "drain" deadline (touch
+    # only re-arms the currently armed phase, so mid-run worker writes
+    # never mask a wedged driver).
+    pipe = AsyncStepWriter(
+        stats=stats,
+        progress=(lambda s: wd.touch("drain", s)) if wd is not None else None,
+    )
     stats.config["async_io_depth"] = pipe.depth
     step = restart_step
+    first_round = True
+
+    def _graceful(at_step: int, ckpt_written: bool):
+        """The preemption grace path: checkpoint NOW (off-schedule if
+        needed), drain every accepted step durably, journal the resume
+        marker, and exit via GracefulShutdown — the distinct
+        EXIT_PREEMPTED code upstream tells the relauncher 'resume me'.
+        """
+        ckpt_step = None
+        if ckpt is not None:
+            if not ckpt_written:
+                if wd is not None:
+                    wd.heartbeat("checkpoint", at_step)
+                snap = sim.snapshot_async()
+                pipe.submit(at_step, snap, [("checkpoint", ckpt.save)])
+                stats.count("checkpoints")
+                log.info(
+                    f"Graceful-shutdown checkpoint accepted at step {at_step}"
+                )
+            ckpt_step = at_step
+        journal.record(
+            event="graceful_shutdown", signal=shutdown.signum,
+            step=at_step, checkpoint_step=ckpt_step,
+        )
+        if wd is not None:
+            wd.heartbeat("drain", at_step)
+        pipe.close()
+        raise GracefulShutdown(shutdown.signum, at_step, ckpt_step)
+
     t0 = time.perf_counter()
     try:
         with trace(), pipe:
             while step < settings.steps:
+                if wd is not None:
+                    # The first round pays jit (and, under Auto, any
+                    # remaining autotune measurement) — its budget is
+                    # the compile deadline, every later round the much
+                    # tighter step_round one.
+                    wd.heartbeat(
+                        "compile" if first_round else "step_round", step
+                    )
                 boundary = min(
                     _next_boundary(step, settings.plotgap, settings.steps),
                     _next_boundary(
@@ -284,6 +377,7 @@ def run_once(
                     sim.block_until_ready()
                 stats.count("steps", boundary - step)
                 step = boundary
+                first_round = False
 
                 fault = plan.take("nan", step)
                 if fault is not None:
@@ -307,6 +401,19 @@ def run_once(
                         f"injected preemption at step {step} "
                         f"(planned step {fault.step})"
                     )
+                fault = plan.take("hang", step)
+                if fault is not None:
+                    # The wedged-collective / dead-tunnel shape: stall
+                    # the driver thread at the boundary. Under an armed
+                    # watchdog the step_round deadline expires
+                    # mid-stall and the stall unwinds as HangError;
+                    # unwatched, the bounded stall resolves and the run
+                    # continues (faults change WHEN, never WHAT).
+                    journal.record(
+                        event="injected", kind="hang", step=step,
+                        planned_step=fault.step,
+                    )
+                    injected_hang_wait(watchdog=wd, shutdown=shutdown)
 
                 at_plot = (
                     settings.plotgap > 0 and step % settings.plotgap == 0
@@ -317,7 +424,11 @@ def run_once(
                     and step % settings.checkpoint_freq == 0
                 )
                 if not (at_plot or at_ckpt):
+                    if shutdown.requested:
+                        _graceful(step, ckpt_written=False)
                     continue
+                if wd is not None:
+                    wd.heartbeat("io", step)
                 targets = []
                 if at_plot:
                     log.info(
@@ -352,10 +463,17 @@ def run_once(
                 if at_ckpt:
                     stats.count("checkpoints")
                     log.info(f"Checkpoint accepted at step {step}")
+                if shutdown.requested:
+                    # After this boundary's scheduled writes so the
+                    # resumed run reproduces the uninterrupted output
+                    # stream byte-for-byte.
+                    _graceful(step, ckpt_written=at_ckpt)
 
             # Drain INSIDE the timed region: the run is complete only
             # once every accepted step is durable (close re-raises a
             # writer failure with the failing step identified).
+            if wd is not None:
+                wd.heartbeat("drain", step)
             pipe.close()
 
         elapsed = time.perf_counter() - t0
@@ -366,6 +484,10 @@ def run_once(
             f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
         )
         stats.record_io(pipe.overlap_stats())
+        if wd is not None:
+            # Re-record with the final heartbeat count (the pre-loop
+            # record only captured the armed deadlines).
+            stats.record_watchdog(wd.describe())
         if journal.events:
             stats.record_faults(journal.events)
         stats.maybe_write()

@@ -119,11 +119,21 @@ class AsyncStepWriter:
     given, driver-side time is recorded under the target phase names
     (inline write time when synchronous, submit/backpressure time when
     async) and the drain under ``io_drain``.
+
+    ``progress`` is an optional ``progress(step)`` callback invoked
+    from the worker thread after each fully written step — the hang
+    watchdog's drain heartbeat (``resilience/watchdog.Watchdog.touch``):
+    a close() draining K queued steps is healthy as long as individual
+    writes keep completing, and only a *stuck* write should trip the
+    drain deadline. Exceptions from the callback are swallowed — a
+    monitoring hook must never poison the store path.
     """
 
-    def __init__(self, *, depth: Optional[int] = None, stats=None):
+    def __init__(self, *, depth: Optional[int] = None, stats=None,
+                 progress=None):
         self.depth = resolve_depth(depth)
         self._stats = stats
+        self._progress = progress
         self._busy: dict = {}
         self._busy_lock = threading.Lock()
         self._submit_wait = 0.0
@@ -169,6 +179,11 @@ class AsyncStepWriter:
             fn(step, blocks)
             self._add_busy(phase, time.perf_counter() - t)
         self._written += 1
+        if self._progress is not None:
+            try:
+                self._progress(step)
+            except Exception:  # noqa: BLE001 — monitoring must not kill writes
+                pass
 
     def _run(self) -> None:
         while True:

@@ -78,6 +78,30 @@ class CheckpointWriter:
         self.writer.close()
 
 
+def latest_durable_step(path: str) -> Optional[int]:
+    """Simulation step of the latest *complete* checkpoint entry in
+    ``path``, or None (missing/empty store).
+
+    The BP-lite reader validates every step entry against the payload
+    file sizes and exposes only complete steps, so whatever this
+    returns is safe to resume from — the supervisor's per-host
+    "latest durable checkpoint" and the multi-host checkpoint quorum
+    (``resilience/rendezvous.py``: cluster ``min`` of these) are both
+    built on it.
+    """
+    try:
+        r = BpReader(path)
+    except FileNotFoundError:
+        return None
+    try:
+        n = r.num_steps()
+        if n == 0:
+            return None
+        return int(r.get("step", step=n - 1))
+    finally:
+        r.close()
+
+
 def open_checkpoint(
     path: str, settings: Settings, restart_step: int = -1
 ) -> Tuple[BpReader, int, int]:

@@ -1,26 +1,42 @@
 """Resilience subsystem: supervised runs that survive their failures.
 
-Three cooperating parts (see docs/RESILIENCE.md for the operator view):
+Five cooperating parts (see docs/RESILIENCE.md for the operator view):
 
 * :mod:`.faults` — a deterministic, replayable fault-injection plan
   (``GS_FAULTS``): transient I/O errors, NaN poisoning, preemption,
-  Pallas kernel failure, each fired once at a chosen step;
+  Pallas kernel failure, driver hangs — each fired once at a chosen
+  step — plus the preemption-aware graceful-shutdown pieces
+  (``ShutdownListener``, ``GracefulShutdown``, the distinct
+  ``EXIT_PREEMPTED``/``EXIT_HANG`` process exit codes);
 * :mod:`.health` — a fused device-side ``isfinite``/range probe on the
   snapshot path with an ``abort`` / ``rollback`` / ``warn`` policy
   (``GS_HEALTH_POLICY``);
+* :mod:`.watchdog` — per-phase deadlines over driver heartbeats
+  (``GS_WATCHDOG*``): on expiry, all-thread stack dump into the
+  journal, a classified transient ``hang`` teardown, and (for C-level
+  wedges) a hard exit the next launch auto-resumes from;
+* :mod:`.rendezvous` — multi-host restart consensus: cluster-wide
+  attempt counter (max) and checkpoint quorum (min latest-durable
+  step), over the JAX coordination-service KV or a shared directory;
 * :mod:`.supervisor` — ``supervise(settings)`` wraps
   ``driver.run_once`` with failure classification, exponential backoff
-  with deterministic jitter, checkpoint auto-resume, Pallas->XLA
-  degradation, and a JSONL fault journal merged into ``RunStats``.
+  with deterministic jitter, (quorum) checkpoint auto-resume,
+  Pallas->XLA degradation, and a durable JSONL fault journal merged
+  into ``RunStats``.
 """
 
 from .faults import (  # noqa: F401
+    EXIT_HANG,
+    EXIT_PREEMPTED,
     FAULT_KINDS,
     Fault,
     FaultPlan,
+    GracefulShutdown,
     InjectedIOError,
     InjectedKernelError,
     PreemptionError,
+    ShutdownListener,
+    injected_hang_wait,
 )
 from .health import (  # noqa: F401
     HealthError,
@@ -33,6 +49,12 @@ from .supervisor import (  # noqa: F401
     SupervisorContext,
     classify_failure,
     latest_durable_checkpoint,
+    resume_marker,
     supervise,
     supervision_enabled,
+)
+from .watchdog import (  # noqa: F401
+    HangError,
+    Watchdog,
+    resolve_watchdog,
 )

@@ -39,24 +39,58 @@ Fault kinds:
     it classifies like a real Pallas runtime failure) inside the
     compute phase. Only armed while the resolved kernel language is
     ``pallas`` — the supervisor's recovery is to degrade to XLA.
+``hang``
+    Stalls the driver thread at the boundary (:func:`injected_hang_wait`
+    — small-chunk sleeps, bounded by ``GS_HANG_BOUND_S`` so an
+    unwatched run stalls briefly instead of wedging forever). Under an
+    armed watchdog (``resilience/watchdog.py``) the deadline expires
+    mid-stall, the all-thread stack dump lands in the journal, and the
+    stall unwinds as a :class:`~.watchdog.HangError` — the wedged-
+    collective / dead-tunnel shape, chaos-testable without a real
+    wedge.
+
+This module also hosts the preemption-aware graceful-shutdown pieces
+(they share the failure taxonomy): :class:`ShutdownListener` turns
+SIGTERM/SIGINT into a boundary-checked request, and
+:class:`GracefulShutdown` is the exit the driver raises after the
+grace-window checkpoint + drain — mapped to the distinct
+:data:`EXIT_PREEMPTED` process exit code so an external relauncher can
+tell "preempted, resume me" from "failed".
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import signal
+import threading
+import time
 from typing import List, Optional
 
 __all__ = [
+    "EXIT_HANG",
+    "EXIT_PREEMPTED",
     "FAULT_KINDS",
     "Fault",
     "FaultPlan",
+    "GracefulShutdown",
     "InjectedIOError",
     "InjectedKernelError",
     "PreemptionError",
+    "ShutdownListener",
+    "injected_hang_wait",
+    "resolve_graceful_shutdown",
 ]
 
-FAULT_KINDS = ("io_error", "nan", "preempt", "kernel")
+FAULT_KINDS = ("io_error", "nan", "preempt", "kernel", "hang")
+
+#: Distinct process exit codes, chosen from the sysexits "temporary
+#: failure" neighborhood so generic tooling reads them as retryable:
+#: a graceful preemption exit (checkpoint written, resume me) ...
+EXIT_PREEMPTED = 75
+#: ... and the watchdog's hard hang exit (stacks + ``hang_exit`` marker
+#: journaled; resume me from the last durable checkpoint).
+EXIT_HANG = 76
 
 
 class InjectedIOError(OSError):
@@ -65,6 +99,139 @@ class InjectedIOError(OSError):
 
 class PreemptionError(RuntimeError):
     """The run lost its chip grant / received SIGTERM at a boundary."""
+
+
+class GracefulShutdown(PreemptionError):
+    """The run shut itself down cleanly after a shutdown request.
+
+    Raised by the driver at the first boundary after SIGTERM/SIGINT,
+    *after* the grace-window checkpoint is durable and the async writer
+    drained. A ``PreemptionError`` subclass so it classifies as
+    ``preemption`` — but the supervisor never restarts it in-process
+    (the scheduler wants the process gone); it propagates to the CLI,
+    which exits :data:`EXIT_PREEMPTED`. The journal's
+    ``graceful_shutdown`` marker makes the next supervised launch
+    auto-resume (``supervisor.resume_marker``).
+    """
+
+    def __init__(self, signum: int, step: int,
+                 checkpoint_step: Optional[int] = None):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        ck = (
+            f"checkpoint durable at step {checkpoint_step}"
+            if checkpoint_step is not None
+            else "no checkpoint store configured"
+        )
+        super().__init__(
+            f"graceful shutdown on {name} at step {step} ({ck})"
+        )
+        self.signum = signum
+        self.step = step
+        self.checkpoint_step = checkpoint_step
+
+
+def resolve_graceful_shutdown(settings=None) -> bool:
+    """``GS_GRACEFUL_SHUTDOWN`` env, else the ``graceful_shutdown``
+    TOML key, default on."""
+    raw = os.environ.get("GS_GRACEFUL_SHUTDOWN")
+    if raw is not None:
+        val = raw.strip().lower()
+        if val in ("1", "true", "yes", "on"):
+            return True
+        if val in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(
+            f"GS_GRACEFUL_SHUTDOWN must be a boolean, got {raw!r}"
+        )
+    return bool(getattr(settings, "graceful_shutdown", True))
+
+
+class ShutdownListener:
+    """SIGTERM/SIGINT -> a boundary-checked shutdown request.
+
+    The first signal only sets a flag — the driver finishes the
+    in-flight compute chunk, writes a grace-window checkpoint at the
+    boundary, drains the async writer, and raises
+    :class:`GracefulShutdown`. A second signal (operator insisting, or
+    the grace window ending) raises ``KeyboardInterrupt`` immediately —
+    the pre-existing hard-kill behavior. Handlers are process-global
+    state, so ``install``/``uninstall`` save and restore the previous
+    handlers; installation is skipped off the main thread (Python
+    forbids it) and when disabled, leaving behavior unchanged.
+
+    ``watchdog``: when the hang watchdog has already expired, its
+    ``interrupt_main`` arrives through the installed handler — the
+    listener must re-raise it as ``KeyboardInterrupt`` instead of
+    swallowing it into a graceful request the wedged driver will never
+    check.
+    """
+
+    def __init__(self, *, enabled: bool = True, watchdog=None):
+        self.enabled = enabled
+        self.signum: Optional[int] = None
+        self._watchdog = watchdog
+        self._prev: dict = {}
+
+    @property
+    def requested(self) -> bool:
+        return self.signum is not None
+
+    def _handle(self, signum, frame) -> None:
+        if self._watchdog is not None and self._watchdog.expired:
+            raise KeyboardInterrupt(
+                "watchdog interrupt (run hung past its deadline)"
+            )
+        if self.signum is None:
+            self.signum = signum
+        else:
+            raise KeyboardInterrupt(
+                f"second signal {signum} during graceful shutdown"
+            )
+
+    def install(self) -> "ShutdownListener":
+        if (not self.enabled
+                or threading.current_thread()
+                is not threading.main_thread()):
+            return self
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}
+
+    def __enter__(self) -> "ShutdownListener":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def injected_hang_wait(
+    watchdog=None, shutdown=None, bound_s: Optional[float] = None
+) -> None:
+    """The ``hang`` fault body: stall the driver thread in small-chunk
+    sleeps until the watchdog trips (raises
+    :class:`~.watchdog.HangError`), a shutdown request arrives (the
+    stall "resolves" — SIGTERM interrupts it so the graceful path can
+    run), or the bound passes (an unwatched run stalls briefly and
+    continues — faults change WHEN the run computes, never WHAT it
+    writes). ``GS_HANG_BOUND_S`` defaults to 30 s.
+    """
+    if bound_s is None:
+        bound_s = float(os.environ.get("GS_HANG_BOUND_S", "30"))
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < bound_s:
+        time.sleep(0.05)
+        if watchdog is not None and watchdog.expired is not None:
+            watchdog.check()  # raises HangError with the expired phase
+        if shutdown is not None and shutdown.requested:
+            return
 
 
 class InjectedKernelError(RuntimeError):

@@ -1,0 +1,247 @@
+"""Multi-host restart consensus for supervised runs.
+
+Restarting one rank of a collective leaves its peers wedged in halo
+ppermutes, which is why supervision used to refuse
+``jax.process_count() > 1`` outright. The missing piece is small: on a
+classified failure every process must (1) restart *together* and
+(2) resume from the *same* checkpoint step. This module provides that
+agreement:
+
+* each process publishes ``(attempt, latest-durable-checkpoint-step)``
+  for the current rendezvous round and gathers every peer's value —
+  publish-then-gather is itself the barrier;
+* the **attempt counter** adopted is the cluster ``max`` — backoff
+  schedules and the ``GS_MAX_RESTARTS`` budget stay cluster-wide even
+  if one rank classified an extra local failure;
+* the **restart step** adopted is the cluster ``min`` of the
+  latest-durable-checkpoint steps (the checkpoint quorum): a step is
+  only resumable if *every* host can restore it from the store it can
+  see. Any host with no durable checkpoint drags the quorum to
+  "restart from scratch" — a missing shard can never be papered over.
+
+Two transports, selected by :func:`from_env`:
+
+* :class:`KVRendezvous` — the JAX coordination-service key-value store,
+  available whenever ``jax.distributed.initialize()`` ran (TPU pods,
+  and the CPU multi-process tests' explicit ``GS_TPU_COORDINATOR``
+  launch). Keys are unique per (launch, round, process), so the
+  no-overwrite KV contract is never violated.
+* :class:`FileRendezvous` — a shared-directory fallback
+  (``GS_RENDEZVOUS_DIR``, default ``<output>.rendezvous/``) for
+  multi-process setups without a live coordination client; files are
+  atomically published (tmp + rename) and namespaced by a launch id
+  derived from the coordinator address so a relaunch never reads a
+  previous launch's rounds.
+
+Symmetry assumption: fault classification is deterministic and faults
+fire at boundaries, so all ranks reach ``agree`` for the same failure;
+a rank that never arrives (a true wedge) trips the gather timeout
+(``GS_RENDEZVOUS_TIMEOUT_S``) and the hang watchdog's ``collective``
+deadline, turning a silent wedge into a classified, journaled failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "FileRendezvous",
+    "KVRendezvous",
+    "RendezvousTimeout",
+    "from_env",
+    "resolve_timeout_s",
+]
+
+
+class RendezvousTimeout(RuntimeError):
+    """A peer never published its restart vote within the timeout."""
+
+
+def resolve_timeout_s() -> float:
+    raw = os.environ.get("GS_RENDEZVOUS_TIMEOUT_S", "120")
+    try:
+        v = float(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"GS_RENDEZVOUS_TIMEOUT_S must be a number, got {raw!r}"
+        ) from e
+    if v <= 0:
+        raise ValueError(
+            f"GS_RENDEZVOUS_TIMEOUT_S must be > 0, got {v}"
+        )
+    return v
+
+
+def _decide(votes: List[dict]) -> Tuple[int, Optional[int]]:
+    """(cluster attempt, quorum restart step) from every process's
+    published ``{"attempt": int, "ckpt": int}`` vote (-1 = no durable
+    checkpoint on that host)."""
+    attempt = max(int(v["attempt"]) for v in votes)
+    steps = [int(v["ckpt"]) for v in votes]
+    lowest = min(steps)
+    return attempt, (None if lowest < 0 else lowest)
+
+
+class _Rendezvous:
+    """Shared publish/gather skeleton; subclasses provide transport."""
+
+    def __init__(self, nprocs: int, proc: int, *, timeout_s: float):
+        self.nprocs = int(nprocs)
+        self.proc = int(proc)
+        self.timeout_s = float(timeout_s)
+        #: Local round counter; symmetric classification keeps every
+        #: process's counter in lockstep (see module docstring).
+        self.round = 0
+
+    def agree(
+        self, attempt: int, ckpt_step: Optional[int]
+    ) -> Tuple[int, Optional[int]]:
+        """Publish this process's vote, gather all peers', return
+        ``(cluster_attempt, quorum_restart_step)`` — identical on every
+        process by construction."""
+        self.round += 1
+        payload = json.dumps(
+            {"attempt": int(attempt),
+             "ckpt": -1 if ckpt_step is None else int(ckpt_step)}
+        )
+        self._publish(self.round, payload)
+        votes = [json.loads(v) for v in self._gather(self.round)]
+        return _decide(votes)
+
+    def _publish(self, round_no: int, payload: str) -> None:
+        raise NotImplementedError
+
+    def _gather(self, round_no: int) -> List[str]:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {
+            "transport": type(self).__name__,
+            "nprocs": self.nprocs,
+            "proc": self.proc,
+            "round": self.round,
+        }
+
+
+class KVRendezvous(_Rendezvous):
+    """Consensus over the JAX coordination-service key-value store."""
+
+    def __init__(self, client, nprocs: int, proc: int, *, timeout_s: float):
+        super().__init__(nprocs, proc, timeout_s=timeout_s)
+        self._client = client
+
+    def _key(self, round_no: int, proc: int) -> str:
+        return f"gs/restart_rdv/r{round_no}/p{proc}"
+
+    def _publish(self, round_no: int, payload: str) -> None:
+        self._client.key_value_set(self._key(round_no, self.proc), payload)
+
+    def _gather(self, round_no: int) -> List[str]:
+        timeout_ms = int(self.timeout_s * 1000)
+        out = []
+        for p in range(self.nprocs):
+            try:
+                out.append(
+                    self._client.blocking_key_value_get(
+                        self._key(round_no, p), timeout_ms
+                    )
+                )
+            except Exception as e:  # jaxlib raises its own error type
+                raise RendezvousTimeout(
+                    f"restart rendezvous round {round_no}: process {p} "
+                    f"never published within {self.timeout_s:.0f}s ({e})"
+                ) from e
+        return out
+
+
+class FileRendezvous(_Rendezvous):
+    """Consensus over a shared directory (atomic per-process files)."""
+
+    def __init__(
+        self, directory: str, nprocs: int, proc: int, *,
+        timeout_s: float, launch_id: str = "0",
+    ):
+        super().__init__(nprocs, proc, timeout_s=timeout_s)
+        self.directory = directory
+        self.launch_id = launch_id
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, round_no: int, proc: int) -> str:
+        return os.path.join(
+            self.directory, f"l{self.launch_id}.r{round_no}.p{proc}"
+        )
+
+    def _publish(self, round_no: int, payload: str) -> None:
+        path = self._path(round_no, self.proc)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _gather(self, round_no: int) -> List[str]:
+        deadline = time.monotonic() + self.timeout_s
+        out: List[Optional[str]] = [None] * self.nprocs
+        while True:
+            for p in range(self.nprocs):
+                if out[p] is None:
+                    try:
+                        with open(self._path(round_no, p),
+                                  encoding="utf-8") as f:
+                            out[p] = f.read()
+                    except FileNotFoundError:
+                        pass
+            if all(v is not None for v in out):
+                return out  # type: ignore[return-value]
+            if time.monotonic() > deadline:
+                missing = [p for p, v in enumerate(out) if v is None]
+                raise RendezvousTimeout(
+                    f"restart rendezvous round {round_no}: processes "
+                    f"{missing} never published within "
+                    f"{self.timeout_s:.0f}s (dir {self.directory})"
+                )
+            time.sleep(0.05)
+
+
+def from_env(settings) -> Optional[_Rendezvous]:
+    """The rendezvous for this run, or None for single-process runs.
+
+    Transport: ``GS_RENDEZVOUS_DIR`` forces the filesystem transport
+    (tests, shared-NFS setups); otherwise the coordination-service KV
+    client when one is live; otherwise a filesystem rendezvous next to
+    the output store.
+    """
+    import jax
+
+    nprocs = jax.process_count()
+    if nprocs <= 1:
+        return None
+    proc = jax.process_index()
+    timeout_s = resolve_timeout_s()
+
+    forced_dir = os.environ.get("GS_RENDEZVOUS_DIR")
+    if not forced_dir:
+        client = None
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+        except Exception:  # pragma: no cover — private-API drift
+            client = None
+        if client is not None:
+            return KVRendezvous(client, nprocs, proc, timeout_s=timeout_s)
+
+    directory = forced_dir or (settings.output + ".rendezvous")
+    # Namespace rounds by launch so a relaunch (fresh supervisor, round
+    # counter back at 0) never matches a previous launch's files. The
+    # coordinator address is the natural shared-but-per-launch token.
+    coord = os.environ.get("GS_TPU_COORDINATOR", "")
+    launch_id = f"{zlib.crc32(coord.encode()):08x}" if coord else "0"
+    return FileRendezvous(
+        directory, nprocs, proc, timeout_s=timeout_s, launch_id=launch_id
+    )

@@ -28,12 +28,24 @@ runtime layer, not user code, must absorb these):
   provenance of the final ``RunStats`` — the run finishes slower
   rather than not at all, and the stats say why.
 * **journal** every failure and recovery action as JSONL
-  (:class:`FaultJournal`); the completing attempt merges the full
-  journal into ``RunStats`` as its ``faults`` section.
+  (:class:`FaultJournal`; every line flushed + fsynced, so the journal
+  survives SIGKILL mid-event); the completing attempt merges the full
+  journal into ``RunStats`` as its ``faults`` section. In multi-process
+  runs each rank journals to its own ``.rank<N>``-suffixed file and
+  every event carries the rank's ``proc``.
 
-Supervision is per-process: multi-host runs (``jax.process_count() >
-1``) need an external restarter that relaunches all ranks together, so
-``driver.main`` refuses to supervise them (see docs/RESILIENCE.md).
+Multi-host runs are supervised for real (PR 5; the old per-process
+refusal is gone): on a classified failure the ranks rendezvous
+(:mod:`.rendezvous` — coordination-service KV when
+``jax.distributed`` is initialized, filesystem otherwise), adopt a
+cluster-wide attempt counter (max) and the quorum restart step (the
+*minimum* latest-durable-checkpoint across hosts), and restart
+together. A :class:`~.faults.GracefulShutdown` (real SIGTERM/SIGINT
+preemption) is never restarted in-process — the scheduler wants the
+process gone; it exits with :data:`~.faults.EXIT_PREEMPTED` and the
+journal's ``graceful_shutdown`` marker makes the *next* supervised
+launch auto-resume (:func:`resume_marker`). The hang watchdog's hard
+exit leaves the analogous ``hang_exit`` marker.
 """
 
 from __future__ import annotations
@@ -44,8 +56,14 @@ import time
 import zlib
 from typing import List, Optional
 
-from .faults import FaultPlan, InjectedKernelError, PreemptionError
+from .faults import (
+    FaultPlan,
+    GracefulShutdown,
+    InjectedKernelError,
+    PreemptionError,
+)
 from .health import HealthError
+from .watchdog import HangError
 
 __all__ = [
     "FaultJournal",
@@ -54,9 +72,17 @@ __all__ = [
     "latest_durable_checkpoint",
     "restart_backoff",
     "resolve_max_restarts",
+    "resume_marker",
     "supervise",
     "supervision_enabled",
 ]
+
+#: Journal events that mark a run interrupted by an external teardown
+#: (graceful preemption exit, watchdog hard exit) — a *resumable* end:
+#: when the last journal line is one of these, the next supervised
+#: launch restarts from the durable checkpoint without waiting for a
+#: fresh failure.
+RESUME_MARKERS = ("graceful_shutdown", "hang_exit")
 
 _TRUTHY = {"1", "true", "yes", "on"}
 _FALSY = {"0", "false", "no", "off"}
@@ -115,38 +141,95 @@ class FaultJournal:
     """Append-only fault/recovery event log, mirrored to JSONL.
 
     Events are plain dicts; ``record`` is called from the driver thread
-    (nan/preempt/health/recovery events) and from the async writer's
-    worker thread (fired io_error injections), so the file append is
-    lock-guarded. The journal object outlives run attempts — the
-    completing attempt merges ``events`` into ``RunStats``.
+    (nan/preempt/health/recovery events), from the async writer's
+    worker thread (fired io_error injections), and from the watchdog's
+    monitor thread (hang events), so the file append is lock-guarded.
+    Every appended line is flushed and fsynced before ``record``
+    returns: the journal is the recovery breadcrumb a SIGKILLed or
+    preempted process leaves behind, and a buffered line that died with
+    the process would hand the next launch an inconsistent fault
+    history. The journal object outlives run attempts — the completing
+    attempt merges ``events`` into ``RunStats``.
+
+    ``process_index`` (set for multi-process runs) is stamped onto
+    every event as ``proc`` so a merged cross-rank read attributes each
+    fault to the host that saw it.
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 process_index: Optional[int] = None):
         import threading
 
         self.path = path
+        self.process_index = process_index
         self.events: List[dict] = []
         self._lock = threading.Lock()
 
     @classmethod
     def from_env(cls, settings=None) -> "FaultJournal":
         """Journal at ``GS_FAULT_JOURNAL``; default ``<output>.faults.jsonl``
-        under supervision, in-memory only otherwise."""
+        under supervision, in-memory only otherwise. In multi-process
+        runs the path gets a ``.rank<N>`` suffix (mirroring
+        ``GS_TPU_STATS``) and events are tagged with the rank."""
         path = os.environ.get("GS_FAULT_JOURNAL")
         if not path and settings is not None and supervision_enabled(settings):
             path = settings.output + ".faults.jsonl"
-        return cls(path or None)
+        proc = None
+        import sys
+
+        if "jax" in sys.modules:  # never force a backend init from here
+            import jax
+
+            if jax.process_count() > 1:
+                proc = jax.process_index()
+                if path:
+                    path = f"{path}.rank{proc}"
+        return cls(path or None, process_index=proc)
 
     def record(self, **event) -> dict:
         import json
 
         event.setdefault("t", round(time.time(), 3))
+        if self.process_index is not None:
+            event.setdefault("proc", self.process_index)
         with self._lock:
             self.events.append(event)
             if self.path:
                 with open(self.path, "a", encoding="utf-8") as f:
                     f.write(json.dumps(event) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
         return event
+
+
+def resume_marker(path: Optional[str]) -> Optional[dict]:
+    """The journal's trailing resume marker, or None.
+
+    Reads the JSONL at ``path`` and returns the last event iff it is a
+    :data:`RESUME_MARKERS` kind — i.e. the previous launch ended in a
+    graceful preemption exit or a watchdog hard exit and nothing has
+    resumed it since (any later event, e.g. the resuming launch's own
+    ``recovery`` record, clears the marker). Corrupt lines are skipped:
+    ``record`` fsyncs whole lines, but a torn tail from a mid-write
+    SIGKILL must not block the resume of everything before it.
+    """
+    import json
+
+    if not path or not os.path.exists(path):
+        return None
+    last = None
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                last = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if isinstance(last, dict) and last.get("event") in RESUME_MARKERS:
+        return last
+    return None
 
 
 @dataclasses.dataclass
@@ -177,7 +260,11 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     from ..io.async_writer import AsyncIOError
 
     if isinstance(exc, PreemptionError):
+        # GracefulShutdown is a PreemptionError too: same taxonomy slot,
+        # but supervise() re-raises it without an in-process restart.
         return "preemption"
+    if isinstance(exc, HangError):
+        return "hang"
     if isinstance(exc, HealthError):
         # abort policy means abort: only rollback is recoverable.
         return "health" if exc.policy == "rollback" else None
@@ -207,19 +294,9 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
     """
     if not settings.checkpoint:
         return None
-    from ..io.bplite import BpReader
+    from ..io.checkpoint import latest_durable_step
 
-    try:
-        r = BpReader(settings.checkpoint_output)
-    except FileNotFoundError:
-        return None
-    try:
-        n = r.num_steps()
-        if n == 0:
-            return None
-        return int(r.get("step", step=n - 1))
-    finally:
-        r.close()
+    return latest_durable_step(settings.checkpoint_output)
 
 
 def _resolved_language(settings) -> str:
@@ -230,23 +307,83 @@ def _resolved_language(settings) -> str:
     )
 
 
+def _apply_resume(settings, resume: Optional[int], actions: list) -> None:
+    """Point ``settings`` at the agreed restart step (or from-scratch)."""
+    if resume is not None:
+        settings.restart = True
+        settings.restart_input = settings.checkpoint_output
+        settings.restart_step = resume
+        actions.append(f"resumed_from_checkpoint_step_{resume}")
+    else:
+        # No durable checkpoint (anywhere, under a quorum): restart the
+        # trajectory from scratch — unless the operator's own restart
+        # settings already point somewhere; leave those alone.
+        if not settings.restart:
+            actions.append("restarted_from_scratch")
+        else:
+            actions.append("restarted_from_configured_checkpoint")
+
+
 def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
     """Run ``driver.run_once`` under the restart loop; returns the
     completed attempt's :class:`~..simulation.Simulation`.
 
     ``settings`` is mutated across attempts (restart target, degraded
     kernel language) — the supervisor owns the run's lifecycle, and the
-    final settings describe how the run actually finished.
+    final settings describe how the run actually finished. Multi-host
+    runs agree on every restart through :mod:`.rendezvous` (cluster-max
+    attempt counter, cluster-min checkpoint quorum).
     """
     from ..driver import run_once
     from ..utils.log import Logger
+    from . import rendezvous as rdv_mod
 
     log = Logger(verbose=True)
     plan = FaultPlan.from_env(settings)
     journal = FaultJournal.from_env(settings)
     limit = resolve_max_restarts(settings)
+    rdv = rdv_mod.from_env(settings)
     attempt = 0
     degraded: Optional[dict] = None
+
+    def _agree(resume_local: Optional[int]):
+        """Quorum (attempt, restart step) across hosts; single-process
+        runs pass the local view through unchanged."""
+        nonlocal attempt
+        if rdv is None:
+            return resume_local
+        attempt, resume = rdv.agree(attempt, resume_local)
+        journal.record(
+            event="rendezvous",
+            round=rdv.round,
+            attempt=attempt,
+            local_step=-1 if resume_local is None else resume_local,
+            quorum_step=-1 if resume is None else resume,
+            procs=rdv.nprocs,
+        )
+        return resume
+
+    # A previous launch that ended in a graceful preemption exit or a
+    # watchdog hard exit left a resume marker as its final journal
+    # line: restart from the (quorum) durable checkpoint immediately
+    # instead of waiting for this launch to fail first.
+    marker = resume_marker(journal.path)
+    if marker is not None and not settings.restart:
+        actions: list = []
+        _apply_resume(settings, _agree(latest_durable_checkpoint(settings)),
+                      actions)
+        journal.record(
+            event="recovery",
+            kind="preemption" if marker["event"] == "graceful_shutdown"
+            else "hang",
+            attempt=attempt,
+            after=marker["event"],
+            action=";".join(actions),
+        )
+        log.info(
+            f"supervisor: resuming after {marker['event']} "
+            f"with [{', '.join(actions)}]"
+        )
 
     while True:
         ctx = SupervisorContext(
@@ -257,11 +394,40 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
                 settings, n_devices=n_devices, seed=seed, context=ctx
             )
         except BaseException as exc:  # noqa: BLE001 — classify, then re-raise
+            if isinstance(exc, GracefulShutdown):
+                # A real preemption signal: the scheduler wants this
+                # process gone — never restart in-place. run_once
+                # already journaled the graceful_shutdown marker; the
+                # CLI exits EXIT_PREEMPTED and the next supervised
+                # launch auto-resumes from it (resume_marker above).
+                raise
             kind = classify_failure(exc)
-            if kind is None or attempt >= limit:
+            if kind is None:
                 journal.record(
                     event="gave_up",
-                    kind=kind or "fatal",
+                    kind="fatal",
+                    attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                raise
+
+            # Cluster consensus BEFORE the budget check: the adopted
+            # attempt counter is the cluster max, so GS_MAX_RESTARTS
+            # bounds the whole cluster, not each rank independently.
+            try:
+                resume = _agree(latest_durable_checkpoint(settings))
+            except rdv_mod.RendezvousTimeout as e:
+                journal.record(
+                    event="gave_up", kind=kind, attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}",
+                    reason=f"restart rendezvous failed: {e}",
+                )
+                raise
+
+            if attempt >= limit:
+                journal.record(
+                    event="gave_up",
+                    kind=kind,
                     attempt=attempt,
                     error=f"{type(exc).__name__}: {exc}",
                 )
@@ -288,20 +454,7 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
                     )
                     raise
 
-            resume = latest_durable_checkpoint(settings)
-            if resume is not None:
-                settings.restart = True
-                settings.restart_input = settings.checkpoint_output
-                settings.restart_step = resume
-                actions.append(f"resumed_from_checkpoint_step_{resume}")
-            else:
-                # No durable checkpoint: restart the trajectory from
-                # scratch (unless the operator's own restart settings
-                # already point somewhere — leave those alone).
-                if not settings.restart:
-                    actions.append("restarted_from_scratch")
-                else:
-                    actions.append("restarted_from_configured_checkpoint")
+            _apply_resume(settings, resume, actions)
 
             delay = restart_backoff(attempt, kind)
             journal.record(

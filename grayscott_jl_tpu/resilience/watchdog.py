@@ -1,0 +1,369 @@
+"""Hang watchdog: per-phase deadlines over the driver's heartbeat.
+
+A wedged run is the one failure the supervisor cannot classify — it
+never raises. BENCH_r05's wedged TPU tunnel burned 19+ minutes with
+zero diagnosis, and a pod rank stuck in a collective wedges every peer
+silently. Production stencil stacks treat stall detection as a runtime
+responsibility, not an operator one (arXiv:2309.10292 §5 supervises
+Frontier runs the same way; arXiv:2404.02218 argues the runtime layer
+must absorb it). The watchdog closes that hole:
+
+* the driver (``driver.run_once``) heartbeats at its host-side
+  boundaries — ``compile`` (first jitted round + autotune), ``step_round``
+  (one fused boundary-to-boundary device round, halo collectives
+  included), ``io`` (boundary snapshot/submit incl. backpressure),
+  ``drain`` (async-writer close), ``checkpoint`` (graceful-shutdown
+  checkpoint), ``collective`` (multi-host rendezvous waits) — and each
+  heartbeat arms that phase's deadline;
+* a monitor thread checks the armed deadline; on expiry it dumps every
+  thread's stack into the :class:`~.supervisor.FaultJournal` (durable —
+  ``record`` fsyncs), classifies the event as a transient ``hang``, and
+  tears the run down: first an ``interrupt_main`` so a Python-level
+  stall unwinds as :class:`HangError` (which the supervisor restarts
+  from the quorum checkpoint), then — if the run is still wedged after
+  ``GS_WATCHDOG_GRACE_S`` (a C-level wedge no interrupt can reach) — a
+  hard ``os._exit`` with the distinct hang exit code, leaving a
+  ``hang_exit`` journal marker the next supervised launch auto-resumes
+  from (``supervisor.resume_marker``).
+
+This module must stay importable without JAX: ``bench.py``'s parent
+process (which never imports jax, by design) arms a watchdog over its
+late TPU probe loop.
+
+Knobs (env wins over the ``watchdog`` / ``watchdog_deadline_s`` TOML
+keys): ``GS_WATCHDOG`` = ``on`` | ``off`` | ``auto`` (auto = armed iff
+supervision is), ``GS_WATCHDOG_DEADLINE_S`` (one deadline for every
+phase), ``GS_WATCHDOG_<PHASE>_S`` (per-phase override, e.g.
+``GS_WATCHDOG_STEP_ROUND_S``), ``GS_WATCHDOG_GRACE_S`` (seconds between
+the soft interrupt and the hard exit; 0 disables the hard exit).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+from .faults import EXIT_HANG
+
+__all__ = [
+    "DEFAULT_DEADLINES",
+    "HangError",
+    "Watchdog",
+    "resolve_watchdog",
+]
+
+#: Per-phase deadline defaults (seconds). Generous in absolute terms —
+#: the point is distinguishing "slow" from "wedged forever", not
+#: policing performance. ``compile`` covers the first fused round
+#: (jit + autotune measurements); ``step_round`` covers one
+#: boundary-to-boundary device round including its halo collectives
+#: (they execute inside the jitted program, so they cannot heartbeat
+#: separately); ``collective`` covers host-side multi-host waits
+#: (restart rendezvous); ``probe_loop`` is bench.py's late TPU probe
+#: loop (kept in lockstep with GS_BENCH_PROBE_BUDGET's default).
+DEFAULT_DEADLINES: Dict[str, float] = {
+    "compile": 1800.0,
+    "step_round": 600.0,
+    "io": 300.0,
+    "drain": 600.0,
+    "checkpoint": 600.0,
+    "collective": 300.0,
+    "probe_loop": 360.0,
+}
+
+
+class HangError(RuntimeError):
+    """The watchdog expired: the run hung past a phase deadline.
+
+    Classified as transient (``hang``) by the supervisor — the recovery
+    is a restart from the (quorum) checkpoint, exactly like a
+    preemption."""
+
+    def __init__(self, phase: str, step: Optional[int], deadline_s: float):
+        at = f" at step {step}" if step is not None else ""
+        super().__init__(
+            f"watchdog: run hung in phase {phase!r}{at} "
+            f"(no heartbeat for {deadline_s:.1f}s)"
+        )
+        self.phase = phase
+        self.step = step
+        self.deadline_s = deadline_s
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        v = float(raw)
+    except ValueError as e:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from e
+    if v <= 0:
+        raise ValueError(f"{name} must be > 0, got {v}")
+    return v
+
+
+def resolve_watchdog(settings=None) -> Optional[Dict[str, float]]:
+    """Resolved per-phase deadlines, or ``None`` when the watchdog is
+    off.
+
+    ``GS_WATCHDOG`` env (``on``/``off``/``auto``) wins over the
+    ``watchdog`` TOML key; ``auto`` (the default) arms the watchdog
+    exactly when supervision is armed — an unsupervised run has no
+    restart loop to hand a ``hang`` to, so by default it is left alone.
+    Deadlines: built-in per-phase defaults, overridden globally by
+    ``GS_WATCHDOG_DEADLINE_S`` (or the ``watchdog_deadline_s`` TOML
+    key), then per-phase by ``GS_WATCHDOG_<PHASE>_S``.
+    """
+    raw = os.environ.get("GS_WATCHDOG")
+    if raw is None:
+        raw = getattr(settings, "watchdog", "") or "auto"
+    mode = raw.strip().lower()
+    mode = {"1": "on", "true": "on", "yes": "on",
+            "0": "off", "false": "off", "no": "off", "": "auto"}.get(
+                mode, mode)
+    if mode not in ("on", "off", "auto"):
+        raise ValueError(
+            f"watchdog / GS_WATCHDOG must be on/off/auto, got {raw!r}"
+        )
+    if mode == "off":
+        return None
+    if mode == "auto":
+        from .supervisor import supervision_enabled
+
+        if not supervision_enabled(settings):
+            return None
+
+    deadlines = dict(DEFAULT_DEADLINES)
+    base = _env_float("GS_WATCHDOG_DEADLINE_S")
+    if base is None and settings is not None:
+        toml_base = float(getattr(settings, "watchdog_deadline_s", 0.0))
+        if toml_base > 0:
+            base = toml_base
+    if base is not None:
+        deadlines = {k: base for k in deadlines}
+    for phase in deadlines:
+        v = _env_float(f"GS_WATCHDOG_{phase.upper()}_S")
+        if v is not None:
+            deadlines[phase] = v
+    return deadlines
+
+
+def _dump_stacks(skip_ident: Optional[int] = None, limit: int = 12) -> list:
+    """Every live thread's stack tail, JSON-able — the diagnosis a
+    wedged run otherwise takes a debugger attach to get."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        if ident == skip_ident:
+            continue
+        stack = [s.strip() for s in traceback.format_stack(frame)[-limit:]]
+        out.append({"thread": names.get(ident, f"tid-{ident}"),
+                    "stack": stack})
+    return out
+
+
+class Watchdog:
+    """Deadline monitor over driver heartbeats.
+
+    One phase is armed at a time (:meth:`heartbeat`); the monitor
+    thread fires at most once — after expiry the event is frozen so the
+    journal tells one coherent story. All methods are thread-safe;
+    ``heartbeat`` is a lock + two attribute writes, cheap enough for
+    every boundary.
+    """
+
+    def __init__(
+        self,
+        deadlines: Optional[Dict[str, float]] = None,
+        *,
+        journal=None,
+        grace_s: Optional[float] = None,
+        on_expire=None,
+    ):
+        self.deadlines = dict(deadlines or DEFAULT_DEADLINES)
+        if not self.deadlines:
+            raise ValueError("watchdog needs at least one phase deadline")
+        for phase, d in self.deadlines.items():
+            if d <= 0:
+                raise ValueError(
+                    f"watchdog deadline for {phase!r} must be > 0, got {d}"
+                )
+        self.journal = journal
+        if grace_s is None:
+            raw = os.environ.get("GS_WATCHDOG_GRACE_S")
+            if raw is None or raw.strip() == "":
+                grace_s = 60.0
+            else:
+                try:
+                    grace_s = float(raw)
+                except ValueError as e:
+                    raise ValueError(
+                        f"GS_WATCHDOG_GRACE_S must be a number, got {raw!r}"
+                    ) from e
+                if grace_s < 0:
+                    raise ValueError(
+                        f"GS_WATCHDOG_GRACE_S must be >= 0, got {grace_s}"
+                    )
+        #: Seconds between the soft interrupt and the hard ``os._exit``;
+        #: 0 disables the hard exit (soft teardown only).
+        self.grace_s = float(grace_s)
+        #: Called from the monitor thread on expiry; default interrupts
+        #: the main thread so a Python-level stall unwinds as an
+        #: exception the driver converts to :class:`HangError`.
+        self._on_expire = on_expire if on_expire is not None else (
+            self._interrupt_main)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._armed = None  # (phase, step, deadline_s, armed_at)
+        self._expired: Optional[dict] = None
+        self._heartbeats = 0
+        self._thread: Optional[threading.Thread] = None
+        # Check often enough that the tightest deadline is detected
+        # promptly, but never busier than 50 Hz.
+        self._tick = min(0.5, max(0.02, min(self.deadlines.values()) / 5.0))
+
+    @staticmethod
+    def _interrupt_main() -> None:
+        import _thread
+
+        _thread.interrupt_main()
+
+    # ------------------------------------------------------------- control
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="gs-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Disarm and join the monitor; after ``stop`` no interrupt or
+        hard exit can fire (the run unwound on its own). Idempotent."""
+        with self._lock:
+            self._stop.set()
+            self._armed = None
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---------------------------------------------------------- heartbeats
+
+    def heartbeat(self, phase: str, step: Optional[int] = None) -> None:
+        """Arm ``phase``'s deadline from now (any previously armed phase
+        is replaced). Unknown phases get the tightest configured
+        deadline — better a premature trip than an unwatched phase."""
+        deadline = self.deadlines.get(phase)
+        if deadline is None:
+            deadline = min(self.deadlines.values())
+        with self._lock:
+            if self._stop.is_set() or self._expired is not None:
+                return
+            self._heartbeats += 1
+            self._armed = (phase, step, deadline, time.monotonic())
+
+    def touch(self, phase: str, step: Optional[int] = None) -> None:
+        """Re-arm only if ``phase`` is the currently armed phase — how a
+        worker thread (e.g. the async writer during drain) reports
+        progress without clobbering the driver's own armed phase."""
+        with self._lock:
+            if (self._armed is None or self._stop.is_set()
+                    or self._expired is not None):
+                return
+            if self._armed[0] == phase:
+                self._heartbeats += 1
+                self._armed = (phase, step, self._armed[2], time.monotonic())
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = None
+
+    # ------------------------------------------------------------- expiry
+
+    @property
+    def expired(self) -> Optional[dict]:
+        """The frozen expiry event, or None while healthy."""
+        return self._expired
+
+    def check(self) -> None:
+        """Raise :class:`HangError` if the watchdog has expired."""
+        e = self._expired
+        if e is not None:
+            raise HangError(e["phase"], e.get("step"), e["deadline_s"])
+
+    def describe(self) -> dict:
+        """JSON-able provenance for ``RunStats``."""
+        e = self._expired
+        return {
+            "enabled": True,
+            "deadlines_s": dict(self.deadlines),
+            "grace_s": self.grace_s,
+            "heartbeats": self._heartbeats,
+            "expired": (
+                {"phase": e["phase"], "step": e.get("step"),
+                 "deadline_s": e["deadline_s"]}
+                if e is not None else None
+            ),
+        }
+
+    # ------------------------------------------------------------- monitor
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._tick):
+            with self._lock:
+                if self._armed is None or self._expired is not None:
+                    continue
+                phase, step, deadline, t0 = self._armed
+                if time.monotonic() - t0 < deadline:
+                    continue
+                event = {
+                    "event": "hang",
+                    "kind": "hang",
+                    "phase": phase,
+                    "step": step,
+                    "deadline_s": deadline,
+                    "threads": _dump_stacks(skip_ident=threading.get_ident()),
+                }
+                self._expired = event
+                self._armed = None
+            # Journal + interrupt outside the lock: record() takes its
+            # own lock and fsyncs; interrupt_main must never deadlock
+            # against a heartbeat.
+            if self.journal is not None:
+                try:
+                    self.journal.record(**event)
+                except Exception:  # noqa: BLE001 — diagnosis must not kill teardown
+                    pass
+            try:
+                self._on_expire()
+            except Exception:  # noqa: BLE001
+                pass
+            if self.grace_s > 0:
+                # Soft teardown got its chance; a C-level wedge (stuck
+                # collective, dead PJRT client) never unwinds from an
+                # interrupt. The distinct exit code + durable journal
+                # marker turn the wedge into a relaunch-resumable event.
+                if self._stop.wait(self.grace_s):
+                    return
+                if self.journal is not None:
+                    try:
+                        self.journal.record(
+                            event="hang_exit", kind="hang", phase=phase,
+                            step=step, exit_code=EXIT_HANG,
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                os._exit(EXIT_HANG)
+            return

@@ -51,6 +51,12 @@ class RunStats:
         #: merges the journal here, so one stats file tells the full
         #: story of how the run survived.
         self.faults: Optional[list] = None
+        #: Hang-watchdog provenance (``resilience/watchdog.py``):
+        #: armed/disabled, the per-phase deadlines in force, heartbeat
+        #: count, and the expiry (phase/step) if the run hung — so a
+        #: stats reader can tell "finished clean" from "finished after
+        #: a watchdog-recovered wedge" without the journal.
+        self.watchdog: Optional[dict] = None
         #: Halo-exchange budget (``parallel/icimodel.comm_report``):
         #: model-projected per-step ``hidden_us``/``exposed_us`` under
         #: the run's split-phase setting — the comm analog of the
@@ -82,6 +88,11 @@ class RunStats:
         trips, recovery actions) to the summary."""
         self.faults = [dict(e) for e in events] if events else None
 
+    def record_watchdog(self, info: Optional[dict]) -> None:
+        """Attach the hang-watchdog provenance
+        (``Watchdog.describe()``, or ``{"enabled": False}``)."""
+        self.watchdog = dict(info) if info else None
+
     def record_comm(self, report: Optional[dict]) -> None:
         """Attach the halo-exchange budget
         (``parallel/icimodel.comm_report``) to the summary."""
@@ -101,6 +112,7 @@ class RunStats:
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
             "io": self.io,
             "comm": self.comm,
+            "watchdog": self.watchdog,
             "faults": self.faults,
             "counters": dict(self.counters),
             "cell_updates_per_s": (

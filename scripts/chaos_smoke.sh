@@ -1,14 +1,23 @@
 #!/usr/bin/env bash
-# Chaos smoke test: kill a small supervised run with an injected
-# preemption at a pseudo-random step and assert the recovered run's
-# stores are byte-identical to an uninterrupted run's.
+# Chaos smoke test, three scenarios against one uninterrupted
+# reference run:
 #
-# The preemption step is derived deterministically from a seed (crc32,
+#   1. injected preemption at a pseudo-random step -> supervised
+#      restart -> all stores byte-identical;
+#   2. injected driver hang at a pseudo-random step -> watchdog trips
+#      (stack dump in the journal) -> supervised restart -> all stores
+#      byte-identical;
+#   3. real SIGTERM mid-run -> graceful boundary checkpoint -> exit 75
+#      -> supervised relaunch auto-resumes from the journal marker ->
+#      output stores byte-identical (the checkpoint store additionally
+#      holds the off-schedule grace entry, asserted separately).
+#
+# The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
 #
 #   ./scripts/chaos_smoke.sh [seed]     # default seed 0, or $CHAOS_SEED
 #
-# The fast fixed-step variant of this scenario runs in tier-1 as
+# The fast fixed-step variants of these scenarios run in tier-1 as
 # tests/functional/test_supervisor.py; this script is the
 # operator-facing knob-twister (vary the seed, watch the journal).
 # See docs/RESILIENCE.md for the failure taxonomy and knobs.
@@ -21,10 +30,11 @@ WORK="$(mktemp -d)"
 trap 'rm -rf "$WORK"' EXIT
 
 STEPS=60
-# Pseudo-random preemption step in [5, 54] — strictly mid-run, printed
-# so a failure is reproducible by re-running with the same seed.
+# Pseudo-random fault steps in [5, 54] — strictly mid-run, printed so a
+# failure is reproducible by re-running with the same seed.
 PREEMPT="$(python3 -c "import zlib; print(5 + zlib.crc32(b'chaos:${SEED}') % ($STEPS - 10))")"
-echo "chaos_smoke: seed=${SEED} -> injected preemption at step ${PREEMPT}"
+HANG="$(python3 -c "import zlib; print(5 + zlib.crc32(b'hang:${SEED}') % ($STEPS - 10))")"
+echo "chaos_smoke: seed=${SEED} -> injected preemption at step ${PREEMPT}, hang at step ${HANG}"
 
 write_config() {
   cat > "$1/config.toml" <<EOF
@@ -60,14 +70,24 @@ run() {
   )
 }
 
-mkdir -p "$WORK/full" "$WORK/sup"
-write_config "$WORK/full"
-write_config "$WORK/sup"
+assert_stores() {
+  local dir="$1"; shift
+  for store in "$@"; do
+    if ! diff -r "$WORK/full/$store" "$dir/$store" > /dev/null; then
+      echo "chaos_smoke: FAIL — $store differs from the uninterrupted run" >&2
+      diff -rq "$WORK/full/$store" "$dir/$store" >&2 || true
+      exit 1
+    fi
+  done
+}
+
+mkdir -p "$WORK/full" "$WORK/sup" "$WORK/hang" "$WORK/term"
+for d in full sup hang term; do write_config "$WORK/$d"; done
 
 echo "chaos_smoke: uninterrupted reference run..."
 run "$WORK/full" > "$WORK/full.log" 2>&1
 
-echo "chaos_smoke: supervised run with injected preemption..."
+echo "chaos_smoke: [1/3] supervised run with injected preemption..."
 run "$WORK/sup" \
   GS_SUPERVISE=1 \
   GS_MAX_RESTARTS=5 \
@@ -75,18 +95,85 @@ run "$WORK/sup" \
   GS_FAULTS="step=${PREEMPT}:kind=preempt" \
   > "$WORK/sup.log" 2>&1
 
-grep -a "supervisor:" "$WORK/sup.log" || {
+grep -a "supervisor:" "$WORK/sup.log" > /dev/null || {
   echo "chaos_smoke: FAIL — the supervisor never recovered anything" >&2
   exit 1
 }
+assert_stores "$WORK/sup" gs.bp gs.vtk ckpt.bp
 
-for store in gs.bp gs.vtk ckpt.bp; do
-  if ! diff -r "$WORK/full/$store" "$WORK/sup/$store" > /dev/null; then
-    echo "chaos_smoke: FAIL — $store differs from the uninterrupted run" >&2
-    diff -rq "$WORK/full/$store" "$WORK/sup/$store" >&2 || true
-    exit 1
-  fi
+echo "chaos_smoke: [2/3] supervised run with injected hang (watchdog)..."
+run "$WORK/hang" \
+  GS_SUPERVISE=1 \
+  GS_MAX_RESTARTS=5 \
+  GS_RESTART_BACKOFF_S=0.05 \
+  GS_WATCHDOG=on \
+  GS_WATCHDOG_STEP_ROUND_S=3 \
+  GS_HANG_BOUND_S=60 \
+  GS_FAULTS="step=${HANG}:kind=hang" \
+  > "$WORK/hang.log" 2>&1
+
+grep -a "supervisor: hang" "$WORK/hang.log" > /dev/null || {
+  echo "chaos_smoke: FAIL — the watchdog never classified the hang" >&2
+  exit 1
+}
+grep -aq '"event": "hang"' "$WORK/hang/gs.bp.faults.jsonl" || {
+  echo "chaos_smoke: FAIL — no hang stack dump in the journal" >&2
+  exit 1
+}
+assert_stores "$WORK/hang" gs.bp gs.vtk ckpt.bp
+
+echo "chaos_smoke: [3/3] SIGTERM mid-run -> graceful checkpoint -> resume..."
+# Park the run at a deterministic boundary with an unwatched injected
+# stall, SIGTERM it there (the injected-hang journal line is fsynced
+# before the stall starts, so polling it makes the timing exact).
+(
+  cd "$WORK/term"
+  # exec: the SIGTERM below must land on python itself, not a wrapper
+  # subshell that would die 143 and orphan the run.
+  exec env GS_SUPERVISE=1 GS_WATCHDOG=off GS_HANG_BOUND_S=60 \
+      GS_FAULTS="step=${HANG}:kind=hang" \
+      JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+      python3 "${REPO}/gray-scott.py" config.toml
+) > "$WORK/term.log" 2>&1 &
+TERM_PID=$!
+for _ in $(seq 1 600); do
+  grep -aq '"kind": "hang"' "$WORK/term/gs.bp.faults.jsonl" 2>/dev/null && break
+  sleep 0.1
 done
+kill -TERM "$TERM_PID"
+RC=0; wait "$TERM_PID" || RC=$?
+if [ "$RC" -ne 75 ]; then
+  echo "chaos_smoke: FAIL — SIGTERM run exited $RC, want 75 (EXIT_PREEMPTED)" >&2
+  tail -n 20 "$WORK/term.log" >&2
+  exit 1
+fi
+grep -aq '"event": "graceful_shutdown"' "$WORK/term/gs.bp.faults.jsonl" || {
+  echo "chaos_smoke: FAIL — no graceful_shutdown marker journaled" >&2
+  exit 1
+}
+# A plain supervised relaunch must auto-resume from the marker.
+run "$WORK/term" GS_SUPERVISE=1 > "$WORK/term_resume.log" 2>&1
+grep -a "resuming after graceful_shutdown" "$WORK/term_resume.log" > /dev/null || {
+  echo "chaos_smoke: FAIL — relaunch did not auto-resume" >&2
+  exit 1
+}
+# Output stores byte-identical; the checkpoint store additionally holds
+# the off-schedule grace entry, so assert it is a superset ending on
+# the schedule instead of diffing bytes.
+assert_stores "$WORK/term" gs.bp gs.vtk
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 - "$WORK/term/ckpt.bp" <<'EOF'
+import sys
+from grayscott_jl_tpu.io.bplite import BpReader
 
-echo "chaos_smoke: PASS — recovered run is byte-identical" \
-     "(journal: $(wc -l < "$WORK/sup/gs.bp.faults.jsonl") events)"
+r = BpReader(sys.argv[1])
+steps = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+assert steps[-1] == 60 and sorted(set(steps)) == steps, steps
+assert set(range(20, 61, 20)) <= set(steps), steps
+EOF
+
+echo "chaos_smoke: PASS — all three scenarios recovered byte-identical" \
+     "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
+     "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
+     "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl") events)"

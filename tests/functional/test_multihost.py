@@ -124,6 +124,135 @@ def _run_dual(tmp_path, lang="Plain", extra_env=None):
     return d, outs
 
 
+#: Worker for the coordination-service consensus test: two REAL
+#: processes bring up jax.distributed over a localhost coordinator and
+#: run a restart rendezvous round through the live KV store — no XLA
+#: computation involved, so this exercises the quorum machinery even on
+#: jaxlib builds whose CPU backend lacks multi-process collectives.
+_KV_WORKER = """\
+import json, os, sys
+import jax
+jax.distributed.initialize(
+    coordinator_address=os.environ["GS_TPU_COORDINATOR"],
+    num_processes=int(os.environ["GS_TPU_NUM_PROCESSES"]),
+    process_id=int(os.environ["GS_TPU_PROCESS_ID"]),
+)
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.resilience import rendezvous
+
+rdv = rendezvous.from_env(Settings(output="out.bp"))
+assert type(rdv).__name__ == "KVRendezvous", type(rdv).__name__
+pid = jax.process_index()
+# rank 0's latest durable checkpoint is 40, rank 1's is 20; rank 1 also
+# claims a higher local attempt count — the quorum must adopt (max
+# attempt, min step) identically on both ranks, across two rounds.
+r1 = rdv.agree(attempt=pid, ckpt_step=40 if pid == 0 else 20)
+r2 = rdv.agree(attempt=r1[0] + 1, ckpt_step=None if pid == 0 else 60)
+print("KVRESULT " + json.dumps({"pid": pid, "r1": r1, "r2": r2}))
+"""
+
+
+def test_two_process_kv_restart_consensus(tmp_path):
+    """Restart rendezvous over the real JAX coordination service KV
+    (the transport supervised pods use), across two real processes."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        extra = {
+            "GS_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "GS_TPU_NUM_PROCESSES": "2",
+            "GS_TPU_PROCESS_ID": str(pid),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _KV_WORKER],
+                cwd=tmp_path, env=_env(tmp_path, 4, extra),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, out + err
+    import json
+
+    results = {}
+    for out, _ in outs:
+        for line in out.splitlines():
+            if line.startswith("KVRESULT "):
+                r = json.loads(line[len("KVRESULT "):])
+                results[r["pid"]] = (r["r1"], r["r2"])
+    assert set(results) == {0, 1}
+    # round 1: max attempt (1), min checkpoint (20) — on BOTH ranks
+    assert results[0][0] == results[1][0] == [1, 20]
+    # round 2: rank 0 has no durable checkpoint -> quorum says scratch
+    assert results[0][1] == results[1][1] == [2, None]
+
+
+@pytest.mark.slow
+def test_two_process_supervised_restart_consensus(tmp_path):
+    """The distributed-supervision acceptance scenario: a 2-process
+    supervised run with an injected hang (watchdog-recovered) and an
+    injected preemption; the ranks rendezvous on the quorum checkpoint,
+    restart together, and finish with stores byte-identical to an
+    unfaulted 2-process run. Slow-marked alongside the other
+    cross-process-collective tests: it needs a jaxlib whose CPU backend
+    implements multi-process computations."""
+    import json
+
+    cfg = _config().replace("steps = 20", "steps = 40")
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "config.toml").write_text(cfg)
+    _run_pair(ref, "config.toml")
+
+    sup = tmp_path / "sup"
+    sup.mkdir()
+    (sup / "config.toml").write_text(cfg)
+    outs = _run_pair(sup, "config.toml", extra_env={
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": "step=15:kind=hang;step=35:kind=preempt",
+        "GS_WATCHDOG": "on",
+        "GS_WATCHDOG_STEP_ROUND_S": "3",
+        "GS_HANG_BOUND_S": "60",
+        "GS_TPU_STATS": "stats.json",
+    })
+    assert "supervisor:" in outs[0][0] + outs[0][1]
+
+    # byte-identity against the unfaulted pair run, both stores
+    for store in ("out.bp", "ckpt.bp"):
+        rs = BpReader(str(ref / store))
+        rd = BpReader(str(sup / store))
+        assert rd.num_steps() == rs.num_steps()
+        for var in ("U", "V") if store == "out.bp" else ("u", "v"):
+            for step in range(rs.num_steps()):
+                np.testing.assert_array_equal(
+                    rs.get(var, step=step), rd.get(var, step=step)
+                )
+
+    # per-rank provenance: both ranks saw both faults, agreed on the
+    # same quorum resume step each round, and tagged events with proc
+    resumes = {}
+    for rank in range(2):
+        stats = json.loads(
+            (sup / f"stats.json.rank{rank}").read_text()
+        )
+        events = stats["faults"]
+        assert {e["kind"] for e in events if e["event"] == "injected"} == {
+            "hang", "preempt",
+        }
+        assert all(e["proc"] == rank for e in events)
+        rdv_events = [e for e in events if e["event"] == "rendezvous"]
+        assert rdv_events, "no rendezvous recorded"
+        resumes[rank] = [
+            (e["round"], e["quorum_step"]) for e in rdv_events
+        ]
+        kinds = [e["kind"] for e in events if e["event"] == "recovery"]
+        assert kinds == ["hang", "preemption"]
+    assert resumes[0] == resumes[1]  # quorum-agreed on both ranks
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("lang", ["Plain", "Pallas"])
 def test_two_process_run_matches_single_process(tmp_path, lang):

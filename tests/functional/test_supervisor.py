@@ -11,6 +11,7 @@ deterministic tier-1 variant.
 """
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -206,6 +207,157 @@ def test_unsupervised_failure_closes_stores(tmp_path):
     assert [int(ck.get("step", step=i)) for i in range(ck.num_steps())] == [
         20, 40,
     ]
+
+
+def test_chaos_hang_watchdog_recovers_byte_identical(
+    tmp_path, uninterrupted
+):
+    """An injected driver hang under an armed watchdog: the step_round
+    deadline expires mid-stall, the all-thread stack dump lands in the
+    journal, the stall unwinds as a classified ``hang``, and the
+    supervisor resumes from the durable checkpoint — final stores
+    byte-identical to the uninterrupted run."""
+    d, res, stats_path = _supervised(
+        tmp_path, "hang", "step=25:kind=hang",
+        extra_env={
+            "GS_WATCHDOG": "on",
+            # step rounds are sub-second here; 3s is comfortably above
+            # CI jitter and far below the 40s stall bound (which only
+            # exists so a broken watchdog fails the test instead of
+            # wedging it).
+            "GS_WATCHDOG_STEP_ROUND_S": "3",
+            "GS_HANG_BOUND_S": "40",
+        },
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(uninterrupted / store, d / store)
+
+    stats = json.loads(stats_path.read_text())
+    events = stats["faults"]
+    assert ("injected", "hang") in [
+        (e["event"], e["kind"]) for e in events
+    ]
+    hangs = [e for e in events if e["event"] == "hang"]
+    assert hangs and hangs[0]["phase"] == "step_round"
+    # the stack dump names the stalled driver thread — the diagnosis a
+    # wedge used to burn 19+ minutes not producing
+    assert any("MainThread" in t["thread"] for t in hangs[0]["threads"])
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    assert recoveries[0]["kind"] == "hang"
+    assert recoveries[0]["action"].startswith("resumed_from_checkpoint_step_")
+    # watchdog provenance in the stats config echo
+    assert stats["watchdog"]["enabled"] is True
+    assert stats["watchdog"]["deadlines_s"]["step_round"] == 3.0
+
+
+def test_hang_without_watchdog_resolves_transparently(
+    tmp_path, uninterrupted
+):
+    """Unwatched, the injected stall is bounded: the run just runs
+    slower — faults change WHEN the run computes, never WHAT it
+    writes."""
+    d, res, stats_path = _supervised(
+        tmp_path, "hangoff", "step=25:kind=hang",
+        extra_env={"GS_WATCHDOG": "off", "GS_HANG_BOUND_S": "1"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    _assert_trees_byte_identical(uninterrupted / "gs.bp", d / "gs.bp")
+    events = json.loads(stats_path.read_text())["faults"]
+    assert [e["kind"] for e in events] == ["hang"]  # injected, no recovery
+
+
+def test_sigterm_graceful_checkpoint_and_supervised_auto_resume(
+    tmp_path, uninterrupted
+):
+    """The preemption contract end to end: SIGTERM mid-run -> the
+    boundary writes a grace-window checkpoint (off-schedule), drains
+    the async writer, exits with the distinct preemption code 75; a
+    plain supervised relaunch reads the journal's graceful_shutdown
+    marker, auto-resumes from that checkpoint, and finishes with output
+    stores byte-identical to the uninterrupted run."""
+    import signal
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    from test_end_to_end import REPO
+
+    d = tmp_path / "sig"
+    d.mkdir()
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env.update({
+        "GS_SUPERVISE": "1",
+        # An unwatched injected stall at boundary 30 parks the run at a
+        # deterministic spot; the journal line is fsynced before the
+        # stall starts, so polling it makes the SIGTERM timing exact.
+        "GS_WATCHDOG": "off",
+        "GS_FAULTS": "step=25:kind=hang",
+        "GS_HANG_BOUND_S": "60",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+        cwd=d, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    journal = Path(d / "gs.bp.faults.jsonl")
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if journal.exists() and '"kind": "hang"' in journal.read_text():
+            break
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("injected hang never journaled")
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 75, out + err  # EXIT_PREEMPTED
+    assert "Graceful-shutdown checkpoint accepted at step 30" in out
+    assert "graceful shutdown on SIGTERM at step 30" in err
+
+    events = [
+        json.loads(line) for line in journal.read_text().splitlines()
+    ]
+    assert events[-1]["event"] == "graceful_shutdown"
+    assert events[-1]["checkpoint_step"] == 30
+    ck = BpReader(str(d / "ckpt.bp"))
+    steps = [int(ck.get("step", step=i)) for i in range(ck.num_steps())]
+    assert steps == [20, 30]  # 30 is the off-schedule grace checkpoint
+
+    # relaunch the SAME config under supervision: the journal marker
+    # triggers the auto-resume, no restart= config edit needed
+    res = run_cli(d, cfg, extra_env={"GS_SUPERVISE": "1"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "resuming after graceful_shutdown" in res.stdout
+    assert "Restarted from ckpt.bp at step 30" in res.stdout
+    for store in ("gs.bp", "gs.vtk"):
+        _assert_trees_byte_identical(uninterrupted / store, d / store)
+    # the checkpoint store keeps the extra grace entry (by design — it
+    # is the resume point), then rejoins the schedule
+    ck = BpReader(str(d / "ckpt.bp"))
+    assert [int(ck.get("step", step=i)) for i in range(ck.num_steps())] == [
+        20, 30, 40, 60,
+    ]
+    events = [
+        json.loads(line) for line in journal.read_text().splitlines()
+    ]
+    resumes = [e for e in events if e.get("after") == "graceful_shutdown"]
+    assert resumes and resumes[0]["action"] == (
+        "resumed_from_checkpoint_step_30"
+    )
 
 
 @pytest.mark.parametrize("depth", [0, 2])

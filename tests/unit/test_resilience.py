@@ -66,6 +66,32 @@ def test_fault_plan_rejects_malformed_specs(spec):
         FaultPlan.parse(spec)
 
 
+def test_hang_kind_parse_roundtrip_and_consume_once():
+    """The watchdog's chaos hook (satellite: ``hang`` is a first-class
+    FAULT_KINDS member with full parser round-trip semantics)."""
+    from grayscott_jl_tpu.resilience import FAULT_KINDS
+
+    assert "hang" in FAULT_KINDS
+    plan = FaultPlan.parse("step=25:kind=hang;step=45:kind=preempt")
+    assert [(f.step, f.kind) for f in plan.faults] == [
+        (25, "hang"), (45, "preempt"),
+    ]
+    # describe() round-trips back through parse()
+    spec = ";".join(
+        f"step={d['step']}:kind={d['kind']}"
+        for d in plan.describe()
+    )
+    again = FaultPlan.parse(spec)
+    assert [(f.step, f.kind) for f in again.faults] == [
+        (f.step, f.kind) for f in plan.faults
+    ]
+    # consume-once at the first boundary >= step, like every other kind
+    assert plan.take("hang", 20) is None
+    fired = plan.take("hang", 30)
+    assert fired.step == 25 and fired.fired
+    assert plan.take("hang", 1000) is None
+
+
 def test_fault_plan_take_is_consume_once_and_kind_scoped():
     plan = FaultPlan.parse("step=20:kind=nan;step=40:kind=nan")
     assert plan.take("nan", 10) is None  # not due yet

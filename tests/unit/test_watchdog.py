@@ -1,0 +1,331 @@
+"""Unit tests for the hang watchdog, graceful shutdown, and restart
+rendezvous (``resilience/watchdog.py``, ``faults.py`` shutdown pieces,
+``rendezvous.py``).
+
+All host-side, no JAX backend required. The end-to-end recovery
+behavior (watchdog-tripped hang -> supervised restart -> byte-identical
+stores; SIGTERM -> graceful checkpoint -> exit 75 -> auto-resume) is
+covered by ``tests/functional/test_supervisor.py``; the 2-process
+consensus by ``tests/functional/test_multihost.py``.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.resilience import (
+    EXIT_HANG,
+    EXIT_PREEMPTED,
+    FaultJournal,
+    GracefulShutdown,
+    HangError,
+    PreemptionError,
+    ShutdownListener,
+    Watchdog,
+    classify_failure,
+    injected_hang_wait,
+    resolve_watchdog,
+    resume_marker,
+)
+from grayscott_jl_tpu.resilience.faults import resolve_graceful_shutdown
+from grayscott_jl_tpu.resilience.rendezvous import (
+    FileRendezvous,
+    KVRendezvous,
+    RendezvousTimeout,
+    _decide,
+)
+
+# ------------------------------------------------------------ resolution
+
+
+def test_resolve_watchdog_auto_follows_supervision(monkeypatch):
+    for var in ("GS_WATCHDOG", "GS_SUPERVISE", "GS_WATCHDOG_DEADLINE_S"):
+        monkeypatch.delenv(var, raising=False)
+    assert resolve_watchdog(Settings()) is None  # unsupervised: off
+    assert resolve_watchdog(Settings(supervise=True)) is not None
+    monkeypatch.setenv("GS_SUPERVISE", "1")
+    assert resolve_watchdog(Settings()) is not None
+    monkeypatch.setenv("GS_WATCHDOG", "off")  # explicit off wins
+    assert resolve_watchdog(Settings(supervise=True)) is None
+    monkeypatch.setenv("GS_WATCHDOG", "on")
+    monkeypatch.delenv("GS_SUPERVISE", raising=False)
+    assert resolve_watchdog(Settings()) is not None  # on without supervise
+
+
+def test_resolve_watchdog_deadline_overrides(monkeypatch):
+    monkeypatch.setenv("GS_WATCHDOG", "on")
+    monkeypatch.delenv("GS_WATCHDOG_DEADLINE_S", raising=False)
+    base = resolve_watchdog(Settings())
+    assert base["compile"] > base["step_round"] > 0  # per-phase defaults
+    monkeypatch.setenv("GS_WATCHDOG_DEADLINE_S", "7.5")
+    assert set(resolve_watchdog(Settings()).values()) == {7.5}
+    monkeypatch.setenv("GS_WATCHDOG_STEP_ROUND_S", "2.5")
+    d = resolve_watchdog(Settings())
+    assert d["step_round"] == 2.5 and d["compile"] == 7.5
+    # the TOML key works too (env unset), and env wins over it
+    monkeypatch.delenv("GS_WATCHDOG_DEADLINE_S", raising=False)
+    monkeypatch.delenv("GS_WATCHDOG_STEP_ROUND_S", raising=False)
+    d = resolve_watchdog(Settings(watchdog="on", watchdog_deadline_s=9.0))
+    assert set(d.values()) == {9.0}
+    with pytest.raises(ValueError, match="GS_WATCHDOG"):
+        monkeypatch.setenv("GS_WATCHDOG", "sideways")
+        resolve_watchdog(Settings())
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def _quiet_watchdog(deadlines, journal=None, grace_s=0):
+    """A watchdog that never interrupts the test runner's main thread."""
+    return Watchdog(
+        deadlines, journal=journal, grace_s=grace_s, on_expire=lambda: None
+    )
+
+
+def test_watchdog_fires_after_deadline_and_journals_stacks():
+    j = FaultJournal(None)
+    with _quiet_watchdog({"step_round": 0.15}, journal=j) as wd:
+        wd.heartbeat("step_round", 42)
+        time.sleep(0.6)
+        assert wd.expired is not None
+        with pytest.raises(HangError, match="step_round.*step 42"):
+            wd.check()
+    events = [e for e in j.events if e["event"] == "hang"]
+    assert len(events) == 1  # fires exactly once
+    e = events[0]
+    assert e["kind"] == "hang" and e["phase"] == "step_round"
+    assert e["step"] == 42
+    # the all-thread stack dump names this (wedged) thread
+    assert any(
+        "MainThread" in t["thread"] and t["stack"] for t in e["threads"]
+    )
+    d = wd.describe()
+    assert d["expired"]["phase"] == "step_round"
+
+
+def test_watchdog_heartbeats_keep_it_alive_and_stop_disarms():
+    with _quiet_watchdog({"step_round": 0.3}) as wd:
+        for i in range(6):
+            wd.heartbeat("step_round", i)
+            time.sleep(0.1)
+        assert wd.expired is None  # heartbeats within deadline
+    wd2 = _quiet_watchdog({"step_round": 0.15}).start()
+    wd2.heartbeat("step_round", 0)
+    wd2.stop()  # run unwound before expiry
+    time.sleep(0.4)
+    assert wd2.expired is None
+
+
+def test_watchdog_touch_only_rearms_the_armed_phase():
+    with _quiet_watchdog({"drain": 0.3, "io": 0.3}) as wd:
+        wd.heartbeat("drain", 1)
+        for _ in range(5):
+            time.sleep(0.1)
+            wd.touch("io", 9)  # wrong phase: must NOT keep it alive
+        assert wd.expired is not None and wd.expired["phase"] == "drain"
+    with _quiet_watchdog({"drain": 0.3}) as wd:
+        wd.heartbeat("drain", 1)
+        for _ in range(5):
+            time.sleep(0.1)
+            wd.touch("drain", 2)  # the async writer's progress path
+        assert wd.expired is None
+
+
+def test_watchdog_interrupts_main_thread():
+    """The default on_expire delivers a KeyboardInterrupt to the main
+    thread — how a Python-level stall is torn down for real."""
+    wd = Watchdog({"step_round": 0.2}, grace_s=0).start()
+    wd.heartbeat("step_round", 7)
+    t0 = time.monotonic()
+    with pytest.raises(KeyboardInterrupt):
+        while time.monotonic() - t0 < 5.0:
+            time.sleep(0.05)
+    wd.stop()
+    assert wd.expired is not None
+    assert time.monotonic() - t0 < 4.0
+
+
+def test_injected_hang_wait_bounded_and_watchdog_aware():
+    t0 = time.monotonic()
+    injected_hang_wait(bound_s=0.2)  # unwatched: resolves at the bound
+    assert 0.15 <= time.monotonic() - t0 < 2.0
+
+    with _quiet_watchdog({"step_round": 0.15}) as wd:
+        wd.heartbeat("step_round", 3)
+        with pytest.raises(HangError):
+            injected_hang_wait(watchdog=wd, bound_s=30.0)
+
+    class _Shutdown:
+        requested = True
+        signum = signal.SIGTERM
+
+    t0 = time.monotonic()
+    injected_hang_wait(shutdown=_Shutdown(), bound_s=30.0)
+    assert time.monotonic() - t0 < 2.0  # SIGTERM resolves the stall
+
+
+# -------------------------------------------------- classification, exits
+
+
+def test_hang_and_graceful_shutdown_classification():
+    assert classify_failure(HangError("step_round", 40, 2.0)) == "hang"
+    g = GracefulShutdown(signal.SIGTERM, 30, 30)
+    assert isinstance(g, PreemptionError)
+    assert classify_failure(g) == "preemption"
+    assert "SIGTERM" in str(g) and "step 30" in str(g)
+    assert EXIT_PREEMPTED != EXIT_HANG
+    assert EXIT_PREEMPTED not in (0, 1) and EXIT_HANG not in (0, 1)
+
+
+def test_shutdown_listener_first_signal_requests_second_forces():
+    lis = ShutdownListener()
+    with lis:
+        assert not lis.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert lis.requested and lis.signum == signal.SIGTERM
+        with pytest.raises(KeyboardInterrupt, match="second signal"):
+            signal.raise_signal(signal.SIGTERM)
+    # restored: the default handler is back (raise outside would kill us)
+    assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_shutdown_listener_reraises_watchdog_interrupt():
+    class _Expired:
+        expired = {"phase": "step_round"}
+
+    with ShutdownListener(watchdog=_Expired()):
+        with pytest.raises(KeyboardInterrupt, match="watchdog"):
+            signal.raise_signal(signal.SIGINT)
+
+
+def test_resolve_graceful_shutdown(monkeypatch):
+    monkeypatch.delenv("GS_GRACEFUL_SHUTDOWN", raising=False)
+    assert resolve_graceful_shutdown(Settings())
+    assert not resolve_graceful_shutdown(Settings(graceful_shutdown=False))
+    monkeypatch.setenv("GS_GRACEFUL_SHUTDOWN", "0")
+    assert not resolve_graceful_shutdown(Settings())
+
+
+# ------------------------------------------------------- resume markers
+
+
+def test_resume_marker_reads_trailing_marker_only(tmp_path):
+    path = tmp_path / "j.jsonl"
+    j = FaultJournal(str(path))
+    j.record(event="injected", kind="hang", step=30)
+    assert resume_marker(str(path)) is None
+    j.record(event="graceful_shutdown", signal=15, step=30,
+             checkpoint_step=30)
+    m = resume_marker(str(path))
+    assert m["event"] == "graceful_shutdown" and m["checkpoint_step"] == 30
+    # any later event (the resuming launch's own record) clears it
+    j.record(event="recovery", kind="preemption", attempt=0, action="resumed")
+    assert resume_marker(str(path)) is None
+    # hang_exit is the watchdog hard-exit marker
+    j.record(event="hang_exit", kind="hang", phase="step_round", step=40)
+    assert resume_marker(str(path))["event"] == "hang_exit"
+    # a torn tail (mid-write SIGKILL) must not block the resume
+    with open(path, "a") as f:
+        f.write('{"event": "hang_ex')
+    assert resume_marker(str(path))["event"] == "hang_exit"
+    assert resume_marker(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_fault_journal_tags_process_index(tmp_path):
+    j = FaultJournal(str(tmp_path / "j.jsonl"), process_index=1)
+    j.record(event="injected", kind="preempt", step=5)
+    assert j.events[0]["proc"] == 1
+    line = json.loads((tmp_path / "j.jsonl").read_text())
+    assert line["proc"] == 1
+    # single-process journals stay untagged (existing format unchanged)
+    j0 = FaultJournal(None)
+    j0.record(event="injected", kind="nan", step=1)
+    assert "proc" not in j0.events[0]
+
+
+# ----------------------------------------------------------- rendezvous
+
+
+def test_rendezvous_decision_is_max_attempt_min_step():
+    assert _decide([{"attempt": 0, "ckpt": 40},
+                    {"attempt": 0, "ckpt": 20}]) == (0, 20)
+    # one rank classified an extra local failure: cluster adopts its count
+    assert _decide([{"attempt": 2, "ckpt": 40},
+                    {"attempt": 1, "ckpt": 40}]) == (2, 40)
+    # any rank without a durable checkpoint drags the quorum to scratch
+    assert _decide([{"attempt": 0, "ckpt": -1},
+                    {"attempt": 0, "ckpt": 60}]) == (0, None)
+
+
+def test_file_rendezvous_two_party_agreement(tmp_path):
+    d = str(tmp_path / "rdv")
+    results = {}
+
+    def party(proc, attempt, ckpt):
+        r = FileRendezvous(d, 2, proc, timeout_s=10.0)
+        results[proc] = r.agree(attempt, ckpt)
+
+    t0 = threading.Thread(target=party, args=(0, 0, 40))
+    t1 = threading.Thread(target=party, args=(1, 1, 20))
+    t0.start(); t1.start(); t0.join(); t1.join()
+    assert results[0] == results[1] == (1, 20)
+
+
+def test_file_rendezvous_round_and_launch_isolation(tmp_path):
+    d = str(tmp_path / "rdv")
+    a = FileRendezvous(d, 1, 0, timeout_s=5.0, launch_id="aaaa")
+    assert a.agree(0, 10) == (0, 10)
+    assert a.agree(1, 30) == (1, 30)  # round 2 does not reread round 1
+    # a fresh launch (new id) never matches the previous launch's files
+    b = FileRendezvous(d, 1, 0, timeout_s=5.0, launch_id="bbbb")
+    assert b.agree(0, None) == (0, None)
+
+
+def test_file_rendezvous_times_out_on_missing_peer(tmp_path):
+    r = FileRendezvous(str(tmp_path / "rdv"), 2, 0, timeout_s=0.3)
+    with pytest.raises(RendezvousTimeout, match=r"processes \[1\]"):
+        r.agree(0, 10)
+
+
+class _FakeKVClient:
+    """The coordination-service KV surface the rendezvous uses."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value):
+        assert key not in self.kv  # the real service forbids overwrite
+        self.kv[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while time.monotonic() < deadline:
+            if key in self.kv:
+                return self.kv[key]
+            time.sleep(0.01)
+        raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+
+
+def test_kv_rendezvous_two_party_agreement():
+    client = _FakeKVClient()
+    results = {}
+
+    def party(proc, attempt, ckpt):
+        r = KVRendezvous(client, 2, proc, timeout_s=10.0)
+        results[proc] = r.agree(attempt, ckpt)
+
+    t0 = threading.Thread(target=party, args=(0, 2, None))
+    t1 = threading.Thread(target=party, args=(1, 0, 60))
+    t0.start(); t1.start(); t0.join(); t1.join()
+    assert results[0] == results[1] == (2, None)
+
+
+def test_kv_rendezvous_timeout_wraps_client_error():
+    r = KVRendezvous(_FakeKVClient(), 2, 0, timeout_s=0.2)
+    with pytest.raises(RendezvousTimeout, match="process 1"):
+        r.agree(0, 10)
