@@ -1,0 +1,321 @@
+#!/usr/bin/env python3
+"""Ensemble A/B: N-member batched launch vs N sequential solo runs.
+
+The batched ensemble engine's claim is aggregate throughput for the
+phase-diagram-sweep workflow: today a sweep over (F, k, Du, Dv, noise,
+seed) costs N FULL launches — N processes, N Simulation constructions,
+N jit compiles of the same step program — where the ensemble engine
+pays all of that once. This tool measures both layers and emits a
+JSONL artifact in the shared ``benchmarks/artifacts.py`` schema:
+
+* ``ab="ensemble"`` — the in-process steady-state step-loop A/B
+  (``utils/benchmark.time_sim_rounds`` on both sides; compile
+  excluded): what the vmapped batch buys per step from op-dispatch
+  amortization and lane fill alone. One ``ab="ensemble_member"`` row
+  per solo run rides along.
+* ``ab="ensemble_launch"`` — the campaign-level A/B: each sequential
+  member is a REAL ``gray-scott.py`` launch (own process: interpreter
+  + jax init + construct + compile + run), the batched side is ONE
+  launch of the same campaign with the ``[ensemble]`` table. This is
+  the number the sweep user experiences, and the acceptance gate
+  (aggregate cell-updates/s, batched vs N sequential runs).
+
+    # CPU fallback (the committed artifact):
+    python benchmarks/ensemble_bench.py --cpu --devices 1 \
+        --L 16 --members 8 --campaign-steps 400
+
+    # TPU chip, members sharded 4-way over an 8-chip slice:
+    python benchmarks/ensemble_bench.py --devices 8 --member-shards 4 \
+        --L 64 --members 16
+
+``benchmarks/tune_sweep.py --calibrate --ensemble N`` runs the same
+A/B at its tuned winner config and appends to its artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+
+def build_settings(L: int, members: int, member_shards: int,
+                   noise: float, backend: str, lang: str):
+    """Bench Settings + an F/k linspace sweep ensemble of ``members``
+    (the phase-diagram sweep shape a real campaign runs)."""
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+
+    settings = Settings(
+        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=noise,
+        precision="Float32", backend=backend, kernel_language=lang,
+    )
+    settings.ensemble = ens_spec.from_toml(
+        {
+            "members": members,
+            "member_shards": member_shards,
+            "sweep": {
+                "F": {"from": 0.010, "to": 0.060},
+                "k": {"from": 0.045, "to": 0.065},
+            },
+        },
+        settings,
+    )
+    return settings
+
+
+def run_ab(
+    settings,
+    *,
+    n_devices: int,
+    steps: int,
+    rounds: int,
+    out: str,
+    backend: str,
+    seed: int = 0,
+) -> dict:
+    """Measure batched-vs-sequential at one config; returns (and
+    appends) the summary row."""
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+    from grayscott_jl_tpu.ensemble.io import member_settings
+    from grayscott_jl_tpu.simulation import Simulation
+    from grayscott_jl_tpu.utils.benchmark import time_sim_rounds
+
+    ens = settings.ensemble
+    L, n = settings.L, ens.n
+
+    batched = EnsembleSimulation(settings, n_devices=n_devices, seed=seed)
+    t_b = time_sim_rounds(batched, steps, rounds)
+    base = {
+        "t": artifacts.utc_stamp(),
+        "platform": backend.lower(),
+        "devices": batched.domain.n_blocks * batched.member_shards,
+        "mesh": list(batched.domain.dims),
+        "member_shards": batched.member_shards,
+        "L": L,
+        "members": n,
+        "kernel": batched.kernel_language,
+    }
+
+    seq_s_per_step = []
+    for i in range(n):
+        solo = Simulation(
+            member_settings(settings, i), n_devices=n_devices,
+            seed=seed + i,
+        )
+        t_i = time_sim_rounds(solo, steps, rounds)
+        seq_s_per_step.append(t_i["median"])
+        row = dict(base, ab="ensemble_member", member=i,
+                   **ens.members[i].describe(),
+                   median_us_per_step=round(t_i["median"] * 1e6, 1),
+                   best_us_per_step=round(t_i["best"] * 1e6, 1))
+        artifacts.append_row(out, row)
+
+    seq_total = sum(seq_s_per_step)  # advance all N one step, serially
+    agg_batched = n * L**3 / t_b["median"]
+    agg_seq = n * L**3 / seq_total
+    summary = dict(
+        base,
+        ab="ensemble",
+        steps=steps,
+        rounds=rounds,
+        batched_us_per_step=round(t_b["median"] * 1e6, 1),
+        batched_best_us_per_step=round(t_b["best"] * 1e6, 1),
+        sequential_us_per_step=round(seq_total * 1e6, 1),
+        agg_cell_updates_per_s_batched=round(agg_batched, 1),
+        agg_cell_updates_per_s_sequential=round(agg_seq, 1),
+        speedup=round(seq_total / t_b["median"], 3),
+    )
+    artifacts.append_row(out, summary)
+    print(json.dumps(summary))
+    return summary
+
+
+CONFIG_TMPL = """\
+L = {L}
+Du = {Du}
+Dv = {Dv}
+F = {F}
+k = {k}
+dt = 1.0
+noise = {noise}
+steps = {steps}
+plotgap = 0
+output = "{output}"
+precision = "Float32"
+backend = "{backend}"
+kernel_language = "{kernel}"
+"""
+
+ENSEMBLE_TMPL = """
+[ensemble]
+members = {members}
+member_shards = {member_shards}
+
+[ensemble.sweep]
+F = {{ from = 0.010, to = 0.060 }}
+k = {{ from = 0.045, to = 0.065 }}
+"""
+
+
+def _launch(config_path: str, cwd: str, *, cpu: bool, devices: int,
+            seed: int = 0) -> float:
+    """One real CLI launch; returns its wall-clock seconds."""
+    import subprocess
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags and devices > 1:
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{devices}"
+            ).strip()
+    env["GS_SEED"] = str(seed)
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "gray-scott.py"), config_path],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=1800,
+    )
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"launch {config_path} failed rc={res.returncode}: "
+            f"{res.stderr[-800:]}"
+        )
+    return time.perf_counter() - t0
+
+
+def run_launch_ab(
+    settings,
+    *,
+    n_devices: int,
+    campaign_steps: int,
+    out: str,
+    backend: str,
+    cpu: bool,
+) -> dict:
+    """The campaign A/B: N real sequential CLI launches vs ONE batched
+    CLI launch of the same sweep; aggregate cell-updates/s over launch
+    wall-clock (interpreter + construct + compile + run — the cost the
+    motivation names: 'a sweep costs N full launches')."""
+    import tempfile
+
+    from grayscott_jl_tpu.ensemble.io import member_settings
+
+    ens = settings.ensemble
+    L, n = settings.L, ens.n
+    kernel = settings.kernel_language
+    with tempfile.TemporaryDirectory() as work:
+        seq_wall = 0.0
+        for i in range(n):
+            ms = member_settings(settings, i)
+            cfg = os.path.join(work, f"member{i}.toml")
+            with open(cfg, "w", encoding="utf-8") as f:
+                f.write(CONFIG_TMPL.format(
+                    L=L, Du=ms.Du, Dv=ms.Dv, F=ms.F, k=ms.k,
+                    noise=ms.noise, steps=campaign_steps,
+                    output=f"m{i}.bp", backend=settings.backend,
+                    kernel=kernel,
+                ))
+            seq_wall += _launch(cfg, work, cpu=cpu, devices=n_devices,
+                                seed=i)
+
+        cfg = os.path.join(work, "ensemble.toml")
+        with open(cfg, "w", encoding="utf-8") as f:
+            f.write(CONFIG_TMPL.format(
+                L=L, Du=settings.Du, Dv=settings.Dv, F=settings.F,
+                k=settings.k, noise=settings.noise,
+                steps=campaign_steps, output="ens.bp",
+                backend=settings.backend, kernel=kernel,
+            ) + ENSEMBLE_TMPL.format(
+                members=n, member_shards=ens.member_shards,
+            ))
+        batched_wall = _launch(cfg, work, cpu=cpu, devices=n_devices)
+
+    cells = n * L**3 * campaign_steps
+    summary = {
+        "t": artifacts.utc_stamp(),
+        "ab": "ensemble_launch",
+        "platform": backend.lower(),
+        "devices": n_devices,
+        "member_shards": ens.member_shards,
+        "L": L,
+        "members": n,
+        "kernel": kernel,
+        "campaign_steps": campaign_steps,
+        "batched_wall_s": round(batched_wall, 3),
+        "sequential_wall_s": round(seq_wall, 3),
+        "agg_cell_updates_per_s_batched": round(cells / batched_wall, 1),
+        "agg_cell_updates_per_s_sequential": round(cells / seq_wall, 1),
+        "speedup": round(seq_wall / batched_wall, 3),
+    }
+    artifacts.append_row(out, summary)
+    print(json.dumps(summary))
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--L", type=int, default=16)
+    ap.add_argument("--members", type=int, default=8)
+    ap.add_argument("--member-shards", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=50,
+                    help="steps per steady-state timing round")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--campaign-steps", type=int, default=400,
+                    help="steps per launch in the campaign A/B "
+                    "(0 skips the launch-level measurement)")
+    ap.add_argument("--noise", type=float, default=0.1)
+    ap.add_argument("--kernel", default="Plain",
+                    help="kernel_language for both sides")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--min-speedup", type=float, default=None,
+                    help="exit 1 if the launch-level batched speedup "
+                    "lands below this (CI gate)")
+    ap.add_argument("--out", default=None,
+                    help="JSONL artifact (default benchmarks/results/"
+                    "ensemble_ab_<platform>_<date>.jsonl)")
+    args = ap.parse_args()
+
+    from grayscott_jl_tpu.utils.benchmark import setup_platform
+
+    backend = setup_platform(args.cpu, args.devices)
+    out = args.out or artifacts.default_out("ensemble_ab", backend)
+
+    settings = build_settings(
+        args.L, args.members, args.member_shards, args.noise, backend,
+        args.kernel,
+    )
+    summary = run_ab(
+        settings, n_devices=args.devices, steps=args.steps,
+        rounds=args.rounds, out=out, backend=backend,
+    )
+    if args.campaign_steps > 0:
+        summary = run_launch_ab(
+            settings, n_devices=args.devices,
+            campaign_steps=args.campaign_steps, out=out,
+            backend=backend, cpu=args.cpu,
+        )
+    if args.min_speedup is not None and summary["speedup"] < args.min_speedup:
+        print(
+            f"# FAIL: batched speedup {summary['speedup']}x below the "
+            f"--min-speedup {args.min_speedup}x gate",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

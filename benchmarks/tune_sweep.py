@@ -199,6 +199,10 @@ def main() -> int:
                     help="also measure the overlap A/B at each winner "
                     "and run the fuse/overlap calibrators on the "
                     "artifact")
+    ap.add_argument("--ensemble", type=int, default=0,
+                    help="with --calibrate: also run the batched-vs-"
+                    "sequential ensemble A/B (ensemble_bench.py) with "
+                    "this many members at each winner config")
     ap.add_argument("--apply", action="store_true",
                     help="with --calibrate: rewrite the icimodel "
                     "literals from the measured ratios")
@@ -231,6 +235,28 @@ def main() -> int:
         if args.calibrate:
             overlap_ab_row(out, backend, settings, sim, L,
                            args.steps, args.rounds)
+            if args.ensemble > 0:
+                # Batched-vs-sequential ensemble A/B at the tuned
+                # winner's kernel language (ensemble_bench emits the
+                # ab="ensemble"/"ensemble_launch" rows into the same
+                # artifact).
+                import ensemble_bench
+
+                lang = ("Pallas" if sim.kernel_language == "pallas"
+                        else "Plain")
+                ens_settings = ensemble_bench.build_settings(
+                    L, args.ensemble, 1, args.noise, backend, lang,
+                )
+                ensemble_bench.run_ab(
+                    ens_settings, n_devices=args.devices,
+                    steps=args.steps, rounds=args.rounds, out=out,
+                    backend=backend,
+                )
+                ensemble_bench.run_launch_ab(
+                    ens_settings, n_devices=args.devices,
+                    campaign_steps=max(args.steps * 10, 200), out=out,
+                    backend=backend, cpu=args.cpu,
+                )
     print(f"# appended to {out}", file=sys.stderr)
     if args.calibrate:
         calibrate(out, args.apply)

@@ -100,6 +100,13 @@ class Settings:
     #: pick unchanged (bit-identical to "off" on a fresh machine).
     #: GS_AUTOTUNE env wins, mirroring the other knobs.
     autotune: str = ""
+    #: Batched ensemble (extension; docs/ENSEMBLE.md): the parsed
+    #: ``[ensemble]`` TOML table (an
+    #: :class:`~..ensemble.spec.EnsembleSettings`), or None for a
+    #: single-scenario run. When set, the driver runs all members as
+    #: ONE compiled executable (``ensemble/engine.py``) with
+    #: member-indexed output/checkpoint stores (``ensemble/io.py``).
+    ensemble: Any = None
 
 
 #: Keys accepted from the TOML file (reference ``Structs.jl:31-52``).
@@ -171,9 +178,16 @@ def parse_settings_toml(toml_contents: str) -> Settings:
     config_dict = _toml.loads(toml_contents)
     settings = Settings()
     for key, value in config_dict.items():
-        if key in SETTINGS_KEYS:
+        if key in SETTINGS_KEYS and key != "ensemble":
             field_type = Settings.__dataclass_fields__[key].type
             setattr(settings, key, _coerce(key, value, field_type))
+    # The [ensemble] table parses AFTER the scalar keys: member
+    # parameters default to the base Settings values set above.
+    ens = config_dict.get("ensemble")
+    if ens is not None:
+        from ..ensemble import spec as ensemble_spec
+
+        settings.ensemble = ensemble_spec.from_toml(ens, settings)
     return settings
 
 

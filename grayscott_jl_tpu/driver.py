@@ -87,8 +87,17 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     the run goes through the restart loop; otherwise a single open-loop
     :func:`run_once` — the reference's behavior, plus guaranteed store
     closure on failure.
+
+    ``GS_SEED`` overrides the base PRNG seed (default 0) without an API
+    call — e.g. to launch the solo-run equivalent of ensemble member k
+    (seed ``base + k``; docs/ENSEMBLE.md).
     """
+    import os
+
     settings = get_settings(list(args))
+    env_seed = os.environ.get("GS_SEED", "").strip()
+    if env_seed:
+        seed = int(env_seed)
 
     # Split-phase exchange support flags (async collective-permute +
     # latency-hiding scheduler) must reach XLA before the backend
@@ -233,30 +242,58 @@ def _run_once_inner(
 
     if wd is not None:
         wd.heartbeat("compile")
-    sim = Simulation(settings, n_devices=n_devices, seed=seed)
+    ens = getattr(settings, "ensemble", None)
+    if ens is not None:
+        # Batched ensemble run (docs/ENSEMBLE.md): one compiled launch
+        # advances every member; stores are member-indexed.
+        from .ensemble.engine import EnsembleSimulation
+
+        sim = EnsembleSimulation(settings, n_devices=n_devices, seed=seed)
+    else:
+        sim = Simulation(settings, n_devices=n_devices, seed=seed)
     log = Logger(verbose=settings.verbose)
     proc, nprocs = jax.process_index(), jax.process_count()
 
     restart_step = 0
     if settings.restart:
-        from .io.checkpoint import open_checkpoint
+        if ens is not None:
+            from .ensemble.io import restore_ensemble
 
-        reader, last, restart_step = open_checkpoint(
-            settings.restart_input, settings, settings.restart_step
-        )
-        sim.restore_from_reader(reader, last, restart_step)
-        reader.close()
-        log.info(f"Restarted from {settings.restart_input} at step {restart_step}")
+            restart_step = restore_ensemble(sim, settings)
+            log.info(
+                f"Restarted {ens.n} ensemble members from "
+                f"{settings.restart_input} member stores at step "
+                f"{restart_step}"
+            )
+        else:
+            from .io.checkpoint import open_checkpoint
 
-    from .io.checkpoint import CheckpointWriter
-    from .io.stream import SimStream
+            reader, last, restart_step = open_checkpoint(
+                settings.restart_input, settings, settings.restart_step
+            )
+            sim.restore_from_reader(reader, last, restart_step)
+            reader.close()
+            log.info(
+                f"Restarted from {settings.restart_input} at step "
+                f"{restart_step}"
+            )
 
-    stream = SimStream(
+    if ens is not None:
+        from .ensemble.io import EnsembleCheckpointWriter, EnsembleStream
+
+        stream_cls, ckpt_cls = EnsembleStream, EnsembleCheckpointWriter
+    else:
+        from .io.checkpoint import CheckpointWriter
+        from .io.stream import SimStream
+
+        stream_cls, ckpt_cls = SimStream, CheckpointWriter
+
+    stream = stream_cls(
         settings, sim.domain, sim.dtype, writer_id=proc, nwriters=nprocs,
         resume_step=restart_step if settings.restart else None,
     )
     ckpt = (
-        CheckpointWriter(
+        ckpt_cls(
             settings, sim.dtype, writer_id=proc, nwriters=nprocs,
             resume_step=restart_step if settings.restart else None,
         )
@@ -293,7 +330,19 @@ def _run_once_inner(
         # a stats reader can tell "not tuned" from "tuner off".
         "autotune_mode": resolve_autotune(settings),
         "process_index": proc,
+        "ensemble": (
+            {"members": ens.n, "member_shards": sim.member_shards}
+            if ens is not None else None
+        ),
     })
+    if ens is not None:
+        # Per-member section: params + resolved seeds up front; the
+        # latest per-member health lands here at each probed boundary.
+        stats.record_ensemble({
+            **ens.describe(),
+            "member_shards": sim.member_shards,
+            "seeds": list(sim.member_seeds),
+        })
     from .parallel import icimodel
 
     stats.record_comm(icimodel.comm_report(sim))
@@ -454,7 +503,21 @@ def _run_once_inner(
                     # Unhealthy + abort/rollback raises BEFORE the
                     # poisoned step is submitted — it never reaches the
                     # stores; warn records and writes anyway.
-                    event = guard.check(step, snap.health_report(), log=log)
+                    report = snap.health_report()
+                    if ens is not None and report is not None:
+                        stats.record_member_health(step, report)
+                    try:
+                        event = guard.check(step, report, log=log)
+                    except Exception:
+                        # Journal the failing report BEFORE unwinding:
+                        # for ensembles this is where the non-finite
+                        # member indices reach the FaultJournal.
+                        journal.record(
+                            event="health", kind="health", step=step,
+                            policy=guard.policy, action=guard.policy,
+                            **report.describe(),
+                        )
+                        raise
                     if event is not None:
                         journal.record(**event)
                 pipe.submit(step, snap, targets)
@@ -477,12 +540,21 @@ def _run_once_inner(
             pipe.close()
 
         elapsed = time.perf_counter() - t0
-        cells = settings.L**3 * (settings.steps - restart_step)
-        log.info(
-            f"Completed {settings.steps - restart_step} steps in "
-            f"{elapsed:.3f}s "
-            f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
-        )
+        members = ens.n if ens is not None else 1
+        cells = settings.L**3 * (settings.steps - restart_step) * members
+        if ens is not None:
+            log.info(
+                f"Completed {settings.steps - restart_step} steps for "
+                f"{members} ensemble members in {elapsed:.3f}s "
+                f"({cells / max(elapsed, 1e-9):.3e} aggregate "
+                "cell-updates/s)"
+            )
+        else:
+            log.info(
+                f"Completed {settings.steps - restart_step} steps in "
+                f"{elapsed:.3f}s "
+                f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
+            )
         stats.record_io(pipe.overlap_stats())
         if wd is not None:
             # Re-record with the final heartbeat count (the pre-loop

@@ -1,0 +1,37 @@
+"""Batched ensemble subsystem: N Gray-Scott scenarios, one launch.
+
+* :mod:`.spec` — the ``[ensemble]`` TOML table (presets, per-member
+  tables, linspace sweeps) -> :class:`~.spec.EnsembleSettings`;
+* :mod:`.engine` — :class:`~.engine.EnsembleSimulation`, the vmapped
+  member axis over the unchanged per-member step body;
+* :mod:`.io` — member-indexed output/checkpoint stores, byte-identical
+  to solo stores.
+
+See docs/ENSEMBLE.md. The spec module is import-light (no JAX) so the
+config layer can parse ensemble tables without touching the engine.
+"""
+
+from .spec import (  # noqa: F401
+    EnsembleSettings,
+    MemberSpec,
+    PRESETS,
+    resolve_seeds,
+)
+
+__all__ = [
+    "EnsembleSettings",
+    "EnsembleSimulation",
+    "MemberSpec",
+    "PRESETS",
+    "resolve_seeds",
+]
+
+
+def __getattr__(name):
+    # The engine pulls in jax + simulation; keep it lazy so importing
+    # the package for spec parsing stays cheap and cycle-free.
+    if name == "EnsembleSimulation":
+        from .engine import EnsembleSimulation
+
+        return EnsembleSimulation
+    raise AttributeError(name)

@@ -1,0 +1,395 @@
+"""Batched ensemble engine: N Gray-Scott scenarios in ONE executable.
+
+A phase-diagram sweep over (F, k, Du, Dv, noise, seed) used to cost N
+full launches; here the N parameter sets run as one compiled program:
+:class:`EnsembleSimulation` stacks a leading **member** axis onto the
+fields, params, and PRNG keys, and ``vmap``-s the *unchanged* per-member
+step body (``Simulation._local_run``) over it — stencil, in-jit noise,
+temporal-blocking chains, and the ``lax.ppermute`` halo exchange all
+batch through JAX's collective batching rules with zero ensemble-aware
+code in ``ops/`` or ``parallel/``. That is the point: the member axis
+composes with the existing spatial sharding instead of forking it.
+
+Mesh: the member axis is optionally sharded on a ``member`` ('m') mesh
+dimension in FRONT of the spatial axes — ``member_shards = m`` builds a
+``(m, dx, dy, dz)`` mesh where each device group of ``dx*dy*dz`` chips
+holds ``N/m`` members, and halo ppermutes still ride the spatial axes
+only (members are independent; no member-axis collectives exist at
+all).
+
+Equality contract (asserted in tier-1, ``tests/unit/test_ensemble.py``):
+member ``k`` of an N-member run is **bitwise identical** to a solo
+:class:`~..simulation.Simulation` with member ``k``'s params and seed
+on the same spatial mesh. Everything downstream leans on this — the
+per-member output stores (``ensemble/io.py``) are byte-identical to
+solo stores, so ensemble restart/resume and the chaos byte-identity
+harness reuse the solo machinery unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6 style
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..config.settings import Settings
+from ..models import grayscott
+from ..parallel.domain import CartDomain
+from ..simulation import (
+    AXIS_NAMES,
+    FieldSnapshot,
+    Simulation,
+    _SHARD_MAP_CHECK_FLAG,
+    mesh_for_topology,
+)
+from . import spec as ensemble_spec
+
+#: Mesh-axis name of the member dimension (in front of the spatial
+#: ('x', 'y', 'z') axes).
+MEMBER_AXIS = "m"
+
+
+class EnsembleFieldSnapshot(FieldSnapshot):
+    """A member-stacked snapshot: blocks carry a leading member axis
+    and the health probe resolves per member."""
+
+    def health_report(self):
+        """Per-member :class:`~..resilience.health.EnsembleHealthReport`
+        (or None) — each member's fused isfinite+range probe, so one
+        diverging member is attributed by index instead of anonymously
+        aborting the whole sweep."""
+        if self._health is None:
+            return None
+        from ..resilience.health import EnsembleHealthReport, HealthReport
+
+        finite, umin, umax, vmin, vmax = (
+            np.asarray(x) for x in self._health
+        )
+        return EnsembleHealthReport(tuple(
+            HealthReport(bool(f), float(a), float(b), float(c), float(d))
+            for f, a, b, c, d in zip(finite, umin, umax, vmin, vmax)
+        ))
+
+
+def member_blocks(blocks, member: int, member_offset: int = 0):
+    """Extract one member's spatial ``(offsets, sizes, u, v)`` blocks
+    from member-stacked 4D snapshot blocks.
+
+    Each 4D entry covers a member range ``[off_m, off_m + n_m)``; the
+    entry contributes iff it holds ``member``. Returns solo-format 3D
+    blocks — exactly what a solo run's ``local_blocks()`` yields, which
+    is what keeps per-member stores byte-identical to solo stores.
+    """
+    out = []
+    for offsets, sizes, ub, vb in blocks:
+        off_m, n_m = offsets[0], sizes[0]
+        if not (off_m <= member < off_m + n_m):
+            continue
+        i = member - off_m
+        out.append((tuple(offsets[1:]), tuple(sizes[1:]), ub[i], vb[i]))
+    return out
+
+
+class EnsembleSimulation(Simulation):
+    """N independent parameter sets advancing in one compiled launch."""
+
+    snapshot_cls = EnsembleFieldSnapshot
+    is_ensemble = True
+
+    def __init__(
+        self,
+        settings: Settings,
+        *,
+        n_devices: Optional[int] = None,
+        seed: int = 0,
+    ):
+        ens = getattr(settings, "ensemble", None)
+        if ens is None:
+            raise ValueError(
+                "EnsembleSimulation requires settings.ensemble "
+                "(an [ensemble] TOML table; docs/ENSEMBLE.md)"
+            )
+        self.ens: ensemble_spec.EnsembleSettings = ens
+        self.n_members = ens.n
+        self.member_shards = int(ens.member_shards)
+        self.member_seeds = ensemble_spec.resolve_seeds(ens, seed)
+        super().__init__(settings, n_devices=n_devices, seed=seed)
+
+    # ------------------------------------------------- construction hooks
+
+    def _make_domain(self, devices) -> CartDomain:
+        m = self.member_shards
+        if len(devices) % m:
+            raise ValueError(
+                f"member_shards = {m} does not divide the "
+                f"{len(devices)} selected devices"
+            )
+        # The member axis consumes its devices in front; the spatial
+        # decomposition (and therefore `self.sharded`, the halo
+        # exchange, kernel dispatch, autotune mesh sweeps) sees only
+        # the remaining count — unchanged solo semantics underneath.
+        return CartDomain.create(len(devices) // m, self.settings.L)
+
+    def _make_params(self) -> grayscott.Params:
+        """Member-stacked Params pytree: every leaf is ``(N,)``, fed to
+        the vmapped step body with ``in_axes=0``."""
+        return grayscott.Params(*(
+            jnp.asarray([getattr(mem, f) for mem in self.ens.members],
+                        self.dtype)
+            for f in grayscott.Params._fields
+        ))
+
+    def _resolve_use_noise(self) -> bool:
+        # One compiled program for all members: the noise term is
+        # traced in if ANY member draws (a member with noise = 0 then
+        # adds an exact-zero field — see docs/ENSEMBLE.md for the
+        # equality fine print).
+        return any(mem.noise != 0.0 for mem in self.ens.members)
+
+    def _make_base_key(self, seed: int):
+        """(N, 2) stacked PRNG keys — per-member position-keyed noise
+        streams; member k's stream equals a solo run at its seed."""
+        return jnp.stack([
+            jax.random.PRNGKey(s) for s in self.member_seeds
+        ])
+
+    def _tune_extras(self) -> dict:
+        return {
+            "ensemble": self.n_members,
+            "member_shards": self.member_shards,
+            "sim_cls": type(self),
+        }
+
+    def _apply_tune_extras(self, decision) -> None:
+        """Adopt a measured ``member_shards`` split (the batch-size ×
+        block-shape trade-off axis) before the mesh is built."""
+        m = getattr(decision, "member_shards", None)
+        if m is None or int(m) == self.member_shards:
+            return
+        m = int(m)
+        if self.n_members % m or self.domain.n_blocks * self.member_shards % m:
+            return  # infeasible for this run's device/member counts
+        total = self.domain.n_blocks * self.member_shards
+        self.member_shards = m
+        self.domain = CartDomain.create(total // m, self.settings.L)
+        self.sharded = self.domain.n_blocks > 1
+        decision.provenance["adopted_member_shards"] = m
+
+    def _build_mesh(self, devices, backend: str) -> None:
+        m = self.member_shards
+        if m == 1 and not self.sharded:
+            self.mesh = None
+            self.field_sharding = None
+            self.device = devices[0]
+            return
+        shape = (m,) + self.domain.dims
+        self.mesh = Mesh(
+            mesh_for_topology(shape, devices, backend),
+            (MEMBER_AXIS,) + AXIS_NAMES,
+        )
+        self.field_sharding = NamedSharding(
+            self.mesh, P(MEMBER_AXIS, *AXIS_NAMES)
+        )
+
+    def _probe_fn(self):
+        from ..resilience.health import device_probe
+
+        return jax.vmap(device_probe)
+
+    # ------------------------------------------------------------ fields
+
+    def _init_fields(self):
+        """Member-stacked initial fields ``(N, *grid)``.
+
+        The seed pattern is parameter-independent (it only depends on
+        L), so every member starts from the same block — broadcast, not
+        recomputed N times.
+        """
+        L, dtype, N = self.settings.L, self.dtype, self.n_members
+        if self.mesh is None:
+            u, v = grayscott.init_fields(L, dtype)
+            return (
+                jax.device_put(
+                    jnp.broadcast_to(u, (N,) + u.shape), self.device
+                ),
+                jax.device_put(
+                    jnp.broadcast_to(v, (N,) + v.shape), self.device
+                ),
+            )
+
+        dom = self.domain
+        gshape = (N,) + dom.storage_shape
+
+        def make(field: str):
+            def cb(index):
+                m_sl, sp = index[0], index[1:]
+                offsets = tuple(s.start or 0 for s in sp)
+                sizes = tuple(
+                    (s.stop or g) - (s.start or 0)
+                    for s, g in zip(sp, dom.storage_shape)
+                )
+                u, v = grayscott.init_fields(
+                    L, dtype, offsets=offsets, sizes=sizes
+                )
+                blk = u if field == "u" else v
+                n_m = (m_sl.stop or N) - (m_sl.start or 0)
+                return jnp.broadcast_to(blk, (n_m,) + blk.shape)
+
+            return jax.make_array_from_callback(
+                gshape, self.field_sharding, cb
+            )
+
+        return make("u"), make("v")
+
+    # ------------------------------------------------------------ runner
+
+    def _runner(self, nsteps: int):
+        """Compiled ``nsteps``-step ensemble advance, cached per nsteps.
+
+        ``vmap`` of the per-member body over the leading axis; under a
+        mesh, ``shard_map`` wraps the vmapped body with the member axis
+        sharded on 'm' and the spatial axes exactly as solo — halo
+        ppermutes batch through vmap's collective batching rules, so
+        every per-member value (noise draws included) is computed by
+        the same program a solo run compiles.
+        """
+        fn = self._runners.get(nsteps)
+        if fn is not None:
+            return fn
+
+        local = partial(self._local_run, nsteps=nsteps)
+        member_local = jax.vmap(local, in_axes=(0, 0, 0, None, 0))
+        if self.mesh is not None:
+            fspec = P(MEMBER_AXIS, *AXIS_NAMES)
+            mspec = P(MEMBER_AXIS)  # keys (N, 2) / params leaves (N,)
+            fn = shard_map(
+                member_local,
+                mesh=self.mesh,
+                in_specs=(fspec, fspec, mspec, P(), mspec),
+                out_specs=(fspec, fspec),
+                **{_SHARD_MAP_CHECK_FLAG: False},
+            )
+        else:
+            fn = member_local
+        fn = jax.jit(fn, donate_argnums=(0, 1))
+        self._runners[nsteps] = fn
+        return fn
+
+    # ------------------------------------------------------------ output
+
+    def _shard_parts(self, u, v):
+        """4D per-shard parts: offsets/sizes carry the member range in
+        front of the spatial box; only the spatial dims are clipped to
+        the true domain."""
+        L = self.settings.L
+
+        def box(index):
+            idx = index if isinstance(index, tuple) else (index,)
+            offsets = tuple(sl.start or 0 for sl in idx)
+            sizes = tuple(
+                (sl.stop or g) - (sl.start or 0)
+                for sl, g in zip(idx, u.shape)
+            )
+            return offsets, sizes
+
+        v_shards = {box(s.index): s for s in v.addressable_shards}
+        parts = []
+        for sh in u.addressable_shards:
+            offsets, sizes = box(sh.index)
+            true = (sizes[0],) + tuple(
+                min(L - o, s) for o, s in zip(offsets[1:], sizes[1:])
+            )
+            parts.append(
+                (offsets, true, sh.data, v_shards[(offsets, sizes)].data)
+            )
+        return parts
+
+    def get_fields(self):
+        """Host ``(N, L, L, L)`` copies of (u, v), storage pad
+        stripped."""
+        jax.block_until_ready((self.u, self.v))
+        L = self.settings.L
+        return (
+            np.asarray(self.u)[:, :L, :L, :L],
+            np.asarray(self.v)[:, :L, :L, :L],
+        )
+
+    def member_fields(self, member: int):
+        """Host (u, v) of one member — the solo ``get_fields`` shape."""
+        u, v = self.get_fields()
+        return u[member], v[member]
+
+    def poison_nan(self, field: str = "u", member: Optional[int] = None
+                   ) -> None:
+        """Chaos hook: poison ONE member's field (default from
+        ``GS_FAULT_MEMBER``, else member 0) — the per-member health
+        attribution scenario: the guard must name this member, and the
+        other members' trajectories must stay untouched."""
+        import os
+
+        if member is None:
+            member = int(os.environ.get("GS_FAULT_MEMBER", "0"))
+        member %= self.n_members
+        arr = getattr(self, field)
+        setattr(
+            self, field,
+            arr.at[(member,) + (0,) * (arr.ndim - 1)].set(
+                jnp.asarray(float("nan"), arr.dtype)
+            ),
+        )
+
+    # ----------------------------------------------------------- restore
+
+    def restore_members(self, blocks: List, step: int) -> None:
+        """Restore from per-member ``(u, v)`` host arrays (each the true
+        ``L^3`` domain, from the member-indexed checkpoint stores).
+
+        Host-side stack + one sharded device_put: ensemble restores are
+        N small solo restores, not a selection-read fan-out — fine at
+        ensemble scale (members are small by construction; huge-L runs
+        use few members).
+        """
+        if len(blocks) != self.n_members:
+            raise ValueError(
+                f"restore_members got {len(blocks)} member states for "
+                f"{self.n_members} members"
+            )
+        L = self.settings.L
+        expected = (L, L, L)
+        from ..ops import stencil
+
+        us, vs = [], []
+        for i, (u, v) in enumerate(blocks):
+            u = jnp.asarray(u, self.dtype)
+            v = jnp.asarray(v, self.dtype)
+            if u.shape != expected or v.shape != expected:
+                raise ValueError(
+                    f"member {i} checkpoint shapes u={u.shape}, "
+                    f"v={v.shape} do not match L={L}"
+                )
+            us.append(u)
+            vs.append(v)
+        u = jnp.stack(us)
+        v = jnp.stack(vs)
+        if self.mesh is not None and self.domain.padded:
+            pads = [(0, 0)] + [
+                (0, g - L) for g in self.domain.storage_shape
+            ]
+            u = jnp.pad(u, pads, constant_values=stencil.U_BOUNDARY)
+            v = jnp.pad(v, pads, constant_values=stencil.V_BOUNDARY)
+        target = (
+            self.field_sharding if self.mesh is not None else self.device
+        )
+        self.u = jax.device_put(u, target)
+        self.v = jax.device_put(v, target)
+        self.step = int(step)

@@ -1,0 +1,186 @@
+"""Member-indexed output/checkpoint stores for ensemble runs.
+
+Each member gets its OWN stores, derived from the configured paths by
+an index tag (``gs.bp`` -> ``gs.m00.bp``), each written through the
+standard solo machinery (``io/stream.SimStream`` /
+``io/checkpoint.CheckpointWriter``) under a per-member Settings copy
+carrying that member's parameters. Consequences, all load-bearing:
+
+* member ``k``'s stores are **byte-identical** to the stores of a solo
+  run with member ``k``'s params and seed (provenance attributes
+  included) — asserted in tier-1;
+* restart/resume is per-member: each member resumes from its own
+  checkpoint store, and the supervisor's "latest durable checkpoint"
+  for an ensemble is the *minimum* durable step across member stores
+  (``resilience/supervisor.latest_durable_checkpoint``) — a crash
+  mid-boundary (some members checkpointed, some not) rolls every
+  member back to the last step all of them have;
+* every downstream tool (analysis readers, VTK/ParaView, chaos
+  byte-identity asserts) consumes member stores with zero ensemble
+  awareness.
+
+The writer-facing classes mirror the solo interfaces exactly
+(``write_step(step, blocks)`` / ``save(step, blocks)`` / ``close()``)
+taking the ENSEMBLE snapshot's member-stacked 4D blocks; the member
+split happens here (``engine.member_blocks``), on the async writer's
+worker thread, not in the driver loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+from ..config.settings import Settings
+from .engine import member_blocks
+from .spec import EnsembleSettings, PARAM_FIELDS
+
+
+def member_tag(i: int, n: int) -> str:
+    """Zero-padded member tag, width from the member count (stable for
+    a given ensemble size): ``m00`` .. ``m63``."""
+    width = max(2, len(str(max(n - 1, 0))))
+    return f"m{i:0{width}d}"
+
+
+def member_path(path: str, i: int, n: int) -> str:
+    """Member-indexed store path: the tag goes before the extension
+    (``out/gs.bp`` -> ``out/gs.m03.bp``) so derived artifacts (VTK
+    series, fault journals, sidecars) inherit the member tag too."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.{member_tag(i, n)}{ext}" if ext else (
+        f"{path}.{member_tag(i, n)}"
+    )
+
+
+def member_settings(settings: Settings, i: int) -> Settings:
+    """The Settings a SOLO run of member ``i`` would use: member
+    parameters substituted, store paths member-indexed, the ensemble
+    table dropped. This is the one definition of "what member i means
+    as a solo run" — the stream/checkpoint writers, the restore path,
+    and the equality tests all build on it."""
+    ens: EnsembleSettings = settings.ensemble
+    n = ens.n
+    mem = ens.members[i]
+    return dataclasses.replace(
+        settings,
+        **{f: getattr(mem, f) for f in PARAM_FIELDS},
+        output=member_path(settings.output, i, n),
+        checkpoint_output=member_path(settings.checkpoint_output, i, n),
+        restart_input=member_path(settings.restart_input, i, n),
+        ensemble=None,
+    )
+
+
+class EnsembleStream:
+    """N member output streams behind the solo ``SimStream`` interface."""
+
+    def __init__(
+        self,
+        settings: Settings,
+        domain,
+        dtype,
+        *,
+        writer_id: int = 0,
+        nwriters: int = 1,
+        resume_step: Optional[int] = None,
+    ):
+        from ..io.stream import SimStream
+
+        self.n = settings.ensemble.n
+        self.members: List[SimStream] = [
+            SimStream(
+                member_settings(settings, i), domain, dtype,
+                writer_id=writer_id, nwriters=nwriters,
+                resume_step=resume_step,
+            )
+            for i in range(self.n)
+        ]
+
+    def write_step(self, step: int, blocks) -> None:
+        blocks = list(blocks)
+        for i, stream in enumerate(self.members):
+            stream.write_step(step, member_blocks(blocks, i))
+
+    def close(self) -> None:
+        for stream in self.members:
+            stream.close()
+
+
+class EnsembleCheckpointWriter:
+    """N member checkpoint stores behind the solo writer interface."""
+
+    def __init__(
+        self,
+        settings: Settings,
+        dtype,
+        *,
+        writer_id: int = 0,
+        nwriters: int = 1,
+        resume_step: Optional[int] = None,
+    ):
+        from ..io.checkpoint import CheckpointWriter
+
+        self.n = settings.ensemble.n
+        self.members: List[CheckpointWriter] = [
+            CheckpointWriter(
+                member_settings(settings, i), dtype,
+                writer_id=writer_id, nwriters=nwriters,
+                resume_step=resume_step,
+            )
+            for i in range(self.n)
+        ]
+
+    def save(self, step: int, blocks) -> None:
+        blocks = list(blocks)
+        for i, writer in enumerate(self.members):
+            writer.save(step, member_blocks(blocks, i))
+
+    def close(self) -> None:
+        for writer in self.members:
+            writer.close()
+
+
+def restore_ensemble(sim, settings: Settings) -> int:
+    """Restore every member from its member-indexed checkpoint store.
+
+    ``restart_step = -1`` resolves to the QUORUM step: the latest step
+    every member store holds durably (the minimum of the per-member
+    latest steps) — after an uneven crash the whole ensemble rolls back
+    together, keeping members in lockstep. An explicit ``restart_step``
+    must exist in every member store. Returns the restored step.
+    """
+    from ..io.checkpoint import open_checkpoint
+
+    n = settings.ensemble.n
+    want = settings.restart_step
+    if want < 0:
+        from ..io.checkpoint import latest_durable_step
+
+        latest = []
+        for i in range(n):
+            s = latest_durable_step(
+                member_path(settings.restart_input, i, n)
+            )
+            if s is None:
+                raise ValueError(
+                    f"member {i} checkpoint store "
+                    f"{member_path(settings.restart_input, i, n)} has no "
+                    "durable steps to resume from"
+                )
+            latest.append(s)
+        want = min(latest)
+
+    blocks = []
+    for i in range(n):
+        ms = member_settings(settings, i)
+        reader, idx, step = open_checkpoint(ms.restart_input, ms, want)
+        try:
+            blocks.append((
+                reader.get("u", step=idx), reader.get("v", step=idx),
+            ))
+        finally:
+            reader.close()
+    sim.restore_members(blocks, want)
+    return want

@@ -1,0 +1,265 @@
+"""Ensemble parameter specification: the ``[ensemble]`` TOML table.
+
+An ensemble runs N independent Gray-Scott parameter sets (F, k, Du,
+Dv, dt, noise, seed) as ONE compiled executable (``ensemble/engine``):
+the member axis is ``vmap``-ed through the whole step loop and
+optionally sharded on a ``member`` mesh dimension alongside the
+spatial axes. This module owns the *description* of that ensemble —
+which members exist and what parameters each carries — with three
+equivalent TOML spellings (mixable; members concatenate in order):
+
+``presets``
+    Named Pearson phase-diagram parameter sets::
+
+        [ensemble]
+        presets = ["spots", "stripes", "waves", "mitosis", "chaos"]
+
+``[[ensemble.member]]`` tables
+    Explicit per-member parameter tables; unspecified fields inherit
+    the base ``Settings`` values::
+
+        [[ensemble.member]]
+        F = 0.03
+        k = 0.062
+
+``[ensemble.sweep]``
+    Linspace sweeps over ``members = N`` points; every swept key takes
+    ``{ from = a, to = b }`` (inclusive endpoints) or an explicit
+    N-long list; unswept parameters inherit the base Settings::
+
+        [ensemble]
+        members = 8
+        [ensemble.sweep]
+        F = { from = 0.01, to = 0.06 }
+        k = { from = 0.045, to = 0.065 }
+
+``member_shards = m`` shards the member axis over ``m`` devices (the
+``member`` mesh dimension; must divide both the member count and the
+device count). ``seeds = [..]`` pins per-member PRNG seeds; the
+default is ``base_seed + index`` (resolved at Simulation
+construction, so a solo run with ``seed = base_seed + k`` reproduces
+member ``k`` bit-for-bit — the equality contract tier-1 asserts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+#: Per-member parameter fields, matching ``models/grayscott.Params``
+#: field-for-field — the stacked ensemble Params pytree is built
+#: directly from these.
+PARAM_FIELDS = ("Du", "Dv", "F", "k", "dt", "noise")
+
+#: Named Gray-Scott phase-diagram parameter sets (Pearson 1993
+#: classes): the (F, k) pairs that land the classic regimes with the
+#: standard diffusion ratio Du = 2*Dv. Loadable by name via
+#: ``presets = [...]`` — see ``examples/settings-ensemble-phases.toml``.
+PRESETS: Dict[str, Dict[str, float]] = {
+    "spots":   {"F": 0.030, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "stripes": {"F": 0.055, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "waves":   {"F": 0.018, "k": 0.051, "Du": 0.2, "Dv": 0.1},
+    "mitosis": {"F": 0.037, "k": 0.065, "Du": 0.2, "Dv": 0.1},
+    "chaos":   {"F": 0.026, "k": 0.051, "Du": 0.2, "Dv": 0.1},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberSpec:
+    """One ensemble member's parameter set.
+
+    ``seed`` is Optional: ``None`` resolves to ``base_seed + index``
+    at Simulation construction (``engine.EnsembleSimulation``), so the
+    spec stays independent of the launch seed.
+    """
+
+    Du: float
+    Dv: float
+    F: float
+    k: float
+    dt: float
+    noise: float
+    seed: Optional[int] = None
+    name: str = ""
+
+    def describe(self) -> dict:
+        d = {f: getattr(self, f) for f in PARAM_FIELDS}
+        if self.seed is not None:
+            d["seed"] = self.seed
+        if self.name:
+            d["name"] = self.name
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class EnsembleSettings:
+    """Parsed ``[ensemble]`` table: the members plus the mesh split."""
+
+    members: Tuple[MemberSpec, ...]
+    member_shards: int = 1
+
+    @property
+    def n(self) -> int:
+        return len(self.members)
+
+    def describe(self) -> dict:
+        return {
+            "members": self.n,
+            "member_shards": self.member_shards,
+            "params": [m.describe() for m in self.members],
+        }
+
+
+def _base_params(base) -> Dict[str, float]:
+    return {f: float(getattr(base, f)) for f in PARAM_FIELDS}
+
+
+def _linspace(a: float, b: float, n: int) -> List[float]:
+    if n == 1:
+        return [a]
+    return [a + (b - a) * i / (n - 1) for i in range(n)]
+
+
+def _sweep_members(table: dict, base, n: Optional[int]) -> List[MemberSpec]:
+    sweep = table["sweep"]
+    if not isinstance(sweep, dict) or not sweep:
+        raise ValueError("[ensemble.sweep] must be a non-empty table")
+    # Resolve every swept key to an N-long value list first, inferring
+    # N from explicit lists when `members` was not given.
+    lists: Dict[str, List[float]] = {}
+    for key, spec in sweep.items():
+        if key not in PARAM_FIELDS:
+            raise ValueError(
+                f"[ensemble.sweep] key {key!r} is not a member parameter "
+                f"(one of {', '.join(PARAM_FIELDS)})"
+            )
+        if isinstance(spec, dict):
+            if not {"from", "to"} <= set(spec):
+                raise ValueError(
+                    f"[ensemble.sweep] {key} needs 'from' and 'to'"
+                )
+            if n is None:
+                raise ValueError(
+                    "[ensemble] sweeps with from/to need an explicit "
+                    "'members = N' count"
+                )
+            lists[key] = _linspace(float(spec["from"]), float(spec["to"]), n)
+        elif isinstance(spec, (list, tuple)):
+            lists[key] = [float(v) for v in spec]
+            if n is None:
+                n = len(lists[key])
+        else:
+            raise ValueError(
+                f"[ensemble.sweep] {key} must be {{from=,to=}} or a list"
+            )
+    assert n is not None
+    for key, vals in lists.items():
+        if len(vals) != n:
+            raise ValueError(
+                f"[ensemble.sweep] {key} has {len(vals)} values, "
+                f"expected {n}"
+            )
+    defaults = _base_params(base)
+    out = []
+    for i in range(n):
+        params = dict(defaults)
+        for key, vals in lists.items():
+            params[key] = vals[i]
+        out.append(MemberSpec(**params, name=f"sweep{i}"))
+    return out
+
+
+def from_toml(table: dict, base) -> EnsembleSettings:
+    """Parse the ``[ensemble]`` TOML table against base ``Settings``.
+
+    ``base`` supplies the default value for every member parameter the
+    table leaves unspecified (duck-typed: anything with the
+    ``PARAM_FIELDS`` attributes works, so this module needs no import
+    of the config layer).
+    """
+    if not isinstance(table, dict):
+        raise ValueError("[ensemble] must be a TOML table")
+    known = {"presets", "member", "sweep", "members", "member_shards",
+             "seeds"}
+    unknown = set(table) - known
+    if unknown:
+        raise ValueError(
+            f"[ensemble] has unknown keys {sorted(unknown)}; "
+            f"supported: {sorted(known)}"
+        )
+    defaults = _base_params(base)
+    members: List[MemberSpec] = []
+
+    presets = table.get("presets")
+    if presets is not None:
+        if isinstance(presets, str):
+            presets = list(PRESETS) if presets == "all" else [presets]
+        for name in presets:
+            if name not in PRESETS:
+                raise ValueError(
+                    f"Unknown ensemble preset {name!r}; available: "
+                    f"{', '.join(sorted(PRESETS))}"
+                )
+            members.append(
+                MemberSpec(**{**defaults, **PRESETS[name]}, name=name)
+            )
+
+    for i, m in enumerate(table.get("member", []) or []):
+        if not isinstance(m, dict):
+            raise ValueError("[[ensemble.member]] entries must be tables")
+        bad = set(m) - set(PARAM_FIELDS) - {"seed", "name"}
+        if bad:
+            raise ValueError(
+                f"[[ensemble.member]] has unknown keys {sorted(bad)}"
+            )
+        params = {f: float(m.get(f, defaults[f])) for f in PARAM_FIELDS}
+        members.append(MemberSpec(
+            **params,
+            seed=int(m["seed"]) if "seed" in m else None,
+            name=str(m.get("name", f"member{i}")),
+        ))
+
+    if "sweep" in table:
+        n = int(table["members"]) if "members" in table else None
+        members.extend(_sweep_members(table, base, n))
+    elif "members" in table and int(table["members"]) != len(members):
+        raise ValueError(
+            f"[ensemble] members = {table['members']} does not match the "
+            f"{len(members)} members declared by presets/member tables"
+        )
+
+    if not members:
+        raise ValueError(
+            "[ensemble] declares no members (need presets, "
+            "[[ensemble.member]] tables, or an [ensemble.sweep])"
+        )
+
+    seeds = table.get("seeds")
+    if seeds is not None:
+        if len(seeds) != len(members):
+            raise ValueError(
+                f"[ensemble] seeds has {len(seeds)} entries for "
+                f"{len(members)} members"
+            )
+        members = [dataclasses.replace(m, seed=int(s))
+                   for m, s in zip(members, seeds)]
+
+    shards = int(table.get("member_shards", 1))
+    if shards < 1:
+        raise ValueError(f"member_shards must be >= 1, got {shards}")
+    if len(members) % shards:
+        raise ValueError(
+            f"member_shards = {shards} does not divide the member "
+            f"count {len(members)}"
+        )
+    return EnsembleSettings(members=tuple(members), member_shards=shards)
+
+
+def resolve_seeds(ens: EnsembleSettings, base_seed: int) -> List[int]:
+    """Per-member PRNG seeds: the spec's pinned seed, else
+    ``base_seed + index`` — the contract that makes member ``k`` of an
+    ensemble reproduce a solo run with ``seed = base_seed + k``."""
+    return [
+        m.seed if m.seed is not None else base_seed + i
+        for i, m in enumerate(ens.members)
+    ]

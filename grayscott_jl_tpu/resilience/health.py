@@ -33,6 +33,7 @@ from typing import NamedTuple, Optional
 
 __all__ = [
     "POLICIES",
+    "EnsembleHealthReport",
     "HealthError",
     "HealthGuard",
     "HealthReport",
@@ -60,15 +61,69 @@ class HealthReport(NamedTuple):
         }
 
 
+class EnsembleHealthReport(NamedTuple):
+    """Per-member probe results for an ensemble boundary.
+
+    The fused probe runs vmapped over the member axis
+    (``EnsembleSimulation._probe_fn``), so each member's
+    :class:`HealthReport` is individually resolved — the point of the
+    exercise: ONE diverging member is attributed by index
+    (:attr:`bad_members`) in the health report, the ``HealthError``
+    message, and the FaultJournal event, instead of anonymously
+    aborting a 64-member sweep.
+    """
+
+    members: tuple  # of HealthReport
+
+    @property
+    def finite(self) -> bool:
+        return all(m.finite for m in self.members)
+
+    @property
+    def bad_members(self) -> list:
+        return [i for i, m in enumerate(self.members) if not m.finite]
+
+    # Aggregate ranges so single-report consumers (log lines, the
+    # HealthError message core) read an ensemble report transparently.
+    @property
+    def u_min(self) -> float:
+        return min(m.u_min for m in self.members)
+
+    @property
+    def u_max(self) -> float:
+        return max(m.u_max for m in self.members)
+
+    @property
+    def v_min(self) -> float:
+        return min(m.v_min for m in self.members)
+
+    @property
+    def v_max(self) -> float:
+        return max(m.v_max for m in self.members)
+
+    def describe(self) -> dict:
+        return {
+            "finite": self.finite,
+            "members": len(self.members),
+            "bad_members": self.bad_members,
+            "u_range": [self.u_min, self.u_max],
+            "v_range": [self.v_min, self.v_max],
+        }
+
+
 class HealthError(RuntimeError):
     """A field failed the health check at a boundary."""
 
-    def __init__(self, step: int, report: HealthReport, policy: str):
+    def __init__(self, step: int, report, policy: str):
+        detail = ""
+        bad = getattr(report, "bad_members", None)
+        if bad is not None:
+            detail = f"; non-finite members={bad}"
         super().__init__(
             f"field health check failed at step {step} "
             f"(finite={report.finite}, u in [{report.u_min}, "
-            f"{report.u_max}], v in [{report.v_min}, {report.v_max}]); "
-            f"policy={policy}"
+            f"{report.u_max}], v in [{report.v_min}, {report.v_max}]"
+            f"{detail}); policy={policy}"
         )
         self.step = step
         self.report = report
@@ -118,9 +173,12 @@ class HealthGuard:
         return self.policy != "off"
 
     def check(
-        self, step: int, report: Optional[HealthReport], *, log=None
+        self, step: int, report, *, log=None
     ) -> Optional[dict]:
-        """Enforce the policy on one boundary's report.
+        """Enforce the policy on one boundary's report (a
+        :class:`HealthReport` or, for ensembles, an
+        :class:`EnsembleHealthReport` — whose ``describe()`` carries
+        the non-finite member indices into the journal event).
 
         Healthy (or disabled) returns None. Unhealthy: ``warn`` logs
         and returns a journal-able event dict; ``abort``/``rollback``

@@ -291,11 +291,31 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
     (``io/checkpoint.py`` pins ``prefer_adios2=False``), and the
     reader's durability validation (``io/bplite.py``) already hides a
     torn final entry — so whatever this returns is safe to resume from.
+
+    Ensemble runs checkpoint into member-indexed stores
+    (``ensemble/io.py``); the resumable step is then the MINIMUM
+    durable step across member stores — the member analog of the
+    multi-host quorum: a crash mid-boundary (some members saved, some
+    not) rolls the whole ensemble back to the last step every member
+    holds.
     """
     if not settings.checkpoint:
         return None
     from ..io.checkpoint import latest_durable_step
 
+    ens = getattr(settings, "ensemble", None)
+    if ens is not None:
+        from ..ensemble.io import member_path
+
+        steps = [
+            latest_durable_step(
+                member_path(settings.checkpoint_output, i, ens.n)
+            )
+            for i in range(ens.n)
+        ]
+        if any(s is None for s in steps):
+            return None
+        return min(steps)
     return latest_durable_step(settings.checkpoint_output)
 
 

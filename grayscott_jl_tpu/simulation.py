@@ -221,6 +221,33 @@ def select_devices(platform: str):
     return devices
 
 
+def mesh_for_topology(shape, devices, backend: str):
+    """Device array for a mesh of ``shape`` over ``devices``.
+
+    On TPU, maps the logical mesh onto the physical ICI topology
+    (v4/v5p are 3D tori) so the 6-face ppermute halo exchange rides
+    single-hop links — the TPU analog of MPI_Cart_create's
+    reorder=true. Virtual/CPU meshes have no topology to exploit and
+    use enumeration order. Shared by the 3D spatial mesh and the
+    ensemble engine's 4D (member, x, y, z) mesh.
+    """
+    if backend == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            return mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            import sys
+
+            print(
+                "gray-scott: warning: topology-aware mesh failed "
+                f"({e}); falling back to enumeration order — halo "
+                "ppermutes may ride multi-hop ICI links",
+                file=sys.stderr,
+            )
+    return np.array(devices).reshape(shape)
+
+
 class FieldSnapshot:
     """A device-detached capture of (u, v) draining to the host.
 
@@ -280,6 +307,15 @@ class FieldSnapshot:
 class Simulation:
     """A running Gray-Scott simulation bound to a set of devices."""
 
+    #: Snapshot container class — the ensemble engine substitutes a
+    #: member-aware one (``ensemble/engine.EnsembleFieldSnapshot``).
+    snapshot_cls = FieldSnapshot
+    #: True on :class:`~.ensemble.engine.EnsembleSimulation`: the step
+    #: body runs under ``vmap`` over a leading member axis, which
+    #: changes a few per-shard decisions (e.g. interpret-mode Pallas is
+    #: not vmapped on CPU — the XLA fallback is).
+    is_ensemble = False
+
     def __init__(
         self,
         settings: Settings,
@@ -333,8 +369,8 @@ class Simulation:
                 )
             devices = devices[:n_devices]
 
-        self.domain = CartDomain.create(len(devices), settings.L)
-        self.sharded = len(devices) > 1
+        self.domain = self._make_domain(devices)
+        self.sharded = self.domain.n_blocks > 1
         #: Split-phase halo exchange (GS_COMM_OVERLAP / comm_overlap
         #: key; docs/OVERLAP.md): "auto" = on for sharded runs. The
         #: trajectory is bitwise identical either way — overlap only
@@ -430,6 +466,7 @@ class Simulation:
                     and config.resolve_comm_overlap(settings) == "auto"
                 ),
                 link_gbps=link_gbps, links=links,
+                **self._tune_extras(),
             )
             self.kernel_selection["autotune"] = decision.provenance
             if decision.provenance.get("source") in ("cache", "measured"):
@@ -449,6 +486,7 @@ class Simulation:
                     # operator's own GS_BX always wins.
                     _os.environ["GS_BX"] = str(decision.bx)
                     decision.provenance["bx_env_pinned"] = True
+                self._apply_tune_extras(decision)
             if _is_primary():
                 import sys as _sys
 
@@ -463,48 +501,60 @@ class Simulation:
                 )
         else:
             self.kernel_selection = None
-        self.params = grayscott.Params.from_settings(settings, self.dtype)
-        self.use_noise = settings.noise != 0.0
-        self.base_key = jax.random.PRNGKey(seed)
+        self.params = self._make_params()
+        self.use_noise = self._resolve_use_noise()
+        self.base_key = self._make_base_key(seed)
         self.step = 0
         self._runners: Dict[int, object] = {}
         self._snapshot_fns: Dict[bool, object] = {}
 
+        self._build_mesh(devices, backend)
+        self.u, self.v = self._init_fields()
+
+    # ------------------------------------------------- construction hooks
+    # Overridden by ensemble/engine.EnsembleSimulation, which threads a
+    # leading member axis through every one of these while the step
+    # body, halo exchange, autotune and I/O plumbing stay shared.
+
+    def _make_domain(self, devices) -> CartDomain:
+        """Spatial decomposition over the selected devices."""
+        return CartDomain.create(len(devices), self.settings.L)
+
+    def _make_params(self):
+        return grayscott.Params.from_settings(self.settings, self.dtype)
+
+    def _resolve_use_noise(self) -> bool:
+        return self.settings.noise != 0.0
+
+    def _make_base_key(self, seed: int):
+        return jax.random.PRNGKey(seed)
+
+    def _tune_extras(self) -> dict:
+        """Extra kwargs for ``tune.autotune`` (ensemble size etc.)."""
+        return {}
+
+    def _apply_tune_extras(self, decision) -> None:
+        """Apply decision fields beyond kernel/fuse/overlap/bx."""
+
+    def _probe_fn(self):
+        """The device-side health probe fused into the snapshot copy."""
+        from .resilience.health import device_probe
+
+        return device_probe
+
+    def _build_mesh(self, devices, backend: str) -> None:
+        """Construct ``self.mesh`` / ``self.field_sharding`` (or pin
+        ``self.device`` for the single-device case)."""
         if self.sharded:
-            if backend == "tpu":
-                # Map the logical 3D mesh onto the physical ICI topology
-                # (v4/v5p are 3D tori) so the 6-face ppermute halo
-                # exchange rides single-hop links — the TPU analog of
-                # MPI_Cart_create's reorder=true.
-                try:
-                    from jax.experimental import mesh_utils
-
-                    mesh_devices = mesh_utils.create_device_mesh(
-                        self.domain.dims, devices=devices
-                    )
-                except (ValueError, NotImplementedError, AssertionError) as e:
-                    import sys
-
-                    print(
-                        "gray-scott: warning: topology-aware mesh failed "
-                        f"({e}); falling back to enumeration order — halo "
-                        "ppermutes may ride multi-hop ICI links",
-                        file=sys.stderr,
-                    )
-                    mesh_devices = np.array(devices).reshape(
-                        self.domain.dims
-                    )
-            else:
-                # Virtual/CPU meshes have no topology to exploit.
-                mesh_devices = np.array(devices).reshape(self.domain.dims)
+            mesh_devices = mesh_for_topology(
+                self.domain.dims, devices, backend
+            )
             self.mesh = Mesh(mesh_devices, AXIS_NAMES)
             self.field_sharding = NamedSharding(self.mesh, P(*AXIS_NAMES))
         else:
             self.mesh = None
             self.field_sharding = None
             self.device = devices[0]
-
-        self.u, self.v = self._init_fields()
 
     def _fuse_base(self) -> int:
         """Chain/temporal-blocking depth before the runner's own caps:
@@ -628,7 +678,10 @@ class Simulation:
             # Concurrent interpret-mode kernels deadlock under shard_map
             # (global interpreter state) — sharded CPU runs take the XLA
             # fallback inside fused_step; real TPU runs the fused kernel.
-            allow_interpret = not sharded
+            # Ensemble bodies run under vmap, where interpret mode is a
+            # liability too (per-member re-interpretation): the XLA
+            # fallback is the same elementwise program, bitwise.
+            allow_interpret = not sharded and not self.is_ensemble
 
             def kernel_step(u, v, step_idx, faces):
                 return pallas_stencil.fused_step(
@@ -1056,7 +1109,7 @@ class Simulation:
             # +0 forces a real output buffer (no donation, so XLA never
             # aliases inputs into outputs); sharding follows the inputs.
             if health:
-                from .resilience.health import device_probe
+                device_probe = self._probe_fn()
 
                 def copy(u, v):
                     return (u + jnp.zeros((), u.dtype),
@@ -1076,7 +1129,7 @@ class Simulation:
         for _, _, ud, vd in parts:
             ud.copy_to_host_async()
             vd.copy_to_host_async()
-        return FieldSnapshot(parts, self.step, health=probe)
+        return self.snapshot_cls(parts, self.step, health=probe)
 
     def poison_nan(self, field: str = "u") -> None:
         """Chaos/testing hook (``resilience/faults.py`` kind ``nan``):
@@ -1103,7 +1156,7 @@ class Simulation:
         For output overlapped with compute use :meth:`snapshot_async`.
         """
         jax.block_until_ready((self.u, self.v))
-        return FieldSnapshot(
+        return self.snapshot_cls(
             self._shard_parts(self.u, self.v), self.step
         ).blocks()
 

@@ -76,6 +76,9 @@ class TuneDecision:
     comm_overlap: Optional[bool]  # None: leave the resolved value alone
     bx: Optional[int]
     provenance: dict
+    #: Ensemble member-axis split the winner measured fastest (None:
+    #: leave the configured split alone; docs/ENSEMBLE.md).
+    member_shards: Optional[int] = None
 
 
 def _analytic_decision(mode: str, analytic_kernel: str,
@@ -89,12 +92,14 @@ def _analytic_decision(mode: str, analytic_kernel: str,
 
 
 def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
+    ms = winner.get("member_shards")
     return TuneDecision(
         kernel=winner["kernel"],
         fuse=int(winner["fuse"]),
         comm_overlap=bool(winner["comm_overlap"]),
         bx=winner.get("bx"),
         provenance=prov,
+        member_shards=int(ms) if ms is not None else None,
     )
 
 
@@ -117,6 +122,9 @@ def autotune(
     link_gbps: float = 90.0,
     links: int = 6,
     timer: Optional[Callable] = None,
+    ensemble: int = 1,
+    member_shards: int = 1,
+    sim_cls=None,
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -126,6 +134,13 @@ def autotune(
     config. ``timer`` is the test seam — a fake with the
     ``time_sim_rounds`` contract makes the whole quick path
     deterministic and measurement-free.
+
+    Ensemble runs pass their member count (``ensemble``) — it joins
+    the cache key (an N-member batched schedule never shares a winner
+    with a solo run), widens the candidate space with member-shard
+    split variants, and routes measurement through ``sim_cls`` (the
+    ensemble engine) so candidates are timed as the batched programs
+    they are.
     """
     import jax
 
@@ -136,6 +151,7 @@ def autotune(
     key = cache.cache_key(
         device_kind=device_kind, platform=platform, dims=dims, L=L,
         dtype=dtype, noise=noise, jax_version=jax.__version__,
+        ensemble=ensemble,
     )
     rec = cache.load(key)
     if rec is not None:
@@ -174,6 +190,7 @@ def autotune(
         overlap_toggle=overlap_toggle, link_gbps=link_gbps, links=links,
         top_n=_top_n(mode),
         bx_variants=2 if mode == "full" else 0,
+        ensemble=ensemble, member_shards=member_shards,
     )
     steps = int(os.environ.get("GS_AUTOTUNE_STEPS", "20"))
     rounds = int(os.environ.get("GS_AUTOTUNE_ROUNDS",
@@ -181,6 +198,7 @@ def autotune(
     ms, skipped = measure.measure_candidates(
         settings, cands, dims=dims, n_devices=n_devices, seed=seed,
         deadline=t0 + budget_s, steps=steps, rounds=rounds, timer=timer,
+        sim_cls=sim_cls,
     )
     tuning_s = round(time.monotonic() - t0, 3)
     win = measure.best(ms)

@@ -27,8 +27,11 @@ from typing import Optional
 
 #: Bump when the record layout or the meaning of a measurement changes;
 #: every existing cache entry becomes invisible (they live under the
-#: old version's subdirectory).
-SCHEMA_VERSION = 1
+#: old version's subdirectory). v2: the key grew the ``ensemble``
+#: member count — a batched N-member run and a solo run at the same
+#: (mesh, L, dtype) are different schedules and must never share a
+#: winner.
+SCHEMA_VERSION = 2
 
 
 def cache_dir() -> str:
@@ -50,10 +53,14 @@ def cache_key(
     dtype: str,
     noise: float,
     jax_version: str,
+    ensemble: int = 1,
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
-    matching)."""
+    matching). ``ensemble`` is the member count of a batched run
+    (``ensemble/engine.py``) — 1 for solo runs; the vmapped batch
+    changes the measured schedule, so ensemble sizes never share
+    winners."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -63,6 +70,7 @@ def cache_key(
         "dtype": str(dtype),
         "noise": float(noise),
         "jax_version": str(jax_version),
+        "ensemble": int(ensemble),
     }
 
 

@@ -21,6 +21,7 @@ import dataclasses
 from typing import List, Optional
 
 from ..parallel import icimodel
+from ..parallel.domain import dims_create
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,12 +34,21 @@ class Candidate:
     bx: Optional[int] = None  # Pallas slab depth (GS_BX); None = auto
     projected_step_us: Optional[float] = None  # model rank, None = unscored
     analytic: bool = False  # this is the model's own pick
+    #: Ensemble-only (docs/ENSEMBLE.md): the member-axis mesh split
+    #: this candidate devotes to batching (None = not an ensemble run /
+    #: keep the configured split), plus the spatial mesh that split
+    #: implies (None = the run's own mesh). Together they span the
+    #: batch-size-per-device x block-shape trade-off.
+    member_shards: Optional[int] = None
+    mesh: Optional[tuple] = None
 
     def label(self) -> str:
         parts = [self.kernel, f"fuse={self.fuse}",
                  "overlap" if self.comm_overlap else "fused"]
         if self.bx is not None:
             parts.append(f"bx={self.bx}")
+        if self.member_shards is not None:
+            parts.append(f"mshards={self.member_shards}")
         return "/".join(parts)
 
     def as_dict(self) -> dict:
@@ -52,7 +62,10 @@ def from_dict(d: dict) -> Candidate:
     """Inverse of :meth:`Candidate.as_dict` for cache records; unknown
     keys (a newer writer) are dropped rather than rejected."""
     fields = {f.name for f in dataclasses.fields(Candidate)}
-    return Candidate(**{k: v for k, v in d.items() if k in fields})
+    out = {k: v for k, v in d.items() if k in fields}
+    if out.get("mesh") is not None:
+        out["mesh"] = tuple(int(x) for x in out["mesh"])
+    return Candidate(**out)
 
 
 def _pallas_depths(local, itemsize: int, dims, kmax: int) -> List[int]:
@@ -110,6 +123,8 @@ def generate(
     links: int = 6,
     top_n: int = 4,
     bx_variants: int = 0,
+    ensemble: int = 1,
+    member_shards: int = 1,
 ) -> List[Candidate]:
     """The ranked measurement shortlist for one run config.
 
@@ -121,6 +136,14 @@ def generate(
     Off-TPU the Pallas rows are excluded outright: the interpret-mode
     path is a correctness tool ~1000x off, and timing it would burn the
     whole budget saying so.
+
+    Ensemble runs (``ensemble > 1``, ``member_shards`` the configured
+    member-axis split) additionally search the batch-size x block-shape
+    trade-off: every alternative split m' of the same device pool
+    (m' | gcd(members, devices)) trades members-per-device-group
+    against spatial block size — a candidate at m' carries its implied
+    spatial mesh, and its score is the per-step projection scaled by
+    the N/m' members each group advances.
     """
     n, m, p = dims
     sharded = n * m * p > 1
@@ -136,12 +159,18 @@ def generate(
             langs["pallas"] = depths
 
     def score(kernel, fuse, ov):
-        return icimodel.projected_step_us(
+        us = icimodel.projected_step_us(
             kernel, dims, L, fuse, itemsize=itemsize, links=links,
             link_gbps=link_gbps, local=local,
             overlap="auto" if ov else 0.0,
         )
+        if us is not None and ensemble > 1:
+            # Rank ensembles by the batch each device group carries so
+            # alternative member-shard splits compare on aggregate.
+            us = us * (ensemble / max(member_shards, 1))
+        return us
 
+    ens_tag = member_shards if ensemble > 1 else None
     out = []
     for kernel, depths in langs.items():
         for fuse in depths:
@@ -152,6 +181,43 @@ def generate(
                     analytic=(kernel == analytic_kernel
                               and fuse == analytic_fuse
                               and ov == comm_overlap),
+                    member_shards=ens_tag,
+                ))
+
+    if ensemble > 1:
+        # Batch-size x block-shape trade-off: alternative member-axis
+        # splits of the SAME device pool. Each m' implies a spatial
+        # mesh over devices/m' chips advancing ensemble/m' members per
+        # group; ranked by the per-step projection scaled by the batch
+        # each group carries (the aggregate-throughput proxy — the
+        # measurement, not the model, decides).
+        total = n * m * p * member_shards
+        import math
+
+        for m_alt in range(1, math.gcd(ensemble, total) + 1):
+            if m_alt == member_shards or ensemble % m_alt or total % m_alt:
+                continue
+            alt_dims = dims_create(total // m_alt, 3)
+            alt_local = tuple(-(-L // d) for d in alt_dims)
+            if any(-(-L // d) * (d - 1) >= L for d in alt_dims):
+                continue  # a block would own no true-domain cells
+            alt_sharded = total // m_alt > 1
+            for fuse in _xla_depths(alt_local, alt_dims, fuse_cap):
+                proj = icimodel.projected_step_us(
+                    "xla", alt_dims, L, fuse, itemsize=itemsize,
+                    links=links, link_gbps=link_gbps, local=alt_local,
+                    overlap="auto" if (comm_overlap and alt_sharded)
+                    else 0.0,
+                )
+                out.append(Candidate(
+                    kernel="xla", fuse=fuse,
+                    comm_overlap=comm_overlap and alt_sharded,
+                    projected_step_us=(
+                        proj * (ensemble / max(m_alt, 1))
+                        if proj is not None else None
+                    ),
+                    member_shards=m_alt,
+                    mesh=tuple(alt_dims),
                 ))
     if not any(c.analytic for c in out):
         # The analytic pick fell outside the enumerable space (e.g. a
@@ -164,6 +230,7 @@ def generate(
                 analytic_kernel, analytic_fuse,
                 comm_overlap if sharded else False),
             analytic=True,
+            member_shards=ens_tag,
         ))
 
     big = float("inf")

@@ -61,10 +61,11 @@ def pinned_settings(settings, candidate: Candidate):
     """A Settings copy with the candidate's kernel/overlap pinned the
     way an operator would pin them (explicit language strings, so the
     measurement Simulation never re-enters Auto dispatch or the
-    tuner)."""
+    tuner). An ensemble candidate's ``member_shards`` is pinned into
+    the ensemble table the same way."""
     import dataclasses as dc
 
-    return dc.replace(
+    pinned = dc.replace(
         settings,
         kernel_language="Pallas" if candidate.kernel == "pallas"
         else "Plain",
@@ -73,6 +74,12 @@ def pinned_settings(settings, candidate: Candidate):
         # must not arm supervision, restart, or checkpoint machinery.
         supervise=False, restart=False, checkpoint=False,
     )
+    ens = getattr(pinned, "ensemble", None)
+    if ens is not None and candidate.member_shards is not None:
+        pinned.ensemble = dc.replace(
+            ens, member_shards=int(candidate.member_shards)
+        )
+    return pinned
 
 
 class _env_pins:
@@ -117,18 +124,24 @@ def measure_candidates(
     steps: int,
     rounds: int,
     timer: Optional[Callable] = None,
+    sim_cls=None,
 ) -> Tuple[List[Measurement], int]:
     """Time each candidate in shortlist order until the deadline.
 
     ``dims`` is the mesh of the run being tuned: the probe sims pin it
     via ``GS_TPU_MESH_DIMS`` so a measurement describes the SAME mesh
     the cache key does (an Auto run may have adopted a swept mesh the
-    default factorization would not reproduce). Returns
+    default factorization would not reproduce); a candidate carrying
+    its own ``mesh`` (an ensemble member-shard split variant) pins that
+    instead. ``sim_cls`` is the Simulation class to probe with — the
+    ensemble engine passes ``EnsembleSimulation`` so batched schedules
+    are measured as the batched programs they are. Returns
     ``(measurements, skipped)`` — measurements for every candidate that
     was started (successful or errored), and the count of candidates
     never started because the budget ran out.
     """
-    from ..simulation import Simulation
+    if sim_cls is None:
+        from ..simulation import Simulation as sim_cls
 
     timer = default_timer if timer is None else timer
     out: List[Measurement] = []
@@ -137,8 +150,9 @@ def measure_candidates(
         if out and time.monotonic() >= deadline:
             skipped = len(cands) - i
             break
+        pin_mesh = cand.mesh if cand.mesh is not None else dims
         pins = {"GS_FUSE": cand.fuse, "GS_BX": cand.bx,
-                "GS_TPU_MESH_DIMS": ",".join(str(d) for d in dims),
+                "GS_TPU_MESH_DIMS": ",".join(str(d) for d in pin_mesh),
                 # The Settings pin below would lose to a stray
                 # GS_COMM_OVERLAP=auto in the environment.
                 "GS_COMM_OVERLAP": "on" if cand.comm_overlap else "off",
@@ -147,8 +161,8 @@ def measure_candidates(
                 "GS_AUTOTUNE": "off"}
         try:
             with _env_pins(pins):
-                sim = Simulation(pinned_settings(settings, cand),
-                                 n_devices=n_devices, seed=seed)
+                sim = sim_cls(pinned_settings(settings, cand),
+                              n_devices=n_devices, seed=seed)
                 t = timer(sim, steps, rounds, deadline)
             out.append(Measurement(
                 candidate=cand,

@@ -63,6 +63,13 @@ class RunStats:
         #: ``io`` overlap section (how much ICI time the split-phase
         #: exchange hides behind interior compute).
         self.comm: Optional[dict] = None
+        #: Per-member ensemble section (``ensemble/``, docs/ENSEMBLE.md):
+        #: member params + seeds, the member-axis mesh split, and the
+        #: latest per-member health probe — one stats file tells which
+        #: member of a sweep did what. Also scales the
+        #: ``cell_updates_per_s`` summary to the AGGREGATE across
+        #: members (the number an ensemble run is judged by).
+        self.ensemble: Optional[dict] = None
         self._t0 = time.perf_counter()
 
     @contextlib.contextmanager
@@ -98,10 +105,31 @@ class RunStats:
         (``parallel/icimodel.comm_report``) to the summary."""
         self.comm = dict(report) if report else None
 
+    def record_ensemble(self, info: Optional[dict]) -> None:
+        """Attach the per-member ensemble section
+        (``EnsembleSettings.describe()`` + resolved seeds)."""
+        self.ensemble = dict(info) if info else None
+
+    def record_member_health(self, step: int, report) -> None:
+        """Record the latest per-member health probe (an
+        ``EnsembleHealthReport``) into the ensemble section — the
+        last-probed ranges plus which members (if any) went
+        non-finite, keyed by the boundary step."""
+        if self.ensemble is None:
+            self.ensemble = {}
+        self.ensemble["health"] = {
+            "step": step,
+            **report.describe(),
+            "member_reports": [m.describe() for m in report.members],
+        }
+
     def summary(self) -> dict:
         total = time.perf_counter() - self._t0
         steps = self.counters.get("steps", 0)
         compute = self.phases.get("compute", total)
+        members = (
+            int(self.ensemble.get("members", 1)) if self.ensemble else 1
+        )
         return {
             "L": self.L,
             # Nested under one key so caller-supplied names can never
@@ -114,9 +142,12 @@ class RunStats:
             "comm": self.comm,
             "watchdog": self.watchdog,
             "faults": self.faults,
+            "ensemble": self.ensemble,
             "counters": dict(self.counters),
+            # Aggregate across ensemble members (members == 1 solo).
             "cell_updates_per_s": (
-                round(self.L**3 * steps / compute, 3) if compute > 0 else None
+                round(self.L**3 * steps * members / compute, 3)
+                if compute > 0 else None
             ),
         }
 

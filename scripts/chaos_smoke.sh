@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Chaos smoke test, three scenarios against one uninterrupted
-# reference run:
+# Chaos smoke test, four scenarios (1-3 against one uninterrupted
+# solo reference run, 4 against an uninterrupted ensemble run):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical;
@@ -10,7 +10,10 @@
 #   3. real SIGTERM mid-run -> graceful boundary checkpoint -> exit 75
 #      -> supervised relaunch auto-resumes from the journal marker ->
 #      output stores byte-identical (the checkpoint store additionally
-#      holds the off-schedule grace entry, asserted separately).
+#      holds the off-schedule grace entry, asserted separately);
+#   4. ensemble edition: injected preemption mid-sweep of a 2-member
+#      batched ensemble -> supervised restart from the member-indexed
+#      checkpoint quorum -> every member store byte-identical.
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -173,7 +176,44 @@ assert steps[-1] == 60 and sorted(set(steps)) == steps, steps
 assert set(range(20, 61, 20)) <= set(steps), steps
 EOF
 
-echo "chaos_smoke: PASS — all three scenarios recovered byte-identical" \
+echo "chaos_smoke: [4/4] ensemble preempt mid-sweep -> auto-resume..."
+write_ensemble_config() {
+  write_config "$1"
+  cat >> "$1/config.toml" <<'EOF'
+
+[ensemble]
+presets = ["spots", "chaos"]
+EOF
+}
+mkdir -p "$WORK/ensfull" "$WORK/enssup"
+for d in ensfull enssup; do write_ensemble_config "$WORK/$d"; done
+
+run "$WORK/ensfull" > "$WORK/ensfull.log" 2>&1
+run "$WORK/enssup" \
+  GS_SUPERVISE=1 \
+  GS_MAX_RESTARTS=5 \
+  GS_RESTART_BACKOFF_S=0.05 \
+  GS_FAULTS="step=${PREEMPT}:kind=preempt" \
+  > "$WORK/enssup.log" 2>&1
+
+grep -a "supervisor:" "$WORK/enssup.log" > /dev/null || {
+  echo "chaos_smoke: FAIL — the ensemble supervisor never recovered" >&2
+  exit 1
+}
+# Per-member byte-identity: every member-indexed store of the faulted
+# run must match the uninterrupted ensemble's.
+for m in m00 m01; do
+  for store in "gs.${m}.bp" "gs.${m}.vtk" "ckpt.${m}.bp"; do
+    if ! diff -r "$WORK/ensfull/$store" "$WORK/enssup/$store" > /dev/null; then
+      echo "chaos_smoke: FAIL — ensemble $store differs after resume" >&2
+      diff -rq "$WORK/ensfull/$store" "$WORK/enssup/$store" >&2 || true
+      exit 1
+    fi
+  done
+done
+
+echo "chaos_smoke: PASS — all four scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
-     "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl") events)"
+     "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \
+     "ens=$(wc -l < "$WORK/enssup/gs.bp.faults.jsonl") events)"

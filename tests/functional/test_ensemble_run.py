@@ -1,0 +1,221 @@
+"""Functional: batched ensemble runs through the real CLI.
+
+The end-to-end contracts (docs/ENSEMBLE.md):
+
+* an N-member ensemble run produces N member-indexed store sets, each
+  BYTE-identical to the stores of a solo run with that member's params
+  and seed — one compiled launch, N solo-equivalent results;
+* ensemble + supervised chaos (injected preemption) auto-resumes from
+  the member-indexed checkpoints and still finishes byte-identical
+  (the test_supervisor chaos harness, ensemble edition);
+* the measured autotuner's `cached` mode on a miss is bit-identical to
+  `off` for ensemble runs (the zero-measurement contract at ensemble
+  scale);
+* RunStats carries the per-member section.
+"""
+
+import json
+
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import run_cli, write_config
+
+from grayscott_jl_tpu.ensemble.io import member_path
+
+#: Short sweep: boundaries every 10 steps, checkpoints every 20.
+STEPS = 40
+
+ENSEMBLE_TABLE = """
+[ensemble]
+presets = ["spots", "chaos"]
+"""
+
+
+def write_ensemble_config(tmp_path, name="config.toml", table=None, **kw):
+    cfg = write_config(tmp_path, name, **kw)
+    cfg.write_text(cfg.read_text() + (table or ENSEMBLE_TABLE))
+    return cfg
+
+
+def _member_stores(base_dir, store, n=2, vtk=False):
+    out = []
+    for i in range(n):
+        out.append(base_dir / member_path(store, i, n))
+        if vtk:
+            out.append(
+                base_dir / member_path(store, i, n).replace(".bp", ".vtk")
+            )
+    return out
+
+
+def test_ensemble_cli_members_match_solo_and_stats(tmp_path):
+    """The acceptance scenario end to end: run the 2-member ensemble
+    once, run each member solo (same params, seed = base + index), and
+    byte-compare every store; the stats JSON carries the per-member
+    section and the aggregate throughput."""
+    ens_dir = tmp_path / "ens"
+    ens_dir.mkdir()
+    cfg = write_ensemble_config(
+        ens_dir, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    stats_path = ens_dir / "stats.json"
+    res = run_cli(ens_dir, cfg, extra_env={
+        "GS_TPU_STATS": str(stats_path),
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "2 ensemble members" in res.stdout
+
+    from grayscott_jl_tpu.ensemble.spec import PRESETS
+
+    import re
+
+    for i, preset in enumerate(["spots", "chaos"]):
+        solo_dir = tmp_path / f"solo{i}"
+        solo_dir.mkdir()
+        solo_cfg = write_config(
+            solo_dir, noise=0.1, steps=STEPS, output="gs.bp",
+            checkpoint="true", checkpoint_freq=20,
+        )
+        # Substitute the member's preset params into the solo config.
+        # The CLI launches at seed 0, so member i's solo equivalent
+        # runs at seed i — resolve_seeds' base_seed + index contract.
+        text = solo_cfg.read_text()
+        for key, val in PRESETS[preset].items():
+            text = re.sub(rf"(?m)^{key} = .*$", f"{key} = {val}", text)
+        solo_cfg.write_text(text)
+        res = run_cli(solo_dir, solo_cfg,
+                      extra_env={"GS_SEED": str(i)})
+        assert res.returncode == 0, res.stderr + res.stdout
+        # member stores vs the solo run's stores, byte for byte
+        for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+            member_store = member_path(store, i, 2)
+            _assert_trees_byte_identical(
+                solo_dir / store, ens_dir / member_store
+            )
+
+    stats = json.loads(stats_path.read_text())
+    assert stats["config"]["ensemble"] == {
+        "members": 2, "member_shards": 1,
+    }
+    ens = stats["ensemble"]
+    assert ens["members"] == 2
+    assert [p["name"] for p in ens["params"]] == ["spots", "chaos"]
+    assert ens["seeds"] == [0, 1]
+    # per-member health was probed at boundaries (default abort policy)
+    assert ens["health"]["finite"] is True
+    assert len(ens["health"]["member_reports"]) == 2
+    assert stats["cell_updates_per_s"] > 0
+    assert stats["steps"] == STEPS
+
+
+def test_ensemble_chaos_preempt_resumes_byte_identical(tmp_path):
+    """The test_supervisor chaos harness, ensemble edition: one
+    injected preemption mid-sweep under supervision; the run restarts
+    from the member-indexed checkpoints (quorum step) and every member
+    store finishes byte-identical to the uninterrupted ensemble's."""
+    full = tmp_path / "full"
+    full.mkdir()
+    cfg = write_ensemble_config(
+        full, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(full, cfg)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    chaos = tmp_path / "chaos"
+    chaos.mkdir()
+    cfg = write_ensemble_config(
+        chaos, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    stats_path = chaos / "stats.json"
+    res = run_cli(chaos, cfg, extra_env={
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": "step=25:kind=preempt",
+        "GS_TPU_STATS": str(stats_path),
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for i in range(2):
+        for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+            ms = member_path(store, i, 2)
+            _assert_trees_byte_identical(full / ms, chaos / ms)
+
+    stats = json.loads(stats_path.read_text())
+    events = stats["faults"]
+    assert ("injected", "preempt") in [
+        (e["event"], e.get("kind")) for e in events
+    ]
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    assert recoveries and recoveries[0]["action"].startswith(
+        "resumed_from_checkpoint_step_"
+    )
+
+
+def test_ensemble_health_rollback_names_member_and_recovers(tmp_path):
+    """A NaN blow-up in ONE member under rollback policy: the journal
+    event names the poisoned member, the supervisor rolls the whole
+    ensemble back, and the final member stores are byte-identical to
+    the uninterrupted ensemble's."""
+    full = tmp_path / "full"
+    full.mkdir()
+    cfg = write_ensemble_config(
+        full, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    assert run_cli(full, cfg).returncode == 0
+
+    d = tmp_path / "nan"
+    d.mkdir()
+    cfg = write_ensemble_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    stats_path = d / "stats.json"
+    res = run_cli(d, cfg, extra_env={
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": "step=25:kind=nan",
+        "GS_FAULT_MEMBER": "1",
+        "GS_HEALTH_POLICY": "rollback",
+        "GS_TPU_STATS": str(stats_path),
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for i in range(2):
+        ms = member_path("gs.bp", i, 2)
+        _assert_trees_byte_identical(full / ms, d / ms)
+
+    events = json.loads(stats_path.read_text())["faults"]
+    health = [e for e in events if e["event"] == "health"]
+    assert health and health[0]["bad_members"] == [1]
+    kinds = [(e["event"], e.get("kind")) for e in events]
+    assert ("recovery", "health") in kinds
+
+
+def test_ensemble_autotune_cached_is_bit_identical_to_off(tmp_path):
+    """Acceptance: `cached` mode on a MISS (fresh cache dir) must be
+    bit-identical to `off` for ensemble runs — the analytic pick goes
+    through untouched, member stores byte-equal."""
+    runs = {}
+    for mode in ("off", "cached"):
+        d = tmp_path / mode
+        d.mkdir()
+        cfg = write_ensemble_config(
+            d, noise=0.1, steps=20, output="gs.bp",
+            kernel_language="Auto",
+        )
+        res = run_cli(d, cfg, extra_env={
+            "GS_AUTOTUNE": mode,
+            "GS_AUTOTUNE_CACHE": str(tmp_path / f"cache_{mode}"),
+        })
+        assert res.returncode == 0, res.stderr + res.stdout
+        runs[mode] = d
+    for i in range(2):
+        ms = member_path("gs.bp", i, 2)
+        _assert_trees_byte_identical(runs["off"] / ms, runs["cached"] / ms)

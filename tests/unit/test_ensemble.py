@@ -1,0 +1,339 @@
+"""Batched ensemble engine (grayscott_jl_tpu/ensemble/, docs/ENSEMBLE.md).
+
+The load-bearing contract: member k of an N-member batched run is
+BITWISE identical to a solo run with member k's params and seed on the
+same spatial mesh — the vmapped member axis must be invisible to every
+per-member value. Everything else (member-indexed stores, per-member
+health attribution, the tuner's ensemble-aware cache key) stacks on
+that.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config.settings import Settings, parse_settings_toml
+from grayscott_jl_tpu.ensemble import PRESETS, spec as ens_spec
+from grayscott_jl_tpu.ensemble.engine import (
+    EnsembleSimulation,
+    member_blocks,
+)
+from grayscott_jl_tpu.ensemble.io import member_path, member_settings
+from grayscott_jl_tpu.simulation import Simulation
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _settings(L=16, noise=0.1, **kw):
+    return Settings(
+        L=L, noise=noise, precision="Float32", backend="CPU",
+        **{**PARAMS, **kw},
+    )
+
+
+def _ensemble_settings(L=16, noise=0.1, member_shards=1, n=3, **kw):
+    s = _settings(L=L, noise=noise, **kw)
+    table = {
+        "presets": ["spots", "waves", "chaos", "mitosis", "stripes"][:n],
+        "member_shards": member_shards,
+    }
+    s.ensemble = ens_spec.from_toml(table, s)
+    return s
+
+
+# ------------------------------------------------------------- spec parsing
+
+def test_parse_presets_and_member_tables():
+    toml = """
+L = 16
+F = 0.02
+k = 0.048
+noise = 0.25
+
+[ensemble]
+presets = ["spots", "chaos"]
+
+[[ensemble.member]]
+F = 0.05
+seed = 42
+name = "custom"
+"""
+    s = parse_settings_toml(toml)
+    ens = s.ensemble
+    assert ens.n == 3
+    assert [m.name for m in ens.members] == ["spots", "chaos", "custom"]
+    assert ens.members[0].F == PRESETS["spots"]["F"]
+    assert ens.members[0].k == PRESETS["spots"]["k"]
+    # unspecified member fields inherit the base settings
+    assert ens.members[2].k == 0.048
+    assert ens.members[2].noise == 0.25
+    assert ens.members[2].seed == 42
+    assert ens.members[0].seed is None  # defaults to base seed + index
+
+
+def test_parse_linspace_sweep():
+    toml = """
+L = 16
+
+[ensemble]
+members = 4
+member_shards = 2
+
+[ensemble.sweep]
+F = { from = 0.01, to = 0.04 }
+k = [0.05, 0.051, 0.052, 0.053]
+"""
+    ens = parse_settings_toml(toml).ensemble
+    assert ens.n == 4 and ens.member_shards == 2
+    np.testing.assert_allclose(
+        [m.F for m in ens.members], [0.01, 0.02, 0.03, 0.04]
+    )
+    assert [m.k for m in ens.members] == [0.05, 0.051, 0.052, 0.053]
+    assert all(m.Du == 0.05 for m in ens.members)  # Settings default
+
+
+@pytest.mark.parametrize("table,match", [
+    ({"presets": ["nope"]}, "Unknown ensemble preset"),
+    ({"member_shards": 2}, "no members"),
+    ({"presets": ["spots", "chaos"], "member_shards": 3},
+     "does not divide"),
+    ({"presets": ["spots"], "seeds": [1, 2]}, "seeds has 2"),
+    ({"presets": ["spots"], "bogus": 1}, "unknown keys"),
+    ({"sweep": {"F": {"from": 0.1, "to": 0.2}}}, "members = N"),
+    ({"members": 3, "sweep": {"F": [0.1, 0.2]}}, "2 values"),
+    ({"members": 2, "sweep": {"L": [8, 16]}}, "not a member parameter"),
+])
+def test_parse_rejects_bad_tables(table, match):
+    with pytest.raises(ValueError, match=match):
+        ens_spec.from_toml(table, _settings())
+
+
+def test_resolve_seeds_contract():
+    s = _ensemble_settings(n=3)
+    ens = dataclasses.replace(
+        s.ensemble,
+        members=(
+            s.ensemble.members[0],
+            dataclasses.replace(s.ensemble.members[1], seed=99),
+            s.ensemble.members[2],
+        ),
+    )
+    assert ens_spec.resolve_seeds(ens, 10) == [10, 99, 12]
+
+
+# -------------------------------------------------------- member store paths
+
+def test_member_path_tagging():
+    assert member_path("out/gs.bp", 3, 8) == "out/gs.m03.bp"
+    assert member_path("ckpt", 0, 2) == "ckpt.m00"
+    assert member_path("gs.bp", 5, 101) == "gs.m005.bp"
+
+
+def test_member_settings_are_the_solo_config():
+    s = _ensemble_settings(n=2, noise=0.1)
+    ms = member_settings(s, 1)
+    mem = s.ensemble.members[1]
+    assert ms.ensemble is None
+    assert (ms.F, ms.k, ms.Du, ms.Dv) == (mem.F, mem.k, mem.Du, mem.Dv)
+    assert ms.output == member_path(s.output, 1, 2)
+    assert ms.checkpoint_output == member_path(s.checkpoint_output, 1, 2)
+
+
+# ------------------------------------------------------- engine equality
+
+def _assert_members_match_solo(ens_sim, settings, nsteps, *, seed,
+                               n_devices, mesh=None, monkeypatch=None):
+    ens_sim.iterate(nsteps)
+    ue, ve = ens_sim.get_fields()
+    for k in range(ens_sim.n_members):
+        if mesh is not None:
+            monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+        solo = Simulation(
+            member_settings(settings, k), n_devices=n_devices,
+            seed=seed + k,
+        )
+        if mesh is not None:
+            monkeypatch.delenv("GS_TPU_MESH_DIMS")
+        solo.iterate(nsteps)
+        us, vs = solo.get_fields()
+        np.testing.assert_array_equal(ue[k], us, err_msg=f"member {k} u")
+        np.testing.assert_array_equal(ve[k], vs, err_msg=f"member {k} v")
+
+
+def test_member_of_ensemble_is_bitwise_solo_single_device():
+    """The acceptance contract on one device: pure vmap over the member
+    axis, zero drift — member k == solo(seed + k), noise on."""
+    s = _ensemble_settings(L=16, noise=0.1, n=3)
+    sim = EnsembleSimulation(s, n_devices=1, seed=7)
+    assert sim.mesh is None and not sim.sharded
+    _assert_members_match_solo(sim, s, 6, seed=7, n_devices=1)
+
+
+@requires8
+def test_member_of_ensemble_is_bitwise_solo_sharded():
+    """Member axis unsharded over the (2,2,2) spatial mesh: the vmapped
+    body runs under shard_map with batched ppermute halo exchange and
+    must still match solo runs on the SAME mesh bitwise."""
+    s = _ensemble_settings(L=16, noise=0.1, n=2)
+    sim = EnsembleSimulation(s, n_devices=8, seed=3)
+    assert sim.domain.dims == (2, 2, 2) and sim.sharded
+    assert sim.mesh.shape["m"] == 1
+    _assert_members_match_solo(sim, s, 5, seed=3, n_devices=8)
+
+
+@requires8
+def test_member_of_ensemble_is_bitwise_solo_member_sharded(monkeypatch):
+    """member_shards=2 devotes 2 mesh devices to the member axis
+    ((2,2,2,1) mesh over 8 devices): each device group advances half
+    the members on a (2,2,1) spatial mesh — bitwise vs solo runs on
+    that spatial mesh."""
+    s = _ensemble_settings(L=16, noise=0.1, n=4, member_shards=2)
+    sim = EnsembleSimulation(s, n_devices=8, seed=5)
+    assert sim.domain.dims == (2, 2, 1)
+    assert sim.mesh.shape["m"] == 2
+    _assert_members_match_solo(
+        sim, s, 5, seed=5, n_devices=4, mesh="2,2,1",
+        monkeypatch=monkeypatch,
+    )
+
+
+def test_ensemble_snapshot_blocks_split_to_solo_blocks():
+    """member_blocks() of the 4D snapshot == the solo local_blocks
+    format, values bitwise."""
+    s = _ensemble_settings(L=16, noise=0.1, n=2)
+    sim = EnsembleSimulation(s, n_devices=1, seed=7)
+    sim.iterate(3)
+    blocks = sim.snapshot_async().blocks()
+    solo = Simulation(member_settings(s, 1), n_devices=1, seed=8)
+    solo.iterate(3)
+    [(offs, sizes, us, vs)] = solo.local_blocks()
+    [(offs_m, sizes_m, um, vm)] = member_blocks(blocks, 1)
+    assert (offs_m, sizes_m) == (offs, sizes)
+    np.testing.assert_array_equal(um, us)
+    np.testing.assert_array_equal(vm, vs)
+
+
+def test_ensemble_restore_members_roundtrip():
+    """restore_members + iterate == uninterrupted iterate, bitwise."""
+    s = _ensemble_settings(L=16, noise=0.1, n=2)
+    base = EnsembleSimulation(s, n_devices=1, seed=7)
+    base.iterate(4)
+    u4, v4 = base.get_fields()
+    base.iterate(3)
+
+    resumed = EnsembleSimulation(s, n_devices=1, seed=7)
+    resumed.restore_members(
+        [(u4[i], v4[i]) for i in range(2)], 4
+    )
+    resumed.iterate(3)
+    np.testing.assert_array_equal(
+        base.get_fields()[0], resumed.get_fields()[0]
+    )
+    np.testing.assert_array_equal(
+        base.get_fields()[1], resumed.get_fields()[1]
+    )
+
+
+# ------------------------------------------------- health attribution
+
+def test_health_probe_names_the_bad_member():
+    """Satellite contract: ONE diverging member is attributed by index
+    in the health report (and from there the journal event), not an
+    anonymous ensemble-wide abort."""
+    s = _ensemble_settings(L=16, noise=0.1, n=3)
+    sim = EnsembleSimulation(s, n_devices=1, seed=7)
+    sim.iterate(2)
+    rep = sim.snapshot_async(health=True).health_report()
+    assert rep.finite and rep.bad_members == []
+    assert len(rep.members) == 3
+
+    sim.poison_nan(member=1)
+    rep = sim.snapshot_async(health=True).health_report()
+    assert not rep.finite
+    assert rep.bad_members == [1]
+    assert rep.members[0].finite and rep.members[2].finite
+    d = rep.describe()
+    assert d["bad_members"] == [1] and d["finite"] is False
+
+    from grayscott_jl_tpu.resilience.health import HealthError, HealthGuard
+
+    guard = HealthGuard("abort")
+    with pytest.raises(HealthError, match=r"non-finite members=\[1\]"):
+        guard.check(20, rep)
+    warn_event = HealthGuard("warn").check(20, rep)
+    assert warn_event["bad_members"] == [1]
+
+
+def test_poison_nan_member_env_selection(monkeypatch):
+    monkeypatch.setenv("GS_FAULT_MEMBER", "2")
+    s = _ensemble_settings(L=16, noise=0.1, n=3)
+    sim = EnsembleSimulation(s, n_devices=1, seed=7)
+    sim.poison_nan()
+    rep = sim.snapshot_async(health=True).health_report()
+    assert rep.bad_members == [2]
+
+
+# ------------------------------------------------------ tuner integration
+
+def test_tune_cache_key_distinguishes_ensemble_size():
+    from grayscott_jl_tpu.tune import cache
+
+    base = dict(device_kind="TPU v5e", platform="tpu", dims=(2, 2, 2),
+                L=64, dtype="float32", noise=0.1, jax_version="0.4.x")
+    solo = cache.cache_key(**base)
+    ens8 = cache.cache_key(**base, ensemble=8)
+    ens16 = cache.cache_key(**base, ensemble=16)
+    assert solo["ensemble"] == 1
+    digests = {cache.key_digest(k) for k in (solo, ens8, ens16)}
+    assert len(digests) == 3  # never share winners
+
+
+def test_candidates_span_member_shard_splits():
+    """Ensemble candidate space gains batch-size x block-shape
+    trade-offs: alternative member-axis splits of the same device
+    pool, each carrying its implied spatial mesh."""
+    from grayscott_jl_tpu.tune import candidates
+
+    cands = candidates.generate(
+        dims=(2, 2, 1), L=16, platform="cpu", itemsize=4, fuse_cap=2,
+        analytic_kernel="xla", analytic_fuse=2, comm_overlap=False,
+        overlap_toggle=False, top_n=16, ensemble=4, member_shards=2,
+    )
+    splits = {c.member_shards for c in cands}
+    assert 2 in splits  # the configured split is tagged
+    assert {1, 4} <= splits  # alternative splits of gcd(4 members, 8 dev)
+    alt = next(c for c in cands if c.member_shards == 4)
+    assert alt.mesh is not None and int(np.prod(alt.mesh)) == 2
+    # the analytic pick survives and carries the configured split
+    analytic = [c for c in cands if c.analytic]
+    assert len(analytic) == 1 and analytic[0].member_shards == 2
+    # round-trip through the cache record form
+    rt = candidates.from_dict(alt.as_dict())
+    assert rt.mesh == alt.mesh and rt.member_shards == 4
+
+
+def test_ensemble_autotune_cached_miss_is_analytic(tmp_path, monkeypatch):
+    """`cached` mode on a miss must leave an ensemble run untouched
+    (the bit-identity-to-`off` contract, asserted end-to-end in
+    tests/functional/test_ensemble_run.py)."""
+    monkeypatch.setenv("GS_AUTOTUNE_CACHE", str(tmp_path / "tc"))
+    monkeypatch.delenv("GS_AUTOTUNE", raising=False)
+    from grayscott_jl_tpu.tune import autotuner
+
+    s = _ensemble_settings(n=2)
+    decision = autotuner.autotune(
+        s, dims=(1, 1, 1), L=16, platform="cpu", device_kind="",
+        dtype="float32", noise=0.1, itemsize=4, n_devices=1, seed=0,
+        analytic_kernel="xla", analytic_fuse=2, comm_overlap=False,
+        overlap_toggle=False, ensemble=2, member_shards=1,
+    )
+    assert decision.provenance["source"] == "analytic"
+    assert decision.provenance["cache"] == "miss"
+    assert decision.member_shards is None
