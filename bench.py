@@ -67,6 +67,11 @@ ROUNDS = int(os.environ.get("GS_BENCH_ROUNDS", "16"))
 # compute cost.
 ROUND_SLEEP = float(os.environ.get("GS_BENCH_ROUND_SLEEP", "8"))
 KERNEL = os.environ.get("GS_BENCH_KERNEL", "Pallas")
+# Which registered model to measure (--model flag wins over the env):
+# per-model perf baselines accumulate in the artifacts, keyed by the
+# "model" field every result row now carries. Non-Gray-Scott models run
+# the XLA kernel (the Pallas kernel is Gray-Scott-gated).
+MODEL = os.environ.get("GS_BENCH_MODEL", "grayscott")
 PROBE_TIMEOUT = float(os.environ.get("GS_BENCH_PROBE_TIMEOUT", "75"))
 # A SIGKILLed tunnel client wedges the chip grant server-side for
 # HOURS (measured r3, BASELINE.md). Round-4 wedge strategy: two quick
@@ -164,7 +169,7 @@ def _measure_subprocess(platform: str, kernel: str):
         env.setdefault("GS_TPU_PROBE_TIMEOUT", "0")
     rc, out, err, timed_out = _run_bounded(
         [sys.executable, os.path.abspath(__file__), "--worker", platform,
-         kernel],
+         kernel, MODEL],
         RUN_TIMEOUT, env=env,
     )
     for line in out.splitlines():
@@ -179,6 +184,18 @@ def _measure_subprocess(platform: str, kernel: str):
     return None, reason, timed_out
 
 
+def model_kernel(kernel: str, model: str) -> str:
+    """The kernel a model can actually measure: the hand-fused Pallas
+    kernel implements Gray-Scott only (Model.pallas_capable), so other
+    models remap to the XLA path at DISPATCH — the result row then
+    truthfully says kernel=Plain instead of silently falling back."""
+    if model != "grayscott" and kernel == "Pallas":
+        print(f"bench: model {model!r} is not Pallas-capable; "
+              "measuring the XLA kernel", file=sys.stderr)
+        return "Plain"
+    return kernel
+
+
 def cpu_kernel(kernel: str) -> str:
     """The kernel to measure on a CPU fallback: off-TPU the Pallas path
     is the TPU-semantics interpreter — a correctness tool ~1000x off
@@ -189,7 +206,7 @@ def cpu_kernel(kernel: str) -> str:
     return "Plain" if kernel == "Pallas" else kernel
 
 
-def worker(platform: str, kernel: str) -> None:
+def worker(platform: str, kernel: str, model: str = "grayscott") -> None:
     """Child-process entry: run the measurement, print one GSRESULT line."""
     import jax
 
@@ -207,6 +224,7 @@ def worker(platform: str, kernel: str) -> None:
         L, "Float32", kernel, noise=0.1, steps=STEPS_PER_ROUND, rounds=rounds,
         sustain_seconds=SUSTAIN_SECONDS,
         round_sleep=ROUND_SLEEP if platform != "cpu" else 0.0,
+        model=model,
     )
     print("GSRESULT " + json.dumps(r), flush=True)
 
@@ -322,6 +340,9 @@ def emit(result, error=None) -> None:
         # regression falling back must be visible in the recorded payload,
         # not only on stderr.
         "kernel": result["kernel"] if result else KERNEL,
+        # Which registered model produced the number — per-model perf
+        # baselines accumulate side by side in the same artifacts.
+        "model": result.get("model", MODEL) if result else MODEL,
         "platform": result["platform"] if result else None,
     }
     if result:
@@ -537,8 +558,16 @@ def _late_probe_loop(t0, measure_accelerator, errors, wd) -> int:
 
 
 if __name__ == "__main__":
+    if "--model" in sys.argv:
+        # --model <name> selects the registered model to measure
+        # (wins over GS_BENCH_MODEL); stripped before worker dispatch.
+        i = sys.argv.index("--model")
+        MODEL = sys.argv[i + 1]
+        del sys.argv[i:i + 2]
     if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
-        worker(sys.argv[2], sys.argv[3])
+        worker(sys.argv[2], sys.argv[3],
+               sys.argv[4] if len(sys.argv) > 4 else MODEL)
     else:
+        KERNEL = model_kernel(KERNEL, MODEL)
         main()
     sys.exit(0)

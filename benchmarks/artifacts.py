@@ -9,6 +9,9 @@ itself is one-JSON-object-per-line with:
 * ``"ab"`` — the experiment family (``comm_overlap``, ``autotune``, a
   fuse case has none but carries ``"fuse"``),
 * ``"t"`` — UTC capture timestamp (``utc_stamp``),
+* ``"model"`` — the registered model the row measured (``models/``;
+  rows written before the multi-model framework carry no field and
+  read as Gray-Scott),
 * measurement fields using the repo-wide ``*_us_per_step`` spellings
   (``median_us_per_step``/``best_us_per_step``/``rounds_us_per_step``)
   so any artifact with per-depth rows is directly consumable by

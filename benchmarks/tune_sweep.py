@@ -41,6 +41,7 @@ def _base_row(backend: str, sim, L: int) -> dict:
     return {
         "t": artifacts.utc_stamp(),
         "platform": backend.lower(),
+        "model": sim.model.name,
         "devices": sim.domain.n_blocks,
         "mesh": list(sim.domain.dims),
         "L": L,
@@ -124,6 +125,7 @@ def overlap_ab_row(out: str, backend: str, settings, sim, L: int,
         "ab": "comm_overlap",
         "t": artifacts.utc_stamp(),
         "platform": backend.lower(),
+        "model": sim.model.name,
         "devices": sim.domain.n_blocks,
         "mesh": list(dims),
         "L_global": L,
@@ -191,6 +193,10 @@ def main() -> int:
     ap.add_argument("--budget", type=float, default=120.0,
                     help="per-config tuning budget (GS_AUTOTUNE_BUDGET_S)")
     ap.add_argument("--noise", type=float, default=0.1)
+    ap.add_argument("--model", default="grayscott",
+                    help="registered model to tune (models/); the "
+                    "model name joins the tune-cache key and every "
+                    "artifact row, so per-model baselines accumulate")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--out", default=None,
                     help="JSONL artifact (default "
@@ -226,10 +232,12 @@ def main() -> int:
 
     for L in (int(s) for s in args.L.split(",")):
         settings = Settings(
-            L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0,
+            L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048,
+            dt=1.0 if args.model == "grayscott" else 0.05,
             noise=args.noise, precision="Float32", backend=backend,
             kernel_language="Auto",
         )
+        settings.model = args.model
         sim = Simulation(settings, n_devices=args.devices)
         emit_tuning_rows(out, backend, sim, L)
         if args.calibrate:

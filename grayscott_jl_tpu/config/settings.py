@@ -27,6 +27,12 @@ try:  # Python 3.11+
 except ImportError:  # pragma: no cover
     import tomli as _toml  # type: ignore
 
+# Loud configuration errors (misspelled/missing model params, unknown
+# model names) — defined with the model registry, re-exported here as
+# the config layer's error type. The models package is JAX-free to
+# import by design.
+from ..models.base import SettingsError  # noqa: F401
+
 
 @dataclasses.dataclass
 class Settings:
@@ -107,6 +113,17 @@ class Settings:
     #: ONE compiled executable (``ensemble/engine.py``) with
     #: member-indexed output/checkpoint stores (``ensemble/io.py``).
     ensemble: Any = None
+    #: Registered model to integrate (extension; docs/MODELS.md): the
+    #: ``[model]`` TOML table's ``name`` key (or a plain ``model =
+    #: "heat"`` string). Gray-Scott is the default and keeps the
+    #: reference's flat F/k/Du/Dv keys working unchanged.
+    model: str = "grayscott"
+    #: Model-specific parameter overrides from the ``[model]`` table
+    #: (everything but ``name``). Validated LOUDLY against the model's
+    #: declaration at parse time: unknown keys and missing required
+    #: params raise :class:`SettingsError` naming the model — a typo
+    #: can never silently fall back to a default.
+    model_params: Any = dataclasses.field(default_factory=dict)
 
 
 #: Keys accepted from the TOML file (reference ``Structs.jl:31-52``).
@@ -178,11 +195,35 @@ def parse_settings_toml(toml_contents: str) -> Settings:
     config_dict = _toml.loads(toml_contents)
     settings = Settings()
     for key, value in config_dict.items():
-        if key in SETTINGS_KEYS and key != "ensemble":
+        if key in SETTINGS_KEYS and key not in (
+            "ensemble", "model", "model_params",
+        ):
             field_type = Settings.__dataclass_fields__[key].type
             setattr(settings, key, _coerce(key, value, field_type))
-    # The [ensemble] table parses AFTER the scalar keys: member
-    # parameters default to the base Settings values set above.
+    # The [model] table (or a plain `model = "name"` string) selects
+    # the registered model and carries its parameters; validation is
+    # LOUD — unknown/missing keys raise SettingsError naming the model.
+    mdl = config_dict.get("model")
+    if mdl is not None:
+        from ..models import get_model
+
+        if isinstance(mdl, str):
+            settings.model = mdl
+        elif isinstance(mdl, dict):
+            table = dict(mdl)
+            settings.model = str(table.pop("name", settings.model))
+            settings.model_params = table
+        else:
+            raise SettingsError(
+                f"'model' must be a name string or a [model] table, "
+                f"got {mdl!r}"
+            )
+        # Resolves the name (unknown -> SettingsError listing the
+        # registry) and validates the parameter keys eagerly.
+        get_model(settings.model).validate_table(settings.model_params)
+    # The [ensemble] table parses AFTER the scalar and model keys:
+    # member parameters default to the base values set above and
+    # resolve against the selected model's declaration.
     ens = config_dict.get("ensemble")
     if ens is not None:
         from ..ensemble import spec as ensemble_spec
@@ -259,6 +300,16 @@ def load_backend_and_lang(settings: Settings) -> Tuple[str, str]:
             f"Supported: {sorted(KERNEL_LANGUAGES)}"
         )
     return BACKENDS[b], KERNEL_LANGUAGES[l]
+
+
+def resolve_model(settings: Settings):
+    """The registered :class:`~..models.base.Model` this config
+    selects (Gray-Scott by default). One resolution point shared by
+    the simulation, the I/O layer, and the benchmarks."""
+    from ..models import get_model
+
+    return get_model(getattr(settings, "model", "grayscott")
+                     or "grayscott")
 
 
 def resolve_comm_overlap(settings: Settings) -> str:

@@ -313,6 +313,8 @@ def _run_once_inner(
     from .config.settings import resolve_autotune
 
     stats = RunStats(settings.L, config={
+        "model": sim.model.name,
+        "fields": list(sim.model.field_names),
         "mesh_dims": list(sim.domain.dims),
         "padded_storage": (
             list(sim.domain.storage_shape) if sim.sharded

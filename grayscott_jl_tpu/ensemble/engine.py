@@ -1,7 +1,8 @@
-"""Batched ensemble engine: N Gray-Scott scenarios in ONE executable.
+"""Batched ensemble engine: N scenarios of one model in ONE executable.
 
-A phase-diagram sweep over (F, k, Du, Dv, noise, seed) used to cost N
-full launches; here the N parameter sets run as one compiled program:
+A parameter sweep (e.g. the Gray-Scott phase diagram over F/k/Du/Dv,
+or a Brusselator A/B sweep — members parametrize the run's registered
+model) used to cost N full launches; here the N parameter sets run as one compiled program:
 :class:`EnsembleSimulation` stacks a leading **member** axis onto the
 fields, params, and PRNG keys, and ``vmap``-s the *unchanged* per-member
 step body (``Simulation._local_run``) over it — stencil, in-jit noise,
@@ -43,7 +44,6 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..config.settings import Settings
-from ..models import grayscott
 from ..parallel.domain import CartDomain
 from ..simulation import (
     AXIS_NAMES,
@@ -72,18 +72,20 @@ class EnsembleFieldSnapshot(FieldSnapshot):
             return None
         from ..resilience.health import EnsembleHealthReport, HealthReport
 
-        finite, umin, umax, vmin, vmax = (
-            np.asarray(x) for x in self._health
-        )
+        finite, *minmax = (np.asarray(x) for x in self._health)
         return EnsembleHealthReport(tuple(
-            HealthReport(bool(f), float(a), float(b), float(c), float(d))
-            for f, a, b, c, d in zip(finite, umin, umax, vmin, vmax)
+            HealthReport(
+                bool(finite[i]),
+                *(float(m[i]) for m in minmax),
+                names=self.field_names,
+            )
+            for i in range(finite.shape[0])
         ))
 
 
 def member_blocks(blocks, member: int, member_offset: int = 0):
-    """Extract one member's spatial ``(offsets, sizes, u, v)`` blocks
-    from member-stacked 4D snapshot blocks.
+    """Extract one member's spatial ``(offsets, sizes, *fields)``
+    blocks from member-stacked 4D snapshot blocks.
 
     Each 4D entry covers a member range ``[off_m, off_m + n_m)``; the
     entry contributes iff it holds ``member``. Returns solo-format 3D
@@ -91,12 +93,15 @@ def member_blocks(blocks, member: int, member_offset: int = 0):
     is what keeps per-member stores byte-identical to solo stores.
     """
     out = []
-    for offsets, sizes, ub, vb in blocks:
+    for offsets, sizes, *fblocks in blocks:
         off_m, n_m = offsets[0], sizes[0]
         if not (off_m <= member < off_m + n_m):
             continue
         i = member - off_m
-        out.append((tuple(offsets[1:]), tuple(sizes[1:]), ub[i], vb[i]))
+        out.append(
+            (tuple(offsets[1:]), tuple(sizes[1:]))
+            + tuple(fb[i] for fb in fblocks)
+        )
     return out
 
 
@@ -140,13 +145,13 @@ class EnsembleSimulation(Simulation):
         # the remaining count — unchanged solo semantics underneath.
         return CartDomain.create(len(devices) // m, self.settings.L)
 
-    def _make_params(self) -> grayscott.Params:
-        """Member-stacked Params pytree: every leaf is ``(N,)``, fed to
-        the vmapped step body with ``in_axes=0``."""
-        return grayscott.Params(*(
-            jnp.asarray([getattr(mem, f) for mem in self.ens.members],
+    def _make_params(self):
+        """Member-stacked Params pytree of the run's model: every leaf
+        is ``(N,)``, fed to the vmapped step body with ``in_axes=0``."""
+        return self.model.params_cls(*(
+            jnp.asarray([mem.value(f) for mem in self.ens.members],
                         self.dtype)
-            for f in grayscott.Params._fields
+            for f in self.model.params_cls._fields
         ))
 
     def _resolve_use_noise(self) -> bool:
@@ -154,7 +159,7 @@ class EnsembleSimulation(Simulation):
         # traced in if ANY member draws (a member with noise = 0 then
         # adds an exact-zero field — see docs/ENSEMBLE.md for the
         # equality fine print).
-        return any(mem.noise != 0.0 for mem in self.ens.members)
+        return any(mem.value("noise") != 0.0 for mem in self.ens.members)
 
     def _make_base_key(self, seed: int):
         """(N, 2) stacked PRNG keys — per-member position-keyed noise
@@ -211,26 +216,23 @@ class EnsembleSimulation(Simulation):
     def _init_fields(self):
         """Member-stacked initial fields ``(N, *grid)``.
 
-        The seed pattern is parameter-independent (it only depends on
-        L), so every member starts from the same block — broadcast, not
-        recomputed N times.
+        The model's seed pattern is parameter-independent (it only
+        depends on L), so every member starts from the same block —
+        broadcast, not recomputed N times.
         """
         L, dtype, N = self.settings.L, self.dtype, self.n_members
         if self.mesh is None:
-            u, v = grayscott.init_fields(L, dtype)
-            return (
+            return tuple(
                 jax.device_put(
-                    jnp.broadcast_to(u, (N,) + u.shape), self.device
-                ),
-                jax.device_put(
-                    jnp.broadcast_to(v, (N,) + v.shape), self.device
-                ),
+                    jnp.broadcast_to(f, (N,) + f.shape), self.device
+                )
+                for f in self.model.init(L, dtype)
             )
 
         dom = self.domain
         gshape = (N,) + dom.storage_shape
 
-        def make(field: str):
+        def make(field_idx: int):
             def cb(index):
                 m_sl, sp = index[0], index[1:]
                 offsets = tuple(s.start or 0 for s in sp)
@@ -238,10 +240,9 @@ class EnsembleSimulation(Simulation):
                     (s.stop or g) - (s.start or 0)
                     for s, g in zip(sp, dom.storage_shape)
                 )
-                u, v = grayscott.init_fields(
+                blk = self.model.init(
                     L, dtype, offsets=offsets, sizes=sizes
-                )
-                blk = u if field == "u" else v
+                )[field_idx]
                 n_m = (m_sl.stop or N) - (m_sl.start or 0)
                 return jnp.broadcast_to(blk, (n_m,) + blk.shape)
 
@@ -249,7 +250,7 @@ class EnsembleSimulation(Simulation):
                 gshape, self.field_sharding, cb
             )
 
-        return make("u"), make("v")
+        return tuple(make(i) for i in range(self.model.n_fields))
 
     # ------------------------------------------------------------ runner
 
@@ -268,68 +269,74 @@ class EnsembleSimulation(Simulation):
             return fn
 
         local = partial(self._local_run, nsteps=nsteps)
-        member_local = jax.vmap(local, in_axes=(0, 0, 0, None, 0))
+        nf = self.model.n_fields
+        member_local = jax.vmap(
+            local, in_axes=(0,) * nf + (0, None, 0)
+        )
         if self.mesh is not None:
             fspec = P(MEMBER_AXIS, *AXIS_NAMES)
             mspec = P(MEMBER_AXIS)  # keys (N, 2) / params leaves (N,)
             fn = shard_map(
                 member_local,
                 mesh=self.mesh,
-                in_specs=(fspec, fspec, mspec, P(), mspec),
-                out_specs=(fspec, fspec),
+                in_specs=(fspec,) * nf + (mspec, P(), mspec),
+                out_specs=(fspec,) * nf,
                 **{_SHARD_MAP_CHECK_FLAG: False},
             )
         else:
             fn = member_local
-        fn = jax.jit(fn, donate_argnums=(0, 1))
+        fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
         self._runners[nsteps] = fn
         return fn
 
     # ------------------------------------------------------------ output
 
-    def _shard_parts(self, u, v):
+    def _shard_parts(self, *arrays):
         """4D per-shard parts: offsets/sizes carry the member range in
         front of the spatial box; only the spatial dims are clipped to
         the true domain."""
         L = self.settings.L
+        first = arrays[0]
 
         def box(index):
             idx = index if isinstance(index, tuple) else (index,)
             offsets = tuple(sl.start or 0 for sl in idx)
             sizes = tuple(
                 (sl.stop or g) - (sl.start or 0)
-                for sl, g in zip(idx, u.shape)
+                for sl, g in zip(idx, first.shape)
             )
             return offsets, sizes
 
-        v_shards = {box(s.index): s for s in v.addressable_shards}
+        other_shards = [
+            {box(s.index): s for s in a.addressable_shards}
+            for a in arrays[1:]
+        ]
         parts = []
-        for sh in u.addressable_shards:
+        for sh in first.addressable_shards:
             offsets, sizes = box(sh.index)
             true = (sizes[0],) + tuple(
                 min(L - o, s) for o, s in zip(offsets[1:], sizes[1:])
             )
             parts.append(
-                (offsets, true, sh.data, v_shards[(offsets, sizes)].data)
+                (offsets, true, sh.data)
+                + tuple(m[(offsets, sizes)].data for m in other_shards)
             )
         return parts
 
     def get_fields(self):
-        """Host ``(N, L, L, L)`` copies of (u, v), storage pad
-        stripped."""
-        jax.block_until_ready((self.u, self.v))
+        """Host ``(N, L, L, L)`` copies of the model's fields, storage
+        pad stripped."""
+        jax.block_until_ready(self.fields)
         L = self.settings.L
-        return (
-            np.asarray(self.u)[:, :L, :L, :L],
-            np.asarray(self.v)[:, :L, :L, :L],
+        return tuple(
+            np.asarray(f)[:, :L, :L, :L] for f in self.fields
         )
 
     def member_fields(self, member: int):
-        """Host (u, v) of one member — the solo ``get_fields`` shape."""
-        u, v = self.get_fields()
-        return u[member], v[member]
+        """Host fields of one member — the solo ``get_fields`` shape."""
+        return tuple(f[member] for f in self.get_fields())
 
-    def poison_nan(self, field: str = "u", member: Optional[int] = None
+    def poison_nan(self, field="u", member: Optional[int] = None
                    ) -> None:
         """Chaos hook: poison ONE member's field (default from
         ``GS_FAULT_MEMBER``, else member 0) — the per-member health
@@ -340,19 +347,21 @@ class EnsembleSimulation(Simulation):
         if member is None:
             member = int(os.environ.get("GS_FAULT_MEMBER", "0"))
         member %= self.n_members
-        arr = getattr(self, field)
-        setattr(
-            self, field,
-            arr.at[(member,) + (0,) * (arr.ndim - 1)].set(
-                jnp.asarray(float("nan"), arr.dtype)
-            ),
+        i = self._field_index(field)
+        arr = self.fields[i]
+        poisoned = arr.at[(member,) + (0,) * (arr.ndim - 1)].set(
+            jnp.asarray(float("nan"), arr.dtype)
+        )
+        self.fields = (
+            self.fields[:i] + (poisoned,) + self.fields[i + 1:]
         )
 
     # ----------------------------------------------------------- restore
 
     def restore_members(self, blocks: List, step: int) -> None:
-        """Restore from per-member ``(u, v)`` host arrays (each the true
-        ``L^3`` domain, from the member-indexed checkpoint stores).
+        """Restore from per-member host field tuples (each field the
+        true ``L^3`` domain, declaration order, from the member-indexed
+        checkpoint stores).
 
         Host-side stack + one sharded device_put: ensemble restores are
         N small solo restores, not a selection-read fan-out — fine at
@@ -366,30 +375,38 @@ class EnsembleSimulation(Simulation):
             )
         L = self.settings.L
         expected = (L, L, L)
-        from ..ops import stencil
-
-        us, vs = [], []
-        for i, (u, v) in enumerate(blocks):
-            u = jnp.asarray(u, self.dtype)
-            v = jnp.asarray(v, self.dtype)
-            if u.shape != expected or v.shape != expected:
+        nf = self.model.n_fields
+        per_field = [[] for _ in range(nf)]
+        for i, member_fields in enumerate(blocks):
+            member_fields = tuple(member_fields)
+            if len(member_fields) != nf:
                 raise ValueError(
-                    f"member {i} checkpoint shapes u={u.shape}, "
-                    f"v={v.shape} do not match L={L}"
+                    f"member {i} checkpoint has {len(member_fields)} "
+                    f"fields; model {self.model.name!r} declares {nf}"
                 )
-            us.append(u)
-            vs.append(v)
-        u = jnp.stack(us)
-        v = jnp.stack(vs)
+            for j, (name, f) in enumerate(
+                zip(self.model.field_names, member_fields)
+            ):
+                f = jnp.asarray(f, self.dtype)
+                if f.shape != expected:
+                    raise ValueError(
+                        f"member {i} checkpoint shape {name}={f.shape} "
+                        f"does not match L={L}"
+                    )
+                per_field[j].append(f)
+        stacked = [jnp.stack(fs) for fs in per_field]
         if self.mesh is not None and self.domain.padded:
             pads = [(0, 0)] + [
                 (0, g - L) for g in self.domain.storage_shape
             ]
-            u = jnp.pad(u, pads, constant_values=stencil.U_BOUNDARY)
-            v = jnp.pad(v, pads, constant_values=stencil.V_BOUNDARY)
+            stacked = [
+                jnp.pad(f, pads, constant_values=bv)
+                for f, bv in zip(stacked, self.model.boundaries)
+            ]
         target = (
             self.field_sharding if self.mesh is not None else self.device
         )
-        self.u = jax.device_put(u, target)
-        self.v = jax.device_put(v, target)
+        self.fields = tuple(
+            jax.device_put(f, target) for f in stacked
+        )
         self.step = int(step)

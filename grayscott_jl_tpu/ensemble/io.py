@@ -33,8 +33,9 @@ import os
 from typing import List, Optional
 
 from ..config.settings import Settings
+from ..models import get_model
 from .engine import member_blocks
-from .spec import EnsembleSettings, PARAM_FIELDS
+from .spec import EnsembleSettings
 
 
 def member_tag(i: int, n: int) -> str:
@@ -59,13 +60,31 @@ def member_settings(settings: Settings, i: int) -> Settings:
     parameters substituted, store paths member-indexed, the ensemble
     table dropped. This is the one definition of "what member i means
     as a solo run" — the stream/checkpoint writers, the restore path,
-    and the equality tests all build on it."""
+    and the equality tests all build on it.
+
+    Model-generic: member parameters land in the ``model_params``
+    table (the ``[model]`` spelling) AND, where the model declares
+    legacy flat keys (Gray-Scott's F/k/Du/Dv), in the flat Settings
+    attributes too — both resolve to the same values, so a solo run
+    configured either way is byte-identical."""
     ens: EnsembleSettings = settings.ensemble
     n = ens.n
     mem = ens.members[i]
+    model = get_model(ens.model)
+    params = mem.params()
+    dt = params.pop("dt")
+    noise = params.pop("noise")
+    flat = {
+        model.legacy_keys[k]: v for k, v in params.items()
+        if k in model.legacy_keys
+    }
     return dataclasses.replace(
         settings,
-        **{f: getattr(mem, f) for f in PARAM_FIELDS},
+        dt=dt, noise=noise, **flat,
+        model=model.name,
+        model_params={
+            **(getattr(settings, "model_params", None) or {}), **params,
+        },
         output=member_path(settings.output, i, n),
         checkpoint_output=member_path(settings.checkpoint_output, i, n),
         restart_input=member_path(settings.restart_input, i, n),
@@ -172,13 +191,14 @@ def restore_ensemble(sim, settings: Settings) -> int:
             latest.append(s)
         want = min(latest)
 
+    field_names = get_model(settings.ensemble.model).field_names
     blocks = []
     for i in range(n):
         ms = member_settings(settings, i)
         reader, idx, step = open_checkpoint(ms.restart_input, ms, want)
         try:
-            blocks.append((
-                reader.get("u", step=idx), reader.get("v", step=idx),
+            blocks.append(tuple(
+                reader.get(name, step=idx) for name in field_names
             ))
         finally:
             reader.close()

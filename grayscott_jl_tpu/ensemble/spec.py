@@ -1,22 +1,26 @@
 """Ensemble parameter specification: the ``[ensemble]`` TOML table.
 
-An ensemble runs N independent Gray-Scott parameter sets (F, k, Du,
-Dv, dt, noise, seed) as ONE compiled executable (``ensemble/engine``):
-the member axis is ``vmap``-ed through the whole step loop and
-optionally sharded on a ``member`` mesh dimension alongside the
-spatial axes. This module owns the *description* of that ensemble —
-which members exist and what parameters each carries — with three
-equivalent TOML spellings (mixable; members concatenate in order):
+An ensemble runs N independent parameter sets **of one registered
+model** (the run's ``[model]`` selection; Gray-Scott by default) as ONE
+compiled executable (``ensemble/engine``): the member axis is
+``vmap``-ed through the whole step loop and optionally sharded on a
+``member`` mesh dimension alongside the spatial axes. This module owns
+the *description* of that ensemble — which members exist and what
+parameters each carries — with three equivalent TOML spellings
+(mixable; members concatenate in order):
 
 ``presets``
-    Named Pearson phase-diagram parameter sets::
+    Named parameter sets, namespaced per model
+    (:data:`MODEL_PRESETS`); for Gray-Scott these are the Pearson
+    phase-diagram classes::
 
         [ensemble]
         presets = ["spots", "stripes", "waves", "mitosis", "chaos"]
 
 ``[[ensemble.member]]`` tables
-    Explicit per-member parameter tables; unspecified fields inherit
-    the base ``Settings`` values::
+    Explicit per-member parameter tables over the model's declared
+    parameter names (plus the framework's ``dt``/``noise``);
+    unspecified fields inherit the base config values::
 
         [[ensemble.member]]
         F = 0.03
@@ -25,7 +29,7 @@ equivalent TOML spellings (mixable; members concatenate in order):
 ``[ensemble.sweep]``
     Linspace sweeps over ``members = N`` points; every swept key takes
     ``{ from = a, to = b }`` (inclusive endpoints) or an explicit
-    N-long list; unswept parameters inherit the base Settings::
+    N-long list; unswept parameters inherit the base config::
 
         [ensemble]
         members = 8
@@ -46,15 +50,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-#: Per-member parameter fields, matching ``models/grayscott.Params``
-#: field-for-field — the stacked ensemble Params pytree is built
-#: directly from these.
+from ..models import FRAMEWORK_PARAMS, get_model
+
+#: Gray-Scott member parameter fields — the historical flat tuple, kept
+#: as the compat alias; the generic form is :func:`member_param_fields`
+#: over the run's model declaration.
 PARAM_FIELDS = ("Du", "Dv", "F", "k", "dt", "noise")
 
 #: Named Gray-Scott phase-diagram parameter sets (Pearson 1993
 #: classes): the (F, k) pairs that land the classic regimes with the
-#: standard diffusion ratio Du = 2*Dv. Loadable by name via
-#: ``presets = [...]`` — see ``examples/settings-ensemble-phases.toml``.
+#: standard diffusion ratio Du = 2*Dv. The compat alias for
+#: ``MODEL_PRESETS["grayscott"]``.
 PRESETS: Dict[str, Dict[str, float]] = {
     "spots":   {"F": 0.030, "k": 0.062, "Du": 0.2, "Dv": 0.1},
     "stripes": {"F": 0.055, "k": 0.062, "Du": 0.2, "Dv": 0.1},
@@ -63,27 +69,76 @@ PRESETS: Dict[str, Dict[str, float]] = {
     "chaos":   {"F": 0.026, "k": 0.051, "Du": 0.2, "Dv": 0.1},
 }
 
+#: Presets namespaced per registered model: ``presets = [...]`` in the
+#: ``[ensemble]`` table resolves against the RUN's model, so a
+#: Brusselator ensemble can never silently inherit Gray-Scott numbers.
+MODEL_PRESETS: Dict[str, Dict[str, Dict[str, float]]] = {
+    "grayscott": PRESETS,
+    "brusselator": {
+        # Distance from the Hopf/Turing thresholds at A=1 (B_c = 1+A^2).
+        "steady":      {"A": 1.0, "B": 1.7, "Du": 0.2, "Dv": 0.02},
+        "turing":      {"A": 1.0, "B": 3.0, "Du": 0.2, "Dv": 0.02},
+        "oscillatory": {"A": 1.0, "B": 2.4, "Du": 0.2, "Dv": 0.02},
+    },
+    "fhn": {
+        "excitable":   {"a": 0.7, "b": 0.8, "eps": 0.08, "I": 0.5},
+        "oscillatory": {"a": 0.7, "b": 0.8, "eps": 0.08, "I": 1.0},
+        "stiff":       {"a": 0.7, "b": 0.8, "eps": 0.02, "I": 0.5},
+    },
+    "heat": {
+        "slow": {"D": 0.1},
+        "fast": {"D": 0.4},
+    },
+}
+
+
+def member_param_fields(model) -> Tuple[str, ...]:
+    """The member parameter universe for one model: its declared params
+    plus the framework-level ``dt`` and ``noise``."""
+    return tuple(model.param_names) + FRAMEWORK_PARAMS
+
+
+def _model_for(base):
+    return get_model(getattr(base, "model", "grayscott") or "grayscott")
+
 
 @dataclasses.dataclass(frozen=True)
 class MemberSpec:
-    """One ensemble member's parameter set.
+    """One ensemble member's parameter set, model-generic.
 
-    ``seed`` is Optional: ``None`` resolves to ``base_seed + index``
-    at Simulation construction (``engine.EnsembleSimulation``), so the
-    spec stays independent of the launch seed.
+    ``values`` is the ordered ``(param, value)`` tuple over
+    :func:`member_param_fields`; parameters read as attributes
+    (``member.F``) for the two-field classics. ``seed`` is Optional:
+    ``None`` resolves to ``base_seed + index`` at Simulation
+    construction (``engine.EnsembleSimulation``), so the spec stays
+    independent of the launch seed.
     """
 
-    Du: float
-    Dv: float
-    F: float
-    k: float
-    dt: float
-    noise: float
+    values: Tuple[Tuple[str, float], ...]
     seed: Optional[int] = None
     name: str = ""
 
+    def params(self) -> Dict[str, float]:
+        return dict(self.values)
+
+    def value(self, key: str) -> float:
+        for k, v in self.values:
+            if k == key:
+                return v
+        raise KeyError(key)
+
+    def __getattr__(self, key: str) -> float:
+        # Only consulted for names not found normally — parameter
+        # attribute access (member.F, member.noise).
+        if key.startswith("_"):
+            raise AttributeError(key)
+        for k, v in self.__dict__.get("values", ()):
+            if k == key:
+                return v
+        raise AttributeError(key)
+
     def describe(self) -> dict:
-        d = {f: getattr(self, f) for f in PARAM_FIELDS}
+        d = dict(self.values)
         if self.seed is not None:
             d["seed"] = self.seed
         if self.name:
@@ -97,6 +152,9 @@ class EnsembleSettings:
 
     members: Tuple[MemberSpec, ...]
     member_shards: int = 1
+    #: The registered model the members parametrize (every member is
+    #: the same physics; ensembles sweep parameters, not equations).
+    model: str = "grayscott"
 
     @property
     def n(self) -> int:
@@ -104,6 +162,7 @@ class EnsembleSettings:
 
     def describe(self) -> dict:
         return {
+            "model": self.model,
             "members": self.n,
             "member_shards": self.member_shards,
             "params": [m.describe() for m in self.members],
@@ -111,7 +170,22 @@ class EnsembleSettings:
 
 
 def _base_params(base) -> Dict[str, float]:
-    return {f: float(getattr(base, f)) for f in PARAM_FIELDS}
+    """Every member parameter's base-config value, resolved through the
+    model declaration (``[model]`` table > legacy flat keys >
+    defaults)."""
+    model = _model_for(base)
+    vals = model.resolve_param_values(base)
+    vals["dt"] = float(base.dt)
+    vals["noise"] = float(base.noise)
+    return vals
+
+
+def _member(defaults: Dict[str, float], fields, *, seed=None,
+            name="") -> MemberSpec:
+    return MemberSpec(
+        values=tuple((f, float(defaults[f])) for f in fields),
+        seed=seed, name=name,
+    )
 
 
 def _linspace(a: float, b: float, n: int) -> List[float]:
@@ -121,6 +195,8 @@ def _linspace(a: float, b: float, n: int) -> List[float]:
 
 
 def _sweep_members(table: dict, base, n: Optional[int]) -> List[MemberSpec]:
+    model = _model_for(base)
+    fields = member_param_fields(model)
     sweep = table["sweep"]
     if not isinstance(sweep, dict) or not sweep:
         raise ValueError("[ensemble.sweep] must be a non-empty table")
@@ -128,10 +204,10 @@ def _sweep_members(table: dict, base, n: Optional[int]) -> List[MemberSpec]:
     # N from explicit lists when `members` was not given.
     lists: Dict[str, List[float]] = {}
     for key, spec in sweep.items():
-        if key not in PARAM_FIELDS:
+        if key not in fields:
             raise ValueError(
                 f"[ensemble.sweep] key {key!r} is not a member parameter "
-                f"(one of {', '.join(PARAM_FIELDS)})"
+                f"of model {model.name!r} (one of {', '.join(fields)})"
             )
         if isinstance(spec, dict):
             if not {"from", "to"} <= set(spec):
@@ -165,17 +241,18 @@ def _sweep_members(table: dict, base, n: Optional[int]) -> List[MemberSpec]:
         params = dict(defaults)
         for key, vals in lists.items():
             params[key] = vals[i]
-        out.append(MemberSpec(**params, name=f"sweep{i}"))
+        out.append(_member(params, fields, name=f"sweep{i}"))
     return out
 
 
 def from_toml(table: dict, base) -> EnsembleSettings:
-    """Parse the ``[ensemble]`` TOML table against base ``Settings``.
+    """Parse the ``[ensemble]`` TOML table against base settings.
 
-    ``base`` supplies the default value for every member parameter the
-    table leaves unspecified (duck-typed: anything with the
-    ``PARAM_FIELDS`` attributes works, so this module needs no import
-    of the config layer).
+    ``base`` supplies the model selection (``base.model``) and the
+    default value for every member parameter the table leaves
+    unspecified (duck-typed: anything carrying the model's parameter
+    attributes works). Member parameter names, sweeps, and presets all
+    resolve against the selected model's declaration.
     """
     if not isinstance(table, dict):
         raise ValueError("[ensemble] must be a TOML table")
@@ -187,34 +264,41 @@ def from_toml(table: dict, base) -> EnsembleSettings:
             f"[ensemble] has unknown keys {sorted(unknown)}; "
             f"supported: {sorted(known)}"
         )
+    model = _model_for(base)
+    fields = member_param_fields(model)
     defaults = _base_params(base)
+    model_presets = MODEL_PRESETS.get(model.name, {})
     members: List[MemberSpec] = []
 
     presets = table.get("presets")
     if presets is not None:
         if isinstance(presets, str):
-            presets = list(PRESETS) if presets == "all" else [presets]
-        for name in presets:
-            if name not in PRESETS:
-                raise ValueError(
-                    f"Unknown ensemble preset {name!r}; available: "
-                    f"{', '.join(sorted(PRESETS))}"
-                )
-            members.append(
-                MemberSpec(**{**defaults, **PRESETS[name]}, name=name)
+            presets = (
+                list(model_presets) if presets == "all" else [presets]
             )
+        for name in presets:
+            if name not in model_presets:
+                raise ValueError(
+                    f"Unknown ensemble preset {name!r} for model "
+                    f"{model.name!r}; available: "
+                    f"{', '.join(sorted(model_presets)) or '(none)'}"
+                )
+            members.append(_member(
+                {**defaults, **model_presets[name]}, fields, name=name,
+            ))
 
     for i, m in enumerate(table.get("member", []) or []):
         if not isinstance(m, dict):
             raise ValueError("[[ensemble.member]] entries must be tables")
-        bad = set(m) - set(PARAM_FIELDS) - {"seed", "name"}
+        bad = set(m) - set(fields) - {"seed", "name"}
         if bad:
             raise ValueError(
-                f"[[ensemble.member]] has unknown keys {sorted(bad)}"
+                f"[[ensemble.member]] has unknown keys {sorted(bad)} "
+                f"for model {model.name!r}"
             )
-        params = {f: float(m.get(f, defaults[f])) for f in PARAM_FIELDS}
-        members.append(MemberSpec(
-            **params,
+        params = {f: float(m.get(f, defaults[f])) for f in fields}
+        members.append(_member(
+            params, fields,
             seed=int(m["seed"]) if "seed" in m else None,
             name=str(m.get("name", f"member{i}")),
         ))
@@ -252,7 +336,9 @@ def from_toml(table: dict, base) -> EnsembleSettings:
             f"member_shards = {shards} does not divide the member "
             f"count {len(members)}"
         )
-    return EnsembleSettings(members=tuple(members), member_shards=shards)
+    return EnsembleSettings(
+        members=tuple(members), member_shards=shards, model=model.name,
+    )
 
 
 def resolve_seeds(ens: EnsembleSettings, base_seed: int) -> List[int]:

@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ..config.settings import Settings
+from ..config.settings import Settings, resolve_model
 from . import open_writer
 from .bplite import BpReader
 
@@ -56,22 +56,34 @@ class CheckpointWriter:
             keep_steps=keep,
             prefer_adios2=False,
         )
+        model = resolve_model(settings)
+        #: Checkpoint variables are the model's declared field names
+        #: (Gray-Scott keeps ``u``/``v``) — the restore path
+        #: (``Simulation.restore_from_reader``) reads the same names.
+        self.field_names = model.field_names
         if writer_id == 0:
             self.writer.define_attribute("L", settings.L)
             self.writer.define_attribute("precision", settings.precision)
+            self.writer.define_attribute("model", model.name)
+            self.writer.define_attribute(
+                "fields", list(self.field_names)
+            )
         self.writer.define_variable("step", np.int32)
-        self.writer.define_variable("u", np.dtype(dtype).name, (L, L, L))
-        self.writer.define_variable("v", np.dtype(dtype).name, (L, L, L))
+        for name in self.field_names:
+            self.writer.define_variable(
+                name, np.dtype(dtype).name, (L, L, L)
+            )
 
     def save(self, step: int, blocks) -> None:
-        """``blocks``: iterable of (offsets, sizes, u_block, v_block) —
-        this process's shards (``Simulation.local_blocks``)."""
+        """``blocks``: iterable of ``(offsets, sizes, *field_blocks)``
+        in model declaration order — this process's shards
+        (``Simulation.local_blocks``)."""
         w = self.writer
         w.begin_step()
         w.put("step", np.int32(step))
-        for offsets, sizes, ub, vb in blocks:
-            w.put("u", ub, start=offsets, count=sizes)
-            w.put("v", vb, start=offsets, count=sizes)
+        for offsets, sizes, *fblocks in blocks:
+            for name, fb in zip(self.field_names, fblocks):
+                w.put(name, fb, start=offsets, count=sizes)
         w.end_step()
 
     def close(self) -> None:
@@ -145,11 +157,14 @@ def open_checkpoint(
 
 def load_checkpoint(
     path: str, settings: Settings, restart_step: int = -1
-) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Full (u, v, step) of one checkpoint entry (single-host convenience
-    wrapper around :func:`open_checkpoint`)."""
+) -> Tuple:
+    """Full ``(*fields, step)`` of one checkpoint entry (single-host
+    convenience wrapper around :func:`open_checkpoint`); fields follow
+    the model's declaration order — ``(u, v, step)`` for Gray-Scott."""
     r, idx, step = open_checkpoint(path, settings, restart_step)
-    u = r.get("u", step=idx)
-    v = r.get("v", step=idx)
+    fields = tuple(
+        r.get(name, step=idx)
+        for name in resolve_model(settings).field_names
+    )
     r.close()
-    return u, v, step
+    return fields + (step,)

@@ -1,10 +1,18 @@
 """Simulation output stream: the reference's ``ADIOSStream`` re-imagined.
 
 Mirrors ``src/simulation/IO.jl`` variable-for-variable and
-attribute-for-attribute: provenance attributes (F, k, dt, Du, Dv, noise —
-``IO.jl:48-53``), Fides and VTK ImageData visualization schemas
-(``IO.jl:123-163``), and per-step ``step``/``U``/``V`` variables with the
-domain-decomposed (shape, start, count) boxes (``IO.jl:60-67``).
+attribute-for-attribute for the Gray-Scott default: provenance
+attributes (F, k, dt, Du, Dv, noise — ``IO.jl:48-53``), Fides and VTK
+ImageData visualization schemas (``IO.jl:123-163``), and per-step
+``step``/``U``/``V`` variables with the domain-decomposed (shape,
+start, count) boxes (``IO.jl:60-67``).
+
+Model-generic: the per-step variables and visualization schemas are
+built from the run's model declaration — field names come from the
+model (uppercased store spelling, so Gray-Scott keeps ``U``/``V``), and
+the provenance attributes are the model's resolved parameters plus the
+framework's ``dt``/``noise``, alongside ``model`` and ``fields``
+metadata attributes naming what the store holds.
 
 Output goes to a BP-lite store (``io/bplite.py``); optionally also to VTK
 ``.vti`` files (``io/vtk.py``) so ParaView can open results directly even
@@ -13,19 +21,24 @@ without an ADIOS2/Fides reader.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
-from ..config.settings import Settings
+from ..config.settings import Settings, resolve_model
 from ..parallel.domain import CartDomain
 from . import open_writer
 
 
-def fides_vtk_schemas(L: int) -> dict:
-    """The Fides + VTK schema attributes, matching ``IO.jl:123-163``."""
+def fides_vtk_schemas(L: int, var_names: Sequence[str] = ("U", "V")) -> dict:
+    """The Fides + VTK schema attributes (``IO.jl:123-163``), over the
+    model's store variable names (Gray-Scott: ``U``/``V``)."""
+    var_names = list(var_names)
     # Example: L=64 -> "0 64 0 64 0 64"
     extent = (("0 " + str(L) + " ") * 3).rstrip()
+    arrays = "\n".join(
+        f"                <DataArray Name=\"{n}\" />" for n in var_names
+    )
     vtk_schema = (
         "\n        <?xml version=\"1.0\"?>\n"
         "        <VTKFile type=\"ImageData\" version=\"0.1\" "
@@ -33,9 +46,8 @@ def fides_vtk_schemas(L: int) -> dict:
         f"          <ImageData WholeExtent=\"{extent}\" Origin=\"0 0 0\" "
         "Spacing=\"1 1 1\">\n"
         f"            <Piece Extent=\"{extent}\">\n"
-        "              <CellData Scalars=\"U\">\n"
-        "                <DataArray Name=\"U\" />\n"
-        "                <DataArray Name=\"V\" />\n"
+        f"              <CellData Scalars=\"{var_names[0]}\">\n"
+        f"{arrays}\n"
         "                <DataArray Name=\"TIME\">\n"
         "                  step\n"
         "                </DataArray>\n"
@@ -48,9 +60,9 @@ def fides_vtk_schemas(L: int) -> dict:
         "Fides_Data_Model": "uniform",
         "Fides_Origin": [0.0, 0.0, 0.0],
         "Fides_Spacing": [0.1, 0.1, 0.1],
-        "Fides_Dimension_Variable": "U",
-        "Fides_Variable_List": ["U", "V"],
-        "Fides_Variable_Associations": ["points", "points"],
+        "Fides_Dimension_Variable": var_names[0],
+        "Fides_Variable_List": var_names,
+        "Fides_Variable_Associations": ["points"] * len(var_names),
         "vtk.xml": vtk_schema,
     }
 
@@ -73,6 +85,11 @@ class SimStream:
         self.domain = domain
         self.io_name = io_name
         L = settings.L
+        model = resolve_model(settings)
+        self.model = model
+        #: Store variable names: the model's field names uppercased
+        #: (Gray-Scott keeps the reference's ``U``/``V`` spelling).
+        self.var_names = tuple(n.upper() for n in model.field_names)
 
         # On restart, append — a resumed run must not truncate the output
         # steps written before the checkpoint it resumed from — but DO
@@ -92,20 +109,28 @@ class SimStream:
             keep_steps=keep,
         )
         if writer_id == 0:
-            # Provenance attributes (IO.jl:48-53)
-            self.writer.define_attribute("F", settings.F)
-            self.writer.define_attribute("k", settings.k)
+            # Provenance attributes (IO.jl:48-53), routed through the
+            # model declaration: every model parameter by name, then
+            # the framework dt/noise, then what-is-this-store metadata.
+            for name, value in model.resolve_param_values(
+                settings
+            ).items():
+                self.writer.define_attribute(name, value)
             self.writer.define_attribute("dt", settings.dt)
-            self.writer.define_attribute("Du", settings.Du)
-            self.writer.define_attribute("Dv", settings.Dv)
             self.writer.define_attribute("noise", settings.noise)
+            self.writer.define_attribute("model", model.name)
+            self.writer.define_attribute("fields", list(self.var_names))
             # Visualization schemas (IO.jl:123-163)
-            for name, value in fides_vtk_schemas(L).items():
+            for name, value in fides_vtk_schemas(
+                L, self.var_names
+            ).items():
                 self.writer.define_attribute(name, value)
 
         self.writer.define_variable("step", np.int32)
-        self.writer.define_variable("U", np.dtype(dtype).name, (L, L, L))
-        self.writer.define_variable("V", np.dtype(dtype).name, (L, L, L))
+        for name in self.var_names:
+            self.writer.define_variable(
+                name, np.dtype(dtype).name, (L, L, L)
+            )
 
         self._vtk = None
         self._pvti = None
@@ -115,7 +140,7 @@ class SimStream:
 
                 self._vtk = VtiSeriesWriter(
                     settings.output, L, append=settings.restart,
-                    max_step=resume_step,
+                    max_step=resume_step, names=self.var_names,
                 )
             else:
                 # Multi-host: per-block .vti pieces + .pvti index — the
@@ -125,40 +150,42 @@ class SimStream:
                 self._pvti = PvtiSeriesWriter(
                     settings.output, domain, dtype,
                     writer_id=writer_id, append=settings.restart,
-                    max_step=resume_step,
+                    max_step=resume_step, names=self.var_names,
                 )
 
     def write_step(self, step: int, blocks) -> None:
         """Write one output step (``IO.write_step!``, ``IO.jl:82-96``).
 
-        ``blocks`` is an iterable of ``(offsets, sizes, u_block, v_block)``
-        — this process's shards of the global fields
-        (``Simulation.local_blocks``).
+        ``blocks`` is an iterable of ``(offsets, sizes, *field_blocks)``
+        — this process's shards of the global fields in model
+        declaration order (``Simulation.local_blocks``).
         """
         w = self.writer
         w.begin_step()
         w.put("step", np.int32(step))
         blocks = list(blocks)
-        for offsets, sizes, ub, vb in blocks:
-            w.put("U", ub, start=offsets, count=sizes)
-            w.put("V", vb, start=offsets, count=sizes)
+        for offsets, sizes, *fblocks in blocks:
+            for name, fb in zip(self.var_names, fblocks):
+                w.put(name, fb, start=offsets, count=sizes)
         w.end_step()
         if self._pvti is not None:
             self._pvti.write(step, blocks)
         if self._vtk is not None:
             L = self.settings.L
             if len(blocks) == 1 and blocks[0][1] == (L, L, L):
-                u, v = blocks[0][2], blocks[0][3]
+                arrays = blocks[0][2:]
             else:
-                u = np.empty((L, L, L), blocks[0][2].dtype)
-                v = np.empty_like(u)
-                for offsets, sizes, ub, vb in blocks:
+                arrays = tuple(
+                    np.empty((L, L, L), blocks[0][2].dtype)
+                    for _ in self.var_names
+                )
+                for offsets, sizes, *fblocks in blocks:
                     sl = tuple(
                         slice(o, o + s) for o, s in zip(offsets, sizes)
                     )
-                    u[sl] = ub
-                    v[sl] = vb
-            self._vtk.write(step, u, v)
+                    for full, fb in zip(arrays, fblocks):
+                        full[sl] = fb
+            self._vtk.write(step, *arrays)
 
     def close(self) -> None:
         self.writer.close()

@@ -35,12 +35,13 @@ def write_vti(
     path: str,
     L: int,
     step: int,
-    u: np.ndarray,
-    v: np.ndarray,
-    *,
+    *arrays: np.ndarray,
+    names=None,
     extent=None,
 ) -> None:
-    """One .vti file with U and V as CellData (appended raw encoding).
+    """One .vti file with the model's fields as CellData (appended raw
+    encoding); ``names`` defaults to the Gray-Scott ``("U", "V")`` for
+    two arrays.
 
     ``extent`` is the block's cell-space box in *global* coordinates as
     ``((x0, x1), (y0, y1), (z0, z1))`` — a piece of a larger grid, the
@@ -48,22 +49,28 @@ def write_vti(
     grid. Dtypes VTK has no type name for (e.g. bfloat16) are widened to
     float32.
     """
-    if u.dtype.name not in _VTK_TYPES:
-        u = u.astype(np.float32)
-        v = v.astype(np.float32)
-    vtk_type = _VTK_TYPES[u.dtype.name]
+    if names is None:
+        names = _default_names(len(arrays))
+    if arrays[0].dtype.name not in _VTK_TYPES:
+        arrays = tuple(a.astype(np.float32) for a in arrays)
+    vtk_type = _VTK_TYPES[arrays[0].dtype.name]
     if extent is None:
         extent = ((0, L),) * 3
     ext = _extent_str(extent)
     payloads = []
     offsets = []
     off = 0
-    for arr in (u, v):
+    for arr in arrays:
         raw = np.ascontiguousarray(arr.transpose(2, 1, 0)).tobytes()
         payloads.append(struct.pack("<Q", len(raw)) + raw)
         offsets.append(off)
         off += len(payloads[-1])
 
+    data_arrays = "\n".join(
+        f'        <DataArray type="{vtk_type}" Name="{n}" '
+        f'format="appended" offset="{o}"/>'
+        for n, o in zip(names, offsets)
+    )
     header = (
         '<?xml version="1.0"?>\n'
         '<VTKFile type="ImageData" version="1.0" byte_order="LittleEndian" '
@@ -71,11 +78,8 @@ def write_vti(
         f'  <ImageData WholeExtent="{ext}" Origin="0 0 0" '
         'Spacing="1 1 1">\n'
         f'    <Piece Extent="{ext}">\n'
-        '      <CellData Scalars="U">\n'
-        f'        <DataArray type="{vtk_type}" Name="U" format="appended" '
-        f'offset="{offsets[0]}"/>\n'
-        f'        <DataArray type="{vtk_type}" Name="V" format="appended" '
-        f'offset="{offsets[1]}"/>\n'
+        f'      <CellData Scalars="{names[0]}">\n'
+        f'{data_arrays}\n'
         '      </CellData>\n'
         '    </Piece>\n'
         '  </ImageData>\n'
@@ -92,6 +96,11 @@ def write_vti(
 
 
 _NP_TYPES = {v: k for k, v in _VTK_TYPES.items()}
+
+
+def _default_names(n: int):
+    """Gray-Scott's historical (U, V) for two arrays, F0..Fn otherwise."""
+    return ("U", "V") if n == 2 else tuple(f"F{i}" for i in range(n))
 
 
 def read_vti(path: str):
@@ -160,11 +169,13 @@ class PvtiSeriesWriter:
         writer_id: int = 0,
         append: bool = False,
         max_step=None,
+        names=("U", "V"),
     ):
         base = output_name[:-3] if output_name.endswith(".bp") else output_name
         self.dir = base + ".vtk"
         self.domain = domain
         self.L = domain.L
+        self.names = tuple(names)
         self.writer_id = writer_id
         dtype = np.dtype(dtype)
         if dtype.name not in _VTK_TYPES:
@@ -181,15 +192,15 @@ class PvtiSeriesWriter:
         return f"step_{step:07d}_b{'_'.join(str(o) for o in offsets)}.vti"
 
     def write(self, step: int, blocks) -> None:
-        """Write this process's ``(offsets, sizes, u, v)`` blocks as
+        """Write this process's ``(offsets, sizes, *fields)`` blocks as
         pieces; writer 0 also publishes the step's ``.pvti`` index."""
-        for offsets, sizes, ub, vb in blocks:
+        for offsets, sizes, *fblocks in blocks:
             extent = tuple(
                 (o, o + s) for o, s in zip(offsets, sizes)
             )
             write_vti(
                 os.path.join(self.dir, self._piece_name(step, offsets)),
-                self.L, step, ub, vb, extent=extent,
+                self.L, step, *fblocks, names=self.names, extent=extent,
             )
         if self.writer_id == 0:
             self._write_pvti(step)
@@ -202,9 +213,11 @@ class PvtiSeriesWriter:
             'byte_order="LittleEndian">',
             f'  <PImageData WholeExtent="{whole}" GhostLevel="0" '
             'Origin="0 0 0" Spacing="1 1 1">',
-            '    <PCellData Scalars="U">',
-            f'      <PDataArray type="{self._vtk_type}" Name="U"/>',
-            f'      <PDataArray type="{self._vtk_type}" Name="V"/>',
+            f'    <PCellData Scalars="{self.names[0]}">',
+            *(
+                f'      <PDataArray type="{self._vtk_type}" Name="{n}"/>'
+                for n in self.names
+            ),
             "    </PCellData>",
         ]
         # Every block of the global decomposition, regardless of which
@@ -260,19 +273,21 @@ class VtiSeriesWriter:
 
     def __init__(
         self, output_name: str, L: int, *, append: bool = False,
-        max_step=None,
+        max_step=None, names=("U", "V"),
     ):
         base = output_name[:-3] if output_name.endswith(".bp") else output_name
         self.dir = base + ".vtk"
         self.L = L
+        self.names = tuple(names)
         os.makedirs(self.dir, exist_ok=True)
         # restart: keep pre-restart frames in the series index
         self._entries = _scan_series(self.dir, ".vti", max_step) if append else []
         self._pvd_path = os.path.join(self.dir, "series.pvd")
 
-    def write(self, step: int, u: np.ndarray, v: np.ndarray) -> None:
+    def write(self, step: int, *arrays: np.ndarray) -> None:
         name = f"step_{step:07d}.vti"
-        write_vti(os.path.join(self.dir, name), self.L, step, u, v)
+        write_vti(os.path.join(self.dir, name), self.L, step, *arrays,
+                  names=self.names)
         self._entries.append((step, name))
         self._flush_pvd()
 

@@ -1,0 +1,34 @@
+"""Model registry: reaction-diffusion models as data.
+
+A model = (named fields, per-field boundary values, typed params
+declaration, pure reaction function, init function) — see
+``models/base.py`` for the protocol and ``docs/MODELS.md`` for how to
+add one. Importing this package registers the built-in models:
+
+* ``grayscott``   — the flagship (reference parity, Pallas-capable)
+* ``brusselator`` — trimolecular autocatalysis
+* ``fhn``         — FitzHugh–Nagumo excitable media
+* ``heat``        — plain one-field diffusion
+
+The execution machinery (``simulation.py``, ``ops/``, ``parallel/``,
+``ensemble/``, ``io/``) consumes only the declaration; no per-model
+code exists outside this package.
+"""
+
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    FRAMEWORK_PARAMS,
+    Model,
+    SettingsError,
+    available_models,
+    get_model,
+    register,
+    seeded_box_init,
+)
+
+# Built-in model registrations (import order = registry population).
+from . import grayscott  # noqa: F401,E402
+from . import brusselator  # noqa: F401,E402
+from . import fhn  # noqa: F401,E402
+from . import heat  # noqa: F401,E402

@@ -1,0 +1,280 @@
+"""The model framework: reaction-diffusion models as *data*.
+
+Every model this framework runs is one instance of the same shape —
+"pointwise reaction + linear 7-point stencil" — so a model is fully
+described by a declaration, not by code threaded through the execution
+machinery:
+
+* **named fields** with per-field frozen-ghost boundary values (the
+  Dirichlet constants the halo exchange delivers at global edges),
+* **typed params** — a NamedTuple pytree of dtype-typed scalars whose
+  model-specific entries are declared with defaults (``None`` =
+  required in the ``[model]`` TOML table), always extended by the
+  framework-level ``dt`` and ``noise``,
+* a pure **reaction** function over field values + Laplacians +
+  pre-scaled noise, returning the time derivatives,
+* an **init** function producing the initial fields for any sub-block
+  of the global grid (multi-host sharded construction).
+
+The distributed execution machinery — halo exchange, split-phase comm
+overlap, temporal blocking, autotune, resilience, ensembles, I/O —
+consumes only this declaration and is shared by every model with zero
+per-model parallelism code (the separation argued by the stencil-DSL
+shared-compilation-stack line of work; PAPERS.md). Gray-Scott
+(``models/grayscott.py``) is the flagship registered instance; the
+hand-fused Pallas TPU kernel is currently implemented for it alone,
+which the :attr:`Model.pallas_capable` flag gates explicitly (other
+models take the XLA path, recorded in ``kernel_selection`` provenance).
+
+Adding a model is ~40 lines: declare fields/params/reaction/init, call
+:func:`register`. See ``docs/MODELS.md`` for the walkthrough.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+
+class SettingsError(ValueError):
+    """A configuration error the operator must fix — raised loudly at
+    parse/construction time, never silently defaulted around."""
+
+
+#: Framework-level parameters appended to every model's Params pytree:
+#: the explicit-Euler step size and the noise amplitude. They are flat
+#: ``Settings`` keys (``dt`` / ``noise``), not ``[model]`` table keys.
+FRAMEWORK_PARAMS = ("dt", "noise")
+
+
+class Model:
+    """One registered reaction-diffusion model.
+
+    ``param_decls`` maps model-specific parameter names to their default
+    values (``None`` = required: omitting it in the ``[model]`` table is
+    a loud :class:`SettingsError`). ``reaction(fields, laps, noise,
+    params)`` receives interior-shaped field arrays, their Laplacians in
+    the same order, the pre-scaled noise array (or a 0.0 scalar when
+    noise is off), and the typed params; it returns the per-field time
+    derivatives. ``init(L, dtype, offsets=..., sizes=...)`` returns the
+    initial interior-shaped field blocks for a sub-box of the global
+    grid.
+
+    ``legacy_keys`` maps a param name to a flat ``Settings`` attribute
+    supplying its default (Gray-Scott's reference-parity F/k/Du/Dv
+    keys); for every other model, params come from the ``[model]``
+    table alone.
+    """
+
+    def __init__(
+        self,
+        *,
+        name: str,
+        field_names: Sequence[str],
+        boundaries: Sequence[float],
+        param_decls: Mapping[str, Optional[float]],
+        reaction: Callable,
+        init: Callable,
+        pallas_capable: bool = False,
+        params_cls: Optional[type] = None,
+        legacy_keys: Optional[Mapping[str, str]] = None,
+        description: str = "",
+    ):
+        if len(field_names) != len(boundaries):
+            raise ValueError(
+                f"model {name!r}: {len(field_names)} fields but "
+                f"{len(boundaries)} boundary values"
+            )
+        overlap = set(param_decls) & set(FRAMEWORK_PARAMS)
+        if overlap:
+            raise ValueError(
+                f"model {name!r} redeclares framework params "
+                f"{sorted(overlap)}"
+            )
+        self.name = str(name)
+        self.field_names: Tuple[str, ...] = tuple(field_names)
+        self.boundaries: Tuple[float, ...] = tuple(
+            float(b) for b in boundaries
+        )
+        self.param_names: Tuple[str, ...] = tuple(param_decls)
+        self.param_defaults: Dict[str, Optional[float]] = dict(param_decls)
+        self.reaction = reaction
+        self.init = init
+        self.pallas_capable = bool(pallas_capable)
+        self.legacy_keys = dict(legacy_keys or {})
+        self.description = description
+        #: The typed Params pytree class: model params in declaration
+        #: order, then the framework's (dt, noise). Gray-Scott passes
+        #: its hand-written NamedTuple so the pre-refactor pytree
+        #: structure (and everything keyed on it) is preserved.
+        self.params_cls = params_cls or namedtuple(
+            f"{self.name.capitalize()}Params",
+            self.param_names + FRAMEWORK_PARAMS,
+        )
+        missing = set(self.param_names + FRAMEWORK_PARAMS) - set(
+            self.params_cls._fields
+        )
+        if missing:
+            raise ValueError(
+                f"model {name!r}: params_cls lacks fields {sorted(missing)}"
+            )
+
+    @property
+    def n_fields(self) -> int:
+        return len(self.field_names)
+
+    # ------------------------------------------------------------ params
+
+    def validate_table(self, table: Mapping) -> None:
+        """Reject a ``[model]`` TOML table with unknown or missing keys
+        — loudly, naming the model (the silent-default trap this
+        replaces is exactly how a misspelled ``Dv`` burns a campaign)."""
+        unknown = set(table) - set(self.param_names)
+        if unknown:
+            raise SettingsError(
+                f"[model] table for model {self.name!r} has unknown "
+                f"parameter keys {sorted(unknown)}; accepted: "
+                f"{sorted(self.param_names)}"
+            )
+        missing = [
+            p for p in self.param_names
+            if p not in table and self.param_defaults[p] is None
+            and p not in self.legacy_keys
+        ]
+        if missing:
+            raise SettingsError(
+                f"model {self.name!r} requires parameter(s) "
+                f"{sorted(missing)} in the [model] table"
+            )
+        for key, value in table.items():
+            if isinstance(value, bool) or not isinstance(
+                value, (int, float)
+            ):
+                raise SettingsError(
+                    f"[model] parameter {key!r} for model {self.name!r} "
+                    f"must be a number, got {value!r}"
+                )
+
+    def resolve_param_values(self, settings) -> Dict[str, float]:
+        """Model-specific parameter values for one run, resolved
+        through THIS model's declaration: ``[model]`` table entry >
+        legacy flat Settings key (Gray-Scott only) > declared default.
+        Raises :class:`SettingsError` (naming the model) on unknown or
+        missing keys — never a silent default for a typo."""
+        table = dict(getattr(settings, "model_params", None) or {})
+        self.validate_table(table)
+        values: Dict[str, float] = {}
+        for p in self.param_names:
+            if p in table:
+                values[p] = float(table[p])
+            elif p in self.legacy_keys:
+                values[p] = float(getattr(settings, self.legacy_keys[p]))
+            else:
+                default = self.param_defaults[p]
+                assert default is not None  # validate_table guarantees
+                values[p] = float(default)
+        return values
+
+    def make_params(self, settings, dtype):
+        """The typed Params pytree for one run — dtype-typed scalars,
+        traced (not baked) so parameter changes never recompile."""
+        import jax.numpy as jnp
+
+        values = self.resolve_param_values(settings)
+        values["dt"] = float(settings.dt)
+        values["noise"] = float(settings.noise)
+        return self.params_cls(**{
+            f: jnp.asarray(values[f], dtype)
+            for f in self.params_cls._fields
+        })
+
+    def describe(self) -> dict:
+        """JSON-able declaration summary for stats/store provenance."""
+        return {
+            "name": self.name,
+            "fields": list(self.field_names),
+            "boundaries": list(self.boundaries),
+            "params": list(self.param_names),
+            "pallas_capable": self.pallas_capable,
+        }
+
+
+# ---------------------------------------------------------------- registry
+
+_REGISTRY: Dict[str, Model] = {}
+
+
+def register(model: Model) -> Model:
+    """Register ``model`` under its name (idempotent re-registration of
+    the same object; a different object under a taken name is a bug)."""
+    existing = _REGISTRY.get(model.name)
+    if existing is not None and existing is not model:
+        raise ValueError(f"model {model.name!r} is already registered")
+    _REGISTRY[model.name] = model
+    return model
+
+
+def get_model(name: str) -> Model:
+    """Look up a registered model by name; unknown names list what IS
+    registered (the typo-facing error path)."""
+    try:
+        return _REGISTRY[str(name).lower()]
+    except KeyError:
+        raise SettingsError(
+            f"Unknown model {name!r}; registered models: "
+            f"{', '.join(sorted(_REGISTRY))}"
+        ) from None
+
+
+def available_models() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+# ------------------------------------------------------------ init helper
+
+def seeded_box_init(
+    L: int,
+    dtype,
+    *,
+    backgrounds: Sequence[float],
+    seed_values: Sequence[float],
+    half_width: int,
+    offsets: Tuple[int, int, int] = (0, 0, 0),
+    sizes: Optional[Tuple[int, int, int]] = None,
+):
+    """Shared initial condition: uniform backgrounds with a seeded
+    center cube ``[L/2-half_width, L/2+half_width]^3`` (inclusive) —
+    the reference's ``Simulation_CPU.jl:23-57`` pattern, generalized to
+    any field count. ``offsets``/``sizes`` select a local block in
+    global 0-based coordinates; the seed region is intersected with the
+    block. Even ``L`` is required (the reference throws
+    ``InexactError`` for odd L; we error clearly)."""
+    import jax.numpy as jnp
+
+    if L % 2 != 0:
+        raise ValueError(
+            f"L must be even (reference requires Int(L/2)); got L={L}"
+        )
+    if sizes is None:
+        sizes = (L, L, L)
+    lo, hi = L // 2 - half_width, L // 2 + half_width
+
+    fields = [
+        jnp.full(sizes, bg, dtype=dtype) for bg in backgrounds
+    ]
+    # Intersect [lo, hi] (global, inclusive) with [off, off+size) per axis.
+    slices = []
+    empty = False
+    for off, size in zip(offsets, sizes):
+        a = max(lo - off, 0)
+        b = min(hi + 1 - off, size)
+        if a >= b:
+            empty = True
+            break
+        slices.append(slice(a, b))
+    if not empty:
+        fields = [
+            f.at[tuple(slices)].set(jnp.asarray(sv, dtype))
+            for f, sv in zip(fields, seed_values)
+        ]
+    return tuple(fields)

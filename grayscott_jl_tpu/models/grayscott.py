@@ -8,14 +8,25 @@ System (reference ``README.md:8-11``):
 integrated with explicit Euler on a cubic grid of side ``L`` with a 1-cell
 frozen ghost shell (u=1, v=0) as the boundary condition.
 
+This module is the flagship :class:`~.base.Model` instance: the fields,
+boundary constants, parameter declaration, reaction, and init below are
+*declaration*, consumed by the shared execution machinery
+(``ops/stencil.py`` n-field update, ``parallel/`` halo exchange and
+temporal blocking, ``simulation.py``) exactly like every other
+registered model's. Two things are Gray-Scott-privileged:
+
+* the hand-fused Pallas TPU kernel (``ops/pallas_stencil.py``)
+  implements this reaction only (``pallas_capable=True``; other models
+  take the XLA path, gated explicitly in ``kernel_selection``);
+* the reference-parity flat TOML keys (``F``/``k``/``Du``/``Dv``)
+  remain valid param spellings via ``legacy_keys`` — reference configs
+  run unmodified, while the ``[model]`` table works too.
+
 Design differences from the reference (idiomatic JAX):
 
-* Fields are interior-shaped ``(L, L, L)`` immutable arrays; the ghost shell
-  is materialized functionally at compute time (single device: constant pad;
-  distributed: halo exchange in ``parallel/halo.py``). The reference instead
-  carries mutable ghost-padded arrays plus explicit double buffers
-  (``Structs.jl:82-93``); in JAX the "swap" is just returning new arrays
-  (``public.jl:67-68`` made free).
+* Fields are interior-shaped ``(L, L, L)`` immutable arrays; the ghost
+  shell is materialized functionally at compute time (single device:
+  constant pad; distributed: halo exchange in ``parallel/halo.py``).
 * Noise comes from the framework's position-keyed counter-hash stream
   (``ops/noise.py``): each draw is a function of (key, absolute step,
   global cell coordinate), so restarts, step chunking, shard layout, and
@@ -27,13 +38,22 @@ Design differences from the reference (idiomatic JAX):
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import TYPE_CHECKING, NamedTuple, Optional, Tuple
 
-import jax
-import jax.numpy as jnp
+from . import base
 
-from ..config.settings import Settings
-from ..ops import stencil
+if TYPE_CHECKING:  # pragma: no cover — annotation-only (keeps this
+    import jax.numpy as jnp  # module, and the registry, JAX-free to import)
+
+#: Frozen ghost-shell boundary values. In the reference, ghost layers
+#: are initialized to u=1, v=0 (``Simulation_CPU.jl:23-24``) and — with
+#: no neighbor to exchange with (``MPI.PROC_NULL``) — stay frozen,
+#: acting as Dirichlet boundary data on the global domain edge. These
+#: are Gray-Scott model data: shared code (``ops/``, ``parallel/``)
+#: receives boundary values through the model declaration, never from
+#: constants of its own.
+U_BOUNDARY = 1.0
+V_BOUNDARY = 0.0
 
 #: Half-width of the seeded center cube (reference ``Simulation_CPU.jl:31``).
 SEED_HALF_WIDTH = 6
@@ -56,15 +76,11 @@ class Params(NamedTuple):
     noise: jnp.ndarray
 
     @classmethod
-    def from_settings(cls, settings: Settings, dtype) -> "Params":
-        return cls(
-            Du=jnp.asarray(settings.Du, dtype),
-            Dv=jnp.asarray(settings.Dv, dtype),
-            F=jnp.asarray(settings.F, dtype),
-            k=jnp.asarray(settings.k, dtype),
-            dt=jnp.asarray(settings.dt, dtype),
-            noise=jnp.asarray(settings.noise, dtype),
-        )
+    def from_settings(cls, settings, dtype) -> "Params":
+        """Params for one run — routed through the model declaration
+        (``[model]`` table wins over the legacy flat keys; unknown
+        table keys raise :class:`~.base.SettingsError`)."""
+        return MODEL.make_params(settings, dtype)
 
 
 def seed_bounds(L: int) -> Tuple[int, int]:
@@ -93,34 +109,45 @@ def init_fields(
 
     u = 1 everywhere, v = 0, except a seeded cube
     ``[L/2-6, L/2+6]^3`` (inclusive) where u=0.25, v=0.33
-    (reference ``Simulation_CPU.jl:23-57``). ``offsets``/``sizes`` select the
-    block owned by this shard in global 0-based coordinates (whole grid by
-    default); the seed region is intersected with the block, mirroring the
-    reference's ``is_inside`` guard (``Common.jl:34-47``).
+    (reference ``Simulation_CPU.jl:23-57``). ``offsets``/``sizes`` select
+    the block owned by this shard in global 0-based coordinates (whole
+    grid by default); the seed region is intersected with the block,
+    mirroring the reference's ``is_inside`` guard (``Common.jl:34-47``).
 
     Returns interior-shaped arrays (no ghost cells).
     """
-    if sizes is None:
-        sizes = (L, L, L)
-    lo, hi = seed_bounds(L)
+    return base.seeded_box_init(
+        L, dtype,
+        backgrounds=(U_BOUNDARY, V_BOUNDARY),
+        seed_values=(SEED_U, SEED_V),
+        half_width=SEED_HALF_WIDTH,
+        offsets=offsets, sizes=sizes,
+    )
 
-    u = jnp.full(sizes, stencil.U_BOUNDARY, dtype=dtype)
-    v = jnp.full(sizes, stencil.V_BOUNDARY, dtype=dtype)
 
-    # Intersect [lo, hi] (global, inclusive) with [off, off+size) per axis.
-    slices = []
-    empty = False
-    for off, size in zip(offsets, sizes):
-        a = max(lo - off, 0)
-        b = min(hi + 1 - off, size)
-        if a >= b:
-            empty = True
-            break
-        slices.append(slice(a, b))
-    if not empty:
-        u = u.at[tuple(slices)].set(jnp.asarray(SEED_U, dtype))
-        v = v.at[tuple(slices)].set(jnp.asarray(SEED_V, dtype))
-    return u, v
+def reaction(fields, laps, noise_u, params):
+    """The Gray-Scott time derivatives (``Simulation_CPU.jl:92-112``):
+
+        du = Du*lap(u) - u*v^2 + F*(1-u) + noise*U(-1,1)
+        dv = Dv*lap(v) + u*v^2 - (F+k)*v
+
+    ``noise_u`` is the pre-scaled noise field ``noise * U(-1,1)`` (or
+    0.0 for the noiseless path); only ``du`` receives noise, as in the
+    reference. The expression order here is load-bearing: it reproduces
+    the pre-framework update's dataflow graph exactly, which is what
+    keeps the refactored trajectory byte-identical to the golden one
+    (``tests/golden/``).
+    """
+    import jax.numpy as jnp
+
+    u, v = fields
+    lap_u, lap_v = laps
+    one = jnp.asarray(1.0, u.dtype)
+
+    uvv = u * v * v
+    du = params.Du * lap_u - uvv + params.F * (one - u) + noise_u
+    dv = params.Dv * lap_v + uvv - (params.F + params.k) * v
+    return du, dv
 
 
 def noise_field(key_i32, step, shape, dtype, noise: jnp.ndarray,
@@ -143,3 +170,15 @@ def noise_field(key_i32, step, shape, dtype, noise: jnp.ndarray,
     return noise * unit
 
 
+MODEL = base.register(base.Model(
+    name="grayscott",
+    field_names=("u", "v"),
+    boundaries=(U_BOUNDARY, V_BOUNDARY),
+    param_decls={"Du": 0.05, "Dv": 0.1, "F": 0.04, "k": 0.0},
+    reaction=reaction,
+    init=init_fields,
+    pallas_capable=True,
+    params_cls=Params,
+    legacy_keys={"Du": "Du", "Dv": "Dv", "F": "F", "k": "k"},
+    description="Gray-Scott cubic autocatalysis (reference parity)",
+))

@@ -1,0 +1,52 @@
+"""Plain heat diffusion — the minimal one-field model.
+
+    T_t = D * lap(T) + noise*U(-1,1)
+
+Deliberately trivial: it exists to pin the framework's n-field
+generality (everything else ships two fields) and as the cheapest
+smoke-test physics — a hot center cube relaxing toward the cold
+Dirichlet frame. With ``noise`` set it becomes the stochastic heat
+equation. This whole file is the model's entire footprint in the
+framework; the distributed machinery is shared (XLA kernel path).
+
+Config::
+
+    [model]
+    name = "heat"
+    D = 0.2
+"""
+
+from __future__ import annotations
+
+from . import base
+
+T_BOUNDARY = 0.0
+
+SEED_HALF_WIDTH = 6
+SEED_T = 1.0
+
+
+def reaction(fields, laps, noise_t, params):
+    (lap_t,) = laps
+    return (params.D * lap_t + noise_t,)
+
+
+def init_fields(L, dtype, *, offsets=(0, 0, 0), sizes=None):
+    return base.seeded_box_init(
+        L, dtype,
+        backgrounds=(T_BOUNDARY,),
+        seed_values=(SEED_T,),
+        half_width=SEED_HALF_WIDTH,
+        offsets=offsets, sizes=sizes,
+    )
+
+
+MODEL = base.register(base.Model(
+    name="heat",
+    field_names=("T",),
+    boundaries=(T_BOUNDARY,),
+    param_decls={"D": 0.2},
+    reaction=reaction,
+    init=init_fields,
+    description="Plain heat diffusion (one field)",
+))

@@ -70,6 +70,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
+# The Pallas kernel IS the Gray-Scott model's hand-fused form: its
+# reaction math and boundary constants come from the model declaration
+# (models/grayscott.py); other registered models take the XLA path
+# (gated in simulation.py's kernel selection).
+from ..models import grayscott as _gs_model
 from .noise import _u32, block_bits, plane_seed, uniform_pm1_block
 
 # Name compat across jax releases: CompilerParams/InterpretParams are
@@ -426,8 +431,8 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
         # cdt == dtype except bf16, which computes in f32 (_compute_dtype).
         cdt = _compute_dtype(dtype)
-        u_bv = jnp.asarray(stencil.U_BOUNDARY, cdt)
-        v_bv = jnp.asarray(stencil.V_BOUNDARY, cdt)
+        u_bv = jnp.asarray(_gs_model.U_BOUNDARY, cdt)
+        v_bv = jnp.asarray(_gs_model.V_BOUNDARY, cdt)
         fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
         # Params land in SMEM at >= f32 (see ref order above); cast the
         # six scalars to the compute dtype at the point of use.
@@ -985,8 +990,8 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
     u_xlo, u_xhi, v_xlo, v_xhi = faces
     nx, ny, nz = u.shape
     k = fuse
-    u_bv = jnp.asarray(stencil.U_BOUNDARY, u.dtype)
-    v_bv = jnp.asarray(stencil.V_BOUNDARY, v.dtype)
+    u_bv = jnp.asarray(_gs_model.U_BOUNDARY, u.dtype)
+    v_bv = jnp.asarray(_gs_model.V_BOUNDARY, v.dtype)
     u_w = jnp.concatenate([u_xlo, u, u_xhi], axis=0)
     v_w = jnp.concatenate([v_xlo, v, v_xhi], axis=0)
     gy = offsets[1] + jnp.arange(ny)
@@ -1014,7 +1019,8 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
         else:
             nz_field = jnp.asarray(0.0, u.dtype)
         u_w, v_w = stencil.reaction_update(
-            pad_yz(u_w, u_bv), pad_yz(v_w, v_bv), nz_field, params
+            (pad_yz(u_w, u_bv), pad_yz(v_w, v_bv)), nz_field, params,
+            _gs_model.MODEL,
         )
         if s == k - 1:
             # Mirror the kernel: the final stage writes its output
@@ -1035,8 +1041,8 @@ def _xla_fallback(u, v, params, seeds, faces, *, use_noise, offsets=None,
     """XLA-path step with the same call contract as ``fused_step``,
     drawing from the same position-keyed noise stream."""
     if faces is None:
-        u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
-        v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
+        u_pad = stencil.pad_with_boundary(u, _gs_model.U_BOUNDARY)
+        v_pad = stencil.pad_with_boundary(v, _gs_model.V_BOUNDARY)
     else:
         u_pad = _pad_from_faces(u, faces[0], faces[1], faces[4], faces[5],
                                 faces[8], faces[9])
@@ -1053,7 +1059,9 @@ def _xla_fallback(u, v, params, seeds, faces, *, use_noise, offsets=None,
         nz_field = params.noise * unit
     else:
         nz_field = jnp.asarray(0.0, u.dtype)
-    return stencil.reaction_update(u_pad, v_pad, nz_field, params)
+    return stencil.reaction_update(
+        (u_pad, v_pad), nz_field, params, _gs_model.MODEL
+    )
 
 
 def _pad_from_faces(x, xlo, xhi, ylo, yhi, zlo, zhi):

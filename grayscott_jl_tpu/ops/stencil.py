@@ -1,4 +1,4 @@
-"""XLA-path stencil ops for the Gray-Scott system.
+"""XLA-path stencil ops — the model-generic compute core.
 
 The 7-point Laplacian matches the reference math core
 (``src/simulation/Common.jl:13-18``):
@@ -14,21 +14,24 @@ compares the Float32 path against a Float64-Laplacian NumPy oracle at
 rtol 2e-5 over 10 steps).
 
 Arrays here are ghost-padded ``(nx+2, ny+2, nz+2)`` blocks; functions return
-interior-shaped ``(nx, ny, nz)`` results. XLA fuses the shifted slices, the
-reaction terms, and the noise into a small number of HBM passes; the Pallas
-kernel (``ops/pallas_stencil.py``) is the hand-fused alternative.
+interior-shaped ``(nx, ny, nz)`` results. :func:`reaction_update` is
+n-field and model-generic: field extraction and Laplacians happen here,
+the time derivatives come from the model's declared ``reaction``
+(``models/base.Model``), and the explicit-Euler update closes the step —
+so a new model touches this file not at all. XLA fuses the shifted
+slices, the reaction terms, and the noise into a small number of HBM
+passes; the Pallas kernel (``ops/pallas_stencil.py``) is the hand-fused
+Gray-Scott-specific alternative.
+
+This module contains no model-specific constants: boundary values and
+seeds are model declarations (``models/``), threaded in by callers.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+from typing import Sequence, Tuple
 
-#: Ghost-cell boundary values. In the reference, ghost layers are initialized
-#: to u=1, v=0 (``Simulation_CPU.jl:23-24``) and — with no neighbor to
-#: exchange with (``MPI.PROC_NULL``) — stay frozen, acting as Dirichlet
-#: boundary data on the global domain edge.
-U_BOUNDARY = 1.0
-V_BOUNDARY = 0.0
+import jax.numpy as jnp
 
 
 def pad_with_boundary(x: jnp.ndarray, value: float) -> jnp.ndarray:
@@ -61,30 +64,31 @@ def laplacian(padded: jnp.ndarray) -> jnp.ndarray:
     return total * inv6 - center
 
 
-def reaction_update(u_pad, v_pad, noise_u, params):
-    """One explicit-Euler Gray-Scott update on ghost-padded fields.
+def reaction_update(
+    fields_pad: Sequence[jnp.ndarray],
+    noise_term,
+    params,
+    model,
+) -> Tuple[jnp.ndarray, ...]:
+    """One explicit-Euler step of ``model`` on ghost-padded fields.
 
-    Mirrors the reference update (``Simulation_CPU.jl:92-112``):
+        f_i' = f_i + d_i * dt   with   (d_1..d_n) = model.reaction(...)
 
-        du = Du*lap(u) - u*v^2 + F*(1-u) + noise*U(-1,1)
-        dv = Dv*lap(v) + u*v^2 - (F+k)*v
-        u' = u + du*dt ;  v' = v + dv*dt
+    The per-field slice extraction and Laplacians are computed here in
+    field order, the model's pure ``reaction`` supplies the derivatives,
+    and ``params.dt`` closes the Euler update — the same dataflow graph
+    the pre-framework Gray-Scott update lowered to, which is what keeps
+    its trajectories byte-identical (``tests/golden/``).
 
-    ``noise_u`` is the pre-scaled noise field ``noise * U(-1,1)`` (or 0.0 for
-    the noiseless path); only ``du`` receives noise, as in the reference.
+    ``noise_term`` is the pre-scaled noise field ``noise * U(-1,1)`` (or
+    a 0.0 scalar on the noiseless path); which derivative receives it is
+    the model's choice inside ``reaction``.
 
-    Returns interior-shaped (u', v').
+    Returns interior-shaped updated fields, in declaration order.
     """
-    u = u_pad[1:-1, 1:-1, 1:-1]
-    v = v_pad[1:-1, 1:-1, 1:-1]
-    dtype = u.dtype
-    one = jnp.asarray(1.0, dtype)
-
-    lap_u = laplacian(u_pad)
-    lap_v = laplacian(v_pad)
-
-    uvv = u * v * v
-    du = params.Du * lap_u - uvv + params.F * (one - u) + noise_u
-    dv = params.Dv * lap_v + uvv - (params.F + params.k) * v
-
-    return u + du * params.dt, v + dv * params.dt
+    fields = tuple(f[1:-1, 1:-1, 1:-1] for f in fields_pad)
+    laps = tuple(laplacian(f) for f in fields_pad)
+    derivs = model.reaction(fields, laps, noise_term, params)
+    return tuple(
+        f + d * params.dt for f, d in zip(fields, derivs)
+    )

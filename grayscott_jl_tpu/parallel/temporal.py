@@ -66,19 +66,23 @@ def pin_out_of_domain(arr, bv, origin, row):
 
 
 def window_chain(
-    u_w, v_w, params, *, depth, step, origin, row, use_noise, unit_noise,
-    boundaries: Sequence[float], final_pin: bool = True,
+    fields_w, params, model, *, depth, step, origin, row, use_noise,
+    unit_noise, boundaries: Sequence[float], final_pin: bool = True,
 ):
-    """``depth`` XLA steps on a ghost-inclusive window, shrinking one
-    cell per side per stage; returns the (shape - 2*depth) core.
+    """``depth`` XLA steps on ghost-inclusive field windows, shrinking
+    one cell per side per stage; returns the (shape - 2*depth) cores.
 
-    ``origin`` (int32[3]) is the global coordinate of ``u_w[0, 0, 0]``;
-    after each stage, cells outside the global domain are pinned to the
-    frozen ``boundaries`` values by :func:`pin_out_of_domain`'s
-    global-coordinate masks. Same op order and position-keyed noise
-    as every other path — bitwise-exact against the stepwise
-    trajectory, so a band it computes can be stitched next to
-    kernel-computed cells seamlessly.
+    Model-generic: ``fields_w`` is the model's field tuple in
+    declaration order and the update is the shared n-field
+    ``stencil.reaction_update`` with ``model``'s reaction.
+
+    ``origin`` (int32[3]) is the global coordinate of each window's
+    ``[0, 0, 0]``; after each stage, cells outside the global domain are
+    pinned to the frozen per-field ``boundaries`` values by
+    :func:`pin_out_of_domain`'s global-coordinate masks. Same op order
+    and position-keyed noise as every other path — bitwise-exact
+    against the stepwise trajectory, so a band it computes can be
+    stitched next to kernel-computed cells seamlessly.
 
     ``final_pin=False`` skips the last stage's pin masks — legal only
     when the caller knows every output cell is in-domain (a divisible-L
@@ -86,33 +90,35 @@ def window_chain(
     Mid-stage pins always run: the shrinking ring reads them back."""
     from ..ops import stencil
 
-    u_bv, v_bv = boundaries
+    fields_w = tuple(fields_w)
     origin = jnp.asarray(origin, jnp.int32)
     for s in range(depth):
-        shape = tuple(d - 2 for d in u_w.shape)
+        shape = tuple(d - 2 for d in fields_w[0].shape)
         o = origin + (s + 1)
         if use_noise:
             nzf = params.noise * unit_noise(step + s, o, shape)
         else:
-            nzf = jnp.asarray(0.0, u_w.dtype)
-        u_w, v_w = stencil.reaction_update(u_w, v_w, nzf, params)
+            nzf = jnp.asarray(0.0, fields_w[0].dtype)
+        fields_w = stencil.reaction_update(fields_w, nzf, params, model)
         if s + 1 < depth or final_pin:
-            u_w = pin_out_of_domain(u_w, u_bv, o, row)
-            v_w = pin_out_of_domain(v_w, v_bv, o, row)
-    return u_w, v_w
+            fields_w = tuple(
+                pin_out_of_domain(f, bv, o, row)
+                for f, bv in zip(fields_w, boundaries)
+            )
+    return fields_w
 
 
 def stitch_bands_from_frame(
-    u_i, v_i, u_w, v_w, params, *, depth, step, offs, row, axis_sizes,
-    use_noise, unit_noise, boundaries: Sequence[float],
+    fields_i, fields_w, params, model, *, depth, step, offs, row,
+    axis_sizes, use_noise, unit_noise, boundaries: Sequence[float],
     dims_to_stitch: Sequence[int] = (0, 1, 2),
 ):
-    """Overwrite the ``depth``-thick boundary bands of a block-shaped
-    result with :func:`window_chain` recomputes from the exchanged
-    corner-propagated frame ``(u_w, v_w)`` (``halo.halo_pad_wide``
-    width ``depth``).
+    """Overwrite the ``depth``-thick boundary bands of block-shaped
+    results with :func:`window_chain` recomputes from the exchanged
+    corner-propagated frames ``fields_w`` (``halo.halo_pad_wide``
+    width ``depth``). Model-generic over the field tuple.
 
-    The split-phase stitch: ``(u_i, v_i)`` came from an interior pass
+    The split-phase stitch: ``fields_i`` came from an interior pass
     that saw frozen-constant ghosts, so every cell within ``depth``
     cells of a sharded face is contaminated (one cell per stage). Each
     such band is recomputed from a 3k-deep frame window spanning the
@@ -126,28 +132,33 @@ def stitch_bands_from_frame(
     inside ``shard_map``.
     """
     k = depth
+    fields_i = tuple(fields_i)
+    fields_w = tuple(fields_w)
     offs = jnp.asarray(offs, jnp.int32)
     base = offs - k  # global origin of the frame
     for dim in range(3):
         if axis_sizes[dim] == 1 or dim not in dims_to_stitch:
             continue
-        n_d = u_i.shape[dim]
-        m = u_w.shape[dim]  # n_d + 2k
+        n_d = fields_i[0].shape[dim]
+        m = fields_w[0].shape[dim]  # n_d + 2k
         for d0, w0 in ((0, 0), (n_d - k, m - 3 * k)):
             sl = [slice(None)] * 3
             sl[dim] = slice(w0, w0 + 3 * k)
             sl = tuple(sl)
-            bu, bv = window_chain(
-                u_w[sl], v_w[sl], params, depth=k, step=step,
+            bands = window_chain(
+                tuple(f[sl] for f in fields_w), params, model,
+                depth=k, step=step,
                 origin=base.at[dim].add(w0), row=row,
                 use_noise=use_noise, unit_noise=unit_noise,
                 boundaries=boundaries,
             )
             pos = [0, 0, 0]
             pos[dim] = d0
-            u_i = lax.dynamic_update_slice(u_i, bu, tuple(pos))
-            v_i = lax.dynamic_update_slice(v_i, bv, tuple(pos))
-    return u_i, v_i
+            fields_i = tuple(
+                lax.dynamic_update_slice(fi, b, tuple(pos))
+                for fi, b in zip(fields_i, bands)
+            )
+    return fields_i
 
 
 def xy_overlap_feasible(local, dims, depth) -> bool:
@@ -164,7 +175,7 @@ def xy_overlap_feasible(local, dims, depth) -> bool:
 
 
 def xy_chain(
-    u, v, params, *, depth, step, offs, chain_kernel: Callable,
+    u, v, params, model, *, depth, step, offs, chain_kernel: Callable,
     use_noise, unit_noise, row, axis_names, axis_sizes,
     boundaries: Sequence[float], sublane: int = 8,
     overlap: bool = False, band_kernel: Callable = None,
@@ -377,9 +388,9 @@ def xy_chain(
         # frame — bitwise the same values, so overwriting
         # unconditionally is correct on edge shards too.
         u_o, v_o = stitch_bands_from_frame(
-            u_o, v_o, u_w, v_w, params, depth=k, step=step, offs=offs,
-            row=row, axis_sizes=dims, use_noise=use_noise,
-            unit_noise=unit_noise, boundaries=boundaries,
-            dims_to_stitch=(2,),
+            (u_o, v_o), (u_w, v_w), params, model, depth=k,
+            step=step, offs=offs, row=row, axis_sizes=dims,
+            use_noise=use_noise, unit_noise=unit_noise,
+            boundaries=boundaries, dims_to_stitch=(2,),
         )
     return u_o, v_o

@@ -44,20 +44,69 @@ __all__ = [
 POLICIES = ("abort", "rollback", "warn", "off")
 
 
-class HealthReport(NamedTuple):
-    """Resolved (host-side) probe result for one boundary."""
+class HealthReport:
+    """Resolved (host-side) probe result for one boundary.
 
-    finite: bool
-    u_min: float
-    u_max: float
-    v_min: float
-    v_max: float
+    Model-generic: carries one ``(min, max)`` range per model field,
+    with the model's field names for attribution. The historical
+    positional form ``HealthReport(finite, u_min, u_max, v_min,
+    v_max)`` still constructs (names default to ``("u", "v")``), and
+    the ``u_min``/``u_max``/``v_min``/``v_max`` accessors keep reading
+    fields 0/1 — so two-field consumers and tests are unchanged.
+    """
+
+    def __init__(self, finite, *minmax, names=None, ranges=None):
+        self.finite = bool(finite)
+        if ranges is None:
+            if len(minmax) % 2:
+                raise ValueError(
+                    "HealthReport needs (min, max) pairs per field"
+                )
+            ranges = tuple(
+                (float(minmax[i]), float(minmax[i + 1]))
+                for i in range(0, len(minmax), 2)
+            )
+        self.ranges = tuple(
+            (float(lo), float(hi)) for lo, hi in ranges
+        )
+        if names is None:
+            names = ("u", "v")[: len(self.ranges)]
+            if len(names) < len(self.ranges):
+                names = tuple(
+                    f"f{i}" for i in range(len(self.ranges))
+                )
+        self.names = tuple(names)
+
+    # Two-field accessors (Gray-Scott-era call sites and log lines).
+    @property
+    def u_min(self) -> float:
+        return self.ranges[0][0]
+
+    @property
+    def u_max(self) -> float:
+        return self.ranges[0][1]
+
+    @property
+    def v_min(self) -> float:
+        return self.ranges[1][0]
+
+    @property
+    def v_max(self) -> float:
+        return self.ranges[1][1]
+
+    def range_summary(self) -> str:
+        return ", ".join(
+            f"{n} in [{lo}, {hi}]"
+            for n, (lo, hi) in zip(self.names, self.ranges)
+        )
 
     def describe(self) -> dict:
         return {
             "finite": self.finite,
-            "u_range": [self.u_min, self.u_max],
-            "v_range": [self.v_min, self.v_max],
+            **{
+                f"{n}_range": [lo, hi]
+                for n, (lo, hi) in zip(self.names, self.ranges)
+            },
         }
 
 
@@ -86,6 +135,20 @@ class EnsembleHealthReport(NamedTuple):
     # Aggregate ranges so single-report consumers (log lines, the
     # HealthError message core) read an ensemble report transparently.
     @property
+    def names(self) -> tuple:
+        return self.members[0].names
+
+    @property
+    def ranges(self) -> tuple:
+        return tuple(
+            (
+                min(m.ranges[i][0] for m in self.members),
+                max(m.ranges[i][1] for m in self.members),
+            )
+            for i in range(len(self.members[0].ranges))
+        )
+
+    @property
     def u_min(self) -> float:
         return min(m.u_min for m in self.members)
 
@@ -101,13 +164,21 @@ class EnsembleHealthReport(NamedTuple):
     def v_max(self) -> float:
         return max(m.v_max for m in self.members)
 
+    def range_summary(self) -> str:
+        return ", ".join(
+            f"{n} in [{lo}, {hi}]"
+            for n, (lo, hi) in zip(self.names, self.ranges)
+        )
+
     def describe(self) -> dict:
         return {
             "finite": self.finite,
             "members": len(self.members),
             "bad_members": self.bad_members,
-            "u_range": [self.u_min, self.u_max],
-            "v_range": [self.v_min, self.v_max],
+            **{
+                f"{n}_range": [lo, hi]
+                for n, (lo, hi) in zip(self.names, self.ranges)
+            },
         }
 
 
@@ -121,8 +192,7 @@ class HealthError(RuntimeError):
             detail = f"; non-finite members={bad}"
         super().__init__(
             f"field health check failed at step {step} "
-            f"(finite={report.finite}, u in [{report.u_min}, "
-            f"{report.u_max}], v in [{report.v_min}, {report.v_max}]"
+            f"(finite={report.finite}, {report.range_summary()}"
             f"{detail}); policy={policy}"
         )
         self.step = step
@@ -130,15 +200,24 @@ class HealthError(RuntimeError):
         self.policy = policy
 
 
-def device_probe(u, v):
-    """The fused device-side reduction: ``(finite, u_min, u_max, v_min,
-    v_max)`` as 0-d device arrays. Traced inside the snapshot-copy jit
-    (``Simulation.snapshot_async``) so XLA fuses it with the copy's HBM
-    read — the fields are touched once for both."""
+def device_probe(*fields):
+    """The fused device-side reduction, model-generic: ``(finite,
+    min_0, max_0, ..., min_n, max_n)`` as 0-d device arrays — one
+    (min, max) pair per model field in declaration order. Traced
+    inside the snapshot-copy jit (``Simulation.snapshot_async``) so
+    XLA fuses it with the copy's HBM read — the fields are touched
+    once for both."""
+    import functools
+
     import jax.numpy as jnp
 
-    finite = jnp.isfinite(u).all() & jnp.isfinite(v).all()
-    return finite, u.min(), u.max(), v.min(), v.max()
+    finite = functools.reduce(
+        lambda a, b: a & b, (jnp.isfinite(f).all() for f in fields)
+    )
+    out = (finite,)
+    for f in fields:
+        out += (f.min(), f.max())
+    return out
 
 
 def resolve_policy(settings=None) -> str:

@@ -1,4 +1,4 @@
-"""Public simulation API: settings -> devices -> fields -> step loop.
+"""Public simulation API: settings -> model -> devices -> fields -> step loop.
 
 This is the TPU-native analog of the reference's ``Simulation`` module
 (``src/simulation/public.jl`` + ``communication.jl:15-46``):
@@ -10,9 +10,20 @@ This is the TPU-native analog of the reference's ``Simulation`` module
   ``lax.fori_loop`` so XLA fuses and overlaps them — there is no per-step
   host round-trip, unlike the reference which re-dispatches from strings
   every step (``public.jl:47``, SURVEY defect #9).
-* ``Simulation.get_fields()`` -> host copies of u, v
+* ``Simulation.get_fields()`` -> host copies of the model's fields
   (``Simulation_CPU.jl:125-133``; ghost stripping is a no-op here because
   fields are stored interior-shaped).
+
+Multi-model: the physics comes from a registered model declaration
+(``models/``: named fields, per-field boundary constants, typed params,
+pure reaction, init) selected by the ``[model]`` TOML table; Gray-Scott
+is the default and flagship. ``self.fields`` is the model's field tuple
+in declaration order (``self.u``/``self.v`` alias fields 0/1 for the
+two-field models). Everything below the model boundary — halo exchange,
+split-phase overlap, temporal blocking, autotune, snapshots — is
+model-generic; the one exception is the hand-fused Pallas kernel, which
+implements Gray-Scott only and is gated per model
+(``Model.pallas_capable``, recorded in ``kernel_selection``).
 
 Distribution: with >1 device of the selected platform, fields are sharded
 ``P('x','y','z')`` over a 3D ``jax.sharding.Mesh`` (the ``MPI.Cart_create``
@@ -50,7 +61,7 @@ _SHARD_MAP_CHECK_FLAG = (
 
 from .config import settings as config
 from .config.settings import Settings
-from .models import grayscott
+from .models import get_model
 from .ops import noise as noise_ops
 from .ops import stencil, validate_kernel_language
 from .parallel import halo, temporal
@@ -249,27 +260,33 @@ def mesh_for_topology(shape, devices, backend: str):
 
 
 class FieldSnapshot:
-    """A device-detached capture of (u, v) draining to the host.
+    """A device-detached capture of the model's fields draining to the
+    host.
 
     Produced by :meth:`Simulation.snapshot_async`: the fields are copied
     into fresh device buffers and every addressable shard has a
     non-blocking device-to-host transfer in flight by the time the
     constructor returns. :meth:`blocks` resolves (blocking only on the
     remaining transfer time) to the ``local_blocks()`` format —
-    ``[(offsets, sizes, u_block, v_block), ...]`` — so a background
-    writer thread can serialize/write while the driver thread dispatches
-    the next compute chunk (``io/async_writer.py``).
+    ``[(offsets, sizes, *field_blocks), ...]`` with one block per model
+    field in declaration order (for Gray-Scott: ``(offsets, sizes,
+    u_block, v_block)``) — so a background writer thread can
+    serialize/write while the driver thread dispatches the next compute
+    chunk (``io/async_writer.py``).
 
     Lifetime contract: the snapshot owns its device buffers outright —
     it stays valid across later ``iterate`` calls even though those
     donate (and thereby delete) the simulation's own field buffers.
     """
 
-    def __init__(self, parts, step: int, health=None):
+    def __init__(self, parts, step: int, health=None,
+                 field_names=("u", "v")):
         #: Simulation step the snapshot was taken at.
         self.step = step
-        self._parts = parts  # [(offsets, true_sizes, u_dev, v_dev), ...]
+        self._parts = parts  # [(offsets, true_sizes, *field_devs), ...]
         self._blocks = None
+        #: Model field names, for the health report's attribution.
+        self.field_names = tuple(field_names)
         #: Device scalars of the fused health probe
         #: (``resilience/health.device_probe``) when the snapshot was
         #: taken with ``health=True``; resolved by :meth:`health_report`.
@@ -278,26 +295,28 @@ class FieldSnapshot:
     def health_report(self):
         """Resolved :class:`~.resilience.health.HealthReport` for this
         snapshot, or None when no probe was requested. Blocks only on
-        the probe's five scalars — the block D2H stays in flight."""
+        the probe's few scalars — the block D2H stays in flight."""
         if self._health is None:
             return None
         from .resilience.health import HealthReport
 
-        finite, umin, umax, vmin, vmax = self._health
+        finite, *minmax = self._health
         return HealthReport(
-            bool(finite), float(umin), float(umax), float(vmin), float(vmax)
+            bool(finite), *(float(x) for x in minmax),
+            names=self.field_names,
         )
 
     def blocks(self):
-        """Host blocks ``[(offsets, sizes, u_block, v_block), ...]``,
+        """Host blocks ``[(offsets, sizes, *field_blocks), ...]``,
         clipped to the true domain; blocks until the in-flight D2H
         transfers land (idempotent — resolved once, then cached)."""
         if self._blocks is None:
             out = []
-            for offsets, true, ud, vd in self._parts:
+            for offsets, true, *devs in self._parts:
                 sl = tuple(slice(0, t) for t in true)
                 out.append(
-                    (offsets, true, np.asarray(ud)[sl], np.asarray(vd)[sl])
+                    (offsets, true)
+                    + tuple(np.asarray(d)[sl] for d in devs)
                 )
             self._blocks = out
             self._parts = None  # release the device buffers
@@ -305,7 +324,8 @@ class FieldSnapshot:
 
 
 class Simulation:
-    """A running Gray-Scott simulation bound to a set of devices."""
+    """A running simulation of one registered model bound to a set of
+    devices (Gray-Scott by default; ``[model]`` TOML table selects)."""
 
     #: Snapshot container class — the ensemble engine substitutes a
     #: member-aware one (``ensemble/engine.EnsembleFieldSnapshot``).
@@ -324,11 +344,22 @@ class Simulation:
         seed: int = 0,
     ):
         self.settings = settings
+        #: The registered model declaration this run integrates —
+        #: fields, boundaries, params, reaction (``models/``).
+        self.model = get_model(
+            getattr(settings, "model", "grayscott") or "grayscott"
+        )
         backend, self.kernel_language = config.load_backend_and_lang(settings)
         # Validate eagerly so an unavailable kernel language fails at
         # construction, not at first iterate (the reference defers all
         # dispatch errors to runtime fallbacks, public.jl:31-32, 77-78).
         validate_kernel_language(self.kernel_language)
+        if self.kernel_language == "pallas" and not self.model.pallas_capable:
+            raise ValueError(
+                f"kernel_language = 'Pallas' is implemented for the "
+                f"Gray-Scott reaction only; model {self.model.name!r} "
+                f"must run the XLA path (use 'Plain'/'XLA' or 'Auto')"
+            )
         self.dtype = config.resolve_precision(settings)
 
         # Persistent compilation cache (GS_COMPILE_CACHE / compile_cache
@@ -402,20 +433,39 @@ class Simulation:
             except Exception:
                 kind = ""
             mesh_forced = bool(_os.environ.get("GS_TPU_MESH_DIMS", ""))
-            self.kernel_language, self.kernel_selection = (
-                icimodel.select_kernel(
-                    self.domain.dims, settings.L, platform=backend,
-                    device_kind=kind,
-                    itemsize=np.dtype(self.dtype).itemsize,
-                    fuse=default_fuse(),
-                    sweep_mesh=self.sharded and not mesh_forced,
-                    # Auto's pick must reflect the comm this run will
-                    # actually expose: the calibrated overlap when the
-                    # split-phase exchange is armed, fully-exposed
-                    # otherwise.
-                    overlap="auto" if self.comm_overlap else 0.0,
+            if not self.model.pallas_capable:
+                # Pallas gate (docs/MODELS.md): the hand-fused kernel
+                # implements the Gray-Scott reaction only, so Auto
+                # resolves straight to XLA for every other model — an
+                # EXPLICIT decision recorded in the provenance, and the
+                # autotuner below searches XLA candidates only.
+                self.kernel_language = "xla"
+                self.kernel_selection = {
+                    "reason": (
+                        f"model '{self.model.name}' is not "
+                        "Pallas-capable (Gray-Scott-only kernel); "
+                        "XLA path"
+                    ),
+                    "pallas_gate": {
+                        "model": self.model.name,
+                        "pallas_capable": False,
+                    },
+                }
+            else:
+                self.kernel_language, self.kernel_selection = (
+                    icimodel.select_kernel(
+                        self.domain.dims, settings.L, platform=backend,
+                        device_kind=kind,
+                        itemsize=np.dtype(self.dtype).itemsize,
+                        fuse=default_fuse(),
+                        sweep_mesh=self.sharded and not mesh_forced,
+                        # Auto's pick must reflect the comm this run
+                        # will actually expose: the calibrated overlap
+                        # when the split-phase exchange is armed,
+                        # fully-exposed otherwise.
+                        overlap="auto" if self.comm_overlap else 0.0,
+                    )
                 )
-            )
             if self.sharded:
                 row = next(
                     (r for r in self.kernel_selection.get("rows", [])
@@ -466,6 +516,13 @@ class Simulation:
                     and config.resolve_comm_overlap(settings) == "auto"
                 ),
                 link_gbps=link_gbps, links=links,
+                # The model joins the tuning-cache key (a Brusselator
+                # run must never adopt a Gray-Scott-measured winner)
+                # and gates the candidate space to what this model's
+                # kernels can actually run.
+                model=self.model.name,
+                n_fields=self.model.n_fields,
+                pallas_allowed=self.model.pallas_capable,
                 **self._tune_extras(),
             )
             self.kernel_selection["autotune"] = decision.provenance
@@ -509,7 +566,44 @@ class Simulation:
         self._snapshot_fns: Dict[bool, object] = {}
 
         self._build_mesh(devices, backend)
-        self.u, self.v = self._init_fields()
+        #: The model's field arrays, declaration order (a tuple — the
+        #: state the runner advances; ``u``/``v`` alias fields 0/1).
+        self.fields = self._init_fields()
+
+    # ----------------------------------------------------- field aliases
+    # Two-field models (Gray-Scott, Brusselator, FHN) read naturally as
+    # (u, v); the canonical state is ``self.fields``.
+
+    @property
+    def u(self):
+        return self.fields[0]
+
+    @u.setter
+    def u(self, value):
+        self.fields = (value,) + tuple(self.fields[1:])
+
+    @property
+    def v(self):
+        return self.fields[1]
+
+    @v.setter
+    def v(self, value):
+        self.fields = (self.fields[0], value) + tuple(self.fields[2:])
+
+    def _field_index(self, field) -> int:
+        """Resolve a field reference — model field name, the legacy
+        ``"u"``/``"v"`` aliases, or an integer index."""
+        if isinstance(field, int):
+            return field
+        if field in self.model.field_names:
+            return self.model.field_names.index(field)
+        alias = {"u": 0, "v": 1}.get(field)
+        if alias is not None and alias < self.model.n_fields:
+            return alias
+        raise ValueError(
+            f"unknown field {field!r} for model {self.model.name!r} "
+            f"(fields: {', '.join(self.model.field_names)})"
+        )
 
     # ------------------------------------------------- construction hooks
     # Overridden by ensemble/engine.EnsembleSimulation, which threads a
@@ -521,7 +615,9 @@ class Simulation:
         return CartDomain.create(len(devices), self.settings.L)
 
     def _make_params(self):
-        return grayscott.Params.from_settings(self.settings, self.dtype)
+        """Typed params pytree, routed through the model declaration
+        (``[model]`` table > legacy flat keys > declared defaults)."""
+        return self.model.make_params(self.settings, self.dtype)
 
     def _resolve_use_noise(self) -> bool:
         return self.settings.noise != 0.0
@@ -566,58 +662,66 @@ class Simulation:
 
     # ------------------------------------------------------------------ init
 
-    def _init_fields(self) -> Tuple[jax.Array, jax.Array]:
+    def _init_fields(self) -> Tuple[jax.Array, ...]:
         """Sharded field construction: each device shard is built locally
         for its block (multi-host ready), mirroring the reference's
-        per-rank ``init_fields`` (``Simulation_CPU.jl:14-72``)."""
+        per-rank ``init_fields`` (``Simulation_CPU.jl:14-72``). The
+        initial condition is the model's declared ``init``."""
         L, dtype = self.settings.L, self.dtype
         if not self.sharded:
-            u, v = grayscott.init_fields(L, dtype)
-            return (
-                jax.device_put(u, self.device),
-                jax.device_put(v, self.device),
+            return tuple(
+                jax.device_put(f, self.device)
+                for f in self.model.init(L, dtype)
             )
 
         dom = self.domain
         # Non-divisible L stores a padded grid (equal blocks, pad cells
         # at global coords >= L held at the boundary value — exactly
-        # what init_fields produces for out-of-seed cells).
+        # what the model's init produces for out-of-seed cells).
         gshape = dom.storage_shape
 
-        def make(field: str):
+        def make(field_idx: int):
             def cb(index):
                 offsets = tuple(s.start or 0 for s in index)
                 sizes = tuple(
                     (s.stop or g) - (s.start or 0)
                     for s, g in zip(index, gshape)
                 )
-                u, v = grayscott.init_fields(
+                return self.model.init(
                     L, dtype, offsets=offsets, sizes=sizes
-                )
-                return u if field == "u" else v
+                )[field_idx]
 
             return jax.make_array_from_callback(
                 gshape, self.field_sharding, cb
             )
 
-        return make("u"), make("v")
+        return tuple(make(i) for i in range(self.model.n_fields))
 
     # ---------------------------------------------------------------- runner
 
-    def _local_run(self, u, v, base_key, step0, params, *, nsteps: int):
+    def _local_run(self, *args, nsteps: int):
         """``nsteps`` fused steps on one (local) block. Called directly on a
         single device, or per-shard under ``shard_map``.
+
+        ``args`` is the model's field tuple (declaration order) followed
+        by ``(base_key, step0, params)`` — the variadic field prefix is
+        what makes the runner model-generic (one field for heat, two for
+        Gray-Scott/Brusselator/FHN, n for anything registered).
 
         Noise everywhere comes from the position-keyed stream
         (``ops/noise.py``): one shared key, absolute step index, global
         cell coordinates — so the trajectory is invariant under step
         chunking, shard layout, and temporal fusion.
         """
+        *fields, base_key, step0, params = args
+        fields = tuple(fields)
+        model = self.model
         use_noise = self.use_noise
         sharded = self.sharded
         dims = self.domain.dims
         L = self.settings.L
-        boundaries = (stencil.U_BOUNDARY, stencil.V_BOUNDARY)
+        boundaries = model.boundaries
+        dtype = fields[0].dtype
         key_i32 = lax.bitcast_convert_type(base_key, jnp.int32)
 
         if sharded:
@@ -634,41 +738,48 @@ class Simulation:
         padded = sharded and self.domain.padded
         overlap_on = self.comm_overlap
 
-        def pin_block(u, v):
-            """Re-pin the block's pad cells (global coords >= L) to the
-            boundary value — required after every chain round with
-            non-divisible L: the chain's final stage writes them
+        def pin_block(fields):
+            """Re-pin each block's pad cells (global coords >= L) to the
+            field's boundary value — required after every chain round
+            with non-divisible L: the chain's final stage writes them
             unpinned, and the next round's stencil reads them as the
             frozen ghost shell."""
+            fields = tuple(fields)
             if not padded:
-                return u, v
-            u = temporal.pin_out_of_domain(u, boundaries[0], offs, L)
-            v = temporal.pin_out_of_domain(v, boundaries[1], offs, L)
-            return u, v
+                return fields
+            return tuple(
+                temporal.pin_out_of_domain(f, bv, offs, L)
+                for f, bv in zip(fields, boundaries)
+            )
 
         def unit_noise(step_idx, offsets, shape):
             return noise_ops.uniform_pm1_block(
-                key_i32, step_idx, offsets, shape, L, u.dtype
+                key_i32, step_idx, offsets, shape, L, dtype
             )
 
-        def run_chain_rounds(chain, fuse, u, v):
+        def run_chain_rounds(chain, fuse, fields):
             """Drive ``nsteps`` as full-depth chain rounds plus a
             shallower remainder chain — the shared loop of all three
             temporal-blocking paths (1D x-chain, 3D Pallas chain,
-            sharded XLA chain)."""
+            sharded XLA chain). ``chain(fields, step, depth)`` maps the
+            field tuple through one exchange-plus-depth-steps round."""
 
             def chain_body(i, carry):
-                uu, vv = carry
-                return chain(uu, vv, step0 + fuse * i, fuse)
+                return chain(carry, step0 + fuse * i, fuse)
 
             rounds, rem = divmod(nsteps, fuse)
-            u, v = lax.fori_loop(0, rounds, chain_body, (u, v))
+            fields = lax.fori_loop(0, rounds, chain_body, fields)
             if rem:
-                u, v = chain(u, v, step0 + fuse * rounds, rem)
-            return u, v
+                fields = chain(fields, step0 + fuse * rounds, rem)
+            return fields
 
         if self.kernel_language == "pallas":
+            # The hand-fused kernel is the Gray-Scott model's own
+            # (models/grayscott.py declares pallas_capable); the gate in
+            # __init__ guarantees a two-field (u, v) state here.
             from .ops import pallas_stencil
+
+            u, v = fields
 
             def step_seeds(step_idx):
                 return jnp.stack(
@@ -729,12 +840,13 @@ class Simulation:
                     )
                     fuse = capped
 
-                def chain(u, v, step, depth):
+                def chain(fields_c, step, depth):
+                    u, v = fields_c
                     if depth == 1:
                         faces12 = halo.exchange_faces(
                             (u, v), boundaries, AXIS_NAMES, dims
                         )
-                        return pin_block(*kernel_step(u, v, step, faces12))
+                        return pin_block(kernel_step(u, v, step, faces12))
                     pairs = halo.exchange_x_slabs(
                         (u, v), boundaries, AXIS_NAMES[0], dims[0], depth
                     )
@@ -793,17 +905,17 @@ class Simulation:
                             v_i = lax.dynamic_update_slice(
                                 v_i, bv_, (d_x, 0, 0)
                             )
-                        return pin_block(u_i, v_i)
+                        return pin_block((u_i, v_i))
                     faces4 = (pairs[0][0], pairs[0][1],
                               pairs[1][0], pairs[1][1])
-                    return pin_block(*pallas_stencil.fused_step(
+                    return pin_block(pallas_stencil.fused_step(
                         u, v, params, step_seeds(step), faces4,
                         use_noise=use_noise,
                         allow_interpret=allow_interpret,
                         fuse=depth, offsets=offs, row=L,
                     ))
 
-                return run_chain_rounds(chain, fuse, u, v)
+                return run_chain_rounds(chain, fuse, (u, v))
 
             if sharded:
                 # xy-chain (+ z-band correction when z is sharded): the
@@ -837,12 +949,13 @@ class Simulation:
                     )
                     fuse = max(feasible, 1)
 
-                def chain(u, v, step, depth):
+                def chain(fields_c, step, depth):
+                    u, v = fields_c
                     if depth == 1:
                         faces12 = halo.exchange_faces(
                             (u, v), boundaries, AXIS_NAMES, dims
                         )
-                        return pin_block(*kernel_step(u, v, step, faces12))
+                        return pin_block(kernel_step(u, v, step, faces12))
 
                     def chain_kernel(u_p, v_p, faces4, stp, offs_p):
                         return pallas_stencil.fused_step(
@@ -867,16 +980,16 @@ class Simulation:
                     )
                     if ov:
                         self.overlap_applied = True
-                    return pin_block(*temporal.xy_chain(
-                        u, v, params, depth=depth, step=step, offs=offs,
-                        chain_kernel=chain_kernel, use_noise=use_noise,
-                        unit_noise=unit_noise, row=L,
-                        axis_names=AXIS_NAMES, axis_sizes=dims,
+                    return pin_block(temporal.xy_chain(
+                        u, v, params, model, depth=depth, step=step,
+                        offs=offs, chain_kernel=chain_kernel,
+                        use_noise=use_noise, unit_noise=unit_noise,
+                        row=L, axis_names=AXIS_NAMES, axis_sizes=dims,
                         boundaries=boundaries, sublane=sublane,
                         overlap=ov, band_kernel=band_kernel,
                     ))
 
-                return run_chain_rounds(chain, fuse, u, v)
+                return run_chain_rounds(chain, fuse, (u, v))
 
             # Single block: in-kernel temporal blocking (``fuse`` steps
             # per HBM pass — the slab pipeline is DMA-envelope-bound on
@@ -901,25 +1014,28 @@ class Simulation:
                     use_noise=use_noise, allow_interpret=allow_interpret,
                     fuse=rem, offsets=offs, row=L,
                 )
-            return u, v
+            return (u, v)
 
         # ---- XLA kernel path ----
 
         def single_step(i, carry):
-            u, v = carry
             if sharded:
-                u_pad, v_pad = halo.halo_pad(
-                    (u, v), boundaries, AXIS_NAMES, dims
+                fields_pad = halo.halo_pad(
+                    carry, boundaries, AXIS_NAMES, dims
                 )
             else:
-                u_pad = stencil.pad_with_boundary(u, stencil.U_BOUNDARY)
-                v_pad = stencil.pad_with_boundary(v, stencil.V_BOUNDARY)
+                fields_pad = tuple(
+                    stencil.pad_with_boundary(f, bv)
+                    for f, bv in zip(carry, boundaries)
+                )
             if use_noise:
-                nz = params.noise * unit_noise(step0 + i, offs, u.shape)
+                nz = params.noise * unit_noise(
+                    step0 + i, offs, carry[0].shape
+                )
             else:
-                nz = jnp.asarray(0.0, u.dtype)
+                nz = jnp.asarray(0.0, dtype)
             return pin_block(
-                *stencil.reaction_update(u_pad, v_pad, nz, params)
+                stencil.reaction_update(fields_pad, nz, params, model)
             )
 
         # Split-phase gate for the XLA window mode: only band windows
@@ -936,7 +1052,7 @@ class Simulation:
         overlap_xla = overlap_on and dims[1] == 1 and dims[2] == 1
 
         if not sharded or (nsteps < 2 and not overlap_xla):
-            return lax.fori_loop(0, nsteps, single_step, (u, v))
+            return lax.fori_loop(0, nsteps, single_step, fields)
 
         # Sharded temporal blocking: ONE width-k halo exchange feeds k
         # steps — stage s recomputes step n+1+s on a window extending
@@ -951,7 +1067,7 @@ class Simulation:
         # what makes the split-phase stitch bitwise.
         fuse = min(self._fuse_base(), nsteps, min(self.domain.local_shape))
 
-        def chain(u, v, step, depth):
+        def chain(fields_c, step, depth):
             """``depth`` steps from one ``depth``-wide exchange."""
             if overlap_xla:
                 # Split-phase round (docs/OVERLAP.md): issue the same
@@ -962,24 +1078,24 @@ class Simulation:
                 # — bitwise the same values.
                 self.overlap_applied = True
                 pending = halo.start_exchange(
-                    (u, v), boundaries, AXIS_NAMES, dims, depth
+                    fields_c, boundaries, AXIS_NAMES, dims, depth
                 )
-                u_c, v_c = halo.frozen_frame((u, v), boundaries, depth)
-                u_i, v_i = temporal.window_chain(
-                    u_c, v_c, params, depth=depth, step=step,
+                frozen = halo.frozen_frame(fields_c, boundaries, depth)
+                fields_i = temporal.window_chain(
+                    frozen, params, model, depth=depth, step=step,
                     origin=offs - depth, row=L, use_noise=use_noise,
                     unit_noise=unit_noise, boundaries=boundaries,
                     final_pin=padded,
                 )
-                u_w, v_w = pending.finish()
+                fields_w = pending.finish()
                 return temporal.stitch_bands_from_frame(
-                    u_i, v_i, u_w, v_w, params, depth=depth, step=step,
-                    offs=offs, row=L, axis_sizes=dims,
+                    fields_i, fields_w, params, model, depth=depth,
+                    step=step, offs=offs, row=L, axis_sizes=dims,
                     use_noise=use_noise, unit_noise=unit_noise,
                     boundaries=boundaries,
                 )
-            u_w, v_w = halo.halo_pad_wide(
-                (u, v), boundaries, AXIS_NAMES, dims, depth
+            fields_w = halo.halo_pad_wide(
+                fields_c, boundaries, AXIS_NAMES, dims, depth
             )
             # Global-coordinate pinning per stage: ring cells outside
             # the domain AND, for non-divisible L, pad cells inside the
@@ -987,13 +1103,13 @@ class Simulation:
             # final stage (m_out == 0) has no ring, so divisible-L runs
             # skip its provably-all-true mask (final_pin).
             return temporal.window_chain(
-                u_w, v_w, params, depth=depth, step=step,
+                fields_w, params, model, depth=depth, step=step,
                 origin=offs - depth, row=L, use_noise=use_noise,
                 unit_noise=unit_noise, boundaries=boundaries,
                 final_pin=padded,
             )
 
-        return run_chain_rounds(chain, fuse, u, v)
+        return run_chain_rounds(chain, fuse, fields)
 
     def _runner(self, nsteps: int):
         """Compiled ``nsteps``-step advance, cached per nsteps."""
@@ -1002,14 +1118,15 @@ class Simulation:
             return fn
 
         local = partial(self._local_run, nsteps=nsteps)
+        nf = self.model.n_fields
         if self.sharded:
             spec = P(*AXIS_NAMES)
             rep = P()
             fn = shard_map(
                 local,
                 mesh=self.mesh,
-                in_specs=(spec, spec, rep, rep, rep),
-                out_specs=(spec, spec),
+                in_specs=(spec,) * nf + (rep, rep, rep),
+                out_specs=(spec,) * nf,
                 # pallas_call outputs carry no varying-mesh-axes metadata;
                 # skip the vma/replication check (shardings are fully
                 # explicit here; flag spelling is version-dependent).
@@ -1017,7 +1134,7 @@ class Simulation:
             )
         else:
             fn = local
-        fn = jax.jit(fn, donate_argnums=(0, 1))
+        fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
         self._runners[nsteps] = fn
         return fn
 
@@ -1038,7 +1155,7 @@ class Simulation:
         if not hasattr(runner, "lower"):
             return  # already AOT-compiled
         compiled = runner.lower(
-            self.u, self.v, self.base_key, jnp.int32(self.step), self.params
+            *self.fields, self.base_key, jnp.int32(self.step), self.params
         ).compile()
         self._runners[nsteps] = compiled
 
@@ -1049,38 +1166,45 @@ class Simulation:
         if nsteps <= 0:
             return
         runner = self._runner(nsteps)
-        self.u, self.v = runner(
-            self.u, self.v, self.base_key, jnp.int32(self.step), self.params
-        )
+        self.fields = tuple(runner(
+            *self.fields, self.base_key, jnp.int32(self.step), self.params
+        ))
         self.step += nsteps
 
-    def _shard_parts(self, u, v):
-        """Per-addressable-shard ``(offsets, true_sizes, u_dev, v_dev)``
+    def _shard_parts(self, *arrays):
+        """Per-addressable-shard ``(offsets, true_sizes, *field_devs)``
         — the device-side half of the output path: each entry carries
         the shard's global (start, count) box clipped to the true
         domain (non-divisible L stores pad cells past L on the high
         edge of the last block per axis; framework internals that never
-        leave the process) plus the single-device shard arrays."""
+        leave the process) plus one single-device shard array per model
+        field."""
         L = self.settings.L
+        first = arrays[0]
 
         def box(index):
             # Slices are unhashable before py3.12, so shards are matched
-            # across u/v by their (start, count) box, not the raw index.
+            # across fields by their (start, count) box, not the raw
+            # index.
             idx = index if isinstance(index, tuple) else (index,)
             offsets = tuple(sl.start or 0 for sl in idx)
             sizes = tuple(
                 (sl.stop or g) - (sl.start or 0)
-                for sl, g in zip(idx, u.shape)
+                for sl, g in zip(idx, first.shape)
             )
             return offsets, sizes
 
-        v_shards = {box(s.index): s for s in v.addressable_shards}
+        other_shards = [
+            {box(s.index): s for s in a.addressable_shards}
+            for a in arrays[1:]
+        ]
         parts = []
-        for sh in u.addressable_shards:
+        for sh in first.addressable_shards:
             offsets, sizes = box(sh.index)
             true = tuple(min(L - o, s) for o, s in zip(offsets, sizes))
             parts.append(
-                (offsets, true, sh.data, v_shards[(offsets, sizes)].data)
+                (offsets, true, sh.data)
+                + tuple(m[(offsets, sizes)].data for m in other_shards)
             )
         return parts
 
@@ -1111,39 +1235,50 @@ class Simulation:
             if health:
                 device_probe = self._probe_fn()
 
-                def copy(u, v):
-                    return (u + jnp.zeros((), u.dtype),
-                            v + jnp.zeros((), v.dtype),
-                            device_probe(u, v))
+                def copy(*fields):
+                    return (
+                        tuple(f + jnp.zeros((), f.dtype) for f in fields),
+                        device_probe(*fields),
+                    )
             else:
-                def copy(u, v):
-                    return (u + jnp.zeros((), u.dtype),
-                            v + jnp.zeros((), v.dtype))
+                def copy(*fields):
+                    return tuple(
+                        f + jnp.zeros((), f.dtype) for f in fields
+                    )
             fn = self._snapshot_fns[health] = jax.jit(copy)
         if health:
-            uc, vc, probe = fn(self.u, self.v)
+            copies, probe = fn(*self.fields)
         else:
-            uc, vc = fn(self.u, self.v)
+            copies = fn(*self.fields)
             probe = None
-        parts = self._shard_parts(uc, vc)
-        for _, _, ud, vd in parts:
-            ud.copy_to_host_async()
-            vd.copy_to_host_async()
-        return self.snapshot_cls(parts, self.step, health=probe)
+        parts = self._shard_parts(*copies)
+        for part in parts:
+            for dev in part[2:]:
+                dev.copy_to_host_async()
+        return self.snapshot_cls(
+            parts, self.step, health=probe,
+            field_names=self.model.field_names,
+        )
 
-    def poison_nan(self, field: str = "u") -> None:
+    def poison_nan(self, field="u") -> None:
         """Chaos/testing hook (``resilience/faults.py`` kind ``nan``):
-        set one cell of ``field`` to NaN, modelling a numerical blow-up
-        the health guard must catch at the next boundary. A scatter on
-        the live buffers; sharding is preserved."""
-        arr = getattr(self, field)
-        setattr(
-            self, field,
-            arr.at[(0,) * arr.ndim].set(jnp.asarray(float("nan"), arr.dtype)),
+        set one cell of ``field`` (a model field name, the legacy
+        ``"u"``/``"v"`` aliases, or an index) to NaN, modelling a
+        numerical blow-up the health guard must catch at the next
+        boundary. A scatter on the live buffers; sharding is
+        preserved."""
+        i = self._field_index(field)
+        arr = self.fields[i]
+        poisoned = arr.at[(0,) * arr.ndim].set(
+            jnp.asarray(float("nan"), arr.dtype)
+        )
+        self.fields = (
+            self.fields[:i] + (poisoned,) + self.fields[i + 1:]
         )
 
     def local_blocks(self):
-        """Per-addressable-shard ``(offsets, sizes, u_block, v_block)``.
+        """Per-addressable-shard ``(offsets, sizes, *field_blocks)``
+        (for Gray-Scott: ``(offsets, sizes, u_block, v_block)``).
 
         The multi-host output path: each process writes only the blocks it
         owns, with their global (start, count) boxes — the ADIOS2
@@ -1155,19 +1290,23 @@ class Simulation:
         callers must consume the result before the next ``iterate``.
         For output overlapped with compute use :meth:`snapshot_async`.
         """
-        jax.block_until_ready((self.u, self.v))
+        jax.block_until_ready(self.fields)
         return self.snapshot_cls(
-            self._shard_parts(self.u, self.v), self.step
+            self._shard_parts(*self.fields), self.step,
+            field_names=self.model.field_names,
         ).blocks()
 
     def restore_from_reader(self, reader, step_index: int, step: int) -> None:
         """Restore state with per-shard selection reads — each process
         pulls only its own blocks from the checkpoint store (scalable
-        multi-host restart; no full-array gather)."""
+        multi-host restart; no full-array gather). Store variables are
+        the model's declared field names."""
+        names = self.model.field_names
         if not self.sharded:
-            self.restore(
-                reader.get("u", step=step_index),
-                reader.get("v", step=step_index),
+            self.restore_fields(
+                tuple(
+                    reader.get(name, step=step_index) for name in names
+                ),
                 step,
             )
             return
@@ -1199,21 +1338,30 @@ class Simulation:
                 storage, self.field_sharding, cb
             )
 
-        self.u = make("u", stencil.U_BOUNDARY)
-        self.v = make("v", stencil.V_BOUNDARY)
+        self.fields = tuple(
+            make(name, bv)
+            for name, bv in zip(names, self.model.boundaries)
+        )
         self.step = int(step)
 
-    def restore(self, u: np.ndarray, v: np.ndarray, step: int) -> None:
-        """Restore state from a checkpoint (fixes the reference's hardcoded
-        ``restart_step = 0``, ``src/GrayScott.jl:77-78``)."""
-        u = jnp.asarray(u, self.dtype)
-        v = jnp.asarray(v, self.dtype)
-        expected = (self.settings.L,) * 3
-        if u.shape != expected or v.shape != expected:
+    def restore_fields(self, fields, step: int) -> None:
+        """Restore state from full host field arrays (fixes the
+        reference's hardcoded ``restart_step = 0``,
+        ``src/GrayScott.jl:77-78``). ``fields`` follows the model's
+        declaration order."""
+        fields = tuple(jnp.asarray(f, self.dtype) for f in fields)
+        if len(fields) != self.model.n_fields:
             raise ValueError(
-                f"Checkpoint shapes u={u.shape}, v={v.shape} do not match "
-                f"L={self.settings.L}"
+                f"Checkpoint has {len(fields)} fields; model "
+                f"{self.model.name!r} declares {self.model.n_fields}"
             )
+        expected = (self.settings.L,) * 3
+        for name, f in zip(self.model.field_names, fields):
+            if f.shape != expected:
+                raise ValueError(
+                    f"Checkpoint shape {name}={f.shape} does not match "
+                    f"L={self.settings.L}"
+                )
         if self.sharded and self.domain.padded:
             # Rebuild the pad shell at the boundary value (the stored
             # arrays cover only the true domain).
@@ -1221,27 +1369,33 @@ class Simulation:
                 (0, g - self.settings.L)
                 for g in self.domain.storage_shape
             ]
-            u = jnp.pad(u, pads, constant_values=stencil.U_BOUNDARY)
-            v = jnp.pad(v, pads, constant_values=stencil.V_BOUNDARY)
+            fields = tuple(
+                jnp.pad(f, pads, constant_values=bv)
+                for f, bv in zip(fields, self.model.boundaries)
+            )
         target = self.field_sharding if self.sharded else self.device
-        self.u = jax.device_put(u, target)
-        self.v = jax.device_put(v, target)
+        self.fields = tuple(jax.device_put(f, target) for f in fields)
         self.step = int(step)
 
-    def get_fields(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Host copies of (u, v), clipped to the true ``L^3`` domain —
-        the ghost-strip + D->H analog (``Simulation_CPU.jl:125-133``,
-        ``CUDAExt.jl:199-209``; the strip also removes the storage pad
-        of a non-divisible sharded L)."""
-        jax.block_until_ready((self.u, self.v))
+    def restore(self, u: np.ndarray, v: np.ndarray, step: int) -> None:
+        """Two-field compatibility form of :meth:`restore_fields` (the
+        historical Gray-Scott signature)."""
+        self.restore_fields((u, v), step)
+
+    def get_fields(self) -> Tuple[np.ndarray, ...]:
+        """Host copies of the model's fields (declaration order),
+        clipped to the true ``L^3`` domain — the ghost-strip + D->H
+        analog (``Simulation_CPU.jl:125-133``, ``CUDAExt.jl:199-209``;
+        the strip also removes the storage pad of a non-divisible
+        sharded L)."""
+        jax.block_until_ready(self.fields)
         L = self.settings.L
-        return (
-            np.asarray(self.u)[:L, :L, :L],
-            np.asarray(self.v)[:L, :L, :L],
+        return tuple(
+            np.asarray(f)[:L, :L, :L] for f in self.fields
         )
 
     def block_until_ready(self) -> None:
-        jax.block_until_ready((self.u, self.v))
+        jax.block_until_ready(self.fields)
 
 
 def initialization(

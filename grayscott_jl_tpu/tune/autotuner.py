@@ -125,6 +125,9 @@ def autotune(
     ensemble: int = 1,
     member_shards: int = 1,
     sim_cls=None,
+    model: str = "grayscott",
+    n_fields: int = 2,
+    pallas_allowed: bool = True,
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -145,13 +148,15 @@ def autotune(
     import jax
 
     mode = resolve_mode(settings)
+    gate = {"model": model, "n_fields": n_fields,
+            "pallas_allowed": bool(pallas_allowed)}
     if mode == "off":
-        return _analytic_decision(mode, analytic_kernel)
+        return _analytic_decision(mode, analytic_kernel, gate)
 
     key = cache.cache_key(
         device_kind=device_kind, platform=platform, dims=dims, L=L,
         dtype=dtype, noise=noise, jax_version=jax.__version__,
-        ensemble=ensemble,
+        ensemble=ensemble, model=model, n_fields=n_fields,
     )
     rec = cache.load(key)
     if rec is not None:
@@ -163,6 +168,7 @@ def autotune(
                 "winner": winner,
                 "cache_created": rec.get("created"),
                 "cache_path": cache.entry_path(key),
+                **gate,
             }
             return _winner_decision(mode, winner, prov)
         except (KeyError, TypeError, ValueError) as e:
@@ -178,7 +184,7 @@ def autotune(
         # The zero-measurement contract: a miss changes NOTHING about
         # the run — the analytic pick goes through untouched.
         return _analytic_decision(mode, analytic_kernel,
-                                  {"cache": "miss"})
+                                  {"cache": "miss", **gate})
 
     # quick | full: measure the shortlist within the budget.
     budget_s = resolve_budget_s()
@@ -191,6 +197,7 @@ def autotune(
         top_n=_top_n(mode),
         bx_variants=2 if mode == "full" else 0,
         ensemble=ensemble, member_shards=member_shards,
+        pallas_allowed=pallas_allowed,
     )
     steps = int(os.environ.get("GS_AUTOTUNE_STEPS", "20"))
     rounds = int(os.environ.get("GS_AUTOTUNE_ROUNDS",
@@ -204,7 +211,7 @@ def autotune(
     win = measure.best(ms)
     model = next((m for m in ms if m.candidate.analytic), None)
     prov = {
-        "mode": mode, "cache": "miss",
+        "mode": mode, "cache": "miss", **gate,
         "candidates_timed": sum(1 for m in ms if m.ok()),
         "candidates_skipped": skipped,
         "candidates_errored": sum(1 for m in ms if not m.ok()),

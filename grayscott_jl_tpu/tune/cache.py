@@ -30,8 +30,12 @@ from typing import Optional
 #: old version's subdirectory). v2: the key grew the ``ensemble``
 #: member count — a batched N-member run and a solo run at the same
 #: (mesh, L, dtype) are different schedules and must never share a
-#: winner.
-SCHEMA_VERSION = 2
+#: winner. v3: the key grew the ``model`` name and ``n_fields`` — a
+#: Brusselator run must never adopt a Gray-Scott-measured winner (a
+#: different reaction is a different program, and a different field
+#: count moves different halo bytes); stale v2 entries degrade to the
+#: analytic pick exactly like any other miss.
+SCHEMA_VERSION = 3
 
 
 def cache_dir() -> str:
@@ -54,13 +58,17 @@ def cache_key(
     noise: float,
     jax_version: str,
     ensemble: int = 1,
+    model: str = "grayscott",
+    n_fields: int = 2,
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
     matching). ``ensemble`` is the member count of a batched run
     (``ensemble/engine.py``) — 1 for solo runs; the vmapped batch
     changes the measured schedule, so ensemble sizes never share
-    winners."""
+    winners. ``model``/``n_fields`` (schema v3) identify the registered
+    model: measurements of one reaction/field-count never apply to
+    another."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -71,6 +79,8 @@ def cache_key(
         "noise": float(noise),
         "jax_version": str(jax_version),
         "ensemble": int(ensemble),
+        "model": str(model),
+        "n_fields": int(n_fields),
     }
 
 

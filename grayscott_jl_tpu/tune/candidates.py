@@ -125,6 +125,7 @@ def generate(
     bx_variants: int = 0,
     ensemble: int = 1,
     member_shards: int = 1,
+    pallas_allowed: bool = True,
 ) -> List[Candidate]:
     """The ranked measurement shortlist for one run config.
 
@@ -153,7 +154,11 @@ def generate(
         overlaps.append(not comm_overlap)
 
     langs = {"xla": _xla_depths(local, dims, fuse_cap)}
-    if platform == "tpu":
+    if platform == "tpu" and pallas_allowed:
+        # pallas_allowed is the model gate: the hand-fused kernel
+        # implements Gray-Scott only (Model.pallas_capable), so the
+        # tuner must never time — or cache a winner for — a Pallas
+        # schedule another model cannot run.
         depths = _pallas_depths(local, itemsize, dims, fuse_cap)
         if depths:
             langs["pallas"] = depths

@@ -157,6 +157,7 @@ def bench_one(
     rounds: int = 3,
     sustain_seconds: float = 0.0,
     round_sleep: float = 0.0,
+    model: str = "grayscott",
 ) -> Dict[str, object]:
     """Throughput of ``steps``-step chunks at grid side ``L`` on the
     default JAX backend (single device): best / median over ``rounds``
@@ -171,9 +172,11 @@ def bench_one(
     platform = jax.devices()[0].platform
     backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
     settings = Settings(
-        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0, noise=noise,
+        L=L, Du=0.2, Dv=0.1, F=0.02, k=0.048,
+        dt=1.0 if model == "grayscott" else 0.05, noise=noise,
         precision=precision, backend=backend, kernel_language=lang,
     )
+    settings.model = model
     sim = Simulation(settings, n_devices=1)
     t = time_sim_rounds(sim, steps, rounds, sustain_seconds=sustain_seconds,
                         round_sleep=round_sleep)
@@ -183,6 +186,7 @@ def bench_one(
         "L": L,
         "precision": precision,
         "kernel": lang,
+        "model": sim.model.name,
         "noise": noise,
         "platform": platform,
         "us_per_step": round(t["best"] * 1e6, 1),

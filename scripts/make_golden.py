@@ -1,0 +1,101 @@
+"""Capture golden Gray-Scott trajectories for the refactor-identity test.
+
+Run from the repo root BEFORE a stencil-core refactor::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python scripts/make_golden.py
+
+Writes ``tests/golden/grayscott_trajectories.npz`` — exact (u, v) field
+bytes after a short run for each covered configuration — and a golden
+output store ``tests/golden/gs_golden.bp`` written through the full CLI
+driver. ``tests/unit/test_models.py::TestGoldenTrajectory`` replays the
+same configurations and asserts byte-identical results, so any refactor
+of the Gray-Scott update path that changes a single bit fails loudly.
+"""
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+
+from grayscott_jl_tpu.config.settings import Settings  # noqa: E402
+from grayscott_jl_tpu.simulation import Simulation  # noqa: E402
+
+OUT = ROOT / "tests" / "golden"
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+#: (tag, n_devices, kernel_language, extra-env GS_FUSE) — the refactor-
+#: sensitive paths: single-device XLA, sharded XLA window chain, and the
+#: sharded Pallas xy-chain (XLA fallback body on CPU).
+CASES = [
+    ("single_xla", 1, "Plain", None),
+    ("sharded_xla", 8, "Plain", "2"),
+    ("sharded_pallas", 8, "Pallas", "2"),
+]
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    arrays = {}
+    for tag, n_devices, lang, fuse in CASES:
+        if fuse is not None:
+            os.environ["GS_FUSE"] = fuse
+        else:
+            os.environ.pop("GS_FUSE", None)
+        sim = Simulation(
+            Settings(
+                L=16, noise=0.1, precision="Float32", backend="CPU",
+                kernel_language=lang, **PARAMS,
+            ),
+            n_devices=n_devices, seed=7,
+        )
+        sim.iterate(10)
+        u, v = sim.get_fields()
+        arrays[f"{tag}_u"] = np.asarray(u)
+        arrays[f"{tag}_v"] = np.asarray(v)
+        print(f"{tag}: u[0,0,0]={arrays[f'{tag}_u'][0, 0, 0]!r}")
+    os.environ.pop("GS_FUSE", None)
+    np.savez(OUT / "grayscott_trajectories.npz", **arrays)
+
+    # Golden CLI store: the full driver path (output stream + checkpoint)
+    # at L=16 for 6 steps, plotgap 2 — U/V payload bytes per output step
+    # are what the identity test compares.
+    import shutil
+    import tempfile
+
+    from grayscott_jl_tpu import driver
+
+    store = OUT / "gs_golden.bp"
+    if store.exists():
+        shutil.rmtree(store)
+    with tempfile.TemporaryDirectory() as td:
+        cfg = pathlib.Path(td) / "golden.toml"
+        cfg.write_text(
+            "L = 16\nsteps = 6\nplotgap = 2\nnoise = 0.1\n"
+            "Du = 0.2\nDv = 0.1\nF = 0.02\nk = 0.048\ndt = 1.0\n"
+            f"output = \"{store}\"\n"
+            "precision = \"Float32\"\nbackend = \"CPU\"\n"
+            "kernel_language = \"Plain\"\n"
+        )
+        os.environ["GS_ASYNC_IO_DEPTH"] = "0"
+        os.environ["GS_SEED"] = "7"
+        try:
+            driver.main([str(cfg)], n_devices=1)
+        finally:
+            os.environ.pop("GS_ASYNC_IO_DEPTH", None)
+            os.environ.pop("GS_SEED", None)
+    print(f"golden store at {store}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,558 @@
+"""The multi-model stencil framework (grayscott_jl_tpu/models/).
+
+Contracts asserted here, in rough order of load-bearing-ness:
+
+* **Golden identity** — the Gray-Scott trajectory is byte-identical to
+  the pre-framework implementation (``tests/golden/``, captured by
+  ``scripts/make_golden.py`` BEFORE the refactor), both at the
+  Simulation API and through the full CLI driver's output store.
+* **Sharded equality matrix over the registry** — every registered
+  model runs single-device vs (2,2,2)-sharded with bitwise identity at
+  chain depth 1 (pure layout invariance) and within the documented
+  XLA:CPU FMA-contraction tolerance for deeper chains (the existing
+  ``test_sharded`` contract, parametrized over the registry) — with
+  zero per-model code in ``ops/`` or ``parallel/``.
+* **Models-as-data hygiene** — ``ops/`` and ``parallel/`` contain no
+  model-specific literals (seeds, boundary constants); grep-asserted.
+* **Loud configuration** — misspelled or missing ``[model]`` params
+  raise :class:`SettingsError` naming the model, never a silent
+  default; the Pallas gate is explicit in provenance.
+* **Autotune neutrality** — ``cached`` mode on a miss is bit-identical
+  to ``off`` for every registered model, and the tune cache key
+  separates models (schema v3).
+"""
+
+import os
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from grayscott_jl_tpu import models
+from grayscott_jl_tpu.config.settings import (
+    Settings,
+    SettingsError,
+    parse_settings_toml,
+)
+from grayscott_jl_tpu.models import base as model_base
+from grayscott_jl_tpu.simulation import Simulation
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+GOLDEN = REPO / "tests" / "golden"
+
+GS_PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+ALL_MODELS = ("grayscott", "brusselator", "fhn", "heat")
+
+
+def _settings(model="grayscott", L=16, noise=0.1, dt=None, **kw):
+    if model == "grayscott":
+        kw = {**GS_PARAMS, **kw}
+        if dt is not None:
+            kw["dt"] = dt
+    else:
+        kw["dt"] = 0.05 if dt is None else dt
+    s = Settings(
+        L=L, noise=noise, precision="Float32", backend="CPU", **kw
+    )
+    s.model = model
+    return s
+
+
+# ----------------------------------------------------------------- registry
+
+def test_registry_round_trip():
+    assert set(ALL_MODELS) <= set(models.available_models())
+    for name in ALL_MODELS:
+        m = models.get_model(name)
+        assert m.name == name
+        assert len(m.field_names) == len(m.boundaries) == m.n_fields
+        d = m.describe()
+        assert d["name"] == name and d["fields"] == list(m.field_names)
+    # the flagship is the only Pallas-capable model
+    assert models.get_model("grayscott").pallas_capable
+    assert not any(
+        models.get_model(n).pallas_capable
+        for n in ("brusselator", "fhn", "heat")
+    )
+
+
+def test_unknown_model_lists_registry():
+    with pytest.raises(SettingsError, match="heat"):
+        models.get_model("grayscot")  # typo
+
+
+def test_reregistering_taken_name_is_rejected():
+    m = models.get_model("heat")
+    assert models.register(m) is m  # idempotent for the same object
+    clone = model_base.Model(
+        name="heat", field_names=("T",), boundaries=(0.0,),
+        param_decls={"D": 0.1}, reaction=m.reaction, init=m.init,
+    )
+    with pytest.raises(ValueError, match="already registered"):
+        models.register(clone)
+
+
+# ------------------------------------------------- loud [model] validation
+
+def test_model_table_unknown_key_is_loud():
+    with pytest.raises(SettingsError, match=r"brusselator.*Dw"):
+        parse_settings_toml(
+            "L = 16\n[model]\nname = \"brusselator\"\nDw = 0.1\n"
+        )
+
+
+def test_model_table_misspelled_grayscott_param_is_loud():
+    # The silent-default trap this framework removes: pre-refactor, an
+    # unknown key was silently ignored (reference Inputs.jl:88-94).
+    with pytest.raises(SettingsError, match=r"grayscott.*DU"):
+        parse_settings_toml("L = 16\n[model]\nDU = 0.3\n")
+
+
+def test_model_table_non_numeric_value_is_loud():
+    with pytest.raises(SettingsError, match="must be a number"):
+        parse_settings_toml("L = 16\n[model]\nname = \"heat\"\nD = \"x\"\n")
+
+
+def test_missing_required_param_names_the_model():
+    m = model_base.Model(
+        name="_test_required", field_names=("a",), boundaries=(0.0,),
+        param_decls={"alpha": None, "beta": 1.0},
+        reaction=lambda f, l, n, p: (p.alpha * l[0],),
+        init=models.get_model("heat").init,
+    )
+    with pytest.raises(SettingsError, match=r"_test_required.*alpha"):
+        m.validate_table({})
+    m.validate_table({"alpha": 2.0})  # satisfied
+
+
+def test_model_table_values_win_over_legacy_flat_keys():
+    s = parse_settings_toml(
+        "L = 16\nF = 0.02\nk = 0.048\n[model]\nF = 0.9\n"
+    )
+    from grayscott_jl_tpu.models import grayscott
+
+    params = grayscott.Params.from_settings(s, jnp.float32)
+    assert float(params.F) == pytest.approx(0.9)
+    assert float(params.k) == pytest.approx(0.048)  # flat key still read
+
+
+def test_model_string_key_selects_model():
+    s = parse_settings_toml("L = 16\nmodel = \"heat\"\n")
+    assert s.model == "heat"
+    sim = Simulation(s, n_devices=1)
+    assert sim.model.name == "heat" and sim.model.field_names == ("T",)
+
+
+# --------------------------------------------------------- golden identity
+
+def test_grayscott_golden_trajectory_identity():
+    """The refactor acceptance gate: trajectories byte-identical to the
+    pre-framework implementation, captured in tests/golden/ by
+    scripts/make_golden.py (single-device XLA, sharded XLA window
+    chain, sharded Pallas xy-chain)."""
+    gold = np.load(GOLDEN / "grayscott_trajectories.npz")
+    cases = [("single_xla", 1, "Plain", None)]
+    if len(jax.devices()) >= 8:
+        cases += [
+            ("sharded_xla", 8, "Plain", "2"),
+            ("sharded_pallas", 8, "Pallas", "2"),
+        ]
+    for tag, n_devices, lang, fuse in cases:
+        if fuse is not None:
+            os.environ["GS_FUSE"] = fuse
+        try:
+            sim = Simulation(
+                _settings(kernel_language=lang), n_devices=n_devices,
+                seed=7,
+            )
+            sim.iterate(10)
+            u, v = sim.get_fields()
+        finally:
+            os.environ.pop("GS_FUSE", None)
+        assert np.asarray(u).tobytes() == gold[f"{tag}_u"].tobytes(), (
+            f"{tag}: u drifted from the pre-refactor golden trajectory"
+        )
+        assert np.asarray(v).tobytes() == gold[f"{tag}_v"].tobytes(), (
+            f"{tag}: v drifted from the pre-refactor golden trajectory"
+        )
+
+
+def test_grayscott_golden_store_identity(tmp_path, monkeypatch):
+    """CLI-level golden comparison: a fresh driver run reproduces the
+    committed pre-refactor output store's U/V payloads byte-for-byte,
+    output step by output step."""
+    from grayscott_jl_tpu import driver
+    from grayscott_jl_tpu.io.bplite import BpReader
+
+    out = tmp_path / "gs.bp"
+    cfg = tmp_path / "golden.toml"
+    cfg.write_text(
+        "L = 16\nsteps = 6\nplotgap = 2\nnoise = 0.1\n"
+        "Du = 0.2\nDv = 0.1\nF = 0.02\nk = 0.048\ndt = 1.0\n"
+        f"output = \"{out}\"\n"
+        "precision = \"Float32\"\nbackend = \"CPU\"\n"
+        "kernel_language = \"Plain\"\n"
+    )
+    monkeypatch.setenv("GS_ASYNC_IO_DEPTH", "0")
+    monkeypatch.setenv("GS_SEED", "7")
+    driver.main([str(cfg)], n_devices=1)
+
+    ref = BpReader(str(GOLDEN / "gs_golden.bp"))
+    new = BpReader(str(out))
+    try:
+        assert new.num_steps() == ref.num_steps() > 0
+        for i in range(ref.num_steps()):
+            assert int(new.get("step", step=i)) == int(
+                ref.get("step", step=i)
+            )
+            for var in ("U", "V"):
+                assert (
+                    new.get(var, step=i).tobytes()
+                    == ref.get(var, step=i).tobytes()
+                ), f"store {var} at output step {i} drifted"
+    finally:
+        ref.close()
+        new.close()
+
+
+# ---------------------------------------------- sharded equality matrix
+
+@requires8
+@pytest.mark.parametrize("model", ALL_MODELS)
+def test_sharded_matches_single_device_bitwise(model, monkeypatch):
+    """The acceptance matrix: every registered model, single-device vs
+    (2,2,2)-sharded, BITWISE at chain depth 1 (pure layout invariance —
+    halo exchange + position-keyed noise reproduce every global cell
+    exactly), with no per-model code in parallel/ or ops/."""
+    monkeypatch.setenv("GS_FUSE", "1")
+    ref = Simulation(_settings(model), n_devices=1, seed=3)
+    sh = Simulation(_settings(model), n_devices=8, seed=3)
+    assert sh.sharded and sh.domain.dims == (2, 2, 2)
+    ref.iterate(6)
+    sh.iterate(6)
+    for name, a, b in zip(
+        ref.model.field_names, ref.get_fields(), sh.get_fields()
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(b), np.asarray(a),
+            err_msg=f"{model}.{name}: sharded != single-device",
+        )
+
+
+@requires8
+@pytest.mark.parametrize("model", ALL_MODELS)
+def test_sharded_temporal_blocking_matches_stepwise(model, monkeypatch):
+    """Depth-2 window chains for every model: one 2-deep exchange per 2
+    steps must reproduce the stepwise trajectory to the documented
+    XLA:CPU FMA-contraction bound (test_sharded.assert_chain_equal —
+    bitwise on TPU; the CPU backend's contraction decisions are
+    window-shape-sensitive)."""
+    from test_sharded import assert_chain_equal
+
+    monkeypatch.setenv("GS_FUSE", "2")
+    fused = Simulation(_settings(model), n_devices=8, seed=5)
+    fused.iterate(5)
+    monkeypatch.setenv("GS_FUSE", "1")
+    stepwise = Simulation(_settings(model), n_devices=8, seed=5)
+    for _ in range(5):
+        stepwise.iterate(1)
+    for name, a, b in zip(
+        fused.model.field_names, fused.get_fields(),
+        stepwise.get_fields(),
+    ):
+        assert_chain_equal(np.asarray(a), np.asarray(b))
+
+
+def test_heat_single_field_runs_and_diffuses():
+    """The one-field model pins n-field generality: state is a 1-tuple,
+    snapshots carry one block array, and the hot cube spreads."""
+    sim = Simulation(_settings("heat", noise=0.0), n_devices=1)
+    assert len(sim.fields) == 1
+    t0 = np.asarray(sim.get_fields()[0])
+    sim.iterate(10)
+    (t10,) = sim.get_fields()
+    t10 = np.asarray(t10)
+    # mass leaks through the cold Dirichlet frame; heat spreads outward
+    assert 0 < float(t10.sum()) < float(t0.sum())
+    assert int((t10 > 0).sum()) > int((t0 > 0).sum())
+    [(offs, sizes, block)] = sim.local_blocks()
+    assert block.shape == (16, 16, 16)
+    rep = sim.snapshot_async(health=True).health_report()
+    assert rep.finite and rep.names == ("T",)
+    assert "T_range" in rep.describe()
+    sim.poison_nan("T")
+    rep = sim.snapshot_async(health=True).health_report()
+    assert not rep.finite
+
+
+@pytest.mark.parametrize("model", ("brusselator", "fhn"))
+def test_two_field_models_evolve_from_seed(model):
+    sim = Simulation(_settings(model, noise=0.0), n_devices=1)
+    init = [np.array(f) for f in sim.get_fields()]
+    sim.iterate(10)
+    after = sim.get_fields()
+    assert all(np.isfinite(np.asarray(f)).all() for f in after)
+    assert not np.array_equal(np.asarray(after[0]), init[0])
+
+
+def test_checkpoint_restart_roundtrip_per_model(tmp_path):
+    """Checkpoint variables carry the model's field names and the
+    restore path reads them back — resumed trajectories are bitwise."""
+    from grayscott_jl_tpu.io import checkpoint
+
+    for model in ("heat", "fhn"):
+        s = _settings(model)
+        s.checkpoint_output = str(tmp_path / f"{model}.ckpt.bp")
+        base = Simulation(s, n_devices=1, seed=2)
+        base.iterate(4)
+        w = checkpoint.CheckpointWriter(s, jnp.float32)
+        assert w.field_names == base.model.field_names
+        w.save(base.step, base.local_blocks())
+        w.close()
+        base.iterate(3)
+
+        resumed = Simulation(s, n_devices=1, seed=2)
+        reader, idx, step = checkpoint.open_checkpoint(
+            s.checkpoint_output, s
+        )
+        assert reader.attributes()["model"] == model
+        resumed.restore_from_reader(reader, idx, step)
+        reader.close()
+        assert resumed.step == 4
+        resumed.iterate(3)
+        for name, a, b in zip(
+            base.model.field_names, base.get_fields(),
+            resumed.get_fields(),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{model}.{name} resume drifted",
+            )
+
+
+# ------------------------------------------------------------ Pallas gate
+
+def test_explicit_pallas_refused_for_non_capable_model():
+    with pytest.raises(ValueError, match="Gray-Scott"):
+        Simulation(
+            _settings("heat", kernel_language="Pallas"), n_devices=1
+        )
+
+
+def test_auto_gates_pallas_with_provenance(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE", "off")
+    sim = Simulation(
+        _settings("brusselator", kernel_language="Auto"), n_devices=1
+    )
+    assert sim.kernel_language == "xla"
+    gate = sim.kernel_selection["pallas_gate"]
+    assert gate == {"model": "brusselator", "pallas_capable": False}
+    assert sim.kernel_selection["autotune"]["pallas_allowed"] is False
+
+
+def test_candidates_respect_pallas_gate():
+    from grayscott_jl_tpu.tune import candidates
+
+    kw = dict(
+        dims=(2, 2, 2), L=256, platform="tpu", itemsize=4, fuse_cap=3,
+        analytic_kernel="xla", analytic_fuse=2, comm_overlap=True,
+        overlap_toggle=False, top_n=16,
+    )
+    gated = candidates.generate(**kw, pallas_allowed=False)
+    assert gated and all(c.kernel == "xla" for c in gated)
+    open_ = candidates.generate(**kw, pallas_allowed=True)
+    assert any(c.kernel == "pallas" for c in open_)
+
+
+def test_tune_cache_key_separates_models():
+    from grayscott_jl_tpu.tune import cache
+
+    base = dict(device_kind="TPU v5e", platform="tpu", dims=(2, 2, 2),
+                L=64, dtype="float32", noise=0.1, jax_version="0.4.x")
+    gs = cache.cache_key(**base)
+    br = cache.cache_key(**base, model="brusselator", n_fields=2)
+    ht = cache.cache_key(**base, model="heat", n_fields=1)
+    assert gs["schema"] == cache.SCHEMA_VERSION == 3
+    assert gs["model"] == "grayscott" and gs["n_fields"] == 2
+    digests = {cache.key_digest(k) for k in (gs, br, ht)}
+    assert len(digests) == 3  # a Brusselator run can never adopt a
+    #                           Gray-Scott-measured winner
+
+
+def test_stale_v2_cache_entry_is_a_warned_miss(tmp_path, capsys):
+    """Pre-v3 entries live under v2/ and are structurally invisible; a
+    v2 record force-written at the v3 path degrades to a warned miss
+    (the existing corrupt-entry contract)."""
+    import json
+
+    from grayscott_jl_tpu.tune import cache
+
+    key = cache.cache_key(
+        device_kind="", platform="cpu", dims=(1, 1, 1), L=16,
+        dtype="float32", noise=0.0, jax_version="x",
+    )
+    path = cache.entry_path(key, str(tmp_path))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    stale = {"schema": 2, "key": {"schema": 2}, "winner": {}}
+    with open(path, "w") as f:
+        json.dump(stale, f)
+    assert cache.load(key, str(tmp_path)) is None
+    assert "stale or malformed" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("model", ALL_MODELS)
+def test_autotune_cached_miss_is_bit_identical_to_off(
+    model, tmp_path, monkeypatch
+):
+    """`cached` mode on a miss must leave every registered model's
+    trajectory untouched relative to `off` (acceptance criterion)."""
+    monkeypatch.setenv("GS_AUTOTUNE_CACHE", str(tmp_path / "tc"))
+    monkeypatch.setenv("GS_AUTOTUNE", "off")
+    a = Simulation(
+        _settings(model, kernel_language="Auto"), n_devices=1, seed=4
+    )
+    a.iterate(5)
+    monkeypatch.setenv("GS_AUTOTUNE", "cached")
+    b = Simulation(
+        _settings(model, kernel_language="Auto"), n_devices=1, seed=4
+    )
+    assert b.kernel_selection["autotune"]["cache"] == "miss"
+    b.iterate(5)
+    for name, fa, fb in zip(
+        a.model.field_names, a.get_fields(), b.get_fields()
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(fa), np.asarray(fb),
+            err_msg=f"{model}.{name}: cached-miss != off",
+        )
+
+
+# ----------------------------------------------------- ensemble of models
+
+def test_heat_ensemble_members_equal_solo():
+    """Ensemble-of-heat-models member equality: a D sweep of the
+    one-field model, member k bitwise-identical to the solo run of
+    member k's params and seed (the engine is model-generic end to
+    end)."""
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+    from grayscott_jl_tpu.ensemble.io import member_settings
+
+    s = _settings("heat", noise=0.1)
+    s.ensemble = ens_spec.from_toml(
+        {"members": 3, "sweep": {"D": [0.1, 0.2, 0.3]}}, s
+    )
+    assert s.ensemble.model == "heat"
+    sim = EnsembleSimulation(s, n_devices=1, seed=11)
+    sim.iterate(5)
+    (te,) = sim.get_fields()
+    for k in range(3):
+        ms = member_settings(s, k)
+        assert ms.model_params["D"] == pytest.approx([0.1, 0.2, 0.3][k])
+        solo = Simulation(ms, n_devices=1, seed=11 + k)
+        solo.iterate(5)
+        (ts,) = solo.get_fields()
+        np.testing.assert_array_equal(
+            te[k], np.asarray(ts), err_msg=f"heat member {k}"
+        )
+
+
+def test_ensemble_presets_are_model_namespaced():
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+
+    s = _settings("brusselator")
+    ens = ens_spec.from_toml({"presets": ["turing", "steady"]}, s)
+    assert ens.model == "brusselator"
+    assert ens.members[0].B == pytest.approx(3.0)
+    # a Gray-Scott preset name is rejected FOR this model, naming it
+    with pytest.raises(ValueError, match=r"spots.*brusselator"):
+        ens_spec.from_toml({"presets": ["spots"]}, s)
+
+
+# ------------------------------------------------- models-as-data hygiene
+
+def test_no_model_literals_in_shared_code():
+    """ops/ and parallel/ must contain no model-specific constants: no
+    seeding constants, no boundary-value definitions. The one sanctioned
+    reference is the Pallas kernel (ops/pallas_stencil.py) — the
+    Gray-Scott model's own hand-fused form — which may IMPORT the model
+    declaration (qualified ``_gs_model.`` reads) but never redefine it."""
+    banned_tokens = re.compile(
+        r"\bSEED_HALF_WIDTH\b|\bSEED_U\b|\bSEED_V\b|\bSEED_T\b"
+    )
+    boundary_def = re.compile(
+        r"^\s*[UVTW]_BOUNDARY\s*=", re.MULTILINE
+    )
+    unqualified_boundary = re.compile(
+        r"(?<![\w.])[UVT]_BOUNDARY\b"
+    )
+    pkg = REPO / "grayscott_jl_tpu"
+    for sub in ("ops", "parallel"):
+        for path in sorted((pkg / sub).glob("*.py")):
+            src = path.read_text()
+            assert not banned_tokens.search(src), (
+                f"{path}: model seeding constants belong in models/"
+            )
+            assert not boundary_def.search(src), (
+                f"{path}: boundary values are model declarations"
+            )
+            if sub == "parallel":
+                assert "BOUNDARY" not in src, (
+                    f"{path}: parallel/ must receive boundaries via "
+                    "the model declaration, not name them"
+                )
+            elif path.name != "pallas_stencil.py":
+                assert not unqualified_boundary.search(src), (
+                    f"{path}: boundary constants must come from the "
+                    "model declaration"
+                )
+
+
+# ------------------------------------------------------------- CLI smoke
+
+@pytest.mark.parametrize("model", ALL_MODELS)
+def test_cli_smoke_four_steps_each_model(model, tmp_path, monkeypatch):
+    """Tier-1 smoke: 4 steps of every registered model through the full
+    CLI driver — [model] TOML table, output stream with model field
+    names, stats config naming the model."""
+    from grayscott_jl_tpu import driver
+    from grayscott_jl_tpu.io.bplite import BpReader
+
+    out = tmp_path / f"{model}.bp"
+    lines = [
+        "L = 16", "steps = 4", "plotgap = 2", "noise = 0.0",
+        f'output = "{out}"', 'precision = "Float32"',
+        'backend = "CPU"', 'kernel_language = "Plain"',
+        "dt = 0.05" if model != "grayscott" else "dt = 1.0",
+        "[model]", f'name = "{model}"',
+    ]
+    cfg = tmp_path / f"{model}.toml"
+    cfg.write_text("\n".join(lines) + "\n")
+    monkeypatch.setenv("GS_ASYNC_IO_DEPTH", "0")
+    sim = driver.main([str(cfg)], n_devices=1)
+    assert sim.step == 4 and sim.model.name == model
+
+    r = BpReader(str(out))
+    try:
+        attrs = r.attributes()
+        assert attrs["model"] == model
+        expected_vars = [
+            n.upper() for n in models.get_model(model).field_names
+        ]
+        assert attrs["fields"] == expected_vars
+        assert r.num_steps() == 2  # steps 2 and 4
+        for var in expected_vars:
+            block = r.get(var, step=r.num_steps() - 1)
+            assert block.shape == (16, 16, 16)
+            assert np.isfinite(block).all()
+    finally:
+        r.close()

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from grayscott_jl_tpu.config.settings import Settings
 from grayscott_jl_tpu.models import grayscott
+from grayscott_jl_tpu.models import grayscott as gs_model
 from grayscott_jl_tpu.ops import pallas_stencil, stencil
 from grayscott_jl_tpu.simulation import Simulation
 
@@ -475,7 +476,7 @@ def test_x_chain_with_boundary_faces_equals_no_faces_chain(monkeypatch):
     nx = ny = nz = 32
     k = 3
     u, v, _, params, seeds = _xchain_inputs(nx, ny, nz, k)
-    bv = ((stencil.U_BOUNDARY,) * 2 + (stencil.V_BOUNDARY,) * 2)
+    bv = ((gs_model.U_BOUNDARY,) * 2 + (gs_model.V_BOUNDARY,) * 2)
     faces = tuple(
         jnp.full((k, ny, nz), b, jnp.float32) for b in bv
     )
@@ -539,7 +540,7 @@ def test_xy_chain_edge_block_pins_out_of_domain_rows(monkeypatch):
     ny_int, nz = 12, 128
     ny = ny_int + 2 * k  # 16, already sublane-aligned
     u, v, _, params, seeds = _xchain_inputs(nx, ny, nz, k)
-    bv = ((stencil.U_BOUNDARY,) * 2 + (stencil.V_BOUNDARY,) * 2)
+    bv = ((gs_model.U_BOUNDARY,) * 2 + (gs_model.V_BOUNDARY,) * 2)
     faces = tuple(jnp.full((k, ny, nz), b, jnp.float32) for b in bv)
     # y origin -k: rows [0, k) are outside the global domain.
     offs = jnp.asarray([0, -k, 0], jnp.int32)

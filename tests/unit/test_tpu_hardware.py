@@ -347,7 +347,7 @@ def test_x_chain_kernel_on_hardware():
     )
 
     # and the bv-faces <-> no-faces bitwise identity on Mosaic
-    from grayscott_jl_tpu.ops import stencil as st
+    from grayscott_jl_tpu.models import grayscott as st
 
     bfaces = tuple(
         jnp.full((k, ny, nz), b, dtype)
