@@ -351,7 +351,9 @@ def emit(result, error=None) -> None:
         # (chronological), the median, and the fixed-duration sustained
         # number alongside the headline best (BASELINE.md caveats).
         for k in ("rounds_us_per_step", "median_us_per_step",
-                  "median_cell_updates_per_s", "sustained_us_per_step",
+                  "median_cell_updates_per_s", "p50_us_per_step",
+                  "p95_us_per_step", "p99_us_per_step",
+                  "sustained_us_per_step",
                   "sustained_cell_updates_per_s", "late_probe_recovery_s",
                   "provisional", "comm", "autotune"):
             if k in result:

@@ -15,7 +15,16 @@ itself is one-JSON-object-per-line with:
 * measurement fields using the repo-wide ``*_us_per_step`` spellings
   (``median_us_per_step``/``best_us_per_step``/``rounds_us_per_step``)
   so any artifact with per-depth rows is directly consumable by
-  ``update_fuse_ratio.load_ratios``.
+  ``update_fuse_ratio.load_ratios``,
+* step-latency percentiles ``p50_us_per_step`` / ``p95_us_per_step`` /
+  ``p99_us_per_step`` over the row's chronological timing rounds
+  (``grayscott_jl_tpu/obs/metrics.quantile`` — numpy-'linear'
+  interpolation, the same math as the driver's ``step_latency_us``
+  histogram in docs/OBSERVABILITY.md). The tail matters on the
+  clock-throttled tunnel chip: a candidate whose p99 is 1.7x its p50
+  is a worse production pick than its median suggests. Rows written
+  before the observability PR carry no percentile fields; readers
+  treat absence as "not measured", not zero.
 """
 
 from __future__ import annotations

@@ -69,6 +69,16 @@ def emit_tuning_rows(out: str, backend: str, sim, L: int) -> dict:
                   "rounds_us_per_step", "error"):
             if k in m:
                 row[k] = m[k]
+        rounds = m.get("rounds_us_per_step") or []
+        if rounds:
+            # Step-latency percentiles over the candidate's timing
+            # rounds (shared percentile math: obs/metrics.quantile) —
+            # the tail, not just the median, decides whether a winner
+            # is actually robust on a clock-throttled chip.
+            from grayscott_jl_tpu.obs.metrics import quantile
+
+            for q in (50, 95, 99):
+                row[f"p{q}_us_per_step"] = round(quantile(rounds, q), 1)
         artifacts.append_row(out, row)
     summary = dict(base, ab="autotune_summary", **{
         k: prov.get(k)

@@ -113,6 +113,13 @@ class Settings:
     #: ONE compiled executable (``ensemble/engine.py``) with
     #: member-indexed output/checkpoint stores (``ensemble/io.py``).
     ensemble: Any = None
+    #: Metrics flush cadence in seconds (extension; obs/metrics.py,
+    #: docs/OBSERVABILITY.md): with ``GS_METRICS=path`` armed, a
+    #: snapshot record is appended to the JSONL at most this often
+    #: (checked at driver boundaries). 0 (default) = one record at run
+    #: end only. ``GS_METRICS_INTERVAL_S`` env wins, mirroring the
+    #: other knobs.
+    metrics_interval_s: float = 0.0
     #: Registered model to integrate (extension; docs/MODELS.md): the
     #: ``[model]`` TOML table's ``name`` key (or a plain ``model =
     #: "heat"`` string). Gray-Scott is the default and keeps the

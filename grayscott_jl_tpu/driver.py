@@ -28,6 +28,16 @@ hit/miss, candidates timed, tuning seconds) lands in the RunStats
 ``kernel_selection`` section below, next to the supervisor's
 degradation provenance.
 
+Observability (``obs/``, docs/OBSERVABILITY.md): the loop below is the
+instrumentation spine — every phase boundary is one watchdog heartbeat
+which is one trace span edge (``GS_TRACE``), every ``RunStats`` phase
+is a nested span, each fused round feeds the step-latency histogram
+(``GS_METRICS``), and lifecycle/fault/recovery markers route through
+the unified event stream (``GS_EVENTS``). ``GS_PROFILE=start:stop``
+brackets a step range with a ``jax.profiler`` device capture. All of
+it observes host-side control flow only: trajectories are bitwise
+identical with observability on or off.
+
 Resilience (``resilience/``): :func:`main` is split into the supervision
 dispatch and :func:`run_once`, the single-attempt loop. ``GS_SUPERVISE``
 routes through ``resilience.supervisor.supervise`` — failure
@@ -41,6 +51,7 @@ must not leak open stores or a half-written rollback sidecar).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional
 
@@ -194,10 +205,18 @@ def run_once(
     # while the Simulation constructor jits (and autotunes), and a
     # SIGTERM during compile should still exit through the graceful
     # path at the first boundary.
+    from .obs import events as obs_events
+
     deadlines = resolve_watchdog(settings)
     wd = Watchdog(deadlines, journal=journal).start() if deadlines else None
     shutdown = ShutdownListener(
-        enabled=resolve_graceful_shutdown(settings), watchdog=wd
+        enabled=resolve_graceful_shutdown(settings), watchdog=wd,
+        # Live preemption notice on the unified event stream the moment
+        # the signal lands (the boundary-time graceful_shutdown journal
+        # marker follows later, possibly much later on a long round).
+        on_request=lambda signum: obs_events.get_events().emit(
+            "shutdown_requested", signum=signum
+        ),
     ).install()
     try:
         return _run_once_inner(
@@ -217,6 +236,19 @@ def run_once(
         shutdown.uninstall()
         if wd is not None:
             wd.stop()
+        # The trace file must be valid after EVERY attempt — a
+        # supervised multi-restart run flushes here between attempts,
+        # and the atomic rewrite keeps the on-disk JSON well-formed
+        # even if the next attempt dies mid-span.
+        from .obs.trace import get_tracer
+
+        try:
+            get_tracer().flush()
+        except OSError as e:
+            import sys
+
+            print(f"gray-scott: warning: could not write trace "
+                  f"({e})", file=sys.stderr)
 
 
 def _run_once_inner(
@@ -233,6 +265,9 @@ def _run_once_inner(
 ):
     import jax
 
+    from .obs import events as obs_events
+    from .obs import metrics as obs_metrics
+    from .obs.trace import ProfileWindow, get_tracer
     from .resilience.faults import (
         GracefulShutdown,
         InjectedKernelError,
@@ -240,8 +275,29 @@ def _run_once_inner(
         injected_hang_wait,
     )
 
-    if wd is not None:
-        wd.heartbeat("compile")
+    # Observability sinks (docs/OBSERVABILITY.md): process-wide
+    # singletons, so a supervised run's restart attempts share one
+    # trace, one event stream, and one metrics registry — the unified
+    # timeline is the point. All of them are no-ops unless their env
+    # knob (GS_TRACE / GS_EVENTS / GS_METRICS / GS_PROFILE) is set, and
+    # none of them touch the jitted programs: trajectories are bitwise
+    # identical obs on or off (asserted in tier-1).
+    tracer = get_tracer()
+    evs = obs_events.get_events()
+    metrics = obs_metrics.get_metrics(settings)
+    profile = ProfileWindow.from_env()
+    attempt = context.attempt if context is not None else 0
+
+    def _mark(phase, step=None):
+        """One driver phase boundary: the watchdog heartbeat (which
+        itself emits the trace span edge) or, on an unwatched run, the
+        edge directly — same timeline either way."""
+        if wd is not None:
+            wd.heartbeat(phase, step)
+        else:
+            tracer.edge(phase, step)
+
+    _mark("compile")
     ens = getattr(settings, "ensemble", None)
     if ens is not None:
         # Batched ensemble run (docs/ENSEMBLE.md): one compiled launch
@@ -312,7 +368,8 @@ def _run_once_inner(
         selection = {**(selection or {}), **context.degraded}
     from .config.settings import resolve_autotune
 
-    stats = RunStats(settings.L, config={
+    stats = RunStats(settings.L, tracer=tracer, config={
+        "attempt": attempt,
         "model": sim.model.name,
         "fields": list(sim.model.field_names),
         "mesh_dims": list(sim.domain.dims),
@@ -345,18 +402,60 @@ def _run_once_inner(
             "member_shards": sim.member_shards,
             "seeds": list(sim.member_seeds),
         })
+    if context is not None:
+        # Hand the live stats to the supervisor: a failing attempt's
+        # phase accumulation becomes an attempt-tagged journal event
+        # (``attempt_phases``) instead of dying with the attempt.
+        context.stats = stats
     from .parallel import icimodel
 
-    stats.record_comm(icimodel.comm_report(sim))
+    comm = icimodel.comm_report(sim)
+    stats.record_comm(comm)
     stats.record_watchdog(
-        wd.describe() if wd is not None else {"enabled": False}
+        {**wd.describe(), "attempt": attempt} if wd is not None
+        else {"enabled": False}
+    )
+
+    # Metrics instruments, registered once per attempt (get-or-create:
+    # restarted attempts find the same objects) and labeled by the
+    # run's resolved config so one scrape distinguishes models/meshes/
+    # kernels sharing a host. Off means the shared null instrument —
+    # the loop below pays a no-op call, nothing else.
+    mlabels = sim.metrics_labels()
+    m_step_us = metrics.histogram("step_latency_us", **mlabels)
+    m_rounds = metrics.counter("step_rounds", **mlabels)
+    m_steps = metrics.counter("steps", **mlabels)
+    metrics.gauge("comm_hidden_us_per_step", **mlabels).set(
+        comm.get("hidden_us")
+    )
+    metrics.gauge("comm_exposed_us_per_step", **mlabels).set(
+        comm.get("exposed_us")
+    )
+
+    def _refresh_device_gauges():
+        """Per-device allocator gauges; only refreshed when a metrics
+        record is actually about to flush (the PJRT query is not
+        boundary-cheap)."""
+        for ms in sim.device_memory_stats():
+            metrics.gauge(
+                "device_bytes_in_use", device=ms["device"]
+            ).set(ms["bytes_in_use"])
+            metrics.gauge(
+                "device_peak_bytes_in_use", device=ms["device"]
+            ).set(ms["peak_bytes_in_use"])
+
+    evs.emit(
+        "run_start", step=restart_step, attempt=attempt,
+        model=sim.model.name, L=settings.L, steps=settings.steps,
+        kernel=sim.kernel_language, mesh=list(sim.domain.dims),
+        restart=bool(settings.restart),
     )
     # The watchdog's drain heartbeat: while close() drains K queued
     # steps, each completed write re-arms the "drain" deadline (touch
     # only re-arms the currently armed phase, so mid-run worker writes
     # never mask a wedged driver).
     pipe = AsyncStepWriter(
-        stats=stats,
+        stats=stats, metrics=metrics,
         progress=(lambda s: wd.touch("drain", s)) if wd is not None else None,
     )
     stats.config["async_io_depth"] = pipe.depth
@@ -372,8 +471,7 @@ def _run_once_inner(
         ckpt_step = None
         if ckpt is not None:
             if not ckpt_written:
-                if wd is not None:
-                    wd.heartbeat("checkpoint", at_step)
+                _mark("checkpoint", at_step)
                 snap = sim.snapshot_async()
                 pipe.submit(at_step, snap, [("checkpoint", ckpt.save)])
                 stats.count("checkpoints")
@@ -385,23 +483,21 @@ def _run_once_inner(
             event="graceful_shutdown", signal=shutdown.signum,
             step=at_step, checkpoint_step=ckpt_step,
         )
-        if wd is not None:
-            wd.heartbeat("drain", at_step)
+        _mark("drain", at_step)
         pipe.close()
         raise GracefulShutdown(shutdown.signum, at_step, ckpt_step)
 
     t0 = time.perf_counter()
+    if profile is not None:
+        profile.on_boundary(step)
     try:
         with trace(), pipe:
             while step < settings.steps:
-                if wd is not None:
-                    # The first round pays jit (and, under Auto, any
-                    # remaining autotune measurement) — its budget is
-                    # the compile deadline, every later round the much
-                    # tighter step_round one.
-                    wd.heartbeat(
-                        "compile" if first_round else "step_round", step
-                    )
+                # The first round pays jit (and, under Auto, any
+                # remaining autotune measurement) — its budget is
+                # the compile deadline, every later round the much
+                # tighter step_round one.
+                _mark("compile" if first_round else "step_round", step)
                 boundary = min(
                     _next_boundary(step, settings.plotgap, settings.steps),
                     _next_boundary(
@@ -421,14 +517,27 @@ def _run_once_inner(
                             step=boundary, planned_step=fault.step,
                         )
                         raise InjectedKernelError(fault.step)
-                with stats.phase("compute"):
+                t_round = time.perf_counter()
+                with stats.phase("compute", step=step):
                     sim.iterate(boundary - step)
                     # iterate() only dispatches; block so the phase
                     # measures device execution, not async enqueue time.
                     sim.block_until_ready()
+                # Step-latency distribution: one sample per fused round
+                # (per-step mean of the round — the host cannot see
+                # individual steps inside the jitted chunk), feeding
+                # the p50/p95/p99 the stats file and bench rows report.
+                m_step_us.observe(
+                    (time.perf_counter() - t_round)
+                    / (boundary - step) * 1e6
+                )
+                m_rounds.inc()
+                m_steps.inc(boundary - step)
                 stats.count("steps", boundary - step)
                 step = boundary
                 first_round = False
+                if profile is not None:
+                    profile.on_boundary(step)
 
                 fault = plan.take("nan", step)
                 if fault is not None:
@@ -478,8 +587,7 @@ def _run_once_inner(
                     if shutdown.requested:
                         _graceful(step, ckpt_written=False)
                     continue
-                if wd is not None:
-                    wd.heartbeat("io", step)
+                _mark("io", step)
                 targets = []
                 if at_plot:
                     log.info(
@@ -494,7 +602,7 @@ def _run_once_inner(
                         (phase, _with_io_fault(plan, journal, fn))
                         for phase, fn in targets
                     ]
-                with stats.phase("device_to_host"):
+                with stats.phase("device_to_host", step=step):
                     snap = sim.snapshot_async(health=guard.enabled)
                     if pipe.synchronous:
                         # Depth 0 reproduces the reference's flow
@@ -509,7 +617,8 @@ def _run_once_inner(
                     if ens is not None and report is not None:
                         stats.record_member_health(step, report)
                     try:
-                        event = guard.check(step, report, log=log)
+                        event = guard.check(step, report, log=log,
+                                            metrics=metrics)
                     except Exception:
                         # Journal the failing report BEFORE unwinding:
                         # for ensembles this is where the non-finite
@@ -525,9 +634,16 @@ def _run_once_inner(
                 pipe.submit(step, snap, targets)
                 if at_plot:
                     stats.count("output_steps")
+                    evs.emit("output", phase="io", step=step,
+                             output_step=step // settings.plotgap)
                 if at_ckpt:
                     stats.count("checkpoints")
+                    evs.emit("checkpoint", phase="io", step=step)
                     log.info(f"Checkpoint accepted at step {step}")
+                # Interval metrics record (metrics_interval_s TOML /
+                # GS_METRICS_INTERVAL_S): boundary-time only, with the
+                # expensive device gauges refreshed just-in-time.
+                metrics.maybe_flush(on_flush=_refresh_device_gauges)
                 if shutdown.requested:
                     # After this boundary's scheduled writes so the
                     # resumed run reproduces the uninterrupted output
@@ -537,8 +653,7 @@ def _run_once_inner(
             # Drain INSIDE the timed region: the run is complete only
             # once every accepted step is durable (close re-raises a
             # writer failure with the failing step identified).
-            if wd is not None:
-                wd.heartbeat("drain", step)
+            _mark("drain", step)
             pipe.close()
 
         elapsed = time.perf_counter() - t0
@@ -557,13 +672,40 @@ def _run_once_inner(
                 f"{elapsed:.3f}s "
                 f"({cells / max(elapsed, 1e-9):.3e} cell-updates/s)"
             )
-        stats.record_io(pipe.overlap_stats())
+        io_stats = pipe.overlap_stats()
+        stats.record_io(io_stats)
+        metrics.gauge("io_hidden_s").set(
+            round(sum(io_stats["hidden_s"].values()), 6)
+        )
+        metrics.gauge("io_exposed_s").set(
+            round(sum(io_stats["exposed_s"].values()), 6)
+        )
         if wd is not None:
             # Re-record with the final heartbeat count (the pre-loop
             # record only captured the armed deadlines).
-            stats.record_watchdog(wd.describe())
+            stats.record_watchdog({**wd.describe(), "attempt": attempt})
         if journal.events:
             stats.record_faults(journal.events)
+        if profile is not None:
+            profile.finish()
+        evs.emit(
+            "run_complete", step=step, attempt=attempt,
+            wall_s=round(elapsed, 3),
+            steps=settings.steps - restart_step,
+        )
+        _refresh_device_gauges()
+        metrics.maybe_flush(force=True)
+        prom = os.environ.get("GS_METRICS_PROM")
+        if prom:
+            metrics.write_prometheus(prom)
+        if metrics.enabled:
+            stats.record_metrics(metrics.snapshot())
+        if tracer.enabled or evs.enabled or metrics.enabled:
+            stats.record_obs({
+                "trace": tracer.describe(),
+                "events": evs.describe(),
+                "metrics": metrics.describe(),
+            })
         stats.maybe_write()
         if settings.verbose:
             log.info(f"run stats: {stats.summary()}")
@@ -571,13 +713,23 @@ def _run_once_inner(
         stream.close()
         if ckpt is not None:
             ckpt.close()
-    except BaseException:
+    except BaseException as exc:
         # Failure path (async-writer re-raise, preemption, health trip,
         # injected kernel error, KeyboardInterrupt): the stores MUST
         # still be closed — an open store leaks file handles and, after
         # a rollback, leaves the sidecar marker pointing at steps that
         # were never committed. Best-effort: never mask the in-flight
         # exception with a secondary close error.
+        if profile is not None:
+            profile.finish()
+        if not isinstance(exc, GracefulShutdown):
+            # GracefulShutdown already journaled its own marker (which
+            # the stream mirrors); everything else gets the live error
+            # notice here. emit() is best-effort by contract.
+            evs.emit(
+                "run_error", step=step, attempt=attempt,
+                error=f"{type(exc).__name__}: {exc}",
+            )
         _close_quietly(stream)
         if ckpt is not None:
             _close_quietly(ckpt)

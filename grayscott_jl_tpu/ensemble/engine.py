@@ -323,6 +323,14 @@ class EnsembleSimulation(Simulation):
             )
         return parts
 
+    def metrics_labels(self) -> dict:
+        """Solo labels plus the member count: an 8-member batched
+        launch and a solo run of the same model/mesh must not share a
+        step-latency histogram — the batched step does N members of
+        work per sample (``obs/metrics.py``)."""
+        return {**super().metrics_labels(),
+                "members": str(self.n_members)}
+
     def get_fields(self):
         """Host ``(N, L, L, L)`` copies of the model's fields, storage
         pad stripped."""

@@ -127,10 +127,24 @@ class AsyncStepWriter:
     writes keep completing, and only a *stuck* write should trip the
     drain deadline. Exceptions from the callback are swallowed — a
     monitoring hook must never poison the store path.
+
+    ``metrics`` is an optional :class:`~..obs.metrics.MetricsRegistry`;
+    when given (and armed), the pipeline keeps a live
+    ``async_io_queue_depth`` gauge and an ``io_steps_written`` counter —
+    the queue-depth time series a stalled disk shows up in long before
+    the backpressure reaches the driver. Disabled metrics hand back the
+    shared null instrument, so the per-step cost is a no-op call.
     """
 
     def __init__(self, *, depth: Optional[int] = None, stats=None,
-                 progress=None):
+                 progress=None, metrics=None):
+        if metrics is None:
+            from ..obs.metrics import NULL_METRIC
+
+            self._m_depth = self._m_written = NULL_METRIC
+        else:
+            self._m_depth = metrics.gauge("async_io_queue_depth")
+            self._m_written = metrics.counter("io_steps_written")
         self.depth = resolve_depth(depth)
         self._stats = stats
         self._progress = progress
@@ -179,6 +193,8 @@ class AsyncStepWriter:
             fn(step, blocks)
             self._add_busy(phase, time.perf_counter() - t)
         self._written += 1
+        self._m_written.inc()
+        self._m_depth.set(self._q.qsize() if self._q is not None else 0)
         if self._progress is not None:
             try:
                 self._progress(step)
@@ -240,6 +256,7 @@ class AsyncStepWriter:
                     fn(step, blocks)
                 self._add_busy(phase, time.perf_counter() - t)
             self._written += 1
+            self._m_written.inc()
             self._accepted += 1
             return
         with contextlib.ExitStack() as st:
@@ -253,6 +270,7 @@ class AsyncStepWriter:
             self._submit_wait += time.perf_counter() - t
         self._accepted += 1
         self._queue_hwm = max(self._queue_hwm, self._q.qsize())
+        self._m_depth.set(self._q.qsize())
 
     def close(self) -> None:
         """Drain and stop the worker; re-raise a pending writer error.

@@ -1,0 +1,49 @@
+"""Unified observability: span tracing, metrics, and the run event stream.
+
+The five production subsystems (overlap, autotune, supervision,
+ensembles, multi-model) each grew their own evidence trail — ``RunStats``
+phase dicts, ``FaultJournal`` JSONL, watchdog stack dumps, bench rows —
+with no common timeline. This package is the one place they meet
+(docs/OBSERVABILITY.md):
+
+* :mod:`.trace` — nestable host-side spans exported as Chrome
+  trace-event JSON (``GS_TRACE=path``; opens in Perfetto), fed by the
+  driver's existing phase boundaries and the watchdog heartbeat (one
+  heartbeat = one span edge — tracing adds nothing new to the hot
+  path), plus ``GS_PROFILE=start:stop`` device-side ``jax.profiler``
+  capture windows.
+* :mod:`.metrics` — counters / gauges / ring-buffer histograms
+  (p50/p95/p99) flushed as interval JSONL (``GS_METRICS=path``,
+  ``metrics_interval_s`` TOML) with a one-shot Prometheus
+  text-exposition dump (``GS_METRICS_PROM=path``). Off means a shared
+  no-op object: zero allocations on the hot path.
+* :mod:`.events` — ONE schema ``(ts, proc, kind, phase, step, attrs)``
+  that fault-journal events, health reports, watchdog expiries,
+  supervisor restart decisions, autotune cache hits/misses, and
+  graceful-shutdown markers all route through (``GS_EVENTS=path``) —
+  tailable live from a single file.
+
+Hard contract (asserted in tier-1): obs on/off leaves trajectories
+bitwise identical — every hook here observes host-side control flow and
+never touches the jitted programs. All three modules are importable
+without JAX (the watchdog and ``bench.py``'s jax-free parent both hook
+in), resolve their output path from the environment exactly once
+(process-wide singletons, ``.rank<N>``-suffixed in multi-process runs),
+and degrade to no-ops when their knob is unset.
+"""
+
+from .events import EventStream, get_events, parse_events  # noqa: F401
+from .metrics import Histogram, MetricsRegistry, get_metrics  # noqa: F401
+from .trace import ProfileWindow, SpanTracer, get_tracer  # noqa: F401
+
+__all__ = [
+    "EventStream",
+    "Histogram",
+    "MetricsRegistry",
+    "ProfileWindow",
+    "SpanTracer",
+    "get_events",
+    "get_metrics",
+    "get_tracer",
+    "parse_events",
+]

@@ -1,0 +1,162 @@
+"""The unified run event stream: one schema, one tailable file.
+
+Every discrete thing that happens to a run — injected faults, health
+trips, watchdog expiries (stack dumps included), supervisor restart
+decisions, autotune cache hits/misses, graceful-shutdown markers,
+output/checkpoint boundaries — lands in ``GS_EVENTS=path`` as one JSONL
+record per event with a single schema::
+
+    {"ts": <unix seconds>, "proc": <rank>, "kind": <event kind>,
+     "phase": <driver phase or null>, "step": <sim step or null>,
+     "attrs": {...}}
+
+Producers route through here automatically: ``FaultJournal.record``
+(``resilience/supervisor.py``) mirrors every journal event, so the
+fault/recovery story that already merges into ``RunStats`` is *also*
+live-tailable (``tail -f``) while the run is still going — the journal
+stays the fsynced recovery breadcrumb; this stream is the operator's
+console. The driver adds run_start / output / checkpoint /
+run_complete lifecycle markers and the autotuner its decision
+(``tune/autotuner.py``).
+
+Contract: emitting is best-effort — a full disk under the event stream
+marks the stream broken and keeps the run alive (the journal, which IS
+allowed to fail loudly, still records). stdlib only; importable
+without JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .trace import _proc_index, rank_path
+
+__all__ = [
+    "EventStream",
+    "NULL_EVENTS",
+    "get_events",
+    "parse_events",
+    "reset_events",
+]
+
+#: The flat record fields; everything else an emitter passes rides in
+#: ``attrs`` so readers can rely on the top-level shape.
+EVENT_FIELDS = ("ts", "proc", "kind", "phase", "step", "attrs")
+
+
+class _NullEventStream:
+    """Shared no-op stream for when ``GS_EVENTS`` is unset."""
+
+    enabled = False
+    emitted = 0
+
+    def emit(self, kind, phase=None, step=None, **attrs):
+        return None
+
+    def describe(self) -> dict:
+        return {"enabled": False}
+
+
+NULL_EVENTS = _NullEventStream()
+
+
+class EventStream:
+    """Append-only JSONL event sink (one line per event, flushed so a
+    tail sees it immediately; durability is the FaultJournal's job)."""
+
+    enabled = True
+
+    def __init__(self, path: str, proc: Optional[int] = None):
+        self.path = path
+        self.proc = _proc_index() if proc is None else proc
+        self.emitted = 0
+        self.broken: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def emit(self, kind, phase=None, step=None, **attrs):
+        """Record one event; returns the record dict (or None once the
+        stream is broken). Thread-safe — called from the driver thread,
+        the async writer's worker, the watchdog monitor (via the
+        journal), and signal handlers."""
+        if self.broken is not None:
+            return None
+        event = {
+            "ts": round(time.time(), 6),
+            "proc": self.proc,
+            "kind": str(kind),
+            "phase": phase,
+            "step": step,
+            "attrs": attrs,
+        }
+        try:
+            line = json.dumps(event)
+        except (TypeError, ValueError):
+            # A non-JSON attr must not kill the producer: stringify.
+            event["attrs"] = {k: repr(v) for k, v in attrs.items()}
+            line = json.dumps(event)
+        try:
+            with self._lock:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                self.emitted += 1
+        except OSError as e:
+            # Monitoring must never take the run down: mark broken,
+            # warn once, keep going.
+            self.broken = f"{type(e).__name__}: {e}"
+            print(f"gray-scott: warning: event stream {self.path} "
+                  f"failed ({self.broken}); further events are dropped",
+                  file=sys.stderr)
+            return None
+        return event
+
+    def describe(self) -> dict:
+        return {"enabled": True, "path": self.path,
+                "emitted": self.emitted, "broken": self.broken}
+
+
+def parse_events(path: str) -> List[dict]:
+    """All events of a stream file, oldest first. Corrupt lines (a torn
+    tail from a killed process) are skipped, mirroring
+    ``supervisor.resume_marker`` — a live-tailed file must be readable
+    mid-write."""
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict):
+                out.append(ev)
+    return out
+
+
+_stream = None
+
+
+def get_events():
+    """The process-wide stream: an :class:`EventStream` when
+    ``GS_EVENTS`` names a path (``.rank<N>``-suffixed in multi-process
+    runs), else the shared no-op. Like the tracer, resolved once so
+    every attempt of a supervised run appends to the same file — the
+    single merged timeline is the point."""
+    global _stream
+    if _stream is None:
+        path = os.environ.get("GS_EVENTS", "").strip()
+        _stream = EventStream(rank_path(path)) if path else NULL_EVENTS
+    return _stream
+
+
+def reset_events() -> None:
+    """Drop the singleton (tests; re-resolves from env on next use)."""
+    global _stream
+    _stream = None

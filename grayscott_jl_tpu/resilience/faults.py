@@ -167,12 +167,21 @@ class ShutdownListener:
     listener must re-raise it as ``KeyboardInterrupt`` instead of
     swallowing it into a graceful request the wedged driver will never
     check.
+
+    ``on_request``: optional ``on_request(signum)`` callback fired once
+    when the first signal arrives — the driver points it at the unified
+    event stream (``obs/events.py``) so an operator tailing the run
+    sees the preemption notice the moment it lands, not at the next
+    boundary. Exceptions are swallowed: a monitoring hook inside a
+    signal handler must never turn a graceful request into a crash.
     """
 
-    def __init__(self, *, enabled: bool = True, watchdog=None):
+    def __init__(self, *, enabled: bool = True, watchdog=None,
+                 on_request=None):
         self.enabled = enabled
         self.signum: Optional[int] = None
         self._watchdog = watchdog
+        self._on_request = on_request
         self._prev: dict = {}
 
     @property
@@ -186,6 +195,11 @@ class ShutdownListener:
             )
         if self.signum is None:
             self.signum = signum
+            if self._on_request is not None:
+                try:
+                    self._on_request(signum)
+                except Exception:  # noqa: BLE001 — monitoring hook
+                    pass
         else:
             raise KeyboardInterrupt(
                 f"second signal {signum} during graceful shutdown"

@@ -251,20 +251,49 @@ class HealthGuard:
     def enabled(self) -> bool:
         return self.policy != "off"
 
+    @staticmethod
+    def record_metrics(report, metrics) -> None:
+        """Mirror one boundary's probe into the metrics registry
+        (``obs/metrics.py``): per-field min/max gauges, the aggregate
+        finite flag, and — for ensembles — per-member health so a
+        scraper can alert on one diverging member of a sweep. No-op
+        cost when metrics are off (the registry hands out the shared
+        null instrument)."""
+        if metrics is None or report is None:
+            return
+        metrics.gauge("field_finite").set(int(report.finite))
+        for name, (lo, hi) in zip(report.names, report.ranges):
+            metrics.gauge("field_min", field=name).set(lo)
+            metrics.gauge("field_max", field=name).set(hi)
+        members = getattr(report, "members", None)
+        if members is not None:
+            bad = report.bad_members
+            metrics.gauge("ensemble_members_bad").set(len(bad))
+            for i, m in enumerate(members):
+                metrics.gauge(
+                    "ensemble_member_finite", member=str(i)
+                ).set(int(m.finite))
+
     def check(
-        self, step: int, report, *, log=None
+        self, step: int, report, *, log=None, metrics=None
     ) -> Optional[dict]:
         """Enforce the policy on one boundary's report (a
         :class:`HealthReport` or, for ensembles, an
         :class:`EnsembleHealthReport` — whose ``describe()`` carries
         the non-finite member indices into the journal event).
+        ``metrics`` (a :class:`~..obs.metrics.MetricsRegistry`)
+        additionally mirrors every probe — healthy ones included —
+        into the field-range gauges.
 
         Healthy (or disabled) returns None. Unhealthy: ``warn`` logs
         and returns a journal-able event dict; ``abort``/``rollback``
         raise :class:`HealthError` (the supervisor maps the policy to
         its recovery action).
         """
-        if not self.enabled or report is None or report.finite:
+        if not self.enabled or report is None:
+            return None
+        self.record_metrics(report, metrics)
+        if report.finite:
             return None
         if self.policy == "warn":
             event = {
@@ -276,8 +305,8 @@ class HealthGuard:
                 **report.describe(),
             }
             if log is not None:
-                log.info(
-                    f"WARNING: field health check failed at step {step} "
+                log.warn(
+                    f"field health check failed at step {step} "
                     f"(non-finite values); policy=warn, continuing"
                 )
             return event

@@ -199,7 +199,33 @@ class FaultJournal:
                     f.write(json.dumps(event) + "\n")
                     f.flush()
                     os.fsync(f.fileno())
+        self._mirror_to_stream(event)
         return event
+
+    @staticmethod
+    def _mirror_to_stream(event: dict) -> None:
+        """Route the journal event into the unified run event stream
+        (``obs/events.py``, ``GS_EVENTS``): the journal's ``event``
+        name becomes the stream ``kind``, the failure-taxonomy ``kind``
+        rides in attrs as ``fault`` — so injected faults, health trips,
+        watchdog expiries (stack dumps included), restart decisions,
+        and shutdown markers are all tailable live from one file. The
+        stream is best-effort by contract; the fsynced journal above
+        stays the durable record."""
+        from ..obs import events as obs_events
+
+        stream = obs_events.get_events()
+        if not stream.enabled:
+            return
+        attrs = dict(event)
+        kind = attrs.pop("event", None) or attrs.pop("kind", "event")
+        fault = attrs.pop("kind", None)
+        if fault is not None:
+            attrs["fault"] = fault
+        attrs.pop("t", None)
+        attrs.pop("proc", None)
+        stream.emit(kind, phase=attrs.pop("phase", None),
+                    step=attrs.pop("step", None), **attrs)
 
 
 def resume_marker(path: Optional[str]) -> Optional[dict]:
@@ -241,6 +267,12 @@ class SupervisorContext:
     attempt: int = 0
     #: kernel_selection provenance patch after a Pallas->XLA degrade.
     degraded: Optional[dict] = None
+    #: The attempt's live RunStats (set by the driver once built): a
+    #: failing attempt's phase timings would otherwise die with the
+    #: attempt — the supervisor journals them as an ``attempt_phases``
+    #: event, so the completing attempt's ``faults`` section attributes
+    #: wall time per restart attempt (``scripts/gs_report.py``).
+    stats: Optional[object] = None
 
 
 #: Message fragments that identify a kernel-runtime failure raised by
@@ -422,6 +454,19 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
                 # launch auto-resumes from it (resume_marker above).
                 raise
             kind = classify_failure(exc)
+            # The failed attempt's phase accumulation, tagged by
+            # attempt: RunStats dies with the attempt, the journal (and
+            # so the final stats' faults section) keeps the per-attempt
+            # wall-time attribution gs_report.py renders.
+            if ctx.stats is not None and ctx.stats.phases:
+                journal.record(
+                    event="attempt_phases",
+                    attempt=attempt,
+                    kind=kind or "fatal",
+                    phases_s={k: round(v, 6)
+                              for k, v in ctx.stats.phases.items()},
+                    steps=ctx.stats.counters.get("steps", 0),
+                )
             if kind is None:
                 journal.record(
                     event="gave_up",
@@ -476,6 +521,9 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
 
             _apply_resume(settings, resume, actions)
 
+            from ..obs import metrics as obs_metrics
+
+            obs_metrics.get_metrics().counter("restarts", kind=kind).inc()
             delay = restart_backoff(attempt, kind)
             journal.record(
                 event="recovery",

@@ -184,6 +184,7 @@ class Watchdog:
         journal=None,
         grace_s: Optional[float] = None,
         on_expire=None,
+        tracer=None,
     ):
         self.deadlines = dict(deadlines or DEFAULT_DEADLINES)
         if not self.deadlines:
@@ -194,6 +195,13 @@ class Watchdog:
                     f"watchdog deadline for {phase!r} must be > 0, got {d}"
                 )
         self.journal = journal
+        #: Span tracer fed one edge per heartbeat (``obs/trace.py``):
+        #: the heartbeat already marks every phase transition, so the
+        #: top-level phase timeline of the Chrome trace costs nothing
+        #: the watchdog wasn't paying. None = resolve the process-wide
+        #: tracer lazily at the first heartbeat (obs is stdlib-only, so
+        #: the no-jax-in-bench-parent rule holds).
+        self._tracer = tracer
         if grace_s is None:
             raw = os.environ.get("GS_WATCHDOG_GRACE_S")
             if raw is None or raw.strip() == "":
@@ -264,7 +272,14 @@ class Watchdog:
     def heartbeat(self, phase: str, step: Optional[int] = None) -> None:
         """Arm ``phase``'s deadline from now (any previously armed phase
         is replaced). Unknown phases get the tightest configured
-        deadline — better a premature trip than an unwatched phase."""
+        deadline — better a premature trip than an unwatched phase.
+        One heartbeat = one span edge in the trace (``obs/trace.py``)."""
+        tr = self._tracer
+        if tr is None:
+            from ..obs.trace import get_tracer
+
+            tr = self._tracer = get_tracer()
+        tr.edge(phase, step)
         deadline = self.deadlines.get(phase)
         if deadline is None:
             deadline = min(self.deadlines.values())
@@ -341,6 +356,13 @@ class Watchdog:
             # Journal + interrupt outside the lock: record() takes its
             # own lock and fsyncs; interrupt_main must never deadlock
             # against a heartbeat.
+            if self._tracer is not None:
+                # Expiry implies an armed phase, which implies at least
+                # one heartbeat resolved the tracer.
+                self._tracer.instant(
+                    "watchdog_expired", step=step, phase=phase,
+                    deadline_s=deadline,
+                )
             if self.journal is not None:
                 try:
                     self.journal.record(**event)

@@ -1397,6 +1397,39 @@ class Simulation:
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.fields)
 
+    def metrics_labels(self) -> dict:
+        """The label set every metric of this run carries
+        (``obs/metrics.py``): model / mesh / resolved kernel, so one
+        scrape endpoint distinguishes runs sharing a host. The ensemble
+        engine extends it with the member count."""
+        return {
+            "model": self.model.name,
+            "mesh": "x".join(str(d) for d in self.domain.dims),
+            "kernel": self.kernel_language,
+        }
+
+    def device_memory_stats(self) -> list:
+        """Per-local-device allocator stats for the metrics registry
+        (``obs/metrics.py``): HBM in use / peak per device, the number
+        an operator watches for creeping fragmentation on a week-long
+        campaign. Backends without ``memory_stats`` (CPU) contribute
+        nothing — the list is empty there, and callers treat that as
+        "no data", not zero."""
+        out = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 — optional PJRT surface
+                ms = None
+            if not ms:
+                continue
+            out.append({
+                "device": f"{d.platform}:{d.id}",
+                "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
+            })
+        return out
+
 
 def initialization(
     args, *, n_devices: Optional[int] = None, seed: int = 0

@@ -81,18 +81,40 @@ class TuneDecision:
     member_shards: Optional[int] = None
 
 
+def _emit_event(prov: dict, kernel: str) -> None:
+    """Route the tuning decision into the unified run event stream
+    (``obs/events.py``, ``GS_EVENTS``): cache hits/misses and
+    measured-vs-analytic outcomes land on the same live timeline as
+    faults and restarts — tuning happens inside the ``compile`` phase,
+    which is exactly when an operator wonders what the run is doing."""
+    from ..obs import events as obs_events
+
+    stream = obs_events.get_events()
+    if not stream.enabled:
+        return
+    stream.emit(
+        "autotune", phase="compile",
+        mode=prov.get("mode"), source=prov.get("source"),
+        cache=prov.get("cache"), kernel=kernel,
+        candidates_timed=prov.get("candidates_timed"),
+        tuning_s=prov.get("tuning_s"),
+    )
+
+
 def _analytic_decision(mode: str, analytic_kernel: str,
                        extra: Optional[dict] = None) -> TuneDecision:
     prov = {"mode": mode, "source": "analytic", "cache": None,
             "candidates_timed": 0, "tuning_s": 0.0}
     if extra:
         prov.update(extra)
+    _emit_event(prov, analytic_kernel)
     return TuneDecision(kernel=analytic_kernel, fuse=None,
                         comm_overlap=None, bx=None, provenance=prov)
 
 
 def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
     ms = winner.get("member_shards")
+    _emit_event(prov, winner["kernel"])
     return TuneDecision(
         kernel=winner["kernel"],
         fuse=int(winner["fuse"]),

@@ -102,6 +102,8 @@ def time_sim_rounds(
 
     import jax.numpy as jnp
 
+    from ..obs.metrics import Histogram
+
     def sync() -> float:
         # block_until_ready does not reliably block under the axon TPU
         # tunnel; a dependent scalar readback forces real completion.
@@ -125,10 +127,20 @@ def time_sim_rounds(
         sim.iterate(steps)
         sync()
         per_round.append((time.perf_counter() - t0) / steps)
+    # Step-latency distribution through the obs histogram (the same
+    # percentile math the driver's step_latency_us metric reports), so
+    # artifact rows carry the tail — the clock-throttle spread above —
+    # not just best/median/mean.
+    h = Histogram("round_s_per_step", capacity=max(len(per_round), 1))
+    for s in per_round:
+        h.observe(s)
     out: Dict[str, object] = {
         "rounds_s_per_step": per_round,
         "best": min(per_round),
         "median": statistics.median(per_round),
+        "p50": h.percentile(50),
+        "p95": h.percentile(95),
+        "p99": h.percentile(99),
     }
     if sustain_seconds > 0:
         t0 = time.perf_counter()
@@ -196,6 +208,11 @@ def bench_one(
         ],
         "median_us_per_step": round(t["median"] * 1e6, 1),
         "median_cell_updates_per_s": round(L**3 / t["median"], 1),
+        # Step-latency percentiles over the chronological rounds (obs
+        # histogram; see time_sim_rounds) — the tail a mean hides.
+        "p50_us_per_step": round(t["p50"] * 1e6, 1),
+        "p95_us_per_step": round(t["p95"] * 1e6, 1),
+        "p99_us_per_step": round(t["p99"] * 1e6, 1),
         # Comm-exposure accounting (RunStats `comm` mirror): zero for
         # this single-device measurement, but carried so BENCH_r*
         # artifacts keep a uniform schema with sharded runs.

@@ -1,32 +1,103 @@
 """Minimal structured logging for the driver.
 
 The reference gates rank-0 ``println`` on ``settings.verbose``
-(``src/GrayScott.jl:88-91``); here only JAX process 0 logs, so multi-host
-runs keep single-writer output.
+(``src/GrayScott.jl:88-91``); here only JAX process 0 logs ``info``, so
+multi-host runs keep single-writer output. ``warn`` prints on every
+rank regardless of ``verbose`` — a health trip on rank 3 must not be
+invisible just because rank 3 is quiet.
+
+``GS_LOG_FORMAT=json`` switches every line to one JSON object
+(``{"ts", "t_rel_s", "level", "proc", "msg"}``) for log aggregators;
+the default ``text`` keeps the historical ``[gray-scott +N.NNNs]``
+prefix. The process-index lookup is resolved once and cached (it is
+stable after ``jax.distributed`` init) instead of re-importing jax on
+every log call.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import time
+from typing import Optional
+
+#: Cached "is this process rank 0" answer. Before JAX initializes the
+#: answer could change (a later ``jax.distributed.initialize`` assigns
+#: ranks), so the pre-init True is NOT cached — only a successful
+#: ``jax.process_index()`` result is.
+_primary: Optional[bool] = None
 
 
 def _is_primary() -> bool:
-    try:
-        import jax
+    global _primary
+    if _primary is None:
+        try:
+            import jax
 
-        return jax.process_index() == 0
-    except Exception:  # pragma: no cover — before/without jax init
-        return True
+            _primary = jax.process_index() == 0
+        except Exception:  # pragma: no cover — before/without jax init
+            return True
+    return _primary
+
+
+def _proc_index() -> int:
+    """Rank for the JSON records; 0 before/without jax (never forces a
+    backend init — mirrors ``FaultJournal.from_env``)."""
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:  # noqa: BLE001
+            return 0
+    return 0
+
+
+LOG_FORMATS = ("text", "json")
 
 
 class Logger:
-    def __init__(self, verbose: bool = False, stream=None):
+    def __init__(self, verbose: bool = False, stream=None,
+                 fmt: Optional[str] = None):
         self.verbose = verbose
         self.stream = stream or sys.stdout
+        if fmt is None:
+            fmt = os.environ.get("GS_LOG_FORMAT", "text")
+        fmt = (fmt or "text").strip().lower()
+        if fmt not in LOG_FORMATS:
+            raise ValueError(
+                f"GS_LOG_FORMAT must be one of "
+                f"{'|'.join(LOG_FORMATS)}, got {fmt!r}"
+            )
+        self.fmt = fmt
         self._t0 = time.perf_counter()
+
+    def _emit(self, level: str, msg: str) -> None:
+        dt = time.perf_counter() - self._t0
+        if self.fmt == "json":
+            print(
+                json.dumps({
+                    "ts": round(time.time(), 3),
+                    "t_rel_s": round(dt, 3),
+                    "level": level,
+                    "proc": _proc_index(),
+                    "msg": msg,
+                }),
+                file=self.stream, flush=True,
+            )
+        else:
+            tag = "" if level == "info" else f" {level.upper()}:"
+            print(f"[gray-scott +{dt:9.3f}s]{tag} {msg}",
+                  file=self.stream, flush=True)
 
     def info(self, msg: str) -> None:
         if self.verbose and _is_primary():
-            dt = time.perf_counter() - self._t0
-            print(f"[gray-scott +{dt:9.3f}s] {msg}", file=self.stream, flush=True)
+            self._emit("info", msg)
+
+    def warn(self, msg: str) -> None:
+        """Always printed — warnings ignore ``verbose`` and the
+        primary-rank gate (attribution rides in the JSON ``proc``
+        field; in text mode duplicates across ranks are the cost of
+        never losing one)."""
+        self._emit("warn", msg)

@@ -31,8 +31,14 @@ class RunStats:
     device traces for per-op attribution.
     """
 
-    def __init__(self, L: int, config: Optional[dict] = None):
+    def __init__(self, L: int, config: Optional[dict] = None,
+                 tracer=None):
         self.L = L
+        #: Span tracer (``obs/trace.py``): every :meth:`phase` context
+        #: doubles as a trace span, so the timings RunStats was already
+        #: measuring appear on the Chrome-trace timeline for free. None
+        #: (or the null tracer) keeps the historical zero-cost path.
+        self.tracer = tracer
         #: Static run configuration echoed into the summary (mesh dims,
         #: kernel language, chain depth, ...) so a pod operator can
         #: correlate a stats file with the layout that produced it
@@ -63,6 +69,15 @@ class RunStats:
         #: ``io`` overlap section (how much ICI time the split-phase
         #: exchange hides behind interior compute).
         self.comm: Optional[dict] = None
+        #: Metrics snapshot (``obs/metrics.py``): the registered
+        #: counters/gauges/histograms at run end — step-latency
+        #: percentiles, queue depths, restart counts — so the stats
+        #: file carries the same numbers a scraper would have seen.
+        self.metrics: Optional[dict] = None
+        #: Observability provenance (``obs/``): which sinks were armed
+        #: (trace path + event/span counts, event-stream path, metrics
+        #: path/interval) — a stats reader can find the companion files.
+        self.obs: Optional[dict] = None
         #: Per-member ensemble section (``ensemble/``, docs/ENSEMBLE.md):
         #: member params + seeds, the member-axis mesh split, and the
         #: latest per-member health probe — one stats file tells which
@@ -73,7 +88,18 @@ class RunStats:
         self._t0 = time.perf_counter()
 
     @contextlib.contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, step: Optional[int] = None):
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            with tr.span(name, phase=name, step=step):
+                t = time.perf_counter()
+                try:
+                    yield
+                finally:
+                    self.phases[name] = self.phases.get(name, 0.0) + (
+                        time.perf_counter() - t
+                    )
+            return
         t = time.perf_counter()
         try:
             yield
@@ -104,6 +130,16 @@ class RunStats:
         """Attach the halo-exchange budget
         (``parallel/icimodel.comm_report``) to the summary."""
         self.comm = dict(report) if report else None
+
+    def record_metrics(self, snapshot: Optional[dict]) -> None:
+        """Attach the end-of-run metrics snapshot
+        (``MetricsRegistry.snapshot()``) to the summary."""
+        self.metrics = dict(snapshot) if snapshot else None
+
+    def record_obs(self, info: Optional[dict]) -> None:
+        """Attach the observability-sink provenance (trace / events /
+        metrics ``describe()`` dicts) to the summary."""
+        self.obs = dict(info) if info else None
 
     def record_ensemble(self, info: Optional[dict]) -> None:
         """Attach the per-member ensemble section
@@ -142,6 +178,8 @@ class RunStats:
             "comm": self.comm,
             "watchdog": self.watchdog,
             "faults": self.faults,
+            "metrics": self.metrics,
+            "obs": self.obs,
             "ensemble": self.ensemble,
             "counters": dict(self.counters),
             # Aggregate across ensemble members (members == 1 solo).

@@ -3,7 +3,11 @@
 # solo reference run, 4 against an uninterrupted ensemble run):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
-#      restart -> all stores byte-identical;
+#      restart -> all stores byte-identical; runs with full
+#      observability armed (GS_TRACE/GS_EVENTS/GS_METRICS) so the
+#      byte-identity assertion doubles as the obs-transparency
+#      contract, then greps the event stream for the injected fault
+#      kind and validates the artifacts with gs_report.py --check;
 #   2. injected driver hang at a pseudo-random step -> watchdog trips
 #      (stack dump in the journal) -> supervised restart -> all stores
 #      byte-identical;
@@ -90,12 +94,18 @@ for d in full sup hang term; do write_config "$WORK/$d"; done
 echo "chaos_smoke: uninterrupted reference run..."
 run "$WORK/full" > "$WORK/full.log" 2>&1
 
-echo "chaos_smoke: [1/3] supervised run with injected preemption..."
+echo "chaos_smoke: [1/3] supervised run with injected preemption (obs armed)..."
+# Full observability rides along (docs/OBSERVABILITY.md): the store
+# byte-identity assertion below doubles as the obs-on/off bitwise
+# contract, and the artifacts are schema-validated afterwards.
 run "$WORK/sup" \
   GS_SUPERVISE=1 \
   GS_MAX_RESTARTS=5 \
   GS_RESTART_BACKOFF_S=0.05 \
   GS_FAULTS="step=${PREEMPT}:kind=preempt" \
+  GS_TRACE="$WORK/sup/trace.json" \
+  GS_EVENTS="$WORK/sup/events.jsonl" \
+  GS_METRICS="$WORK/sup/metrics.jsonl" \
   > "$WORK/sup.log" 2>&1
 
 grep -a "supervisor:" "$WORK/sup.log" > /dev/null || {
@@ -103,6 +113,24 @@ grep -a "supervisor:" "$WORK/sup.log" > /dev/null || {
   exit 1
 }
 assert_stores "$WORK/sup" gs.bp gs.vtk ckpt.bp
+
+# The unified event stream must carry the injected fault kind AND its
+# recovery on one timeline, and the trace/events files must validate
+# against the Chrome-trace / event schemas (gs_report.py --check).
+grep -aq '"fault": "preempt"' "$WORK/sup/events.jsonl" || {
+  echo "chaos_smoke: FAIL — injected preempt missing from the event stream" >&2
+  exit 1
+}
+grep -aq '"kind": "recovery"' "$WORK/sup/events.jsonl" || {
+  echo "chaos_smoke: FAIL — recovery decision missing from the event stream" >&2
+  exit 1
+}
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --trace "$WORK/sup/trace.json" --events "$WORK/sup/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the obs artifacts" >&2
+  exit 1
+}
 
 echo "chaos_smoke: [2/3] supervised run with injected hang (watchdog)..."
 run "$WORK/hang" \

@@ -1,0 +1,228 @@
+#!/usr/bin/env python3
+"""Human run report from the observability artifacts.
+
+Renders the three obs outputs (docs/OBSERVABILITY.md) — the
+``GS_TPU_STATS`` summary JSON, the ``GS_TRACE`` Chrome trace, and the
+``GS_EVENTS`` unified stream — into one operator-facing story: where
+the wall time went, the slowest step rounds, how much I/O and comm was
+exposed vs hidden, the step-latency percentiles, and the fault /
+restart timeline with per-attempt wall-time attribution.
+
+    python scripts/gs_report.py --stats stats.json --trace trace.json \
+        --events events.jsonl [--top 5]
+
+    # CI validation mode: schema-check the artifacts, render nothing
+    python scripts/gs_report.py --check --trace trace.json \
+        --events events.jsonl
+
+Runs without JAX (stdlib + the jax-free ``grayscott_jl_tpu.obs``
+helpers only) so it works on a laptop holding artifacts scp'd off a
+pod. Exit code: 0 on success, 1 when ``--check`` finds a problem or a
+requested artifact is unreadable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from grayscott_jl_tpu.obs.events import parse_events  # noqa: E402
+from grayscott_jl_tpu.obs.trace import validate_trace  # noqa: E402
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def check(trace_path, events_path, stats_path) -> int:
+    """Schema validation (the chaos_smoke / CI entry): returns the
+    process exit code."""
+    problems = []
+    if trace_path:
+        try:
+            with open(trace_path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            problems.append(f"trace {trace_path}: unreadable ({e})")
+        else:
+            for p in validate_trace(doc):
+                problems.append(f"trace {trace_path}: {p}")
+            n = sum(1 for e in doc.get("traceEvents", [])
+                    if isinstance(e, dict) and e.get("ph") == "X")
+            if n == 0:
+                problems.append(f"trace {trace_path}: no spans")
+    if events_path:
+        try:
+            events = parse_events(events_path)
+        except OSError as e:
+            problems.append(f"events {events_path}: unreadable ({e})")
+        else:
+            if not events:
+                problems.append(f"events {events_path}: no events")
+            for i, e in enumerate(events):
+                missing = [k for k in ("ts", "kind") if k not in e]
+                if missing:
+                    problems.append(
+                        f"events {events_path}: record {i} missing "
+                        f"{missing}"
+                    )
+    if stats_path:
+        try:
+            with open(stats_path, encoding="utf-8") as f:
+                json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            problems.append(f"stats {stats_path}: unreadable ({e})")
+    for p in problems:
+        print(f"gs_report: FAIL — {p}", file=sys.stderr)
+    if not problems:
+        print("gs_report: OK — artifacts validate")
+    return 1 if problems else 0
+
+
+def report_stats(stats: dict) -> None:
+    cfg = stats.get("config", {})
+    print("== run ==")
+    print(f"  model={cfg.get('model')} L={stats.get('L')} "
+          f"mesh={cfg.get('mesh_dims')} kernel="
+          f"{cfg.get('kernel_language')} devices="
+          f"{cfg.get('n_devices')} attempt={cfg.get('attempt', 0)}")
+    print(f"  steps={stats.get('steps')} wall={_fmt_s(stats.get('wall_s'))} "
+          f"cell-updates/s={stats.get('cell_updates_per_s')}")
+    phases = stats.get("phases_s") or {}
+    total = sum(phases.values()) or 1.0
+    print("== phases ==")
+    for name, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<16} {v:10.3f}s  {100 * v / total:5.1f}%")
+    io = stats.get("io")
+    if io:
+        hidden = sum((io.get("hidden_s") or {}).values())
+        exposed = sum((io.get("exposed_s") or {}).values())
+        busy = hidden + exposed
+        frac = exposed / busy if busy > 0 else 0.0
+        print("== i/o overlap ==")
+        print(f"  busy={busy:.3f}s hidden={hidden:.3f}s "
+              f"exposed={exposed:.3f}s ({100 * frac:.1f}% exposed), "
+              f"queue hwm={io.get('queue_depth_hwm')}")
+    comm = stats.get("comm")
+    if comm and comm.get("comm_us_per_step"):
+        print("== comm (model projection) ==")
+        print(f"  {comm.get('comm_us_per_step')}us/step, hidden="
+              f"{comm.get('hidden_us')}us exposed="
+              f"{comm.get('exposed_us')}us "
+              f"(overlap={comm.get('overlap')})")
+    metrics = stats.get("metrics")
+    if metrics:
+        for h in metrics.get("histograms", []):
+            if h.get("name") == "step_latency_us":
+                print("== step latency (per fused round) ==")
+                print(f"  p50={h.get('p50')}us p95={h.get('p95')}us "
+                      f"p99={h.get('p99')}us mean={h.get('mean')}us "
+                      f"over {h.get('count')} rounds")
+
+
+def report_attempts(events) -> None:
+    """Per-attempt wall-time attribution from ``attempt_phases``
+    journal events (stats ``faults`` section or the event stream)."""
+    rows = [e for e in events if e.get("kind") == "attempt_phases"
+            or e.get("event") == "attempt_phases"]
+    if not rows:
+        return
+    print("== attempts ==")
+    for e in rows:
+        attrs = e.get("attrs", e)
+        phases = attrs.get("phases_s") or {}
+        print(f"  attempt {attrs.get('attempt')}: "
+              f"ended as {attrs.get('fault', attrs.get('kind'))} after "
+              f"{attrs.get('steps')} steps, "
+              f"compute={_fmt_s(phases.get('compute'))}")
+
+
+def report_timeline(events, top: int) -> None:
+    """The fault/recovery story, oldest first, with relative times."""
+    interesting = [e for e in events if e.get("kind") not in
+                   ("output", "checkpoint")]
+    if not interesting:
+        return
+    t0 = interesting[0].get("ts") or 0
+    print("== timeline ==")
+    for e in interesting:
+        attrs = e.get("attrs") or {}
+        extra = ""
+        if attrs.get("fault"):
+            extra += f" fault={attrs['fault']}"
+        if attrs.get("action"):
+            extra += f" action={attrs['action']}"
+        if attrs.get("error"):
+            extra += f" error={attrs['error']}"
+        if attrs.get("cache"):
+            extra += f" cache={attrs['cache']}"
+        step = e.get("step")
+        print(f"  +{(e.get('ts') or t0) - t0:8.3f}s  "
+              f"{e.get('kind', '?'):<20} "
+              f"{'step ' + str(step) if step is not None else '':<10}"
+              f"{extra}")
+
+
+def report_slow_rounds(doc: dict, top: int) -> None:
+    spans = [e for e in doc.get("traceEvents", [])
+             if isinstance(e, dict) and e.get("ph") == "X"
+             and e.get("name") in ("step_round", "compute", "compile")]
+    if not spans:
+        return
+    spans.sort(key=lambda e: -e["dur"])
+    print(f"== slowest rounds (top {top}) ==")
+    for e in spans[:top]:
+        step = (e.get("args") or {}).get("step")
+        print(f"  {e['name']:<12} step={step!s:<8} "
+              f"{e['dur'] / 1e3:10.3f}ms at t+{e['ts'] / 1e6:.3f}s")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="render gray-scott observability artifacts"
+    )
+    ap.add_argument("--stats", help="GS_TPU_STATS summary JSON")
+    ap.add_argument("--trace", help="GS_TRACE Chrome trace JSON")
+    ap.add_argument("--events", help="GS_EVENTS unified stream JSONL")
+    ap.add_argument("--check", action="store_true",
+                    help="validate schemas only; no report")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest rounds to list (default 5)")
+    args = ap.parse_args()
+    if not (args.stats or args.trace or args.events):
+        ap.error("need at least one of --stats / --trace / --events")
+    if args.check:
+        return check(args.trace, args.events, args.stats)
+
+    stats = None
+    if args.stats:
+        with open(args.stats, encoding="utf-8") as f:
+            stats = json.load(f)
+        report_stats(stats)
+    if args.trace:
+        with open(args.trace, encoding="utf-8") as f:
+            doc = json.load(f)
+        problems = validate_trace(doc)
+        if problems:
+            print(f"gs_report: warning — trace has "
+                  f"{len(problems)} schema problem(s)", file=sys.stderr)
+        report_slow_rounds(doc, args.top)
+    events = []
+    if args.events:
+        events = parse_events(args.events)
+    elif stats and stats.get("faults"):
+        events = stats["faults"]
+    if events:
+        report_attempts(events)
+        report_timeline(events, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

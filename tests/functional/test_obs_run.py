@@ -1,0 +1,225 @@
+"""Functional: unified observability end to end (``obs/``).
+
+The two hard contracts from docs/OBSERVABILITY.md, both tier-1:
+
+* **transparency** — a run with every sink armed (trace + events +
+  metrics + JSON logs) writes stores bitwise identical to an
+  unobserved run: obs hooks watch host-side control flow and never
+  touch the jitted programs;
+* **coverage** — a supervised multi-restart chaos run produces ONE
+  schema-valid Chrome trace covering the
+  compile/step_round/io/checkpoint/drain driver phases and ONE merged
+  event stream containing both the injected fault and the supervisor's
+  recovery, validated by ``scripts/gs_report.py --check`` exactly as
+  CI's chaos_smoke does.
+
+The ``-m slow`` overhead guard bounds the cost of the whole apparatus:
+the obs-on step loop stays within 3% of obs-off on the CPU host.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import run_cli, write_config
+
+from grayscott_jl_tpu.obs.events import parse_events
+from grayscott_jl_tpu.obs.trace import validate_trace
+
+REPO = Path(__file__).resolve().parents[2]
+
+STEPS = 60
+
+OBS_ENV_KEYS = ("GS_TRACE", "GS_EVENTS", "GS_METRICS", "GS_METRICS_PROM",
+                "GS_LOG_FORMAT")
+
+
+def _obs_env(d):
+    return {
+        "GS_TRACE": str(d / "trace.json"),
+        "GS_EVENTS": str(d / "events.jsonl"),
+        "GS_METRICS": str(d / "metrics.jsonl"),
+        "GS_METRICS_PROM": str(d / "prom.txt"),
+        "GS_TPU_STATS": str(d / "stats.json"),
+    }
+
+
+def _run(tmp_path, name, extra_env=None, **config_kw):
+    d = tmp_path / name
+    d.mkdir()
+    kw = dict(noise=0.1, steps=STEPS, output="gs.bp",
+              checkpoint="true", checkpoint_freq=20)
+    kw.update(config_kw)
+    cfg = write_config(d, **kw)
+    res = run_cli(d, cfg, extra_env=extra_env)
+    return d, res
+
+
+def test_stores_bitwise_identical_with_full_obs(tmp_path):
+    """The transparency contract: GS_TRACE + GS_METRICS + GS_EVENTS +
+    JSON logs on vs everything off — byte-identical stores."""
+    off, res_off = _run(tmp_path, "off")
+    assert res_off.returncode == 0, res_off.stderr + res_off.stdout
+
+    on_dir = tmp_path / "on"
+    on_dir.mkdir()
+    cfg = write_config(on_dir, noise=0.1, steps=STEPS, output="gs.bp",
+                       checkpoint="true", checkpoint_freq=20)
+    env = {**_obs_env(on_dir), "GS_LOG_FORMAT": "json",
+           "GS_METRICS_INTERVAL_S": "0.05"}
+    res_on = run_cli(on_dir, cfg, extra_env=env)
+    assert res_on.returncode == 0, res_on.stderr + res_on.stdout
+
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(off / store, on_dir / store)
+
+    # every sink actually produced its artifact
+    for f in ("trace.json", "events.jsonl", "metrics.jsonl", "prom.txt",
+              "stats.json"):
+        assert (on_dir / f).exists(), f
+
+    # JSON log mode: every stdout line parses
+    for line in res_on.stdout.strip().splitlines():
+        rec = json.loads(line)
+        assert {"ts", "level", "proc", "msg"} <= set(rec)
+
+    # interval flushing produced >= 2 records (0.05s over a multi-second
+    # run) and the prometheus dump carries the step histogram
+    records = [json.loads(ln) for ln in
+               (on_dir / "metrics.jsonl").read_text().splitlines()]
+    assert len(records) >= 2
+    assert "step_latency_us" in (on_dir / "prom.txt").read_text()
+
+
+def test_supervised_chaos_run_single_merged_timeline(tmp_path):
+    """The acceptance scenario: a supervised run eating a preemption
+    AND a hang restarts twice; the single trace file validates against
+    the Chrome schema with all five driver phases covered, and the
+    single event stream tells the whole fault+recovery story."""
+    d = tmp_path / "chaos"
+    d.mkdir()
+    cfg = write_config(d, noise=0.1, steps=STEPS, output="gs.bp",
+                       checkpoint="true", checkpoint_freq=20)
+    env = {
+        **_obs_env(d),
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": "step=25:kind=preempt;step=45:kind=hang",
+        "GS_WATCHDOG": "on",
+        "GS_WATCHDOG_STEP_ROUND_S": "3",
+        "GS_HANG_BOUND_S": "40",
+    }
+    res = run_cli(d, cfg, extra_env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    # -- trace: valid, one file, all driver phases present
+    doc = json.loads((d / "trace.json").read_text())
+    assert validate_trace(doc) == []
+    spans = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"compile", "step_round", "io", "checkpoint",
+            "drain"} <= spans, spans
+    # the nested RunStats spans ride along on their own tracks
+    assert {"compute", "device_to_host"} <= spans
+    # the watchdog expiry left its instant marker
+    assert any(e["ph"] == "i" and e["name"] == "watchdog_expired"
+               for e in doc["traceEvents"])
+
+    # -- events: ONE stream holds both faults and both recoveries
+    events = parse_events(str(d / "events.jsonl"))
+    kinds = [e["kind"] for e in events]
+    injected = [e["attrs"]["fault"] for e in events
+                if e["kind"] == "injected"]
+    assert set(injected) == {"preempt", "hang"}
+    recovered = [e["attrs"]["fault"] for e in events
+                 if e["kind"] == "recovery"]
+    assert recovered == ["preemption", "hang"]
+    assert kinds.count("run_start") == 3  # original + two restarts
+    assert "hang" in kinds        # the watchdog's stack-dump event
+    assert "run_complete" in kinds
+    # per-attempt phase attribution for gs_report
+    attempts = [e["attrs"]["attempt"] for e in events
+                if e["kind"] == "attempt_phases"]
+    assert attempts == [0, 1]
+    # schema: flat six-field records throughout
+    for e in events:
+        assert set(e) == {"ts", "proc", "kind", "phase", "step",
+                          "attrs"}
+
+    # -- stats: metrics + obs provenance merged, attempt-tagged
+    stats = json.loads((d / "stats.json").read_text())
+    assert stats["config"]["attempt"] == 2
+    assert stats["watchdog"]["attempt"] == 2
+    names = {m["name"] for m in stats["metrics"]["counters"]}
+    assert {"steps", "restarts", "io_steps_written"} <= names
+    hist = next(h for h in stats["metrics"]["histograms"]
+                if h["name"] == "step_latency_us")
+    assert hist["count"] > 0 and hist["p50"] is not None
+    assert stats["obs"]["trace"]["enabled"] is True
+    assert any(e["event"] == "attempt_phases" for e in stats["faults"])
+
+    # -- gs_report --check agrees (the CI entry point)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--check", "--trace", str(d / "trace.json"),
+         "--events", str(d / "events.jsonl"),
+         "--stats", str(d / "stats.json")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "OK" in proc.stdout
+
+
+def test_autotune_decision_reaches_event_stream(tmp_path):
+    """Auto dispatch under GS_EVENTS: the tuning decision (cache
+    hit/miss, source) lands on the same timeline as everything else."""
+    d = tmp_path / "auto"
+    d.mkdir()
+    cfg = write_config(d, noise=0.1, steps=20,
+                       kernel_language="Auto")
+    env = {"GS_EVENTS": str(d / "events.jsonl"), "GS_AUTOTUNE": "cached",
+           "GS_AUTOTUNE_CACHE": str(d / "tunecache")}
+    res = run_cli(d, cfg, extra_env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    events = parse_events(str(d / "events.jsonl"))
+    (tune,) = [e for e in events if e["kind"] == "autotune"]
+    assert tune["phase"] == "compile"
+    assert tune["attrs"]["mode"] == "cached"
+    assert tune["attrs"]["cache"] == "miss"
+
+
+@pytest.mark.slow
+def test_obs_overhead_within_three_percent(tmp_path):
+    """The cost guard: the fully-instrumented step loop stays within 3%
+    of the uninstrumented one (min-of-3 wall each way, CPU host)."""
+
+    def measure(name, extra_env):
+        walls = []
+        for i in range(3):
+            d = tmp_path / f"{name}{i}"
+            d.mkdir()
+            cfg = write_config(d, noise=0.1, steps=300, plotgap=10,
+                               output="gs.bp", checkpoint="true",
+                               checkpoint_freq=50)
+            env = dict(extra_env)
+            env["GS_TPU_STATS"] = str(d / "stats.json")
+            res = run_cli(d, cfg, extra_env=env)
+            assert res.returncode == 0, res.stderr + res.stdout
+            walls.append(
+                json.loads((d / "stats.json").read_text())["wall_s"]
+            )
+        return min(walls)
+
+    off = measure("off", {})
+    on_env = {k: str(tmp_path / f"on.{k.lower()}") for k in
+              ("GS_TRACE", "GS_EVENTS", "GS_METRICS")}
+    on_env["GS_METRICS_INTERVAL_S"] = "0.1"
+    on = measure("on", on_env)
+    # 3% relative plus a 50ms absolute floor so sub-second timer jitter
+    # cannot fail a run whose real overhead is microseconds/boundary.
+    assert on <= off * 1.03 + 0.05, (on, off)

@@ -1,0 +1,390 @@
+"""Unit: the observability primitives (``obs/``).
+
+The contracts docs/OBSERVABILITY.md promises: the span tracer exports
+valid Chrome trace-event JSON (every ``X`` event carries pid/tid/ts/dur
+and nesting is balanced), histogram percentiles match the numpy
+reference, the event stream round-trips through its JSONL schema, and
+metrics-off is a shared no-op object with zero allocations on the hot
+path. All host-side and jax-free — these run before any backend
+exists, like the watchdog tests.
+"""
+
+import json
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.obs.events import (
+    NULL_EVENTS,
+    EventStream,
+    parse_events,
+)
+from grayscott_jl_tpu.obs.metrics import (
+    NULL_METRIC,
+    Histogram,
+    MetricsRegistry,
+    quantile,
+    resolve_interval_s,
+)
+from grayscott_jl_tpu.obs.trace import (
+    NULL_TRACER,
+    ProfileWindow,
+    SpanTracer,
+    validate_trace,
+)
+
+# --------------------------------------------------------------- tracer
+
+
+def _flush_doc(tracer):
+    path = tracer.flush()
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_trace_schema_valid_and_nesting_balanced(tmp_path):
+    """Spans + edges + instants export as schema-valid Chrome trace
+    JSON: every X event has pid/tid/ts/dur, spans nest cleanly."""
+    t = SpanTracer(str(tmp_path / "trace.json"), proc=0)
+    with t.span("outer", phase="compute", step=0):
+        with t.span("inner", phase="compute", step=0, detail="x"):
+            pass
+        with t.span("inner2", phase="compute", step=0):
+            pass
+    t.edge("compile", 0)
+    t.edge("step_round", 10)
+    t.edge("io", 10)
+    t.instant("fault", step=10, kind="preempt")
+    doc = _flush_doc(t)
+
+    assert validate_trace(doc) == []
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    for e in xs:
+        for field in ("pid", "tid", "ts", "dur", "name"):
+            assert field in e, (field, e)
+    # edges: compile and step_round closed (io still open at flush time
+    # is exported as running-until-now), spans: outer/inner/inner2
+    names = {e["name"] for e in xs}
+    assert {"outer", "inner", "inner2", "compile", "step_round",
+            "io"} <= names
+    # step attribution rides in args
+    inner = next(e for e in xs if e["name"] == "inner")
+    assert inner["args"]["step"] == 0 and inner["args"]["detail"] == "x"
+
+
+def test_trace_flush_is_rereadable_and_keeps_edge_open(tmp_path):
+    """Flushing mid-run (every supervised attempt does) must leave
+    valid JSON AND keep accumulating — the multi-attempt timeline is
+    one file."""
+    t = SpanTracer(str(tmp_path / "trace.json"))
+    t.edge("compile", 0)
+    doc1 = _flush_doc(t)
+    assert validate_trace(doc1) == []
+    t.edge("step_round", 10)  # closes compile for real
+    t.edge("drain", 20)
+    doc2 = _flush_doc(t)
+    assert validate_trace(doc2) == []
+    names2 = [e["name"] for e in doc2["traceEvents"] if e["ph"] == "X"]
+    assert names2.count("compile") == 1
+    assert "step_round" in names2 and "drain" in names2
+
+
+def test_trace_event_cap_counts_drops(tmp_path):
+    t = SpanTracer(str(tmp_path / "trace.json"), max_events=3)
+    for i in range(10):
+        t.edge("step_round", i)
+    doc = _flush_doc(t)
+    assert validate_trace(doc) == []
+    assert t.dropped > 0
+    assert doc["otherData"]["dropped_events"] == t.dropped
+
+
+def test_trace_threads_get_distinct_tids(tmp_path):
+    t = SpanTracer(str(tmp_path / "trace.json"))
+
+    def worker():
+        with t.span("worker-span", phase="output", step=1):
+            pass
+
+    th = threading.Thread(target=worker, name="gs-async-io")
+    th.start()
+    th.join()
+    with t.span("driver-span", phase="compute", step=1):
+        pass
+    doc = _flush_doc(t)
+    assert validate_trace(doc) == []
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert xs["worker-span"]["tid"] != xs["driver-span"]["tid"]
+    thread_names = {e["args"]["name"] for e in doc["traceEvents"]
+                    if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "gs-async-io" in thread_names
+
+
+def test_validate_trace_rejects_broken_documents():
+    assert validate_trace({"nope": 1}) != []
+    assert validate_trace(
+        {"traceEvents": [{"ph": "X", "name": "a", "pid": 0, "tid": 0,
+                          "ts": 0}]}
+    ) != []  # missing dur
+    # partial overlap on one track = unbalanced nesting
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 0, "tid": 0, "ts": 0,
+         "dur": 100},
+        {"ph": "X", "name": "b", "pid": 0, "tid": 0, "ts": 50,
+         "dur": 100},
+    ]}
+    assert any("overlap" in p for p in validate_trace(bad))
+    # same intervals on distinct tracks are fine
+    ok = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 0, "tid": 0, "ts": 0,
+         "dur": 100},
+        {"ph": "X", "name": "b", "pid": 0, "tid": 1, "ts": 50,
+         "dur": 100},
+    ]}
+    assert validate_trace(ok) == []
+
+
+def test_null_tracer_is_inert():
+    assert NULL_TRACER.enabled is False
+    with NULL_TRACER.span("x", phase="compute"):
+        NULL_TRACER.edge("io", 1)
+        NULL_TRACER.instant("y")
+    assert NULL_TRACER.flush() is None
+
+
+# ------------------------------------------------------------- histogram
+
+
+def test_histogram_percentiles_match_numpy():
+    rng = np.random.RandomState(7)
+    vals = list(rng.lognormal(3.0, 1.0, size=313))
+    h = Histogram("lat", capacity=1024)
+    for v in vals:
+        h.observe(v)
+    for q in (0, 10, 50, 90, 95, 99, 100):
+        assert h.percentile(q) == pytest.approx(
+            float(np.percentile(vals, q)), rel=1e-12
+        )
+    s = h.summary()
+    assert s["count"] == len(vals)
+    assert s["min"] == pytest.approx(min(vals))
+    assert s["max"] == pytest.approx(max(vals))
+    assert s["mean"] == pytest.approx(float(np.mean(vals)))
+
+
+def test_histogram_ring_buffer_wraps():
+    h = Histogram("lat", capacity=4)
+    for v in range(100):
+        h.observe(float(v))
+    # scalar aggregates cover the whole stream ...
+    assert h.count == 100 and h.vmin == 0.0 and h.vmax == 99.0
+    # ... percentiles cover the trailing window only
+    assert sorted(h.window) == [96.0, 97.0, 98.0, 99.0]
+    assert h.percentile(50) == pytest.approx(
+        float(np.percentile([96, 97, 98, 99], 50))
+    )
+
+
+def test_quantile_validation():
+    with pytest.raises(ValueError):
+        quantile([], 50)
+    with pytest.raises(ValueError):
+        quantile([1.0], 101)
+    assert quantile([3.0], 99) == 3.0
+
+
+# --------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_get_or_create_and_snapshot(tmp_path):
+    r = MetricsRegistry(path=str(tmp_path / "m.jsonl"))
+    c = r.counter("steps", model="gs")
+    assert r.counter("steps", model="gs") is c
+    assert r.counter("steps", model="heat") is not c
+    c.inc(3)
+    r.gauge("depth").set(2)
+    h = r.histogram("lat_us")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    snap = r.snapshot()
+    assert {"counters", "gauges", "histograms"} == set(snap)
+    assert any(m["value"] == 3 and m["labels"] == {"model": "gs"}
+               for m in snap["counters"])
+    hist = snap["histograms"][0]
+    assert hist["count"] == 3 and hist["p50"] == 2.0
+
+
+def test_metrics_interval_flush_jsonl(tmp_path, monkeypatch):
+    path = tmp_path / "m.jsonl"
+    r = MetricsRegistry(path=str(path), interval_s=0.0)
+    r.counter("steps").inc()
+    assert r.maybe_flush() is None  # interval 0 = end-of-run only
+    assert r.maybe_flush(force=True) == str(path)
+    refreshed = []
+    r.maybe_flush(force=True, on_flush=lambda: refreshed.append(1))
+    assert refreshed == [1]
+    records = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(records) == 2
+    assert all({"ts", "proc", "counters", "gauges", "histograms"}
+               <= set(rec) for rec in records)
+
+
+def test_metrics_off_is_shared_noop_with_zero_allocations():
+    """The hard hot-path contract: a disabled registry hands out ONE
+    shared null instrument whose mutators allocate nothing."""
+    r = MetricsRegistry(path=None)
+    assert not r.enabled
+    c = r.counter("steps", model="gs")
+    g = r.gauge("depth")
+    h = r.histogram("lat")
+    assert c is g is h is NULL_METRIC
+    assert r.snapshot() == {"counters": [], "gauges": [],
+                            "histograms": []}
+
+    # warm up, then measure: no net allocations across 10k hot calls
+    for _ in range(10):
+        c.inc()
+        g.set(1.0)
+        h.observe(2.0)
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(10000):
+        c.inc()
+        g.set(1.0)
+        h.observe(2.0)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grown = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                if s.size_diff > 0)
+    # tracemalloc's own bookkeeping shows up as a few small blocks;
+    # anything per-call would be >= 10k allocations.
+    assert grown < 50_000, f"metrics-off hot path allocated {grown}B"
+
+
+def test_prometheus_text_exposition(tmp_path):
+    r = MetricsRegistry(path=str(tmp_path / "m.jsonl"))
+    r.counter("steps", model="gs", mesh="2x2x2").inc(5)
+    r.gauge("queue_depth").set(3)
+    h = r.histogram("step_latency_us")
+    for v in (10.0, 20.0):
+        h.observe(v)
+    text = r.prometheus_text()
+    assert "# TYPE steps counter" in text
+    assert 'steps{mesh="2x2x2",model="gs"} 5' in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "queue_depth 3" in text
+    assert "# TYPE step_latency_us summary" in text
+    assert 'step_latency_us{quantile="0.50"} 15.0' in text
+    assert "step_latency_us_count 2" in text
+    out = tmp_path / "prom.txt"
+    r.write_prometheus(str(out))
+    assert out.read_text() == text
+
+
+def test_resolve_interval_env_wins(monkeypatch):
+    class S:
+        metrics_interval_s = 5.0
+
+    assert resolve_interval_s(S()) == 5.0
+    monkeypatch.setenv("GS_METRICS_INTERVAL_S", "2.5")
+    assert resolve_interval_s(S()) == 2.5
+    monkeypatch.setenv("GS_METRICS_INTERVAL_S", "nope")
+    with pytest.raises(ValueError):
+        resolve_interval_s(S())
+
+
+# ---------------------------------------------------------------- events
+
+
+def test_event_stream_round_trip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    es = EventStream(str(path), proc=0)
+    e1 = es.emit("injected", phase="io", step=12, fault="preempt",
+                 planned_step=10)
+    e2 = es.emit("recovery", fault="preemption",
+                 action="resumed_from_checkpoint_step_10")
+    assert es.emitted == 2
+    back = parse_events(str(path))
+    assert back == [e1, e2]
+    # the flat schema: exactly the six documented fields, extras in attrs
+    for ev in back:
+        assert set(ev) == {"ts", "proc", "kind", "phase", "step",
+                           "attrs"}
+    assert back[0]["attrs"] == {"fault": "preempt", "planned_step": 10}
+
+
+def test_event_stream_skips_torn_tail(tmp_path):
+    path = tmp_path / "events.jsonl"
+    es = EventStream(str(path), proc=0)
+    es.emit("run_start", step=0)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"ts": 1, "kind": "torn')  # mid-write SIGKILL shape
+    events = parse_events(str(path))
+    assert len(events) == 1 and events[0]["kind"] == "run_start"
+
+
+def test_event_stream_non_json_attrs_degrade_to_repr(tmp_path):
+    path = tmp_path / "events.jsonl"
+    es = EventStream(str(path), proc=0)
+    es.emit("health", step=3, report=object())
+    (ev,) = parse_events(str(path))
+    assert ev["kind"] == "health" and "object" in ev["attrs"]["report"]
+
+
+def test_event_stream_breaks_quietly_on_io_error(tmp_path, capsys):
+    es = EventStream(str(tmp_path / "nodir" / "e.jsonl"), proc=0)
+    assert es.emit("run_start") is None
+    assert es.broken is not None
+    assert es.emit("run_start") is None  # stays broken, stays quiet
+    assert "event stream" in capsys.readouterr().err
+
+
+def test_null_event_stream_is_inert():
+    assert NULL_EVENTS.enabled is False
+    assert NULL_EVENTS.emit("anything", step=1, x=2) is None
+
+
+# -------------------------------------------------------- profile window
+
+
+def test_profile_window_parse(monkeypatch):
+    monkeypatch.delenv("GS_PROFILE", raising=False)
+    assert ProfileWindow.from_env() is None
+    monkeypatch.setenv("GS_PROFILE", "100:200")
+    w = ProfileWindow.from_env()
+    assert (w.start, w.stop) == (100, 200)
+    for bad in ("100", "a:b", "200:100", "-1:50"):
+        monkeypatch.setenv("GS_PROFILE", bad)
+        with pytest.raises(ValueError):
+            ProfileWindow.from_env()
+
+
+# ------------------------------------------------------------ structured log
+
+
+def test_logger_json_format(capsys, monkeypatch):
+    from grayscott_jl_tpu.utils.log import Logger
+
+    monkeypatch.setenv("GS_LOG_FORMAT", "json")
+    log = Logger(verbose=True)
+    log.info("hello world")
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["level"] == "info" and rec["msg"] == "hello world"
+    assert {"ts", "t_rel_s", "proc"} <= set(rec)
+    monkeypatch.setenv("GS_LOG_FORMAT", "yaml")
+    with pytest.raises(ValueError):
+        Logger()
+
+
+def test_logger_warn_ignores_verbose(capsys, monkeypatch):
+    from grayscott_jl_tpu.utils.log import Logger
+
+    monkeypatch.delenv("GS_LOG_FORMAT", raising=False)
+    log = Logger(verbose=False)
+    log.info("quiet")
+    log.warn("loud")
+    out = capsys.readouterr().out
+    assert "quiet" not in out
+    assert "WARN: loud" in out
