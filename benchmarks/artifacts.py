@@ -6,8 +6,12 @@ One implementation of the read/append/naming conventions that
 calibrators and the tools that produce their inputs. The record schema
 itself is one-JSON-object-per-line with:
 
-* ``"ab"`` — the experiment family (``comm_overlap``, ``autotune``, a
-  fuse case has none but carries ``"fuse"``),
+* ``"ab"`` — the experiment family (``comm_overlap``, ``autotune``,
+  ``halo_depth`` — s-step exchange rows with ``fuse_base``/
+  ``halo_depth``/``speedup_vs_k1``/``measured_comm_reduction``/
+  ``model_ideal_reduction`` plus an ``engaged`` flag, consumed by
+  ``update_halo_depth.py``; a fuse case has none but carries
+  ``"fuse"``),
 * ``"t"`` — UTC capture timestamp (``utc_stamp``),
 * ``"model"`` — the registered model the row measured (``models/``;
   rows written before the multi-model framework carry no field and

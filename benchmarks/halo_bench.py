@@ -53,6 +53,15 @@ def main() -> int:
         "OVERLAP_EFFICIENCY",
     )
     ap.add_argument(
+        "--halo-depths", default=None, metavar="K1,K2,...",
+        help="with --ab: sweep the s-step exchange depth instead "
+        "(halo_depth, docs/TEMPORAL.md) — time the sharded run at each "
+        "k (k=1 is always measured as the baseline), emit one "
+        "ab=halo_depth row per k with the measured comm reduction vs "
+        "k=1, for benchmarks/update_halo_depth.py to calibrate the ICI "
+        "model's HALO_DEPTH_EFFICIENCY",
+    )
+    ap.add_argument(
         "--out", default=None,
         help="JSONL artifact path for --ab rows (default "
         "benchmarks/results/overlap_ab_<platform>_<date>.jsonl)",
@@ -105,6 +114,78 @@ def main() -> int:
                 "collectives_per_chunk": n_perm,
                 "collectives_per_step": round(n_perm / k, 2),
             }))
+        return 0
+
+    if args.ab and args.halo_depths:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import artifacts
+
+        from grayscott_jl_tpu.parallel import icimodel
+
+        # Pin each side via the Settings keys only.
+        os.environ.pop("GS_COMM_OVERLAP", None)
+        os.environ.pop("GS_HALO_DEPTH", None)
+        ks = sorted({int(s) for s in args.halo_depths.split(",")} | {1})
+        out = args.out
+        if out is None:
+            out = artifacts.default_out("halo_depth_ab", backend)
+        single = Simulation(Settings(L=args.local, **base), n_devices=1)
+        t_single = time_sim(single, args.steps, args.rounds)
+        times = {}
+        sims = {}
+        for k in ks:
+            sims[k] = Simulation(
+                Settings(L=L_global, halo_depth=k, **base),
+                n_devices=args.devices,
+            )
+            times[k] = time_sim(sims[k], args.steps, args.rounds)
+        fuse_base = min(sims[1]._fuse_base(),
+                        min(sims[1].domain.local_shape))
+        for k in ks:
+            t_k = times[k]
+            comm_k = max(t_k - t_single, 0.0)
+            comm_1 = max(times[1] - t_single, 0.0)
+            row = {
+                "ab": "halo_depth",
+                "t": artifacts.utc_stamp(),
+                "platform": backend.lower(),
+                "devices": args.devices,
+                "mesh": list(sims[k].domain.dims),
+                "L_global": L_global,
+                "local_block": [L_global // d
+                                for d in sims[k].domain.dims],
+                "kernel": args.kernel,
+                # Chain base d (GS_FUSE-resolved): each k exchanges a
+                # (d x k)-deep frame once per d*k steps.
+                "fuse_base": fuse_base,
+                "halo_depth": k,
+                # The constructed sim's resolved k (a Pallas-language
+                # sweep gates to 1; such rows carry no s-step signal).
+                "engaged": sims[k].halo_depth == k,
+                "us_per_step": round(t_k * 1e6, 1),
+                "us_per_step_k1": round(times[1] * 1e6, 1),
+                "us_per_step_single_equivalent": round(
+                    t_single * 1e6, 1
+                ),
+                "speedup_vs_k1": round(times[1] / t_k, 4)
+                if t_k > 0 else None,
+                "comm_us": round(comm_k * 1e6, 1),
+                "comm_us_k1": round(comm_1 * 1e6, 1),
+                # Net exchange-cost reduction vs exchanging every chain
+                # round; the ideal is the 1/k latency amortization —
+                # their ratio is the realized HALO_DEPTH_EFFICIENCY.
+                "measured_comm_reduction": (
+                    round(1.0 - comm_k / comm_1, 4)
+                    if k > 1 and comm_1 > 0 else None
+                ),
+                "model_ideal_reduction": (
+                    round(1.0 - 1.0 / k, 4) if k > 1 else None
+                ),
+                "model_comm": icimodel.comm_report(sims[k]),
+            }
+            print(json.dumps(row))
+            artifacts.append_row(out, row)
+        print(f"# appended to {out}", file=sys.stderr)
         return 0
 
     if args.ab:

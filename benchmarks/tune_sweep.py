@@ -155,12 +155,86 @@ def overlap_ab_row(out: str, backend: str, settings, sim, L: int,
     print(json.dumps(row))
 
 
+def halo_depth_ab_rows(out: str, backend: str, settings, sim, L: int,
+                       steps: int, rounds: int, ks=(1, 2, 4)):
+    """halo_bench-style s-step depth A/B at the tuned winner config —
+    the rows ``update_halo_depth.py`` calibrates HALO_DEPTH_EFFICIENCY
+    from. XLA-language winners only (the Pallas chains gate
+    halo_depth); needs a cubic local block for the single-device comm
+    anchor, like the overlap A/B."""
+    import dataclasses
+
+    from grayscott_jl_tpu.parallel import icimodel
+    from grayscott_jl_tpu.simulation import Simulation
+    from grayscott_jl_tpu.utils.benchmark import time_sim
+
+    if sim.kernel_language == "pallas":
+        print("# halo-depth A/B skipped: the Pallas chains have no "
+              "s-step schedule (docs/TEMPORAL.md)", file=sys.stderr)
+        return
+    dims = sim.domain.dims
+    locals_ = [L // d for d in dims]
+    if len(set(locals_)) != 1 or any(L % d for d in dims):
+        print(f"# halo-depth A/B skipped: mesh {dims} at L={L} has no "
+              "cubic local block for the single-device anchor",
+              file=sys.stderr)
+        return
+    base = dataclasses.replace(settings, kernel_language="Plain")
+    os.environ.pop("GS_HALO_DEPTH", None)
+    fuse = max(1, min(sim._fuse_base(), min(sim.domain.local_shape)))
+    ks = sorted({k for k in ks
+                 if fuse * k <= min(sim.domain.local_shape)} | {1})
+    single = Simulation(dataclasses.replace(base, L=locals_[0]),
+                        n_devices=1)
+    t_single = time_sim(single, steps, rounds)
+    times, sims = {}, {}
+    for k in ks:
+        sims[k] = Simulation(dataclasses.replace(base, halo_depth=k),
+                             n_devices=sim.domain.n_blocks)
+        times[k] = time_sim(sims[k], steps, rounds)
+    for k in ks:
+        comm_k = max(times[k] - t_single, 0.0)
+        comm_1 = max(times[1] - t_single, 0.0)
+        row = {
+            "ab": "halo_depth",
+            "t": artifacts.utc_stamp(),
+            "platform": backend.lower(),
+            "model": sim.model.name,
+            "devices": sim.domain.n_blocks,
+            "mesh": list(dims),
+            "L_global": L,
+            "local_block": locals_,
+            "kernel": "Plain",
+            "fuse_base": fuse,
+            "halo_depth": k,
+            "engaged": sims[k].halo_depth == k,
+            "us_per_step": round(times[k] * 1e6, 1),
+            "us_per_step_k1": round(times[1] * 1e6, 1),
+            "us_per_step_single_equivalent": round(t_single * 1e6, 1),
+            "speedup_vs_k1": round(times[1] / times[k], 4)
+            if times[k] > 0 else None,
+            "comm_us": round(comm_k * 1e6, 1),
+            "comm_us_k1": round(comm_1 * 1e6, 1),
+            "measured_comm_reduction": (
+                round(1.0 - comm_k / comm_1, 4)
+                if k > 1 and comm_1 > 0 else None
+            ),
+            "model_ideal_reduction": (
+                round(1.0 - 1.0 / k, 4) if k > 1 else None
+            ),
+            "model_comm": icimodel.comm_report(sims[k]),
+        }
+        artifacts.append_row(out, row)
+        print(json.dumps(row))
+
+
 def calibrate(out: str, apply: bool) -> None:
     """Fold the sweep's measurements back into the icimodel literals —
     the measured-ground-truth replacement for running
     update_fuse_ratio.py / update_overlap.py by hand. Each calibrator
     runs only when the artifact carries its kind of signal."""
     import update_fuse_ratio
+    import update_halo_depth
     import update_overlap
 
     model = os.path.join(
@@ -188,6 +262,18 @@ def calibrate(out: str, apply: bool) -> None:
                   file=sys.stderr)
     except SystemExit as e:
         print(f"# overlap calibration skipped: {e}", file=sys.stderr)
+    try:
+        eff = update_halo_depth.load_efficiency(out)
+        print(json.dumps({
+            "measured_halo_depth_efficiency": eff["median"],
+            "rows": eff["efficiencies"], "artifact": out,
+        }))
+        if apply:
+            update_halo_depth.apply_to_model(eff["median"], model)
+            print(f"# updated HALO_DEPTH_EFFICIENCY in {model}",
+                  file=sys.stderr)
+    except SystemExit as e:
+        print(f"# halo-depth calibration skipped: {e}", file=sys.stderr)
 
 
 def main() -> int:
@@ -253,6 +339,8 @@ def main() -> int:
         if args.calibrate:
             overlap_ab_row(out, backend, settings, sim, L,
                            args.steps, args.rounds)
+            halo_depth_ab_rows(out, backend, settings, sim, L,
+                               args.steps, args.rounds)
             if args.ensemble > 0:
                 # Batched-vs-sequential ensemble A/B at the tuned
                 # winner's kernel language (ensemble_bench emits the

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Fold a measured s-step halo-depth A/B artifact into the ICI model.
+
+Reads a ``halo_bench.py --ab --halo-depths`` JSONL artifact (one row
+per depth with ``measured_comm_reduction`` — the net exchange-cost
+reduction of halo_depth=k vs k=1 at identical local volume — and
+``model_ideal_reduction`` — the ideal 1/k latency amortization),
+computes the realized efficiency ``measured / ideal`` per k>1 row, and
+— with ``--apply`` — rewrites the ``HALO_DEPTH_EFFICIENCY`` literal in
+``grayscott_jl_tpu/parallel/icimodel.py`` with the median (the same
+measurement-replaces-default loop as ``update_overlap.py`` /
+``update_fuse_ratio.py``; median because the tunnel chip's clock state
+spreads identical configs, BASELINE.md "artifact hygiene").
+
+Rows where the s-step schedule never engaged (``engaged: false`` — a
+Pallas-language sweep gates halo_depth to 1) or where the k=1 run
+exposed no measurable comm carry no signal and are skipped.
+
+    python benchmarks/update_halo_depth.py \
+        benchmarks/results/halo_depth_ab_*.jsonl
+    python benchmarks/update_halo_depth.py --apply <artifact.jsonl>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+
+def load_efficiency(path: str) -> dict:
+    """Per-row realized s-step efficiencies from an --ab --halo-depths
+    artifact, plus their median. Raises SystemExit when no row carries
+    signal."""
+    rows = artifacts.read_rows(path)
+    effs = []
+    skipped = 0
+    for r in rows:
+        if r.get("ab") != "halo_depth":
+            continue
+        k = int(r.get("halo_depth", 1))
+        ideal = r.get("model_ideal_reduction")
+        if k <= 1 or not r.get("engaged", True) or not ideal:
+            skipped += 1
+            continue
+        measured = r.get("measured_comm_reduction")
+        if measured is None:
+            skipped += 1
+            continue
+        effs.append(max(0.0, min(1.0, float(measured) / float(ideal))))
+    if not effs:
+        raise SystemExit(
+            f"no usable halo_depth A/B rows in {path} "
+            f"({skipped} rows without signal)"
+        )
+    return {
+        "efficiencies": [round(e, 4) for e in effs],
+        "median": round(statistics.median(effs), 4),
+        "skipped": skipped,
+    }
+
+
+def apply_to_model(efficiency: float, model_path: str) -> None:
+    """Rewrite the ``HALO_DEPTH_EFFICIENCY`` literal in place (the
+    model keeps its docstring; only the number changes)."""
+    src = open(model_path, encoding="utf-8").read()
+    m = re.search(r"HALO_DEPTH_EFFICIENCY = [0-9.]+", src)
+    if m is None:
+        raise SystemExit(
+            f"HALO_DEPTH_EFFICIENCY literal not found in {model_path}"
+        )
+    new_src = (src[:m.start()]
+               + f"HALO_DEPTH_EFFICIENCY = {round(efficiency, 4)}"
+               + src[m.end():])
+    open(model_path, "w", encoding="utf-8").write(new_src)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifact",
+                    help="halo_bench --ab --halo-depths JSONL with "
+                    "halo_depth rows")
+    ap.add_argument("--apply", action="store_true",
+                    help="rewrite HALO_DEPTH_EFFICIENCY in "
+                    "grayscott_jl_tpu/parallel/icimodel.py")
+    args = ap.parse_args()
+
+    result = load_efficiency(args.artifact)
+    print(json.dumps({
+        "measured_halo_depth_efficiency": result["median"],
+        "rows": result["efficiencies"],
+        "skipped_rows": result["skipped"],
+        "artifact": args.artifact,
+    }))
+    if args.apply:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        model = os.path.join(root, "grayscott_jl_tpu", "parallel",
+                             "icimodel.py")
+        apply_to_model(result["median"], model)
+        print(f"updated HALO_DEPTH_EFFICIENCY = {result['median']} in "
+              f"{model}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,21 @@ class Settings:
     #: exchange-then-compute flow bit-for-bit (the trajectories are
     #: bitwise identical either way — overlap only reorders dataflow).
     comm_overlap: str = "auto"
+    #: Communication-avoiding s-step halo exchange (extension;
+    #: docs/TEMPORAL.md): exchange a (chain_depth x halo_depth)-deep
+    #: ghost frame ONCE and advance that many steps on progressively
+    #: shrinking valid regions before the next exchange restores full
+    #: width — amortizing per-round ICI latency by 1/halo_depth on
+    #: latency-dominated small-shard meshes. 0 (default) = "auto":
+    #: behaves as 1 (today's one-exchange-per-chain-round schedule,
+    #: byte-identical) unless the measured autotuner adopts a deeper
+    #: k; an explicit value >= 1 pins it. GS_HALO_DEPTH env wins
+    #: (integer, or "auto"/"0"). XLA chain paths only — the Pallas
+    #: in-kernel chains keep k=1 (gated with a warning; the VMEM-bound
+    #: fused chain is its own amortization). A k the local block
+    #: cannot serve (chain_depth x k > min local extent) raises
+    #: SettingsError at construction.
+    halo_depth: int = 0
     #: JAX persistent compilation cache directory (extension): ""
     #: resolves to a default user-cache dir when supervision is armed
     #: (restart attempts and repeated bench invocations skip recompiles)
@@ -337,6 +352,43 @@ def resolve_comm_overlap(settings: Settings) -> str:
             f"got {raw!r}"
         )
     return v
+
+
+def resolve_halo_depth(settings: Settings) -> Tuple[bool, int]:
+    """Normalized s-step exchange depth: ``(pinned, k)`` with ``k >= 1``.
+
+    ``GS_HALO_DEPTH`` env wins over the ``halo_depth`` TOML key,
+    mirroring the other knobs. ``0`` / ``"auto"`` / unset resolve to
+    ``(False, 1)`` — today's one-exchange-per-chain-round schedule,
+    which the measured autotuner may deepen; an explicit integer >= 1
+    resolves to ``(True, k)`` and is never searched over. Geometry
+    validation (does the local block support a k-deep exchange?)
+    happens at Simulation construction, where the mesh is known."""
+    import os
+
+    raw = os.environ.get("GS_HALO_DEPTH")
+    if raw is None:
+        v = getattr(settings, "halo_depth", 0) or 0
+    else:
+        r = raw.strip().lower()
+        if r in ("", "auto"):
+            v = 0
+        else:
+            try:
+                v = int(r)
+            except ValueError as e:
+                raise ValueError(
+                    f"GS_HALO_DEPTH must be an integer or 'auto', "
+                    f"got {raw!r}"
+                ) from e
+    if v < 0:
+        raise ValueError(
+            f"halo_depth / GS_HALO_DEPTH must be >= 0 (0 = auto), "
+            f"got {v}"
+        )
+    if v == 0:
+        return False, 1
+    return True, int(v)
 
 
 #: Valid autotune modes (docs/TUNING.md); shared with

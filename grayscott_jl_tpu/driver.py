@@ -383,6 +383,7 @@ def _run_once_inner(
         "n_devices": sim.domain.n_blocks,
         "n_processes": nprocs,
         "comm_overlap": sim.comm_overlap,
+        "halo_depth": sim.halo_depth,
         "compile_cache": sim.compile_cache_dir,
         # The resolved tuner mode rides in the config echo even for
         # explicitly-pinned kernel languages (where no tuning runs):
@@ -430,6 +431,15 @@ def _run_once_inner(
     )
     metrics.gauge("comm_exposed_us_per_step", **mlabels).set(
         comm.get("exposed_us")
+    )
+    # s-step exchange visibility (docs/TEMPORAL.md): exchanges and
+    # ghost bytes per step make the halo_depth amortization legible on
+    # the same scrape that carries the hidden/exposed comm split.
+    metrics.gauge("comm_exchanges_per_step", **mlabels).set(
+        comm.get("exchanges_per_step")
+    )
+    metrics.gauge("comm_halo_bytes_per_step", **mlabels).set(
+        comm.get("halo_bytes_per_step")
     )
 
     def _refresh_device_gauges():

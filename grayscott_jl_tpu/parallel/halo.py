@@ -163,34 +163,25 @@ def halo_pad_wide(
         jnp.pad(a, w, mode="constant", constant_values=bv)
         for a, bv in zip(arrays, boundary_values)
     ]
-    n_arr = len(arrays)
 
     for dim, (ax, n) in enumerate(zip(axis_names, axis_sizes)):
         if n == 1:
             continue  # single shard on this axis: ghosts stay frozen
-        idx = lax.axis_index(ax)
         m = padded[0].shape[dim]
-
-        def slab(x, start):
-            i = [slice(None)] * x.ndim
-            i[dim] = slice(start, start + w)
-            return x[tuple(i)]
-
-        # Interior boundary slabs (full padded extent on other axes, so
-        # previously-filled ghosts ride along -> corners propagate).
-        send_up = jnp.concatenate([slab(p, m - 2 * w) for p in padded], dim)
-        send_dn = jnp.concatenate([slab(p, w) for p in padded], dim)
-        up_perm = [(i, i + 1) for i in range(n - 1)]
-        dn_perm = [(i + 1, i) for i in range(n - 1)]
-        recv_lo = lax.ppermute(send_up, ax, up_perm)
-        recv_dn = lax.ppermute(send_dn, ax, dn_perm)
-
-        lo_slabs = jnp.split(recv_lo, n_arr, axis=dim)
-        hi_slabs = jnp.split(recv_dn, n_arr, axis=dim)
-        for i, bv in enumerate(boundary_values):
-            bvt = jnp.asarray(bv, padded[i].dtype)
-            lo = jnp.where(idx > 0, lo_slabs[i], bvt)
-            hi = jnp.where(idx < n - 1, hi_slabs[i], bvt)
+        # One slab-exchange implementation (``_exchange_dim``) serves
+        # both the 1-deep face paths and this corner-propagated frame:
+        # trimming the exchange axis's own ghosts makes the outermost
+        # OWNED slabs the "boundary slabs" _exchange_dim sends, while
+        # the other axes keep their full padded extent — so ghosts
+        # filled by earlier axes ride along and corners propagate (the
+        # reference's sequential xy/xz/yz ordering).
+        trim = [slice(None)] * 3
+        trim[dim] = slice(w, m - w)
+        trim = tuple(trim)
+        pairs = _exchange_dim(
+            [p[trim] for p in padded], boundary_values, dim, ax, n, w
+        )
+        for i, (lo, hi) in enumerate(pairs):
             start_lo = [0] * 3
             start_hi = [0] * 3
             start_hi[dim] = m - w

@@ -68,6 +68,29 @@ STAGE_RATIO = {"Pallas": FUSE_COST_RATIO[1], "XLA": 1.0}
 #: artifact (the same calibration loop as FUSE_COST_RATIO).
 OVERLAP_EFFICIENCY = 0.85
 
+#: Fraction of the *ideal* 1/k s-step latency amortization
+#: (``halo_depth``, docs/TEMPORAL.md) the schedule actually realizes:
+#: exchanging a (d x k)-deep frame once per k chain rounds removes
+#: (1 - 1/k) of the per-round hop latency in the ideal model, but the
+#: wider frame costs serialization, cache pressure, and ring-recompute
+#: growth the latency term does not see. The default is the analytic
+#: guess until ``benchmarks/update_halo_depth.py --apply`` rewrites
+#: this literal from a measured ``halo_bench.py --ab --halo-depths``
+#: artifact (the same calibration loop as OVERLAP_EFFICIENCY).
+HALO_DEPTH_EFFICIENCY = 0.9
+
+
+def sstep_amortization(halo_depth: int, efficiency: float = None) -> float:
+    """Fraction of the per-chain-round exchange hop latency that
+    REMAINS under s-step exchange at depth ``halo_depth`` — 1.0 at
+    k=1 (every round exchanges), approaching ``1 - efficiency`` as k
+    grows (the calibrated share of the ideal 1/k win)."""
+    k = max(1, int(halo_depth))
+    if k == 1:
+        return 1.0
+    eff = HALO_DEPTH_EFFICIENCY if efficiency is None else efficiency
+    return 1.0 - eff * (1.0 - 1.0 / k)
+
 
 def overlap_fraction(compute_us: float, comm_us: float,
                      efficiency: float = None) -> float:
@@ -107,6 +130,7 @@ def project(
     link_gbps: float = 90.0,
     hop_us: float = 1.0,
     overlap: float = 0.0,
+    halo_depth: int = 1,
 ) -> dict:
     """Weak-scaling efficiency projection for one cubic-local config.
 
@@ -121,20 +145,32 @@ def project(
       measurement does not contain;
     * exposed communication (serialization at the max-loaded link +
       hop latency), amortized over the k steps per exchange round.
+
+    ``halo_depth`` (s-step exchange, docs/TEMPORAL.md) multiplies the
+    steps per exchange round: the frame deepens to
+    ``fuse * halo_depth`` (pricing the wider slabs and the extra ring
+    recompute exactly) while the hop-latency amortization beyond one
+    chain round is discounted by the calibrated
+    :data:`HALO_DEPTH_EFFICIENCY`.
     """
-    wide = local + 2 * fuse  # corner-propagated k-wide exchange slab
-    face_bytes = wide * wide * fuse * itemsize * 2  # per face, per k steps
+    sk = max(1, int(halo_depth))
+    s_steps = fuse * sk  # steps per exchange round
+    wide = local + 2 * s_steps  # corner-propagated exchange slab
+    face_bytes = wide * wide * s_steps * itemsize * 2  # per face/round
     total_bytes = 6 * face_bytes
     # The exchange completes at the MAX-loaded link, not at aggregate
     # bandwidth: with 6 links each face rides its own (1 face/link);
     # with 4 (v5e 2D torus) the y/z-shared links carry 2 faces each.
     faces_per_link = -(-6 // links)  # ceil
-    ser_us = faces_per_link * face_bytes / (link_gbps * 1e3) / fuse
-    lat_us = 6 * hop_us / fuse  # one exchange round per k steps
+    ser_us = faces_per_link * face_bytes / (link_gbps * 1e3) / s_steps
+    # One exchange round per s_steps; the amortization beyond the
+    # chain-round baseline is what s-step adds, discounted by the
+    # calibrated efficiency.
+    lat_us = 6 * hop_us / fuse * sstep_amortization(sk)
     raw_us = ser_us + lat_us
     recompute = sum(
-        (local + 2 * (fuse - 1 - s)) ** 3 for s in range(fuse)
-    ) / (fuse * local**3)
+        (local + 2 * (s_steps - 1 - s)) ** 3 for s in range(s_steps)
+    ) / (s_steps * local**3)
     ov = _resolve_overlap(
         overlap, us_per_step * stage_ratio * recompute, raw_us
     )
@@ -143,10 +179,13 @@ def project(
     return {
         "local": local,
         "fuse": fuse,
+        "halo_depth": sk,
         "stage_ratio": stage_ratio,
         "compute_us_per_step": round(us_per_step, 1),
         "ring_recompute_ratio": round(recompute, 4),
         "halo_bytes_per_round": total_bytes,
+        "halo_bytes_per_step": round(total_bytes / s_steps),
+        "exchanges_per_step": round(1.0 / s_steps, 4),
         "comm_us_per_step_exposed": round(comm_us, 2),
         "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
         "links": links,
@@ -293,9 +332,14 @@ def project_chain(
         "mesh": f"{n},{m},{p}",
         "local": list(local),
         "fuse": k,
+        # The Pallas chains amortize via in-kernel depth only; s-step
+        # halo_depth is an XLA-chain schedule (gated in simulation.py).
+        "halo_depth": 1,
         "fuse_cost_ratio": r,
         "fuse_cost_ratio_interpolated": k in (2, 3),
         "compute_us_per_step": round(us_base, 1),
+        "halo_bytes_per_step": round(n_faces * face_bytes / k),
+        "exchanges_per_step": round(1.0 / k, 4) if n_faces else 0.0,
         "y_plane_overhead": round(y_over, 4),
         "x_ring_recompute": round(x_ring, 4),
         "z_band_us_per_step": round(band_us, 2),
@@ -400,6 +444,7 @@ def project_1d(
     link_gbps: float = 90.0,
     hop_us: float = 1.0,
     overlap: float = 0.0,
+    halo_depth: int = 1,
 ) -> dict:
     """Weak-scaling projection for the 1D x-sharded in-kernel fused
     chain (``GS_TPU_MESH_DIMS=n,1,1``): each shard owns an
@@ -421,7 +466,9 @@ def project_1d(
         local = (L // n, L, L)
     nx, ny, nz = local
     us_base = base_us_per_step / n
-    recompute = 1.0 + (fuse - 1) / nx  # ring grows only along x
+    sk = max(1, int(halo_depth))
+    s_steps = fuse * sk  # steps per exchange round (s-step exchange)
+    recompute = 1.0 + (s_steps - 1) / nx  # ring grows only along x
     r = FUSE_COST_RATIO.get(fuse)
     if r is None:
         raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
@@ -430,7 +477,7 @@ def project_1d(
     # link, else they serialize on the shared one.
     faces_per_link = -(-2 // links)
     ser_us = faces_per_link * ny * nz * itemsize * 2 / (link_gbps * 1e3)
-    lat_us = 2 * hop_us / fuse
+    lat_us = 2 * hop_us / fuse * sstep_amortization(sk)
     raw_us = ser_us + lat_us
     ov = _resolve_overlap(overlap, us_base * r * recompute, raw_us)
     comm_us = raw_us * (1.0 - ov)
@@ -439,10 +486,13 @@ def project_1d(
         "mesh": f"{n},1,1",
         "local": nx,
         "fuse": fuse,
+        "halo_depth": sk,
         "fuse_cost_ratio": r,
         "fuse_cost_ratio_interpolated": fuse in (2, 3),
         "compute_us_per_step": round(us_base, 1),
         "ring_recompute_ratio": round(recompute, 4),
+        "halo_bytes_per_step": round(2 * ny * nz * itemsize * 2),
+        "exchanges_per_step": round(1.0 / s_steps, 4),
         "comm_us_per_step_exposed": round(comm_us, 2),
         "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
         "links": links,
@@ -672,6 +722,7 @@ def projected_step_us(
     hop_us: float = 1.0,
     overlap="auto",
     local=None,
+    halo_depth: int = 1,
 ) -> Optional[float]:
     """Model-projected µs/step for ONE concrete (language, mesh, depth)
     config — the scalar the measured autotuner (``tune/candidates``)
@@ -680,9 +731,11 @@ def projected_step_us(
     language, :func:`project_1d`/:func:`project_chain` for the Pallas
     chains, the single-chip anchors for one device) and converts
     efficiency back to absolute time against the language's own base.
-    ``None`` when the model has nothing to say (no measured fuse ratio,
-    no chain at this depth) — unscored candidates rank last, they are
-    not excluded."""
+    ``halo_depth`` prices the s-step exchange for XLA candidates
+    (``None`` for a Pallas candidate requesting k > 1 — no such
+    schedule exists). ``None`` when the model has nothing to say (no
+    measured fuse ratio, no chain at this depth) — unscored candidates
+    rank last, they are not excluded."""
     n, m, p = dims
     ndev = n * m * p
     if local is None:
@@ -694,8 +747,10 @@ def projected_step_us(
         side = max(2, round((local[0] * local[1] * local[2]) ** (1 / 3)))
         row = project(side, max(1, fuse), base, itemsize=itemsize,
                       links=links, link_gbps=link_gbps, hop_us=hop_us,
-                      overlap=overlap)
+                      overlap=overlap, halo_depth=halo_depth)
         return base / row["projected_weak_scaling_eff"]
+    if max(1, int(halo_depth)) > 1:
+        return None  # the Pallas chains have no s-step schedule
     base_full = anchor_us("Pallas", L)
     r = FUSE_COST_RATIO.get(fuse)
     if ndev == 1:
@@ -739,6 +794,9 @@ def comm_report(sim) -> dict:
             "hidden_us": 0.0,
             "exposed_us": 0.0,
             "overlap": 0.0,
+            "halo_depth": 1,
+            "exchanges_per_step": 0.0,
+            "halo_bytes_per_step": 0,
         }
     dims = sim.domain.dims
     L = sim.settings.L
@@ -776,7 +834,8 @@ def comm_report(sim) -> dict:
             (local[0] * local[1] * local[2]) ** (1 / 3)
         ))
         n_dev = dims[0] * dims[1] * dims[2]
-        row = project(side, fuse, anchor_us("XLA", L) / n_dev, **kw)
+        row = project(side, fuse, anchor_us("XLA", L) / n_dev,
+                      halo_depth=getattr(sim, "halo_depth", 1), **kw)
     exposed = row["comm_us_per_step_exposed"]
     hidden = row.get("comm_us_per_step_hidden", 0.0)
     return {
@@ -785,6 +844,14 @@ def comm_report(sim) -> dict:
         "device_kind": kind or None,
         "kernel": lang,
         "fuse": row.get("fuse", fuse),
+        # s-step exchange visibility (docs/TEMPORAL.md): how often this
+        # schedule actually exchanges, and how many ghost bytes each
+        # step amortizes — the numbers that make a halo_depth win
+        # legible in gs_report.py.
+        "halo_depth": row.get("halo_depth",
+                              getattr(sim, "halo_depth", 1)),
+        "exchanges_per_step": row.get("exchanges_per_step", 0.0),
+        "halo_bytes_per_step": row.get("halo_bytes_per_step", 0),
         "links": links,
         "link_gbps": link_gbps,
         "comm_us_per_step": round(exposed + hidden, 2),

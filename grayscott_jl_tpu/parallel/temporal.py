@@ -33,6 +33,21 @@ step-at-a-time trajectory exactly (position-keyed noise,
 This supersedes the round-3 design (single-step kernel launches with an
 XLA-advanced ghost shell), which paid a measured 1.46x per-stage
 penalty because in-kernel fusion stopped at every shard boundary.
+
+Communication-avoiding s-step exchange (``halo_depth``, round 9,
+docs/TEMPORAL.md): the XLA chain path generalizes the same machinery
+into exchanging once per ``halo_depth`` chain rounds — a
+(chain_depth x halo_depth)-deep corner-propagated frame
+(``halo.halo_pad_wide``) feeds one :func:`window_chain` whose valid
+region shrinks one cell per side per step until the next exchange
+restores full width. Because :func:`window_chain` shrinks uniformly,
+composing ``k`` depth-``d`` segments on the shared frame is the SAME
+program as one depth-``k*d`` chain — the realization ``simulation.py``
+uses — so ``halo_depth=k`` at chain depth ``d`` is bitwise identical
+to ``halo_depth=1`` at chain depth ``k*d``, and the split-phase form
+(:func:`stitch_bands_from_frame` after an interior pass on a frozen
+frame) composes with it unchanged: the deeper transfer hides behind
+proportionally more interior steps.
 """
 
 from __future__ import annotations

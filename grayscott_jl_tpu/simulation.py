@@ -415,6 +415,20 @@ class Simulation:
         #: (degenerate geometries fall back to the fused round even
         #: with overlap armed) — introspection for tests and stats.
         self.overlap_applied = False
+        #: Communication-avoiding s-step exchange depth (GS_HALO_DEPTH
+        #: / halo_depth key; docs/TEMPORAL.md): each exchange round
+        #: transfers a (chain_depth x halo_depth)-deep ghost frame once
+        #: and the XLA chain advances that many steps on progressively
+        #: shrinking valid regions. 1 = today's one-exchange-per-round
+        #: schedule (byte-identical); resolved "auto" stays 1 unless
+        #: the measured autotuner adopts a deeper k below.
+        self._halo_depth_pinned, self.halo_depth = (
+            config.resolve_halo_depth(settings)
+        )
+        #: Set when a requested halo_depth > 1 was degraded to 1
+        #: because the resolved kernel language has no s-step schedule
+        #: (the Pallas in-kernel chains) — provenance for stats/tests.
+        self.halo_depth_gate = None
         self._auto_fuse = None
         if self.kernel_language == "auto":
             # Resolve via the ICI cost model for the ACTUAL run config
@@ -523,6 +537,11 @@ class Simulation:
                 model=self.model.name,
                 n_fields=self.model.n_fields,
                 pallas_allowed=self.model.pallas_capable,
+                # A pinned s-step depth joins the tuning-cache key and
+                # is respected, not searched; "auto" (0) lets the
+                # tuner widen the shortlist across k.
+                halo_depth=(self.halo_depth if self._halo_depth_pinned
+                            else 0),
                 **self._tune_extras(),
             )
             self.kernel_selection["autotune"] = decision.provenance
@@ -535,6 +554,9 @@ class Simulation:
                         and config.resolve_comm_overlap(settings)
                         == "auto"):
                     self.comm_overlap = decision.comm_overlap
+                if (decision.halo_depth is not None
+                        and not self._halo_depth_pinned):
+                    self.halo_depth = max(1, int(decision.halo_depth))
                 if decision.bx is not None and not _os.environ.get(
                         "GS_BX", ""):
                     # GS_BX is read at kernel-trace time; an env pin is
@@ -558,6 +580,55 @@ class Simulation:
                 )
         else:
             self.kernel_selection = None
+        if self.kernel_language == "pallas" and self.halo_depth > 1:
+            # The Pallas in-kernel chains have no s-step schedule (the
+            # fused chain IS their exchange amortization, and its depth
+            # is VMEM-bound) — degrade to k=1 LOUDLY and record it, so
+            # a config written for the XLA path never silently changes
+            # meaning here (docs/TEMPORAL.md "Interactions").
+            self.halo_depth_gate = {
+                "requested": self.halo_depth,
+                "applied": 1,
+                "reason": (
+                    "the Pallas in-kernel chain amortizes its exchange "
+                    "via fuse depth; s-step halo_depth applies to the "
+                    "XLA chain paths only"
+                ),
+            }
+            if isinstance(self.kernel_selection, dict):
+                self.kernel_selection["halo_depth_gate"] = (
+                    self.halo_depth_gate
+                )
+            if _is_primary():
+                import sys as _sys
+
+                print(
+                    f"gray-scott: warning: halo_depth="
+                    f"{self.halo_depth} ignored for the Pallas kernel "
+                    "language (s-step exchange is an XLA-chain "
+                    "schedule); running with halo_depth=1",
+                    file=_sys.stderr,
+                )
+            self.halo_depth = 1
+        if self.sharded and self.halo_depth > 1:
+            # The s-step frame is exchanged in ONE single-hop round:
+            # every slab must consist of owned cells, so the effective
+            # exchange depth (chain depth x k) cannot exceed the local
+            # block's smallest extent. Refuse loudly at construction —
+            # a silently-capped k would misreport the schedule every
+            # artifact records.
+            d = max(1, min(self._fuse_base(),
+                           min(self.domain.local_shape)))
+            deep = d * self.halo_depth
+            cap = min(self.domain.local_shape)
+            if deep > cap:
+                raise config.SettingsError(
+                    f"halo_depth={self.halo_depth} needs a {deep}-deep "
+                    f"ghost exchange (chain depth {d} x halo_depth), "
+                    f"but the local block {self.domain.local_shape} "
+                    f"supports at most {cap}; lower halo_depth/GS_FUSE "
+                    "or use fewer devices per axis"
+                )
         self.params = self._make_params()
         self.use_noise = self._resolve_use_noise()
         self.base_key = self._make_base_key(seed)
@@ -1066,6 +1137,17 @@ class Simulation:
         # shrinking-window program the band recomputes use, which is
         # what makes the split-phase stitch bitwise.
         fuse = min(self._fuse_base(), nsteps, min(self.domain.local_shape))
+        if self.halo_depth > 1:
+            # Communication-avoiding s-step schedule (docs/TEMPORAL.md):
+            # one exchange round carries a (fuse x halo_depth)-deep
+            # frame and the shrinking-window chain advances all of it
+            # before the next exchange — the same program shape a
+            # (fuse x halo_depth)-deep chain round lowers to, so
+            # halo_depth=k at depth d is bitwise identical to
+            # halo_depth=1 at depth k*d. Geometry was validated at
+            # construction; nsteps still bounds the final round.
+            fuse = min(fuse * self.halo_depth, nsteps,
+                       min(self.domain.local_shape))
 
         def chain(fields_c, step, depth):
             """``depth`` steps from one ``depth``-wide exchange."""

@@ -79,6 +79,9 @@ class TuneDecision:
     #: Ensemble member-axis split the winner measured fastest (None:
     #: leave the configured split alone; docs/ENSEMBLE.md).
     member_shards: Optional[int] = None
+    #: s-step exchange depth the winner measured fastest (None: leave
+    #: the resolved halo_depth alone; docs/TEMPORAL.md).
+    halo_depth: Optional[int] = None
 
 
 def _emit_event(prov: dict, kernel: str) -> None:
@@ -92,10 +95,12 @@ def _emit_event(prov: dict, kernel: str) -> None:
     stream = obs_events.get_events()
     if not stream.enabled:
         return
+    winner = prov.get("winner") or {}
     stream.emit(
         "autotune", phase="compile",
         mode=prov.get("mode"), source=prov.get("source"),
         cache=prov.get("cache"), kernel=kernel,
+        halo_depth=winner.get("halo_depth"),
         candidates_timed=prov.get("candidates_timed"),
         tuning_s=prov.get("tuning_s"),
     )
@@ -114,6 +119,7 @@ def _analytic_decision(mode: str, analytic_kernel: str,
 
 def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
     ms = winner.get("member_shards")
+    sk = winner.get("halo_depth")
     _emit_event(prov, winner["kernel"])
     return TuneDecision(
         kernel=winner["kernel"],
@@ -122,6 +128,10 @@ def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
         bx=winner.get("bx"),
         provenance=prov,
         member_shards=int(ms) if ms is not None else None,
+        # Pre-v4 records carry no halo_depth; None leaves the run's
+        # resolved value alone (they are structurally invisible anyway
+        # — the schema bump orphaned them).
+        halo_depth=int(sk) if sk is not None else None,
     )
 
 
@@ -150,6 +160,7 @@ def autotune(
     model: str = "grayscott",
     n_fields: int = 2,
     pallas_allowed: bool = True,
+    halo_depth: int = 0,
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -171,7 +182,8 @@ def autotune(
 
     mode = resolve_mode(settings)
     gate = {"model": model, "n_fields": n_fields,
-            "pallas_allowed": bool(pallas_allowed)}
+            "pallas_allowed": bool(pallas_allowed),
+            "halo_depth_pin": int(halo_depth)}
     if mode == "off":
         return _analytic_decision(mode, analytic_kernel, gate)
 
@@ -179,6 +191,7 @@ def autotune(
         device_kind=device_kind, platform=platform, dims=dims, L=L,
         dtype=dtype, noise=noise, jax_version=jax.__version__,
         ensemble=ensemble, model=model, n_fields=n_fields,
+        halo_depth=halo_depth,
     )
     rec = cache.load(key)
     if rec is not None:
@@ -219,7 +232,7 @@ def autotune(
         top_n=_top_n(mode),
         bx_variants=2 if mode == "full" else 0,
         ensemble=ensemble, member_shards=member_shards,
-        pallas_allowed=pallas_allowed,
+        pallas_allowed=pallas_allowed, halo_depth=halo_depth,
     )
     steps = int(os.environ.get("GS_AUTOTUNE_STEPS", "20"))
     rounds = int(os.environ.get("GS_AUTOTUNE_ROUNDS",

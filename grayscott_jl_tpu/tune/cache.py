@@ -34,8 +34,13 @@ from typing import Optional
 #: Brusselator run must never adopt a Gray-Scott-measured winner (a
 #: different reaction is a different program, and a different field
 #: count moves different halo bytes); stale v2 entries degrade to the
-#: analytic pick exactly like any other miss.
-SCHEMA_VERSION = 3
+#: analytic pick exactly like any other miss. v4: the key grew
+#: ``halo_depth`` — the operator's s-step exchange pin (0 = auto;
+#: docs/TEMPORAL.md): a run pinned to a given k measures a constrained
+#: candidate space, so pinned and auto runs must never share winners;
+#: stale v3 entries degrade to the analytic pick with the usual
+#: warning.
+SCHEMA_VERSION = 4
 
 
 def cache_dir() -> str:
@@ -60,6 +65,7 @@ def cache_key(
     ensemble: int = 1,
     model: str = "grayscott",
     n_fields: int = 2,
+    halo_depth: int = 0,
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
@@ -68,7 +74,10 @@ def cache_key(
     changes the measured schedule, so ensemble sizes never share
     winners. ``model``/``n_fields`` (schema v3) identify the registered
     model: measurements of one reaction/field-count never apply to
-    another."""
+    another. ``halo_depth`` (schema v4) is the operator's s-step
+    exchange pin (0 = auto-searched): a pinned run measures a
+    constrained shortlist, so its winner must never leak into an
+    auto run or a differently-pinned one."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -81,6 +90,7 @@ def cache_key(
         "ensemble": int(ensemble),
         "model": str(model),
         "n_fields": int(n_fields),
+        "halo_depth": int(halo_depth),
     }
 
 

@@ -31,6 +31,10 @@ class Candidate:
     kernel: str  # "pallas" | "xla"
     fuse: int  # chain / temporal-blocking depth (GS_FUSE)
     comm_overlap: bool  # split-phase exchange armed (GS_COMM_OVERLAP)
+    #: s-step exchange depth (GS_HALO_DEPTH, docs/TEMPORAL.md): one
+    #: (fuse x halo_depth)-deep exchange per halo_depth chain rounds.
+    #: Always 1 for Pallas candidates (no s-step schedule there).
+    halo_depth: int = 1
     bx: Optional[int] = None  # Pallas slab depth (GS_BX); None = auto
     projected_step_us: Optional[float] = None  # model rank, None = unscored
     analytic: bool = False  # this is the model's own pick
@@ -45,6 +49,8 @@ class Candidate:
     def label(self) -> str:
         parts = [self.kernel, f"fuse={self.fuse}",
                  "overlap" if self.comm_overlap else "fused"]
+        if self.halo_depth != 1:
+            parts.append(f"sk={self.halo_depth}")
         if self.bx is not None:
             parts.append(f"bx={self.bx}")
         if self.member_shards is not None:
@@ -126,6 +132,7 @@ def generate(
     ensemble: int = 1,
     member_shards: int = 1,
     pallas_allowed: bool = True,
+    halo_depth: int = 0,
 ) -> List[Candidate]:
     """The ranked measurement shortlist for one run config.
 
@@ -137,6 +144,14 @@ def generate(
     Off-TPU the Pallas rows are excluded outright: the interpret-mode
     path is a correctness tool ~1000x off, and timing it would burn the
     whole budget saying so.
+
+    ``halo_depth`` is the s-step-exchange pin: 0 (auto) widens XLA
+    candidates across k in {1, 2, 4} wherever the local block supports
+    the (fuse x k)-deep exchange; an explicit value is respected, not
+    searched (infeasible fuse/k combinations are pruned by the same
+    geometry rule ``simulation.py`` validates with a SettingsError).
+    Pallas candidates always carry k=1 — no s-step schedule exists
+    there (docs/TEMPORAL.md "Interactions").
 
     Ensemble runs (``ensemble > 1``, ``member_shards`` the configured
     member-axis split) additionally search the batch-size x block-shape
@@ -163,11 +178,11 @@ def generate(
         if depths:
             langs["pallas"] = depths
 
-    def score(kernel, fuse, ov):
+    def score(kernel, fuse, ov, sk=1):
         us = icimodel.projected_step_us(
             kernel, dims, L, fuse, itemsize=itemsize, links=links,
             link_gbps=link_gbps, local=local,
-            overlap="auto" if ov else 0.0,
+            overlap="auto" if ov else 0.0, halo_depth=sk,
         )
         if us is not None and ensemble > 1:
             # Rank ensembles by the batch each device group carries so
@@ -175,19 +190,34 @@ def generate(
             us = us * (ensemble / max(member_shards, 1))
         return us
 
+    analytic_sk = max(1, int(halo_depth)) if halo_depth else 1
+
+    def sstep_depths(kernel, fuse):
+        """s-step depths to enumerate for one (kernel, fuse): Pallas
+        and single-device runs have no s-step schedule; XLA candidates
+        search {1, 2, 4} (or honor the pin) within the same geometry
+        bound the runner validates (fuse x k <= min local extent)."""
+        if kernel != "xla" or not sharded:
+            return [1]
+        ks = [halo_depth] if halo_depth else [1, 2, 4]
+        return [k for k in ks if fuse * k <= min(local)] or [1]
+
     ens_tag = member_shards if ensemble > 1 else None
     out = []
     for kernel, depths in langs.items():
         for fuse in depths:
             for ov in overlaps if sharded else [False]:
-                out.append(Candidate(
-                    kernel=kernel, fuse=fuse, comm_overlap=ov,
-                    projected_step_us=score(kernel, fuse, ov),
-                    analytic=(kernel == analytic_kernel
-                              and fuse == analytic_fuse
-                              and ov == comm_overlap),
-                    member_shards=ens_tag,
-                ))
+                for sk in sstep_depths(kernel, fuse):
+                    out.append(Candidate(
+                        kernel=kernel, fuse=fuse, comm_overlap=ov,
+                        halo_depth=sk,
+                        projected_step_us=score(kernel, fuse, ov, sk),
+                        analytic=(kernel == analytic_kernel
+                                  and fuse == analytic_fuse
+                                  and ov == comm_overlap
+                                  and sk == analytic_sk),
+                        member_shards=ens_tag,
+                    ))
 
     if ensemble > 1:
         # Batch-size x block-shape trade-off: alternative member-axis
@@ -231,9 +261,11 @@ def generate(
         out.append(Candidate(
             kernel=analytic_kernel, fuse=analytic_fuse,
             comm_overlap=comm_overlap if sharded else False,
+            halo_depth=analytic_sk if analytic_kernel == "xla" else 1,
             projected_step_us=score(
                 analytic_kernel, analytic_fuse,
-                comm_overlap if sharded else False),
+                comm_overlap if sharded else False,
+                analytic_sk if analytic_kernel == "xla" else 1),
             analytic=True,
             member_shards=ens_tag,
         ))

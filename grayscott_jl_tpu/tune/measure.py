@@ -70,6 +70,7 @@ def pinned_settings(settings, candidate: Candidate):
         kernel_language="Pallas" if candidate.kernel == "pallas"
         else "Plain",
         comm_overlap="on" if candidate.comm_overlap else "off",
+        halo_depth=max(1, int(getattr(candidate, "halo_depth", 1))),
         # Tuning is a construction-time concern; the pinned probe sims
         # must not arm supervision, restart, or checkpoint machinery.
         supervise=False, restart=False, checkpoint=False,
@@ -153,9 +154,12 @@ def measure_candidates(
         pin_mesh = cand.mesh if cand.mesh is not None else dims
         pins = {"GS_FUSE": cand.fuse, "GS_BX": cand.bx,
                 "GS_TPU_MESH_DIMS": ",".join(str(d) for d in pin_mesh),
-                # The Settings pin below would lose to a stray
-                # GS_COMM_OVERLAP=auto in the environment.
+                # The Settings pins below would lose to stray
+                # GS_COMM_OVERLAP/GS_HALO_DEPTH in the environment.
                 "GS_COMM_OVERLAP": "on" if cand.comm_overlap else "off",
+                "GS_HALO_DEPTH": max(
+                    1, int(getattr(cand, "halo_depth", 1))
+                ),
                 # A probe sim must never consult or write the tuning
                 # cache itself.
                 "GS_AUTOTUNE": "off"}

@@ -75,9 +75,25 @@ def check(trace_path, events_path, stats_path) -> int:
     if stats_path:
         try:
             with open(stats_path, encoding="utf-8") as f:
-                json.load(f)
+                stats = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             problems.append(f"stats {stats_path}: unreadable ({e})")
+        else:
+            comm = stats.get("comm") if isinstance(stats, dict) else None
+            if isinstance(comm, dict):
+                # The s-step visibility fields (docs/TEMPORAL.md) are
+                # part of the comm schema: a stats writer that drops
+                # them silently hides the exchange cadence the
+                # halo_depth knob exists to change.
+                missing = [k for k in ("halo_depth",
+                                       "exchanges_per_step",
+                                       "halo_bytes_per_step")
+                           if k not in comm]
+                if missing:
+                    problems.append(
+                        f"stats {stats_path}: comm section missing "
+                        f"{missing}"
+                    )
     for p in problems:
         print(f"gs_report: FAIL — {p}", file=sys.stderr)
     if not problems:
@@ -116,6 +132,12 @@ def report_stats(stats: dict) -> None:
               f"{comm.get('hidden_us')}us exposed="
               f"{comm.get('exposed_us')}us "
               f"(overlap={comm.get('overlap')})")
+        ex = comm.get("exchanges_per_step")
+        if ex is not None:
+            per = round(1.0 / ex, 2) if ex else float("inf")
+            print(f"  halo_depth={comm.get('halo_depth')}: one exchange "
+                  f"per {per} steps, "
+                  f"{comm.get('halo_bytes_per_step')} halo B/step")
     metrics = stats.get("metrics")
     if metrics:
         for h in metrics.get("histograms", []):

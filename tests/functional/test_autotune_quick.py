@@ -49,7 +49,14 @@ def test_quick_mode_smoke_through_run_once(tmp_path, monkeypatch):
     from grayscott_jl_tpu.tune import measure
 
     def fake_timer(sim, steps, rounds, deadline):
-        us = 500.0 if not sim.comm_overlap else 800.0
+        # Reward the s-step candidates so the measured winner differs
+        # from the analytic pick on BOTH searched axes (overlap off,
+        # halo_depth deepened) — the probe sim carries the candidate's
+        # resolved schedule, so keying on it is exact.
+        if sim.halo_depth > 1:
+            us = 500.0
+        else:
+            us = 900.0 if sim.comm_overlap else 700.0
         return {"median": us / 1e6, "best": us / 1e6,
                 "rounds_s_per_step": [us / 1e6] * rounds}
 
@@ -68,8 +75,10 @@ def test_quick_mode_smoke_through_run_once(tmp_path, monkeypatch):
     assert prov["source"] == "measured"
     assert prov["candidates_timed"] >= 2
     assert prov["tuning_s"] >= 0
-    assert prov["winner"]["comm_overlap"] is False  # the fake's winner
+    assert prov["winner"]["halo_depth"] > 1  # the fake's winner
     assert prov["measured_pick_us"] == 500.0
+    # the adopted s-step depth is the one the run actually used
+    assert stats["config"]["halo_depth"] == prov["winner"]["halo_depth"]
     # the winner is on disk for the next run
     assert os.path.isfile(prov["cache_path"])
 

@@ -395,3 +395,35 @@ def test_restart_determinism_across_async_depth(tmp_path, depth):
             full.get(var, step=full.num_steps() - 1),
             resumed.get(var, step=resumed.num_steps() - 1),
         )
+
+
+def test_chaos_preempt_at_halo_depth_2_byte_identical(tmp_path):
+    """The s-step schedule (halo_depth=2, docs/TEMPORAL.md) under a
+    mid-run preemption: the supervised run resumes from the durable
+    checkpoint and finishes with stores byte-identical to an
+    uninterrupted halo_depth=2 run — restart replay composes with the
+    k-deep exchange cadence (checkpoint steps need not align with
+    exchange rounds; the runner re-chains from any step), and the
+    stats config echo records the k the run actually used."""
+    base = tmp_path / "k2_base"
+    base.mkdir()
+    cfg = write_config(
+        base, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(base, cfg, extra_env={"GS_HALO_DEPTH": "2"})
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    d, res, stats_path = _supervised(
+        tmp_path, "k2_chaos", "step=45:kind=preempt",
+        extra_env={"GS_HALO_DEPTH": "2"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(base / store, d / store)
+
+    stats = json.loads(stats_path.read_text())
+    assert stats["config"]["halo_depth"] == 2
+    assert stats["comm"]["halo_depth"] == 2
+    recoveries = [e for e in stats["faults"] if e["event"] == "recovery"]
+    assert [e["kind"] for e in recoveries] == ["preemption"]

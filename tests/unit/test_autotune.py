@@ -117,7 +117,7 @@ def test_cache_roundtrip_hit():
 @pytest.mark.parametrize("field,value", [
     ("L", 512), ("dims", (4, 2, 1)), ("dtype", "bfloat16"),
     ("device_kind", "TPU v5p"), ("platform", "cpu"), ("noise", 0.0),
-    ("jax_version", "999.0"),
+    ("jax_version", "999.0"), ("halo_depth", 2),
 ])
 def test_cache_key_field_mismatch_misses(field, value):
     cache.store(_key(), {"winner": _winner()})
@@ -252,6 +252,11 @@ def _autotune(settings, mode, timer=None, dims=(2, 2, 2), **kw):
         dtype="float32", noise=settings.noise, itemsize=4,
         n_devices=8, seed=0, analytic_kernel="xla", analytic_fuse=2,
         comm_overlap=True, overlap_toggle=True,
+        # These decision-path tests pin the s-step depth so the
+        # shortlist stays the historical kernel x fuse x overlap space;
+        # the k-search axis has its own coverage in
+        # tests/unit/test_halo_depth.py.
+        halo_depth=1,
     )
     base.update(kw)
     os.environ["GS_AUTOTUNE"] = mode
@@ -342,6 +347,7 @@ def test_cached_mode_corrupt_entry_degrades_to_analytic(capsys):
     key = cache.cache_key(
         device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=s.L,
         dtype="float32", noise=s.noise, jax_version=jax.__version__,
+        halo_depth=1,  # matches the _autotune harness pin
     )
     path = cache.entry_path(key)
     os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -1,0 +1,427 @@
+"""Communication-avoiding s-step halo exchange (``halo_depth``,
+docs/TEMPORAL.md).
+
+The contract under test, layer by layer:
+
+* **Resolution** — ``GS_HALO_DEPTH`` env wins over the ``halo_depth``
+  TOML key; 0/"auto" resolve to today's schedule; garbage is loud.
+* **Program identity** — ``halo_depth=k`` at chain-depth base ``d``
+  IS the depth-``k*d`` chain: the runner lowers ONE widened exchange
+  feeding ``k*d`` shrinking-window steps, so it is bitwise identical
+  to ``halo_depth=1`` at ``GS_FUSE=k*d`` (same program, same HLO) —
+  for every registered model, on even and uneven L, for ensembles,
+  and composed with split-phase overlap.
+* **k=1 is a no-op** — default-config trajectories and compiled
+  collective counts are reproduced exactly.
+* **Same-base comparison** — k>1 vs k=1 at the SAME fuse base changes
+  window shapes, which XLA:CPU's FP-contraction keys on: equal within
+  the documented ``assert_chain_equal`` ulp bound here, bitwise on
+  TPU (the same backend caveat as every chain-vs-stepwise pair in
+  ``test_sharded``).
+* **Gates** — Pallas chains have no s-step schedule (warned degrade
+  to 1, recorded in provenance); a k the local block cannot serve is
+  a construction-time ``SettingsError``.
+* **Tuning** — k joins the candidate axes (searched when auto, pinned
+  when explicit, geometry-pruned), the v4 cache key, and the cost
+  model; stale pre-v4 records degrade to analytic with a warning.
+* **Visibility** — ``comm_report`` carries exchanges-per-step and
+  halo-bytes-per-step, and ``gs_report.py --check`` rejects a stats
+  file whose comm section lost them.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config import settings as config
+from grayscott_jl_tpu.config.settings import Settings, SettingsError
+from grayscott_jl_tpu.parallel import icimodel
+from grayscott_jl_tpu.simulation import Simulation
+from grayscott_jl_tpu.tune import cache, candidates, measure
+
+from test_sharded import assert_chain_equal
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _settings(L=16, noise=0.1, **kw):
+    return Settings(
+        L=L, noise=noise, precision="Float32", backend="CPU",
+        **{**PARAMS, **kw},
+    )
+
+
+def _run(k, fuse, monkeypatch, L=16, steps=8, n_devices=8, seed=0,
+         noise=0.1, **kw):
+    """Trajectory at s-step depth ``k`` over chain base ``fuse``."""
+    monkeypatch.setenv("GS_FUSE", str(fuse))
+    sim = Simulation(
+        _settings(L=L, noise=noise, halo_depth=k, **kw),
+        n_devices=n_devices, seed=seed,
+    )
+    assert sim.halo_depth == k
+    sim.iterate(steps)
+    monkeypatch.delenv("GS_FUSE")
+    return [np.asarray(f) for f in sim.get_fields()]
+
+
+# ------------------------------------------------------------- resolution
+
+def test_resolve_defaults_to_auto_depth_1(monkeypatch):
+    monkeypatch.delenv("GS_HALO_DEPTH", raising=False)
+    assert config.resolve_halo_depth(_settings()) == (False, 1)
+    assert config.resolve_halo_depth(
+        _settings(halo_depth=0)) == (False, 1)
+
+
+def test_resolve_toml_pin_and_env_override(monkeypatch):
+    monkeypatch.delenv("GS_HALO_DEPTH", raising=False)
+    assert config.resolve_halo_depth(
+        _settings(halo_depth=3)) == (True, 3)
+    monkeypatch.setenv("GS_HALO_DEPTH", "2")
+    assert config.resolve_halo_depth(
+        _settings(halo_depth=3)) == (True, 2)
+    monkeypatch.setenv("GS_HALO_DEPTH", "auto")
+    assert config.resolve_halo_depth(
+        _settings(halo_depth=3)) == (False, 1)
+
+
+@pytest.mark.parametrize("bad", ["1.5", "deep", "-2"])
+def test_resolve_rejects_garbage(monkeypatch, bad):
+    monkeypatch.setenv("GS_HALO_DEPTH", bad)
+    with pytest.raises(ValueError):
+        config.resolve_halo_depth(_settings())
+
+
+# --------------------------------------------------- trajectory identity
+
+def test_single_device_k_is_a_bitwise_noop(monkeypatch):
+    """Unsharded runs have no exchange to avoid: any k is accepted and
+    the trajectory is the default one, bitwise."""
+    monkeypatch.setenv("GS_FUSE", "1")
+    ref = Simulation(_settings(), n_devices=1)
+    ref.iterate(6)
+    deep = Simulation(_settings(halo_depth=4), n_devices=1)
+    deep.iterate(6)
+    for a, b in zip(ref.get_fields(), deep.get_fields()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@requires8
+@pytest.mark.parametrize("k", [2, 4])
+def test_sstep_is_the_deep_chain_program_bitwise(monkeypatch, k):
+    """THE s-step contract (docs/TEMPORAL.md): halo_depth=k over chain
+    base d is the SAME program as halo_depth=1 at GS_FUSE=k*d — one
+    (k*d)-deep corner-propagated exchange feeding k*d shrinking-window
+    steps — so the trajectories are bitwise identical, noise on, on
+    the (2,2,2) mesh. No new numerics enter with k; only the exchange
+    cadence changes."""
+    a = _run(k, 1, monkeypatch)
+    b = _run(1, k, monkeypatch)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+def test_sstep_composes_with_chain_depth_bitwise(monkeypatch):
+    """k=2 on a depth-2 base == one depth-4 chain, bitwise."""
+    for x, y in zip(_run(2, 2, monkeypatch), _run(1, 4, monkeypatch)):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("model", ["grayscott", "brusselator", "fhn",
+                                   "heat"])
+def test_sstep_program_identity_every_model(monkeypatch, model):
+    """The bitwise contract holds for every registered model — the
+    s-step schedule lives in ``parallel/``, which carries zero
+    per-model code (test_models asserts the grep)."""
+    kw = {} if model == "grayscott" else {"model": model}
+    a = _run(2, 1, monkeypatch, steps=6, **kw)
+    b = _run(1, 2, monkeypatch, steps=6, **kw)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("k", [2, 4])
+def test_uneven_L_program_identity_bitwise(monkeypatch, k):
+    """Non-divisible L (pad-and-mask blocks): the widened exchange and
+    per-stage global-coordinate pinning keep pad cells invisible at
+    every s-step stage — bitwise vs the equivalent deep chain."""
+    a = _run(k, 1, monkeypatch, L=22, steps=5, seed=3)
+    b = _run(1, k, monkeypatch, L=22, steps=5, seed=3)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("k", [2, 4])
+def test_sstep_same_base_vs_k1_within_chain_bound(monkeypatch, k):
+    """k vs k=1 at the SAME fuse base: the s-step run advances through
+    (k*d)-wide windows where the k=1 run uses d-wide ones, and XLA:CPU
+    FP-contraction (FMA formation) is window-shape-sensitive — the
+    comparison lands within the same documented ulp-scale bound as
+    every chain-vs-stepwise pair (``assert_chain_equal``; measured
+    ~9e-8 max abs here). On TPU the compiled programs agree exactly.
+    The *bitwise* statement of the k contract is the program-identity
+    test above."""
+    a = _run(1, 1, monkeypatch)
+    b = _run(k, 1, monkeypatch)
+    for x, y in zip(a, b):
+        assert_chain_equal(x, y)
+
+
+@requires8
+def test_sstep_composes_with_overlap_bitwise(monkeypatch):
+    """Split-phase overlap on the 1D x-sharded mesh at k=2: the k-deep
+    transfer is issued with no consumer on the interior chain's
+    dataflow path and the stitched bands reproduce the fused s-step
+    round bitwise — PR 3's on/off contract extends to every k."""
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    monkeypatch.setenv("GS_COMM_OVERLAP", "on")
+    a = _run(2, 1, monkeypatch, seed=5)
+    monkeypatch.setenv("GS_COMM_OVERLAP", "off")
+    b = _run(2, 1, monkeypatch, seed=5)
+    monkeypatch.delenv("GS_COMM_OVERLAP")
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+def test_ensemble_member_is_bitwise_solo_at_k2(monkeypatch):
+    """The ensemble equality contract survives s-step exchange: member
+    m of an N-member run at halo_depth=2 == the solo run with member
+    m's params and seed, bitwise, on the same (2,2,2) spatial mesh."""
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+    from grayscott_jl_tpu.ensemble.io import member_settings
+
+    monkeypatch.setenv("GS_FUSE", "1")
+    s = _settings(halo_depth=2)
+    s.ensemble = ens_spec.from_toml(
+        {"presets": ["spots", "chaos"], "member_shards": 1}, s
+    )
+    ens = EnsembleSimulation(s, n_devices=8, seed=3)
+    assert ens.halo_depth == 2
+    ens.iterate(6)
+    ue, ve = ens.get_fields()
+    for m in range(2):
+        solo = Simulation(member_settings(s, m), n_devices=8,
+                          seed=3 + m)
+        assert solo.halo_depth == 2
+        solo.iterate(6)
+        us, vs = solo.get_fields()
+        np.testing.assert_array_equal(ue[m], np.asarray(us))
+        np.testing.assert_array_equal(ve[m], np.asarray(vs))
+
+
+# ------------------------------------------------------- compiled shape
+
+def _collective_count(sim, nsteps=8):
+    import re
+
+    import jax.numpy as jnp
+
+    txt = sim._runner(nsteps).lower(
+        *sim.fields, sim.base_key, jnp.int32(0), sim.params
+    ).compile().as_text()
+    return len(re.findall(r"collective-permute(?:-start)?\(", txt))
+
+
+@requires8
+def test_halo_depth_1_reproduces_todays_collective_count(monkeypatch):
+    """halo_depth=1 is byte-for-byte today's schedule: the compiled
+    8-step runner carries exactly the same collective-permute count as
+    a build that never heard of the knob (6 — one 6-ppermute exchange
+    per chain round; test_sharded asserts the baseline)."""
+    monkeypatch.setenv("GS_FUSE", "4")
+    base = Simulation(_settings(), n_devices=8)
+    pinned = Simulation(_settings(halo_depth=1), n_devices=8)
+    assert _collective_count(base) == _collective_count(pinned) == 6
+
+
+@requires8
+def test_sstep_round_still_one_exchange(monkeypatch):
+    """A k=2 round over base 2 lowers to ONE 6-ppermute exchange per
+    (now 4-step) round — deepening the frame must not add collectives
+    to the round body."""
+    monkeypatch.setenv("GS_FUSE", "2")
+    sim = Simulation(_settings(halo_depth=2), n_devices=8)
+    assert _collective_count(sim) == 6
+
+
+# ----------------------------------------------------------------- gates
+
+@requires8
+def test_infeasible_k_is_a_loud_settings_error(monkeypatch):
+    """chain base 4 x k=4 needs a 16-deep exchange; an 8^3 local block
+    cannot serve it — construction refuses with the geometry spelled
+    out rather than silently capping the schedule."""
+    monkeypatch.setenv("GS_FUSE", "4")
+    with pytest.raises(SettingsError, match="halo_depth=4"):
+        Simulation(_settings(halo_depth=4), n_devices=8)
+
+
+@requires8
+def test_pallas_gate_degrades_to_1_with_provenance(monkeypatch, capsys):
+    """The Pallas in-kernel chains have no s-step schedule (fuse depth
+    IS their exchange amortization): an explicit k>1 warns, runs at
+    k=1, and records the gate in kernel_selection provenance."""
+    monkeypatch.setenv("GS_FUSE", "1")
+    sim = Simulation(
+        _settings(halo_depth=2, kernel_language="Pallas"), n_devices=8
+    )
+    assert sim.halo_depth == 1
+    assert sim.halo_depth_gate["requested"] == 2
+    assert sim.halo_depth_gate["applied"] == 1
+    assert "halo_depth=2 ignored" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------- tuning
+
+_GEN = dict(dims=(2, 2, 2), L=16, platform="cpu", itemsize=4,
+            fuse_cap=2, analytic_kernel="xla", analytic_fuse=1,
+            comm_overlap=False, overlap_toggle=False, top_n=99)
+
+
+def test_candidates_auto_widens_across_k():
+    cands = candidates.generate(halo_depth=0, **_GEN)
+    xla_ks = {c.halo_depth for c in cands if c.kernel == "xla"}
+    assert {1, 2, 4} <= xla_ks
+    assert all(c.halo_depth == 1 for c in cands if c.kernel == "pallas")
+    # the s-step variants are labeled for provenance/artifacts
+    assert any("sk=2" in c.label() for c in cands)
+
+
+def test_candidates_respect_an_explicit_pin():
+    cands = candidates.generate(halo_depth=2, **_GEN)
+    assert {c.halo_depth for c in cands if c.kernel == "xla"} == {2}
+
+
+def test_candidates_prune_infeasible_k():
+    """local 2^3 at L=16 on a (8,1,1)-ish split: fuse*k must stay
+    within the min local extent, same rule as the SettingsError."""
+    gen = dict(_GEN, dims=(8, 1, 1), L=16)  # local (2, 16, 16)
+    cands = candidates.generate(halo_depth=0, **gen)
+    assert all(c.fuse * c.halo_depth <= 2
+               for c in cands if c.kernel == "xla")
+
+
+def test_model_prices_sstep_latency_amortization():
+    """On a latency-dominated config the projected step time strictly
+    improves with k, and the Pallas language is unscored at k>1 (no
+    such schedule exists to project)."""
+    us = {
+        k: icimodel.projected_step_us(
+            "xla", (2, 2, 2), 16, 1, local=(8, 8, 8), halo_depth=k
+        )
+        for k in (1, 2, 4)
+    }
+    assert us[4] < us[2] < us[1]
+    assert icimodel.projected_step_us(
+        "pallas", (2, 2, 2), 16, 1, local=(8, 8, 8), halo_depth=2
+    ) is None
+
+
+def test_sstep_amortization_shape():
+    assert icimodel.sstep_amortization(1) == 1.0
+    a2, a4 = (icimodel.sstep_amortization(k) for k in (2, 4))
+    assert 0.0 < a4 < a2 < 1.0
+    # a perfectly-realized schedule keeps exactly 1/k of the latency
+    assert icimodel.sstep_amortization(4, efficiency=1.0) == (
+        pytest.approx(0.25)
+    )
+
+
+def test_probe_sim_carries_the_candidate_k(monkeypatch):
+    """The measured path pins the candidate's k into BOTH the Settings
+    and the env (a stray GS_HALO_DEPTH must not leak into a probe)."""
+    c = candidates.Candidate(kernel="xla", fuse=1, comm_overlap=False,
+                             halo_depth=4)
+    pinned = measure.pinned_settings(_settings(), c)
+    assert pinned.halo_depth == 4
+    monkeypatch.delenv("GS_HALO_DEPTH", raising=False)
+    assert config.resolve_halo_depth(pinned) == (True, 4)
+
+
+def test_cache_key_v4_carries_halo_depth(tmp_path):
+    """Schema v4: the key grew the s-step pin — a pinned run's winner
+    never leaks into an auto run; a forged record carrying the old v3
+    schema at the v4 path is a WARNED stale miss (the same degradation
+    contract ``test_autotune`` asserts for every bump)."""
+    key = cache.cache_key(
+        device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=16,
+        dtype="float32", noise=0.1, jax_version=jax.__version__,
+        halo_depth=2,
+    )
+    assert key["schema"] == cache.SCHEMA_VERSION == 4
+    assert key["halo_depth"] == 2
+    auto = cache.cache_key(
+        device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=16,
+        dtype="float32", noise=0.1, jax_version=jax.__version__,
+        halo_depth=0,
+    )
+    assert cache.key_digest(key) != cache.key_digest(auto)
+
+
+def test_cache_stale_v3_record_degrades_with_warning(tmp_path, capsys):
+    key = cache.cache_key(
+        device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=16,
+        dtype="float32", noise=0.1, jax_version=jax.__version__,
+        halo_depth=0,
+    )
+    root = str(tmp_path)
+    path = cache.entry_path(key, root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    v3_key = {k: v for k, v in key.items() if k != "halo_depth"}
+    v3_key["schema"] = 3
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"schema": 3, "key": v3_key,
+                   "winner": {"kernel": "xla", "fuse": 2,
+                              "comm_overlap": True}}, f)
+    assert cache.load(key, root) is None
+    assert "stale or malformed" in capsys.readouterr().err
+
+
+# ------------------------------------------------------------ visibility
+
+@requires8
+def test_comm_report_carries_sstep_fields(monkeypatch):
+    monkeypatch.setenv("GS_FUSE", "2")
+    sim = Simulation(_settings(halo_depth=2), n_devices=8)
+    rep = icimodel.comm_report(sim)
+    assert rep["halo_depth"] == 2
+    # base 2 x k=2 -> one exchange per 4 steps
+    assert rep["exchanges_per_step"] == pytest.approx(0.25)
+    assert rep["halo_bytes_per_step"] > 0
+
+
+def test_gs_report_check_rejects_missing_sstep_fields(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "gs_report",
+        os.path.join(os.path.dirname(__file__), "..", "..", "scripts",
+                     "gs_report.py"),
+    )
+    gs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gs_report)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"comm": {
+        "halo_depth": 2, "exchanges_per_step": 0.25,
+        "halo_bytes_per_step": 4096,
+    }}))
+    assert gs_report.check(None, None, str(good)) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"comm": {"hidden_us": 1.0}}))
+    assert gs_report.check(None, None, str(bad)) == 1
