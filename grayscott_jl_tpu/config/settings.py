@@ -128,6 +128,13 @@ class Settings:
     #: ONE compiled executable (``ensemble/engine.py``) with
     #: member-indexed output/checkpoint stores (``ensemble/io.py``).
     ensemble: Any = None
+    #: Elastic resharding on restore (extension; docs/RESHARD.md):
+    #: "auto" (default) lets a restart adopt the CURRENT mesh even when
+    #: the checkpoint was written on a different one (the restore path
+    #: selection-reads the new shards from the global-indexed store);
+    #: "off" refuses any restore-time layout change with a loud
+    #: ReshardError naming both layouts. GS_RESHARD env wins.
+    reshard: str = "auto"
     #: Metrics flush cadence in seconds (extension; obs/metrics.py,
     #: docs/OBSERVABILITY.md): with ``GS_METRICS=path`` armed, a
     #: snapshot record is appended to the JSONL at most this often
@@ -389,6 +396,26 @@ def resolve_halo_depth(settings: Settings) -> Tuple[bool, int]:
     if v == 0:
         return False, 1
     return True, int(v)
+
+
+def resolve_reshard(settings: Settings) -> str:
+    """Normalized elastic-reshard mode: ``"auto"`` (restore may adopt a
+    different mesh than the checkpoint's) or ``"off"`` (a layout change
+    at restore is a loud ReshardError). ``GS_RESHARD`` env wins over
+    the ``reshard`` TOML key, mirroring the other knobs."""
+    import os
+
+    raw = os.environ.get("GS_RESHARD")
+    if raw is None:
+        raw = getattr(settings, "reshard", "auto") or "auto"
+    v = raw.strip().lower()
+    v = {"1": "auto", "true": "auto", "yes": "auto", "on": "auto",
+         "0": "off", "false": "off", "no": "off", "": "auto"}.get(v, v)
+    if v not in ("auto", "off"):
+        raise ValueError(
+            f"reshard / GS_RESHARD must be auto/off, got {raw!r}"
+        )
+    return v
 
 
 #: Valid autotune modes (docs/TUNING.md); shared with

@@ -312,23 +312,23 @@ def _run_once_inner(
 
     restart_step = 0
     if settings.restart:
-        if ens is not None:
-            from .ensemble.io import restore_ensemble
+        # Elastic restore (docs/RESHARD.md): the checkpoint's recorded
+        # layout is compared against the mesh THIS run adopted; a
+        # mismatch reshards via per-new-shard selection reads (and, for
+        # ensembles, grows/shrinks the member set) with a `reshard`
+        # event on the journal and the unified stream.
+        from .reshard.restore import restore_run
 
-            restart_step = restore_ensemble(sim, settings)
+        restart_step, _plan = restore_run(
+            sim, settings, log=log, journal=journal
+        )
+        if ens is not None:
             log.info(
                 f"Restarted {ens.n} ensemble members from "
                 f"{settings.restart_input} member stores at step "
                 f"{restart_step}"
             )
         else:
-            from .io.checkpoint import open_checkpoint
-
-            reader, last, restart_step = open_checkpoint(
-                settings.restart_input, settings, settings.restart_step
-            )
-            sim.restore_from_reader(reader, last, restart_step)
-            reader.close()
             log.info(
                 f"Restarted from {settings.restart_input} at step "
                 f"{restart_step}"
@@ -352,6 +352,10 @@ def _run_once_inner(
         ckpt_cls(
             settings, sim.dtype, writer_id=proc, nwriters=nprocs,
             resume_step=restart_step if settings.restart else None,
+            # Elastic-resume metadata (docs/RESHARD.md): fresh stores
+            # record the writing run's layout so a future restore can
+            # plan an old->new reshard.
+            layout=sim.layout(),
         )
         if settings.checkpoint
         else None
@@ -384,6 +388,11 @@ def _run_once_inner(
         "n_processes": nprocs,
         "comm_overlap": sim.comm_overlap,
         "halo_depth": sim.halo_depth,
+        # Elastic-restore provenance: the old->new plan when this
+        # attempt resumed a checkpoint written on a different layout
+        # (mesh change, process-count change, ensemble grow); None
+        # otherwise. docs/RESHARD.md.
+        "reshard": sim.reshard,
         "compile_cache": sim.compile_cache_dir,
         # The resolved tuner mode rides in the config echo even for
         # explicitly-pinned kernel languages (where no tuning runs):

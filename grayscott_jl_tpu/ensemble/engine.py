@@ -366,6 +366,23 @@ class EnsembleSimulation(Simulation):
 
     # ----------------------------------------------------------- restore
 
+    def member_init_fields(self):
+        """Host initial fields of ONE member — what an elastic GROW
+        initializes new trailing members from (docs/RESHARD.md).
+
+        The model's init is parameter-independent by declaration
+        contract (it depends on L only), so a grown member's state at
+        the resume step is exactly the block a fresh member would have
+        started from; its trajectory from there equals a solo run of
+        its params/seed whose integration *begins* at the resume step
+        (the noise stream is keyed on absolute step, so joining late
+        does not alias any other member's draws).
+        """
+        return tuple(
+            np.asarray(f)
+            for f in self.model.init(self.settings.L, self.dtype)
+        )
+
     def restore_members(self, blocks: List, step: int) -> None:
         """Restore from per-member host field tuples (each field the
         true ``L^3`` domain, declaration order, from the member-indexed

@@ -138,15 +138,19 @@ class EnsembleCheckpointWriter:
         writer_id: int = 0,
         nwriters: int = 1,
         resume_step: Optional[int] = None,
+        layout=None,
     ):
         from ..io.checkpoint import CheckpointWriter
 
         self.n = settings.ensemble.n
+        # The SAME (spatial) layout record goes to every member store —
+        # it is exactly what an equivalent solo run would write, which
+        # preserves the member==solo store byte-identity contract.
         self.members: List[CheckpointWriter] = [
             CheckpointWriter(
                 member_settings(settings, i), dtype,
                 writer_id=writer_id, nwriters=nwriters,
-                resume_step=resume_step,
+                resume_step=resume_step, layout=layout,
             )
             for i in range(self.n)
         ]
@@ -161,46 +165,84 @@ class EnsembleCheckpointWriter:
             writer.close()
 
 
-def restore_ensemble(sim, settings: Settings) -> int:
-    """Restore every member from its member-indexed checkpoint store.
+def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
+    """Restore the ensemble from its member-indexed checkpoint stores —
+    elastically (docs/RESHARD.md).
 
     ``restart_step = -1`` resolves to the QUORUM step: the latest step
-    every member store holds durably (the minimum of the per-member
-    latest steps) — after an uneven crash the whole ensemble rolls back
-    together, keeping members in lockstep. An explicit ``restart_step``
-    must exist in every member store. Returns the restored step.
+    every *present* member store holds durably (the minimum of the
+    per-member latest steps) — after an uneven crash the whole ensemble
+    rolls back together, keeping members in lockstep. An explicit
+    ``restart_step`` must exist in every present member store.
+
+    Elastic semantics: the configured member count N' may differ from
+    the checkpointed N. **Grow** (N' > N): members beyond the present
+    store prefix initialize from their spec at the resume step
+    (``EnsembleSimulation.member_init_fields`` — the model's t=0 block;
+    position-keyed noise means a late joiner equals a solo run whose
+    integration begins at the resume step). **Shrink** (N' < N): only
+    the first N' stores are consulted; trailing members are dropped,
+    their stores left untouched. A GAP in the store prefix is a loud
+    :class:`~..reshard.plan.ReshardError` (``reshard/plan.member_map``).
+    The spatial mesh may change at the same time — each member restore
+    is a full-host-array restore, so the member path is layout-agnostic
+    by construction. Returns ``(restored_step, ReshardPlan)``.
     """
-    from ..io.checkpoint import open_checkpoint
+    import dataclasses as _dc
+
+    from ..io.checkpoint import (
+        latest_durable_step,
+        open_checkpoint,
+        read_layout,
+    )
+    from ..reshard import plan as plan_mod
+    from ..reshard.restore import layout_of
 
     n = settings.ensemble.n
+    latest = [
+        latest_durable_step(member_path(settings.restart_input, i, n))
+        for i in range(n)
+    ]
+    mapping = plan_mod.member_map([s is not None for s in latest], n)
+    restored = [i for action, i in mapping if action == "restore"]
+    grown = [i for action, i in mapping if action == "init"]
+    if grown and allow == "off":
+        raise plan_mod.ReshardError(
+            f"resuming {len(restored)} checkpointed members as {n} "
+            "(ensemble grow) is an elastic resume and reshard='off' "
+            "refuses it; set reshard='auto' (or GS_RESHARD=auto)"
+        )
     want = settings.restart_step
     if want < 0:
-        from ..io.checkpoint import latest_durable_step
-
-        latest = []
-        for i in range(n):
-            s = latest_durable_step(
-                member_path(settings.restart_input, i, n)
-            )
-            if s is None:
-                raise ValueError(
-                    f"member {i} checkpoint store "
-                    f"{member_path(settings.restart_input, i, n)} has no "
-                    "durable steps to resume from"
-                )
-            latest.append(s)
-        want = min(latest)
+        want = min(latest[i] for i in restored)
 
     field_names = get_model(settings.ensemble.model).field_names
     blocks = []
-    for i in range(n):
+    old = None
+    for action, i in mapping:
+        if action == "init":
+            blocks.append(sim.member_init_fields())
+            continue
         ms = member_settings(settings, i)
         reader, idx, step = open_checkpoint(ms.restart_input, ms, want)
         try:
+            if old is None:
+                # Member 0 speaks for the ensemble's old spatial layout
+                # (member stores are solo-identical, so they all carry
+                # the same record).
+                old = read_layout(reader)
             blocks.append(tuple(
                 reader.get(name, step=idx) for name in field_names
             ))
         finally:
             reader.close()
+    plan = plan_mod.plan_restore(
+        old, layout_of(sim), L=settings.L, allow=allow
+    )
+    members = {"restored": len(restored), "grown": len(grown),
+               "new_n": n}
+    plan = _dc.replace(
+        plan, members=members, changed=plan.changed or bool(grown)
+    )
     sim.restore_members(blocks, want)
-    return want
+    return want, plan

@@ -10,17 +10,28 @@ trajectory, because the noise key is folded per absolute step
 (``models/grayscott.py``).
 
 Checkpoints append as new steps in one store; restart loads the latest.
+
+Elastic resume (docs/RESHARD.md): the store additionally records the
+writing run's LAYOUT as attributes (mesh dims, axis names, process
+count, halo/chain config, schema version — ``reshard/plan.py``
+:data:`~..reshard.plan.LAYOUT_ATTRS`). The data was always
+global-indexed, so the layout record is provenance for the restore
+plan, not a restore requirement: a run checkpointed on mesh A can
+resume on mesh B by selection-reading B's shards out of the same
+store.
 """
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Optional, Tuple
 
 import numpy as np
 
 from ..config.settings import Settings, resolve_model
 from . import open_writer
-from .bplite import BpReader
+from .bplite import BpReader, _md_path
 
 
 class CheckpointWriter:
@@ -32,7 +43,15 @@ class CheckpointWriter:
         writer_id: int = 0,
         nwriters: int = 1,
         resume_step: Optional[int] = None,
+        layout=None,
     ):
+        """``layout`` (a :class:`~..reshard.plan.LayoutMeta`, or None)
+        is the writing run's decomposition record; written as store
+        attributes on a FRESH store only — an append (resume) keeps the
+        creation layout, so a resumed store's metadata stays
+        byte-identical to an uninterrupted run's even when the resuming
+        attempt adopted a different mesh (the per-step blocks say what
+        each attempt actually wrote)."""
         L = settings.L
         # On restart, append: truncating would destroy the very store the
         # run just resumed from when checkpoint_output == restart_input.
@@ -43,6 +62,12 @@ class CheckpointWriter:
             from . import count_steps_upto
 
             keep = count_steps_upto(settings.checkpoint_output, resume_step)
+        # Layout attributes go on fresh stores only (checkpoints are
+        # always BP-lite, so rank-0 metadata presence decides "fresh").
+        fresh = not (
+            settings.restart
+            and os.path.isfile(_md_path(settings.checkpoint_output))
+        )
         # Checkpoints stay on the BP-lite engines even when adios2 is
         # importable: rollback-append and selection-restore are BP-lite
         # semantics, and nothing downstream needs ADIOS2 byte
@@ -68,6 +93,18 @@ class CheckpointWriter:
             self.writer.define_attribute(
                 "fields", list(self.field_names)
             )
+            if layout is not None and fresh:
+                from ..reshard.plan import layout_attrs
+
+                for name, value in layout_attrs(
+                    mesh_dims=layout.mesh_dims,
+                    axis_names=layout.axis_names,
+                    process_count=layout.process_count,
+                    halo_depth=layout.halo_depth,
+                    chain_fuse=layout.chain_fuse,
+                    ensemble_size=layout.ensemble_size,
+                ).items():
+                    self.writer.define_attribute(name, value)
         self.writer.define_variable("step", np.int32)
         for name in self.field_names:
             self.writer.define_variable(
@@ -100,18 +137,56 @@ def latest_durable_step(path: str) -> Optional[int]:
     "latest durable checkpoint" and the multi-host checkpoint quorum
     (``resilience/rendezvous.py``: cluster ``min`` of these) are both
     built on it.
+
+    Hardened against corrupt or torn stores: a metadata file the
+    reader cannot even parse (truncated md.json from a dying
+    filesystem, scribbled bytes) degrades to "no durable checkpoint"
+    with a warning instead of propagating a parse error out of the
+    supervisor's restart loop — an unreadable store must cost the
+    trajectory (restart from scratch / drag the quorum down), never
+    the supervision itself.
     """
     try:
         r = BpReader(path)
     except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt store, documented
+        print(
+            f"gray-scott: warning: checkpoint store {path} is "
+            f"unreadable ({type(e).__name__}: {e}); treating as no "
+            "durable checkpoint",
+            file=sys.stderr,
+        )
         return None
     try:
         n = r.num_steps()
         if n == 0:
             return None
         return int(r.get("step", step=n - 1))
+    except Exception as e:  # noqa: BLE001 — torn step entry, documented
+        print(
+            f"gray-scott: warning: checkpoint store {path} has no "
+            f"readable step entries ({type(e).__name__}: {e}); "
+            "treating as no durable checkpoint",
+            file=sys.stderr,
+        )
+        return None
     finally:
         r.close()
+
+
+def read_layout(reader: BpReader):
+    """The store's recorded layout
+    (:class:`~..reshard.plan.LayoutMeta`), or None for a pre-elastic
+    store — the "old" side of a restore plan
+    (``reshard/plan.plan_restore``)."""
+    from ..reshard.plan import read_layout as _read
+
+    try:
+        attrs = reader.attributes()
+    except Exception:  # noqa: BLE001 — layout is advisory provenance
+        return None
+    return _read(attrs)
 
 
 def open_checkpoint(
@@ -136,6 +211,40 @@ def open_checkpoint(
     if int(attrs.get("L", settings.L)) != settings.L:
         raise ValueError(
             f"Checkpoint L={attrs['L']} does not match config L={settings.L}"
+        )
+    # Identity validation (loud, naming both sides): a store of one
+    # model/precision must never restore into a run of another — the
+    # variables would even happen to line up for same-arity models
+    # (a Brusselator store into a Gray-Scott run), silently fusing two
+    # different physics into one trajectory. Attributes absent from
+    # old stores are skipped: the store predates the metadata, and L/
+    # shape validation still applies.
+    model = resolve_model(settings)
+    stored_model = attrs.get("model")
+    if stored_model is not None and str(stored_model) != model.name:
+        raise ValueError(
+            f"Checkpoint store {path} holds model {stored_model!r} but "
+            f"this run integrates model {model.name!r}; point "
+            "restart_input at a matching store"
+        )
+    stored_fields = attrs.get("fields")
+    if stored_fields is not None and list(stored_fields) != list(
+        model.field_names
+    ):
+        raise ValueError(
+            f"Checkpoint store {path} holds fields "
+            f"{list(stored_fields)} but model {model.name!r} declares "
+            f"{list(model.field_names)}"
+        )
+    stored_precision = attrs.get("precision")
+    if stored_precision is not None and str(stored_precision) != str(
+        settings.precision
+    ):
+        raise ValueError(
+            f"Checkpoint store {path} was written at precision "
+            f"{stored_precision!r} but this run is configured for "
+            f"{settings.precision!r}; a silent dtype cast would fork "
+            "the trajectory"
         )
     if restart_step < 0:
         idx = n - 1

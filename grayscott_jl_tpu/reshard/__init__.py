@@ -1,0 +1,21 @@
+"""Elastic resharding: checkpoint on N devices, resume on M.
+
+``plan`` computes and validates the old->new layout plan from the
+checkpoint store's layout attributes (pure host math, JAX-free);
+``restore`` executes it host-side — per-shard selection reads of the
+NEW decomposition against the global-indexed store — and leaves the
+ICI all-to-all device path as a documented seam. See docs/RESHARD.md.
+"""
+
+from .plan import (  # noqa: F401
+    LAYOUT_SCHEMA_VERSION,
+    LayoutMeta,
+    ReshardError,
+    ReshardPlan,
+    layout_attrs,
+    member_map,
+    plan_restore,
+    read_layout,
+    shard_boxes,
+)
+from .restore import layout_of, restore_run  # noqa: F401

@@ -1,0 +1,152 @@
+"""Elastic resharding: executing the restore plan (docs/RESHARD.md).
+
+The host-side path is the one implemented here: each process
+selection-reads exactly its NEW shards from the global-indexed
+checkpoint store (``Simulation.restore_from_reader`` already reads per
+addressable shard, so no process ever materializes the full field),
+making the mesh shape a restore-time decision with zero data movement
+beyond what any restore pays. The plan (``reshard/plan.py``) supplies
+the validation and the provenance; this module supplies the
+orchestration the driver calls: open -> read layout -> plan -> restore
+-> journal/event.
+
+The ICI all-to-all device path — reshuffling LIVE device buffers
+between two meshes without a checkpoint round-trip — is a documented
+seam (:func:`device_all_to_all_restore`), not an implementation: the
+host path is correct and preemption-shaped (the replacement slice
+boots from the durable store anyway), while the device path only pays
+off for planned in-job reshapes, which need TPU hardware to validate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..config.settings import Settings, resolve_reshard
+from . import plan as plan_mod
+from .plan import LayoutMeta, ReshardError, ReshardPlan
+
+__all__ = [
+    "device_all_to_all_restore",
+    "layout_of",
+    "restore_run",
+]
+
+
+def layout_of(sim, *, process_count: Optional[int] = None) -> LayoutMeta:
+    """The :class:`LayoutMeta` describing a live simulation — the
+    record its checkpoints carry, and the "new" side of a restore plan.
+
+    Deliberately the SPATIAL layout even for ensembles
+    (``EnsembleSimulation.domain`` is the spatial decomposition):
+    member stores must stay byte-identical to solo stores, so the
+    member axis never enters the per-store attributes.
+    """
+    import jax
+
+    return LayoutMeta(
+        mesh_dims=tuple(int(d) for d in sim.domain.dims),
+        process_count=int(
+            jax.process_count() if process_count is None
+            else process_count
+        ),
+        halo_depth=int(sim.halo_depth),
+        chain_fuse=int(sim._fuse_base()),
+        ensemble_size=1,
+    )
+
+
+def _announce(sim, plan: ReshardPlan, *, log=None, journal=None) -> None:
+    """One ``reshard`` record on every observer: the unified event
+    stream (GS_EVENTS), the fault journal (and through it the final
+    RunStats ``faults`` section), and the console log."""
+    from ..obs import events as obs_events
+
+    old = plan.old.describe() if plan.old is not None else None
+    obs_events.get_events().emit(
+        "reshard", step=sim.step,
+        old_mesh=(old or {}).get("mesh_dims"),
+        new_mesh=list(plan.new.mesh_dims),
+        old_procs=(old or {}).get("process_count"),
+        new_procs=plan.new.process_count,
+        members=plan.members,
+    )
+    if journal is not None:
+        journal.record(
+            event="reshard", step=sim.step,
+            old=old, new=plan.new.describe(), members=plan.members,
+        )
+    if log is not None:
+        old_mesh = (
+            "x".join(str(d) for d in plan.old.mesh_dims)
+            if plan.old is not None else "?"
+        )
+        new_mesh = "x".join(str(d) for d in plan.new.mesh_dims)
+        log.info(
+            f"Resharded restore: checkpoint layout {old_mesh} "
+            f"({plan.old.process_count if plan.old else '?'} proc) -> "
+            f"adopted {new_mesh} ({plan.new.process_count} proc) "
+            f"at step {sim.step}"
+        )
+
+
+def restore_run(
+    sim, settings: Settings, *, log=None, journal=None
+) -> Tuple[int, ReshardPlan]:
+    """Restore ``sim`` from its configured checkpoint store(s),
+    resharding to the simulation's (already-built) mesh when the store
+    was written on a different layout.
+
+    Returns ``(restart_step, plan)``. Solo runs restore through
+    per-shard selection reads; ensembles route through the elastic
+    member restore (``ensemble/io.restore_ensemble`` — grow/shrink plus
+    per-member spatial reshard). The adopting simulation records the
+    plan as ``sim.reshard`` (None when the layout did not change) so
+    the stats config echo says whether this attempt moved.
+    """
+    allow = resolve_reshard(settings)
+    ens = getattr(settings, "ensemble", None)
+    if ens is not None:
+        from ..ensemble.io import restore_ensemble
+
+        step, plan = restore_ensemble(sim, settings, allow=allow)
+    else:
+        from ..io.checkpoint import open_checkpoint, read_layout
+
+        reader, idx, step = open_checkpoint(
+            settings.restart_input, settings, settings.restart_step
+        )
+        try:
+            old = read_layout(reader)
+            plan = plan_mod.plan_restore(
+                old, layout_of(sim), L=settings.L, allow=allow
+            )
+            # The reshard IS these selection reads: each process pulls
+            # exactly its NEW shards' (start, count) boxes out of the
+            # global store — plan.boxes enumerates them.
+            sim.restore_from_reader(reader, idx, step)
+        finally:
+            reader.close()
+    sim.reshard = plan.describe() if plan.changed else None
+    if plan.changed:
+        _announce(sim, plan, log=log, journal=journal)
+    return step, plan
+
+
+def device_all_to_all_restore(sim, plan: ReshardPlan):
+    """SEAM — the ICI device path for planned in-job reshapes.
+
+    Contract (not yet implemented; the host selection-read path above
+    is the production restore): given live device buffers laid out on
+    mesh A and a plan targeting mesh B over the SAME device set, emit
+    one ``jax.device_put``-free all-to-all that re-slices every shard
+    on-fabric — ``plan.boxes`` with
+    :func:`~.plan.overlapping_old_shards` is exactly the send/recv
+    schedule. Needs TPU hardware to validate (the standing note in
+    ROADMAP.md); on CPU the host path is measurably equivalent.
+    """
+    raise NotImplementedError(
+        "the ICI all-to-all reshard path is a documented seam "
+        "(docs/RESHARD.md); use the host-side checkpoint restore "
+        "(reshard.restore.restore_run)"
+    )

@@ -112,6 +112,63 @@ class _Rendezvous:
         votes = [json.loads(v) for v in self._gather(self.round)]
         return _decide(votes)
 
+    def agree_mesh(
+        self, local_devices: int, proposed_dims: Optional[Tuple] = None
+    ) -> dict:
+        """Mesh-agreement round (docs/RESHARD.md): every host of the
+        (possibly replaced) slice publishes its local device count and
+        its forced mesh proposal (``GS_TPU_MESH_DIMS``, or None for
+        "derive"), and all hosts adopt the SAME target topology before
+        restore — the elastic-resume precondition: a replacement slice
+        of a different shape must agree on its decomposition, or the
+        per-shard selection reads would reconstruct different grids.
+
+        Returns ``{"devices": total, "dims": adopted-or-None,
+        "procs": n}`` — identical on every host by construction.
+        Disagreeing proposals, or a proposal that does not factor the
+        gathered device total, raise
+        :class:`~..reshard.plan.ReshardError` loudly: a cluster that
+        cannot agree on its own shape must not restore into it.
+        """
+        from ..reshard.plan import ReshardError
+
+        self.round += 1
+        payload = json.dumps({
+            "devices": int(local_devices),
+            "dims": (None if proposed_dims is None
+                     else [int(d) for d in proposed_dims]),
+        })
+        self._publish(self.round, payload)
+        votes = [json.loads(v) for v in self._gather(self.round)]
+        total = sum(int(v["devices"]) for v in votes)
+        proposals = {
+            None if v["dims"] is None else tuple(v["dims"])
+            for v in votes
+        }
+        if len(proposals) > 1:
+            raise ReshardError(
+                f"mesh-agreement round {self.round}: hosts disagree on "
+                f"the target mesh ({sorted(p or () for p in proposals)})"
+                " — set the same GS_TPU_MESH_DIMS on every host, or "
+                "none"
+            )
+        adopted = proposals.pop()
+        if adopted is not None:
+            n = 1
+            for d in adopted:
+                n *= int(d)
+            if n != total:
+                raise ReshardError(
+                    f"mesh-agreement round {self.round}: proposed mesh "
+                    f"{adopted} does not factor the slice's {total} "
+                    "devices"
+                )
+        return {
+            "devices": total,
+            "dims": None if adopted is None else list(adopted),
+            "procs": self.nprocs,
+        }
+
     def _publish(self, round_no: int, payload: str) -> None:
         raise NotImplementedError
 

@@ -413,6 +413,32 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
             quorum_step=-1 if resume is None else resume,
             procs=rdv.nprocs,
         )
+        # Mesh agreement (docs/RESHARD.md): the replacement slice may
+        # be a different shape than the one that checkpointed — every
+        # host publishes its local device count and mesh proposal, and
+        # all adopt the same topology BEFORE the restoring attempt
+        # builds its Simulation (the adopted dims are pinned through
+        # GS_TPU_MESH_DIMS, the same channel an operator uses). The
+        # elastic restore path then reshards to it.
+        import jax
+
+        forced = os.environ.get("GS_TPU_MESH_DIMS", "")
+        proposal = (
+            tuple(int(x) for x in forced.split(",")) if forced else None
+        )
+        mesh = rdv.agree_mesh(jax.local_device_count(), proposal)
+        if mesh["dims"] is not None:
+            os.environ["GS_TPU_MESH_DIMS"] = ",".join(
+                str(d) for d in mesh["dims"]
+            )
+        journal.record(
+            event="mesh_agreement",
+            round=rdv.round,
+            attempt=attempt,
+            devices=mesh["devices"],
+            dims=mesh["dims"],
+            procs=mesh["procs"],
+        )
         return resume
 
     # A previous launch that ended in a graceful preemption exit or a

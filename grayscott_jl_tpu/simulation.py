@@ -542,6 +542,12 @@ class Simulation:
                 # tuner widen the shortlist across k.
                 halo_depth=(self.halo_depth if self._halo_depth_pinned
                             else 0),
+                # The ADOPTED placement joins the key (schema v5,
+                # docs/RESHARD.md): an elastically resumed run is a
+                # different placement, and a winner tuned on mesh A
+                # (or another process count) must never be applied on
+                # mesh B.
+                procs=jax.process_count(),
                 **self._tune_extras(),
             )
             self.kernel_selection["autotune"] = decision.provenance
@@ -633,6 +639,12 @@ class Simulation:
         self.use_noise = self._resolve_use_noise()
         self.base_key = self._make_base_key(seed)
         self.step = 0
+        #: Elastic-restore provenance (docs/RESHARD.md): set by
+        #: ``reshard.restore.restore_run`` to the plan's describe()
+        #: when this run resumed a checkpoint written on a DIFFERENT
+        #: layout; None for fresh runs and same-shape resumes. Echoed
+        #: into the RunStats config by the driver.
+        self.reshard = None
         self._runners: Dict[int, object] = {}
         self._snapshot_fns: Dict[bool, object] = {}
 
@@ -1478,6 +1490,14 @@ class Simulation:
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.fields)
+
+    def layout(self):
+        """The :class:`~.reshard.plan.LayoutMeta` describing this run's
+        adopted decomposition — what its checkpoints record, and the
+        "new" side of an elastic restore plan (docs/RESHARD.md)."""
+        from .reshard.restore import layout_of
+
+        return layout_of(self)
 
     def metrics_labels(self) -> dict:
         """The label set every metric of this run carries

@@ -161,6 +161,7 @@ def autotune(
     n_fields: int = 2,
     pallas_allowed: bool = True,
     halo_depth: int = 0,
+    procs: int = 1,
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -187,11 +188,15 @@ def autotune(
     if mode == "off":
         return _analytic_decision(mode, analytic_kernel, gate)
 
+    # The key describes the ADOPTED placement (schema v5): with
+    # elastic resharding the same config resumes on different meshes /
+    # member splits / process counts, and winners never transfer.
     key = cache.cache_key(
         device_kind=device_kind, platform=platform, dims=dims, L=L,
         dtype=dtype, noise=noise, jax_version=jax.__version__,
         ensemble=ensemble, model=model, n_fields=n_fields,
-        halo_depth=halo_depth,
+        halo_depth=halo_depth, member_shards=member_shards,
+        procs=procs,
     )
     rec = cache.load(key)
     if rec is not None:

@@ -39,8 +39,14 @@ from typing import Optional
 #: docs/TEMPORAL.md): a run pinned to a given k measures a constrained
 #: candidate space, so pinned and auto runs must never share winners;
 #: stale v3 entries degrade to the analytic pick with the usual
-#: warning.
-SCHEMA_VERSION = 4
+#: warning. v5: the key grew the ADOPTED placement — ``member_shards``
+#: (the ensemble member mesh axis) and ``procs`` (process count):
+#: elastic resharding (docs/RESHARD.md) makes the mesh a restore-time
+#: decision, so one config legitimately runs on different placements
+#: across resumes, and a winner tuned on placement A must never be
+#: applied on placement B; stale v4 entries are structurally invisible
+#: and degrade to the warned analytic pick like any other miss.
+SCHEMA_VERSION = 5
 
 
 def cache_dir() -> str:
@@ -66,6 +72,8 @@ def cache_key(
     model: str = "grayscott",
     n_fields: int = 2,
     halo_depth: int = 0,
+    member_shards: int = 1,
+    procs: int = 1,
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
@@ -77,7 +85,11 @@ def cache_key(
     another. ``halo_depth`` (schema v4) is the operator's s-step
     exchange pin (0 = auto-searched): a pinned run measures a
     constrained shortlist, so its winner must never leak into an
-    auto run or a differently-pinned one."""
+    auto run or a differently-pinned one. ``member_shards``/``procs``
+    (schema v5) complete the ADOPTED placement: with elastic
+    resharding (docs/RESHARD.md) the same config can resume on a
+    different member split or process count, and measurements never
+    transfer across placements."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -91,6 +103,8 @@ def cache_key(
         "model": str(model),
         "n_fields": int(n_fields),
         "halo_depth": int(halo_depth),
+        "member_shards": int(member_shards),
+        "procs": int(procs),
     }
 
 

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# Chaos smoke test, four scenarios (1-3 against one uninterrupted
-# solo reference run, 4 against an uninterrupted ensemble run):
+# Chaos smoke test, five scenarios (1-3 against one uninterrupted
+# solo reference run, 4 against an uninterrupted ensemble run, 5
+# elastic — resume on a DIFFERENT mesh / member count than the kill):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -17,7 +18,16 @@
 #      holds the off-schedule grace entry, asserted separately);
 #   4. ensemble edition: injected preemption mid-sweep of a 2-member
 #      batched ensemble -> supervised restart from the member-indexed
-#      checkpoint quorum -> every member store byte-identical.
+#      checkpoint quorum -> every member store byte-identical;
+#   5. elastic resharding (docs/RESHARD.md): SIGTERM a supervised
+#      (2,2,2) run mid-flight -> graceful checkpoint + exit 75 ->
+#      supervised relaunch on a 4-device (1,2,2) replacement mesh
+#      auto-resumes across the shape change (reshard event on
+#      GS_EVENTS, gs_report.py --check validates) with stores
+#      value-identical to the uninterrupted (2,2,2) run; then the
+#      scenario-4 ensemble wreckage is resumed GROWN 2 -> 3 members on
+#      the (2,2,2,1)-member layout, surviving member stores
+#      byte-identical, the new member joining at the resume step.
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -66,13 +76,16 @@ EOF
 }
 
 run() {
+  # Fixed vars first, scenario vars last: a scenario may override
+  # XLA_FLAGS (device count) / GS_TPU_MESH_DIMS for the elastic
+  # reshard scenario below.
   local dir="$1"; shift
   (
     cd "$dir"
-    env "$@" \
-      JAX_PLATFORMS=cpu \
+    env JAX_PLATFORMS=cpu \
       XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+      "$@" \
       python3 "${REPO}/gray-scott.py" config.toml
   )
 }
@@ -94,7 +107,7 @@ for d in full sup hang term; do write_config "$WORK/$d"; done
 echo "chaos_smoke: uninterrupted reference run..."
 run "$WORK/full" > "$WORK/full.log" 2>&1
 
-echo "chaos_smoke: [1/3] supervised run with injected preemption (obs armed)..."
+echo "chaos_smoke: [1/5] supervised run with injected preemption (obs armed)..."
 # Full observability rides along (docs/OBSERVABILITY.md): the store
 # byte-identity assertion below doubles as the obs-on/off bitwise
 # contract, and the artifacts are schema-validated afterwards.
@@ -132,7 +145,7 @@ PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
   exit 1
 }
 
-echo "chaos_smoke: [2/3] supervised run with injected hang (watchdog)..."
+echo "chaos_smoke: [2/5] supervised run with injected hang (watchdog)..."
 run "$WORK/hang" \
   GS_SUPERVISE=1 \
   GS_MAX_RESTARTS=5 \
@@ -153,7 +166,7 @@ grep -aq '"event": "hang"' "$WORK/hang/gs.bp.faults.jsonl" || {
 }
 assert_stores "$WORK/hang" gs.bp gs.vtk ckpt.bp
 
-echo "chaos_smoke: [3/3] SIGTERM mid-run -> graceful checkpoint -> resume..."
+echo "chaos_smoke: [3/5] SIGTERM mid-run -> graceful checkpoint -> resume..."
 # Park the run at a deterministic boundary with an unwatched injected
 # stall, SIGTERM it there (the injected-hang journal line is fsynced
 # before the stall starts, so polling it makes the timing exact).
@@ -204,7 +217,7 @@ assert steps[-1] == 60 and sorted(set(steps)) == steps, steps
 assert set(range(20, 61, 20)) <= set(steps), steps
 EOF
 
-echo "chaos_smoke: [4/4] ensemble preempt mid-sweep -> auto-resume..."
+echo "chaos_smoke: [4/5] ensemble preempt mid-sweep -> auto-resume..."
 write_ensemble_config() {
   write_config "$1"
   cat >> "$1/config.toml" <<'EOF'
@@ -240,8 +253,116 @@ for m in m00 m01; do
   done
 done
 
-echo "chaos_smoke: PASS — all four scenarios recovered byte-identical" \
+echo "chaos_smoke: [5/5] elastic — SIGTERM on (2,2,2), resume on (1,2,2)..."
+# Value-level store identity: a store that changed mesh mid-life frames
+# its later steps in the new decomposition's blocks, so the assertion
+# is on what the store SERVES — attributes and every step's assembled
+# global arrays, bitwise (docs/RESHARD.md "Equality fine print"). The
+# VTK series is written globally and stays raw-byte-identical.
+compare_bp() {
+  PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 - "$1" "$2" <<'EOF'
+import sys
+import numpy as np
+from grayscott_jl_tpu.io.bplite import BpReader
+
+a, b = BpReader(sys.argv[1]), BpReader(sys.argv[2])
+assert a.attributes() == b.attributes()
+assert a.num_steps() == b.num_steps(), (a.num_steps(), b.num_steps())
+for i in range(a.num_steps()):
+    for name in a.available_variables():
+        x = np.asarray(a.get(name, step=i))
+        y = np.asarray(b.get(name, step=i))
+        assert x.dtype == y.dtype and np.array_equal(x, y), (name, i)
+EOF
+}
+
+mkdir -p "$WORK/elastic"
+write_config "$WORK/elastic"
+(
+  cd "$WORK/elastic"
+  exec env GS_SUPERVISE=1 GS_WATCHDOG=off GS_HANG_BOUND_S=60 \
+      GS_FAULTS="step=${HANG}:kind=hang" \
+      JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+      python3 "${REPO}/gray-scott.py" config.toml
+) > "$WORK/elastic.log" 2>&1 &
+EL_PID=$!
+for _ in $(seq 1 600); do
+  grep -aq '"kind": "hang"' "$WORK/elastic/gs.bp.faults.jsonl" 2>/dev/null && break
+  sleep 0.1
+done
+kill -TERM "$EL_PID"
+RC=0; wait "$EL_PID" || RC=$?
+if [ "$RC" -ne 75 ]; then
+  echo "chaos_smoke: FAIL — elastic SIGTERM run exited $RC, want 75" >&2
+  exit 1
+fi
+# Replacement slice: 4 devices shaped (1,2,2). A plain supervised
+# relaunch auto-resumes from the journal marker ACROSS the shape
+# change; the reshard event lands on GS_EVENTS.
+run "$WORK/elastic" \
+  GS_SUPERVISE=1 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+  GS_TPU_MESH_DIMS="1,2,2" \
+  GS_EVENTS="$WORK/elastic/events.jsonl" \
+  > "$WORK/elastic_resume.log" 2>&1
+grep -a "Resharded restore" "$WORK/elastic_resume.log" > /dev/null || {
+  echo "chaos_smoke: FAIL — the relaunch never announced the reshard" >&2
+  exit 1
+}
+compare_bp "$WORK/full/gs.bp" "$WORK/elastic/gs.bp" || {
+  echo "chaos_smoke: FAIL — gs.bp values differ after the (1,2,2) resume" >&2
+  exit 1
+}
+if ! diff -r "$WORK/full/gs.vtk" "$WORK/elastic/gs.vtk" > /dev/null; then
+  echo "chaos_smoke: FAIL — gs.vtk differs after the (1,2,2) resume" >&2
+  exit 1
+fi
+grep -aq '"kind": "reshard"' "$WORK/elastic/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no reshard event on GS_EVENTS" >&2
+  exit 1
+}
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/elastic/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the reshard events" >&2
+  exit 1
+}
+
+echo "chaos_smoke: [5/5] elastic — ensemble grow 2 -> 3 members..."
+mkdir -p "$WORK/ensgrow"
+write_ensemble_config "$WORK/ensgrow"
+# Kill a fresh 2-member run unsupervised mid-sweep, then resume the
+# wreckage GROWN to 3 members on the (2,2,2,1)-member layout.
+run "$WORK/ensgrow" GS_FAULTS="step=${PREEMPT}:kind=preempt" \
+  > "$WORK/ensgrow.log" 2>&1 || true
+# restart must precede the [ensemble] table (top-level TOML key)
+sed -i 's/^checkpoint = true$/checkpoint = true\nrestart = true/' \
+  "$WORK/ensgrow/config.toml"
+sed -i 's/presets = \["spots", "chaos"\]/presets = ["spots", "chaos", "waves"]/' \
+  "$WORK/ensgrow/config.toml"
+run "$WORK/ensgrow" > "$WORK/ensgrow_resume.log" 2>&1
+grep -a "Restarted 3 ensemble members" "$WORK/ensgrow_resume.log" > /dev/null || {
+  echo "chaos_smoke: FAIL — the grown ensemble never restored 3 members" >&2
+  exit 1
+}
+for m in m00 m01; do
+  for store in "gs.${m}.bp" "gs.${m}.vtk" "ckpt.${m}.bp"; do
+    if ! diff -r "$WORK/ensfull/$store" "$WORK/ensgrow/$store" > /dev/null; then
+      echo "chaos_smoke: FAIL — ensemble $store differs after grow-resume" >&2
+      exit 1
+    fi
+  done
+done
+[ -d "$WORK/ensgrow/gs.m02.bp" ] || {
+  echo "chaos_smoke: FAIL — the grown member wrote no store" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all five scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \
-     "ens=$(wc -l < "$WORK/enssup/gs.bp.faults.jsonl") events)"
+     "ens=$(wc -l < "$WORK/enssup/gs.bp.faults.jsonl")" \
+     "elastic=$(wc -l < "$WORK/elastic/gs.bp.faults.jsonl") events)"

@@ -1,0 +1,353 @@
+"""Functional: elastic resharding end to end (docs/RESHARD.md).
+
+The chaos acceptance for ROADMAP item 3: a run killed on an N-device
+mesh A resumes on an M != N-device mesh B, and the resumed trajectory
+and stores are byte-identical after K further steps to a same-seed run
+that never moved — for Gray-Scott and a 1-field model, through the
+real CLI, plus the supervisor auto-resuming across the shape change
+and the ensemble growing N -> N'.
+
+"Byte-identical stores" is asserted at the strongest level each store
+admits: the assembled per-step global arrays (and attributes) of the
+``.bp`` stores are compared bitwise — the raw block layout inside a
+store legitimately follows whoever wrote each step, so a store that
+changed mesh mid-life differs in framing while every value it serves
+is identical — and the ``.vtk`` series, which is written globally, is
+compared byte-for-byte on disk.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import REPO, run_cli, write_config
+
+from grayscott_jl_tpu.io.bplite import BpReader
+
+STEPS = 60
+
+
+def _assert_bp_content_identical(ref, got):
+    """Every step's assembled global arrays (and the attributes) match
+    bitwise — the mesh-agnostic store-equality contract."""
+    a, b = BpReader(str(ref)), BpReader(str(got))
+    try:
+        assert a.attributes() == b.attributes()
+        assert a.num_steps() == b.num_steps(), (
+            ref, a.num_steps(), b.num_steps()
+        )
+        names = set(a.available_variables())
+        assert names == set(b.available_variables())
+        for i in range(a.num_steps()):
+            for name in sorted(names):
+                x = np.asarray(a.get(name, step=i))
+                y = np.asarray(b.get(name, step=i))
+                assert x.dtype == y.dtype
+                assert np.array_equal(x, y), (name, i)
+    finally:
+        a.close()
+        b.close()
+
+
+def _ckpt_steps(path):
+    r = BpReader(str(path))
+    try:
+        return [int(r.get("step", step=i)) for i in range(r.num_steps())]
+    finally:
+        r.close()
+
+
+def _devices_env(n, mesh=None, extra=None):
+    env = {"XLA_FLAGS": f"--xla_force_host_platform_device_count={n}"}
+    if mesh is not None:
+        env["GS_TPU_MESH_DIMS"] = mesh
+    env.update(extra or {})
+    return env
+
+
+@pytest.fixture(scope="module")
+def uninterrupted222(tmp_path_factory):
+    """Fault-free reference on the 8-device (2,2,2) mesh."""
+    d = tmp_path_factory.mktemp("ref222")
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg, extra_env=_devices_env(8))
+    assert res.returncode == 0, res.stderr + res.stdout
+    return d
+
+
+def test_killed_on_222_resumes_on_122_byte_identical(
+    tmp_path, uninterrupted222
+):
+    """The headline chaos scenario: a (2,2,2) run dies mid-flight; the
+    replacement 'slice' is 4 devices shaped (1,2,2); the restart
+    selection-reads its new shards, finishes, and every store serves
+    values byte-identical to the run that never moved."""
+    d = tmp_path / "move"
+    d.mkdir()
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    # Phase 1 on (2,2,2): an unsupervised injected preemption kills the
+    # run after the step-40 boundary writes.
+    res = run_cli(d, cfg, extra_env=_devices_env(
+        8, extra={"GS_FAULTS": "step=45:kind=preempt"}
+    ))
+    assert res.returncode == 1
+    assert _ckpt_steps(d / "ckpt.bp") == [20, 40]
+
+    # Phase 2: resume the SAME stores on 4 devices, mesh (1,2,2).
+    resume_cfg = write_config(
+        d, name="resume.toml", noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20, restart="true",
+    )
+    stats = d / "stats.json"
+    res = run_cli(d, resume_cfg, extra_env=_devices_env(
+        4, mesh="1,2,2",
+        extra={"GS_TPU_STATS": str(stats),
+               "GS_EVENTS": str(d / "events.jsonl")},
+    ))
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "Restarted from ckpt.bp at step 40" in res.stdout
+    assert "Resharded restore" in res.stdout
+
+    for store in ("gs.bp", "ckpt.bp"):
+        _assert_bp_content_identical(
+            uninterrupted222 / store, d / store
+        )
+    # the VTK series is written globally — raw bytes must match
+    _assert_trees_byte_identical(
+        uninterrupted222 / "gs.vtk", d / "gs.vtk"
+    )
+
+    # provenance: the stats config echoes the plan, the unified event
+    # stream carries the reshard event, and gs_report --check accepts
+    # the artifacts
+    rs = json.loads(stats.read_text())["config"]["reshard"]
+    assert rs["changed"] is True
+    assert rs["old"]["mesh_dims"] == [2, 2, 2]
+    assert rs["new"]["mesh_dims"] == [1, 2, 2]
+    events = [json.loads(l)
+              for l in (d / "events.jsonl").read_text().splitlines()]
+    reshards = [e for e in events if e["kind"] == "reshard"]
+    assert reshards and reshards[0]["attrs"]["new_mesh"] == [1, 2, 2]
+    check = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--check", "--events", str(d / "events.jsonl")],
+        capture_output=True, text=True,
+        env={**os.environ,
+             "PYTHONPATH": str(REPO) + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+def test_sigterm_then_supervised_auto_resume_on_new_mesh(
+    tmp_path, uninterrupted222
+):
+    """The supervisor piece: SIGTERM a supervised (2,2,2) run (graceful
+    checkpoint, exit 75), then relaunch supervised on a 4-device
+    (1,2,2) 'replacement slice' — the journal marker auto-resumes it
+    ACROSS the shape change, and the output stores are byte-identical
+    to the run that never moved."""
+    d = tmp_path / "sig"
+    d.mkdir()
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.update({
+        "GS_SUPERVISE": "1",
+        # Park at the step-30 boundary via an unwatched injected stall
+        # (the journal line is fsynced before the stall, so polling it
+        # makes the SIGTERM timing exact — same trick as
+        # test_supervisor).
+        "GS_WATCHDOG": "off",
+        "GS_FAULTS": "step=25:kind=hang",
+        "GS_HANG_BOUND_S": "60",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+        cwd=d, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    journal = Path(d / "gs.bp.faults.jsonl")
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if journal.exists() and '"kind": "hang"' in journal.read_text():
+            break
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("injected hang never journaled")
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 75, out + err  # EXIT_PREEMPTED
+
+    # Replacement slice: 4 devices, (1,2,2). A plain supervised
+    # relaunch must auto-resume from the marker and reshard.
+    stats = d / "stats.json"
+    res = run_cli(d, cfg, extra_env=_devices_env(
+        4, mesh="1,2,2",
+        extra={"GS_SUPERVISE": "1", "GS_TPU_STATS": str(stats)},
+    ))
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "resuming after graceful_shutdown" in res.stdout
+    assert "Restarted from ckpt.bp at step 30" in res.stdout
+    assert "Resharded restore" in res.stdout
+
+    _assert_bp_content_identical(
+        uninterrupted222 / "gs.bp", d / "gs.bp"
+    )
+    _assert_trees_byte_identical(
+        uninterrupted222 / "gs.vtk", d / "gs.vtk"
+    )
+    # ckpt additionally holds the off-schedule grace entry (the resume
+    # point), then rejoins the schedule
+    assert _ckpt_steps(d / "ckpt.bp") == [20, 30, 40, 60]
+
+    stats_doc = json.loads(stats.read_text())
+    assert stats_doc["config"]["reshard"]["changed"] is True
+    assert stats_doc["config"]["mesh_dims"] == [1, 2, 2]
+    # the journal timeline carries the reshard record
+    events = [json.loads(l) for l in journal.read_text().splitlines()]
+    kinds = [e.get("event") for e in events]
+    assert "reshard" in kinds
+
+
+@pytest.mark.parametrize("model", ["grayscott", "heat"])
+def test_single_device_resumes_on_two_devices(tmp_path, model):
+    """(1,1,1) -> (2,1,1) for Gray-Scott and the 1-field heat model —
+    the grow-the-slice direction, bitwise at the depth-1 chain (the
+    cross-mesh contract XLA:CPU honors; docs/RESHARD.md fine print)."""
+    fuse1 = {"GS_FUSE": "1"}
+
+    def cfg_for(dirpath):
+        cfg = write_config(
+            dirpath, noise=0.1, steps=STEPS, output="gs.bp",
+            checkpoint="true", checkpoint_freq=20,
+        )
+        if model != "grayscott":
+            cfg.write_text(cfg.read_text() + f'\nmodel = "{model}"\n')
+        return cfg
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    res = run_cli(ref, cfg_for(ref), extra_env=_devices_env(
+        1, extra=fuse1
+    ))
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    d = tmp_path / "move"
+    d.mkdir()
+    cfg = cfg_for(d)
+    res = run_cli(d, cfg, extra_env=_devices_env(
+        1, extra={**fuse1, "GS_FAULTS": "step=45:kind=preempt"}
+    ))
+    assert res.returncode == 1
+    resume_cfg = write_config(
+        d, name="resume.toml", noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20, restart="true",
+    )
+    if model != "grayscott":
+        resume_cfg.write_text(
+            resume_cfg.read_text() + f'\nmodel = "{model}"\n'
+        )
+    res = run_cli(d, resume_cfg, extra_env=_devices_env(
+        2, mesh="2,1,1", extra=fuse1
+    ))
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "Resharded restore" in res.stdout
+    for store in ("gs.bp", "ckpt.bp"):
+        _assert_bp_content_identical(ref / store, d / store)
+    _assert_trees_byte_identical(ref / "gs.vtk", d / "gs.vtk")
+
+
+def test_ensemble_grow_and_shrink_resume(tmp_path):
+    """Elastic ensemble: a 2-member run dies mid-sweep; resumed as 3
+    members (grow) the surviving member stores finish BYTE-identical to
+    the uninterrupted 2-member run's (raw bytes — the mesh never
+    changed), the grown member writes its own solo-identical store from
+    the resume step on, and a 1-member resume (shrink) continues member
+    0 alone."""
+    ens_table = '\n[ensemble]\npresets = [{presets}]\n'
+
+    def write_ens(dirpath, presets, name="config.toml", restart="false"):
+        cfg = write_config(
+            dirpath, name=name, noise=0.1, steps=STEPS, output="gs.bp",
+            checkpoint="true", checkpoint_freq=20, restart=restart,
+        )
+        cfg.write_text(
+            cfg.read_text() + ens_table.format(presets=presets)
+        )
+        return cfg
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    res = run_cli(ref, write_ens(ref, '"spots", "chaos"'),
+                  extra_env=_devices_env(8))
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    d = tmp_path / "grow"
+    d.mkdir()
+    res = run_cli(d, write_ens(d, '"spots", "chaos"'),
+                  extra_env=_devices_env(
+                      8, extra={"GS_FAULTS": "step=45:kind=preempt"}
+                  ))
+    assert res.returncode == 1
+    stats = d / "stats.json"
+    res = run_cli(
+        d,
+        write_ens(d, '"spots", "chaos", "waves"', name="resume.toml",
+                  restart="true"),
+        extra_env=_devices_env(8, extra={"GS_TPU_STATS": str(stats)}),
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "Restarted 3 ensemble members" in res.stdout
+
+    # surviving members: raw byte identity against the uninterrupted
+    # 2-member reference (same mesh throughout)
+    for m in ("m00", "m01"):
+        for store in (f"gs.{m}.bp", f"gs.{m}.vtk", f"ckpt.{m}.bp"):
+            _assert_trees_byte_identical(ref / store, d / store)
+    # the grown member joined at the resume step (40): outputs 50/60,
+    # checkpoint 60
+    r = BpReader(str(d / "gs.m02.bp"))
+    steps = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+    r.close()
+    assert steps == [50, 60]
+    assert _ckpt_steps(d / "ckpt.m02.bp") == [60]
+    rs = json.loads(stats.read_text())["config"]["reshard"]
+    assert rs["members"] == {"restored": 2, "grown": 1, "new_n": 3}
+
+    # shrink: resume the same wreckage as a 1-member ensemble
+    e = tmp_path / "shrink"
+    e.mkdir()
+    res = run_cli(e, write_ens(e, '"spots", "chaos"'),
+                  extra_env=_devices_env(
+                      8, extra={"GS_FAULTS": "step=45:kind=preempt"}
+                  ))
+    assert res.returncode == 1
+    res = run_cli(
+        e, write_ens(e, '"spots"', name="resume.toml", restart="true"),
+        extra_env=_devices_env(8),
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "Restarted 1 ensemble members" in res.stdout
+    for store in ("gs.m00.bp", "gs.m00.vtk", "ckpt.m00.bp"):
+        _assert_trees_byte_identical(ref / store, e / store)
