@@ -12,7 +12,10 @@ itself is one-JSON-object-per-line with:
   ``model_ideal_reduction`` plus an ``engaged`` flag, consumed by
   ``update_halo_depth.py``; a fuse case has none but carries
   ``"fuse"``),
-* ``"t"`` — UTC capture timestamp (``utc_stamp``),
+* ``"t"`` — UTC capture timestamp (``utc_stamp``; ``bench.py``
+  headline payloads and ``utils/benchmark.bench_one`` rows carry it
+  too, and the staleness/provenance scans prefer it over file mtime —
+  an mtime is a checkout time on a fresh clone),
 * ``"model"`` — the registered model the row measured (``models/``;
   rows written before the multi-model framework carry no field and
   read as Gray-Scott),
@@ -29,6 +32,12 @@ itself is one-JSON-object-per-line with:
   is a worse production pick than its median suggests. Rows written
   before the observability PR carry no percentile fields; readers
   treat absence as "not measured", not zero.
+
+Rows in this schema are also what the perf-regression sentinel
+(``regression_gate.py``) judges: it groups committed history by the
+schedule-determining fields and flags a fresh ``*_us_per_step`` that
+exceeds the population's MAD-scaled noise envelope — so every artifact
+appended here doubles as tomorrow's regression baseline.
 """
 
 from __future__ import annotations

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+"""Perf-regression sentinel over the committed benchmark artifacts.
+
+The benchmarks/ tooling accumulates measurement history as JSONL rows
+in the shared ``artifacts.py`` schema (``benchmarks/results/``). This
+gate compares a FRESH row against the committed history for the *same
+configuration* and exits non-zero — naming the culprit metric — when
+the fresh measurement regressed beyond what the history's own noise
+justifies. It is the CI tripwire that turns "yesterday's numbers are
+in git" into "today's step time quietly getting 2x slower fails the
+build".
+
+Config identity (:func:`config_key`) is the row's experiment family
+plus every schedule-determining field present (platform, model,
+kernel, L, mesh, devices, fuse, halo_depth, precision, members) — two
+rows compare only when they measured the same thing.
+
+Noise model: the compared value is already a median-of-rounds
+(``median_us_per_step`` — the artifacts carry every chronological
+round precisely so tools like this don't trust one window), and the
+threshold is MAD-scaled over the history population::
+
+    threshold = median(history)
+              + max(nsigma * 1.4826 * MAD(history),
+                    rel_floor * median(history))
+
+The ``1.4826 * MAD`` term is the robust sigma estimate (normal-
+consistent), so a noisy config (the clock-throttled tunnel chip
+spreads identical configs ~1.7x) gets a proportionally wider gate,
+while the ``rel_floor`` term (default 25%) keeps a near-noiseless
+history from flagging microsecond jitter. Lower-is-better metrics
+only (``*_us_per_step``); keys with fewer than ``--min-history``
+comparable rows are reported as skipped, never failed.
+
+Usage::
+
+    # gate a fresh sweep artifact against the committed history
+    python benchmarks/regression_gate.py --fresh new_rows.jsonl
+
+    # self mode: the LAST row of each key in --fresh is the fresh
+    # measurement, earlier rows join the history (CI sanity run over
+    # a committed artifact — must exit 0)
+    python benchmarks/regression_gate.py \
+        --fresh benchmarks/results/tune_ab_cpu_2026-08-04.jsonl --self
+
+    # the tier-1 / chaos_smoke tripwire check: a synthetic 2x slowdown
+    # of every fresh value MUST flip the exit code
+    python benchmarks/regression_gate.py --fresh ... --inject-slowdown 2
+
+Wired into ``tune_sweep.py --calibrate`` (the fresh sweep artifact is
+gated against ``benchmarks/results/`` after calibration) and
+``scripts/chaos_smoke.sh`` scenario 1 (the chaos run gates a row built
+from its own step-latency stats). stdlib only — runs anywhere the
+artifacts do.
+
+Exit codes: 0 = no regression (all keys pass or are skipped), 1 =
+regression (stderr names metric, key, fresh value, and threshold),
+2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+#: Row fields that determine whether two measurements are comparable.
+#: Absent fields participate as absent — a row without ``fuse`` only
+#: compares against other rows without ``fuse``.
+KEY_FIELDS = (
+    "ab", "platform", "model", "kernel", "L", "L_global", "devices",
+    "mesh", "local_block", "fuse", "fuse_base", "halo_depth",
+    "precision", "members", "comm_overlap", "bx", "metric",
+)
+
+#: Lower-is-better metrics, in preference order — the first one a row
+#: carries is what the gate compares. Medians over the row's own
+#: timing rounds come first (the noise-aware number), single-shot
+#: times last.
+METRICS = (
+    "median_us_per_step",
+    "p50_us_per_step",
+    "us_per_step",
+    "best_us_per_step",
+)
+
+
+def config_key(row: dict) -> Tuple:
+    """Hashable config identity of one artifact row."""
+    out = []
+    for f in KEY_FIELDS:
+        v = row.get(f)
+        if isinstance(v, list):
+            v = tuple(v)
+        out.append((f, v))
+    return tuple(out)
+
+
+def key_str(key: Tuple) -> str:
+    return " ".join(f"{k}={v}" for k, v in key if v is not None)
+
+
+def pick_metric(row: dict) -> Optional[Tuple[str, float]]:
+    """The row's gated metric ``(name, value)``, or None for rows that
+    carry no lower-is-better time (summary rows, error rows)."""
+    for name in METRICS:
+        v = row.get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v > 0:
+            return name, float(v)
+    return None
+
+
+def median(values: List[float]) -> float:
+    vs = sorted(values)
+    n = len(vs)
+    mid = n // 2
+    return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def mad(values: List[float], med: Optional[float] = None) -> float:
+    """Median absolute deviation (the robust spread estimate)."""
+    m = median(values) if med is None else med
+    return median([abs(v - m) for v in values])
+
+
+def threshold(history: List[float], *, nsigma: float,
+              rel_floor: float) -> Tuple[float, float, float]:
+    """``(threshold, median, mad)`` for one history population."""
+    med = median(history)
+    spread = mad(history, med)
+    return (
+        med + max(nsigma * 1.4826 * spread, rel_floor * med),
+        med,
+        spread,
+    )
+
+
+def load_history(paths: List[str],
+                 exclude: Optional[str] = None) -> List[dict]:
+    """Rows of every named file/dir/glob; ``exclude`` drops one file
+    (the --fresh artifact, when it lives inside the history dir — a
+    measurement must never be its own reference)."""
+
+    def _skip(p: str) -> bool:
+        try:
+            return exclude is not None and os.path.samefile(p, exclude)
+        except OSError:
+            return False
+
+    rows: List[dict] = []
+    for pattern in paths:
+        matches = sorted(glob.glob(pattern)) if any(
+            c in pattern for c in "*?[") else [pattern]
+        for p in matches:
+            if os.path.isdir(p):
+                for f in sorted(glob.glob(os.path.join(p, "*.jsonl"))):
+                    if not _skip(f):
+                        rows.extend(
+                            artifacts.read_rows(f, skip_corrupt=True)
+                        )
+            elif os.path.isfile(p) and not _skip(p):
+                rows.extend(artifacts.read_rows(p, skip_corrupt=True))
+    return rows
+
+
+def gate(fresh_rows: List[dict], history_rows: List[dict], *,
+         nsigma: float = 4.0, rel_floor: float = 0.25,
+         min_history: int = 3,
+         inject_slowdown: float = 1.0) -> dict:
+    """Judge every fresh row against its key's history population.
+
+    Returns ``{"regressions": [...], "passed": [...], "skipped":
+    [...]}`` — each regression names the metric, the key, the fresh
+    value, and the threshold that condemned it.
+    """
+    by_key: Dict[Tuple, List[float]] = {}
+    for row in history_rows:
+        m = pick_metric(row)
+        if m is None:
+            continue
+        by_key.setdefault(config_key(row), []).append(m[1])
+
+    out = {"regressions": [], "passed": [], "skipped": []}
+    for row in fresh_rows:
+        m = pick_metric(row)
+        key = config_key(row)
+        if m is None:
+            out["skipped"].append(
+                {"key": key_str(key), "reason": "no gated metric"}
+            )
+            continue
+        name, value = m
+        value *= inject_slowdown
+        history = by_key.get(key, [])
+        if len(history) < min_history:
+            out["skipped"].append({
+                "key": key_str(key), "metric": name,
+                "reason": f"history has {len(history)} comparable "
+                          f"rows (< {min_history})",
+            })
+            continue
+        limit, med, spread = threshold(
+            history, nsigma=nsigma, rel_floor=rel_floor
+        )
+        entry = {
+            "key": key_str(key),
+            "metric": name,
+            "fresh": round(value, 1),
+            "threshold": round(limit, 1),
+            "history_median": round(med, 1),
+            "history_mad": round(spread, 1),
+            "history_n": len(history),
+        }
+        (out["regressions"] if value > limit
+         else out["passed"]).append(entry)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf-regression sentinel over benchmark artifacts"
+    )
+    ap.add_argument("--fresh", required=True,
+                    help="JSONL artifact holding the fresh rows")
+    ap.add_argument("--history", nargs="*", default=None,
+                    help="history files/dirs/globs (default: "
+                    "benchmarks/results/)")
+    ap.add_argument("--self", dest="self_mode", action="store_true",
+                    help="the LAST row of each key in --fresh is the "
+                    "fresh measurement; its earlier rows join the "
+                    "history")
+    ap.add_argument("--nsigma", type=float, default=4.0,
+                    help="MAD-sigma multiplier (default 4)")
+    ap.add_argument("--rel-floor", type=float, default=0.25,
+                    help="minimum relative slack (default 0.25)")
+    ap.add_argument("--min-history", type=int, default=3,
+                    help="comparable rows required before a key is "
+                    "gated (default 3); smaller populations are "
+                    "skipped, not failed")
+    ap.add_argument("--inject-slowdown", type=float, default=1.0,
+                    help="multiply every fresh value by this factor — "
+                    "the self-test knob (2.0 must flip the exit code)")
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = load_history([args.fresh])
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"regression_gate: cannot read --fresh: {e}",
+              file=sys.stderr)
+        return 2
+    if not fresh:
+        print(f"regression_gate: no rows in {args.fresh}",
+              file=sys.stderr)
+        return 2
+    history_paths = args.history
+    if history_paths is None:
+        history_paths = [artifacts.results_dir()]
+    history = load_history(history_paths, exclude=args.fresh)
+
+    if args.self_mode:
+        # Chronological per-key split: everything but the last row of
+        # each key becomes history, the last row is judged.
+        last: Dict[Tuple, dict] = {}
+        earlier: List[dict] = []
+        for row in fresh:
+            key = config_key(row)
+            if key in last:
+                earlier.append(last[key])
+            last[key] = row
+        # The --fresh file itself is always excluded from the history
+        # read above, so the population is exactly: other files'
+        # rows + this file's pre-last rows per key.
+        history = history + earlier
+        fresh = list(last.values())
+
+    result = gate(
+        fresh, history, nsigma=args.nsigma, rel_floor=args.rel_floor,
+        min_history=args.min_history,
+        inject_slowdown=args.inject_slowdown,
+    )
+    print(json.dumps({
+        "fresh_rows": len(fresh),
+        "history_rows": len(history),
+        "passed": len(result["passed"]),
+        "skipped": len(result["skipped"]),
+        "regressions": result["regressions"],
+    }))
+    for r in result["regressions"]:
+        print(
+            f"regression_gate: REGRESSION — {r['metric']} = "
+            f"{r['fresh']} exceeds threshold {r['threshold']} "
+            f"(history median {r['history_median']}, MAD "
+            f"{r['history_mad']}, n={r['history_n']}) for {r['key']}",
+            file=sys.stderr,
+        )
+    if not result["regressions"]:
+        gated = len(result["passed"])
+        print(
+            f"regression_gate: OK — {gated} key(s) gated, "
+            f"{len(result['skipped'])} skipped",
+            file=sys.stderr,
+        )
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

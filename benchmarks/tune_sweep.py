@@ -366,6 +366,22 @@ def main() -> int:
     print(f"# appended to {out}", file=sys.stderr)
     if args.calibrate:
         calibrate(out, args.apply)
+        # Perf-regression sentinel (regression_gate.py): judge the
+        # fresh sweep rows against the committed history for the same
+        # config keys AFTER the calibrators ran — a quiet step-time
+        # regression fails the sweep with the culprit metric named,
+        # instead of silently becoming the new baseline. Keys without
+        # enough committed history are skipped, not failed, so a
+        # first-ever config never blocks.
+        import regression_gate
+
+        rc = regression_gate.main([
+            "--fresh", out, "--history", artifacts.results_dir(),
+        ])
+        if rc != 0:
+            print("# regression_gate flagged the sweep (see above)",
+                  file=sys.stderr)
+            return rc
     return 0
 
 

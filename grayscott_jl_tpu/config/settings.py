@@ -142,6 +142,16 @@ class Settings:
     #: end only. ``GS_METRICS_INTERVAL_S`` env wins, mirroring the
     #: other knobs.
     metrics_interval_s: float = 0.0
+    #: In-graph numerics probe (extension; obs/numerics.py,
+    #: docs/OBSERVABILITY.md): off | boundary | every_round — per-field
+    #: min/max/mean/L2/non-finite reductions fused into the snapshot
+    #: jit, with a windowed drift signal. GS_NUMERICS env wins.
+    numerics: str = ""
+    #: Executable analytics (extension; obs/xstats.py): on | off —
+    #: capture cost/memory analysis, HLO collective counts, compile
+    #: wall time, and compile-cache hit/miss per compiled step runner.
+    #: GS_XSTATS env wins; armed implicitly with the compile cache.
+    xstats: str = ""
     #: Registered model to integrate (extension; docs/MODELS.md): the
     #: ``[model]`` TOML table's ``name`` key (or a plain ``model =
     #: "heat"`` string). Gray-Scott is the default and keeps the

@@ -435,6 +435,28 @@ def _run_once_inner(
     m_step_us = metrics.histogram("step_latency_us", **mlabels)
     m_rounds = metrics.counter("step_rounds", **mlabels)
     m_steps = metrics.counter("steps", **mlabels)
+    # In-graph numerics telemetry (obs/numerics.py, GS_NUMERICS):
+    # "boundary" fuses the per-field min/max/mean/L2/finite reductions
+    # into the snapshot-copy jit at write boundaries; "every_round"
+    # additionally probes after every fused round. Off builds nothing —
+    # the loop below pays one `is not None` check.
+    from .obs import numerics as obs_numerics
+    from .resilience.health import DriftGate
+
+    num_mode = obs_numerics.resolve_numerics(settings)
+    num_recorder = (
+        obs_numerics.NumericsRecorder(
+            sim.model.field_names, metrics=metrics, events=evs,
+            gate=DriftGate.from_env(settings), log=log, labels=mlabels,
+        )
+        if num_mode != "off" else None
+    )
+    stats.config["numerics"] = num_mode
+    # The reference side of the live model-vs-measured residual gauge:
+    # what the ICI model projects one step should cost on this exact
+    # config. Computed once — the observed p50 moves, the projection
+    # does not.
+    proj_us = icimodel.projected_step_us_for(sim)
     metrics.gauge("comm_hidden_us_per_step", **mlabels).set(
         comm.get("hidden_us")
     )
@@ -462,6 +484,18 @@ def _run_once_inner(
             metrics.gauge(
                 "device_peak_bytes_in_use", device=ms["device"]
             ).set(ms["peak_bytes_in_use"])
+        # Model-vs-measured residual (docs/OBSERVABILITY.md): observed
+        # step-latency p50 minus the icimodel projection — calibration
+        # drift, live on the same scrape as the latency itself.
+        if proj_us is not None and hasattr(m_step_us, "percentile"):
+            p50 = m_step_us.percentile(50)
+            if p50 is not None:
+                metrics.gauge(
+                    "model_projected_step_us", **mlabels
+                ).set(round(proj_us, 1))
+                metrics.gauge(
+                    "model_vs_measured_residual_us", **mlabels
+                ).set(round(p50 - proj_us, 1))
 
     evs.emit(
         "run_start", step=restart_step, attempt=attempt,
@@ -555,6 +589,13 @@ def _run_once_inner(
                 stats.count("steps", boundary - step)
                 step = boundary
                 first_round = False
+                if num_recorder is not None and num_mode == "every_round":
+                    # Probe-only reduction over the live fields: every
+                    # fused round is covered, write boundaries
+                    # included ("boundary" mode instead fuses the probe
+                    # into the snapshot copy below — one HBM pass for
+                    # copy, health, and numerics together).
+                    num_recorder.observe(step, sim.numerics_stats())
                 if profile is not None:
                     profile.on_boundary(step)
 
@@ -622,7 +663,10 @@ def _run_once_inner(
                         for phase, fn in targets
                     ]
                 with stats.phase("device_to_host", step=step):
-                    snap = sim.snapshot_async(health=guard.enabled)
+                    snap = sim.snapshot_async(
+                        health=guard.enabled,
+                        numerics=num_mode == "boundary",
+                    )
                     if pipe.synchronous:
                         # Depth 0 reproduces the reference's flow
                         # exactly: D2H resolves here, writes run inline
@@ -651,6 +695,12 @@ def _run_once_inner(
                     if event is not None:
                         journal.record(**event)
                 pipe.submit(step, snap, targets)
+                if num_mode == "boundary":
+                    # After submit — the resolution blocks only on the
+                    # probe's scalars, never delays the write pipeline.
+                    num_recorder.observe(
+                        step, snap.numerics_report(), boundary=True
+                    )
                 if at_plot:
                     stats.count("output_steps")
                     evs.emit("output", phase="io", step=step,
@@ -725,6 +775,32 @@ def _run_once_inner(
                 "events": evs.describe(),
                 "metrics": metrics.describe(),
             })
+        if num_recorder is not None:
+            stats.record_numerics(
+                {"mode": num_mode, **num_recorder.describe()}
+            )
+        if sim.xstats_enabled:
+            # Executable analytics (obs/xstats.py): the per-compile
+            # records captured by the runner registrations, plus the
+            # model-vs-measured residual so a stats reader sees the
+            # calibration drift the gauge showed live.
+            from .obs import xstats as obs_xstats
+
+            xinfo = obs_xstats.summarize(sim.executables)
+            xinfo["records"] = list(sim.executables)
+            xinfo["model_projected_step_us"] = (
+                round(proj_us, 1) if proj_us is not None else None
+            )
+            p50 = (
+                m_step_us.percentile(50)
+                if hasattr(m_step_us, "percentile") else None
+            )
+            xinfo["observed_p50_us"] = p50
+            xinfo["model_vs_measured_residual_us"] = (
+                round(p50 - proj_us, 1)
+                if p50 is not None and proj_us is not None else None
+            )
+            stats.record_executables(xinfo)
         stats.maybe_write()
         if settings.verbose:
             log.info(f"run stats: {stats.summary()}")

@@ -82,6 +82,24 @@ class EnsembleFieldSnapshot(FieldSnapshot):
             for i in range(finite.shape[0])
         ))
 
+    def numerics_report(self):
+        """Per-member numerics statistics aggregated into one
+        :class:`~..obs.numerics.NumericsReport` (``members`` carries
+        the per-member rows; ``fields`` the cross-member aggregate) —
+        the same attribution shape as the per-member health probe."""
+        if self._numerics is None:
+            return None
+        from ..obs import numerics as obs_numerics
+
+        vals = [np.asarray(x) for x in self._numerics]
+        members = [
+            obs_numerics.resolve_report(
+                [v[i] for v in vals], self.field_names
+            ).fields
+            for i in range(vals[0].shape[0])
+        ]
+        return obs_numerics.NumericsReport.aggregate_members(members)
+
 
 def member_blocks(blocks, member: int, member_offset: int = 0):
     """Extract one member's spatial ``(offsets, sizes, *fields)``
@@ -211,6 +229,28 @@ class EnsembleSimulation(Simulation):
 
         return jax.vmap(device_probe)
 
+    def _numerics_probe_fn(self):
+        """Numerics reductions vmapped over the member axis — each
+        member's statistics resolve individually
+        (``EnsembleFieldSnapshot.numerics_report``), so a drifting
+        member of a sweep is attributed by index, mirroring the
+        per-member health probe."""
+        from ..obs.numerics import device_numerics_probe
+
+        return jax.vmap(device_numerics_probe)
+
+    def _resolve_numerics_host(self, raw):
+        from ..obs import numerics as obs_numerics
+
+        vals = [np.asarray(x) for x in raw]
+        members = [
+            obs_numerics.resolve_report(
+                [v[i] for v in vals], self.model.field_names
+            ).fields
+            for i in range(vals[0].shape[0])
+        ]
+        return obs_numerics.NumericsReport.aggregate_members(members)
+
     # ------------------------------------------------------------ fields
 
     def _init_fields(self):
@@ -286,8 +326,7 @@ class EnsembleSimulation(Simulation):
         else:
             fn = member_local
         fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
-        self._runners[nsteps] = fn
-        return fn
+        return self._register_runner(nsteps, fn)
 
     # ------------------------------------------------------------ output
 

@@ -21,7 +21,17 @@ with no common timeline. This package is the one place they meet
   that fault-journal events, health reports, watchdog expiries,
   supervisor restart decisions, autotune cache hits/misses, and
   graceful-shutdown markers all route through (``GS_EVENTS=path``) —
-  tailable live from a single file.
+  tailable live from a single file, rank-merged on read
+  (:func:`~.events.parse_events_multi`).
+* :mod:`.numerics` — the device-side half: per-field
+  min/max/mean/L2/non-finite reductions fused into the snapshot-copy
+  jit (``GS_NUMERICS=boundary|every_round``), resolved into gauges,
+  ``numerics`` events, and a windowed drift signal gated by the
+  precision-policy seam (``resilience.health.DriftGate``).
+* :mod:`.xstats` — executable analytics per compile (``GS_XSTATS``):
+  cost/memory analysis, HLO collective counts, compile wall time,
+  persistent-compile-cache hit/miss, and the model-vs-measured
+  step-time residual.
 
 Hard contract (asserted in tier-1): obs on/off leaves trajectories
 bitwise identical — every hook here observes host-side control flow and
@@ -32,18 +42,27 @@ in), resolve their output path from the environment exactly once
 and degrade to no-ops when their knob is unset.
 """
 
-from .events import EventStream, get_events, parse_events  # noqa: F401
+from .events import (  # noqa: F401
+    EventStream,
+    get_events,
+    parse_events,
+    parse_events_multi,
+)
 from .metrics import Histogram, MetricsRegistry, get_metrics  # noqa: F401
+from .numerics import NumericsRecorder, NumericsReport  # noqa: F401
 from .trace import ProfileWindow, SpanTracer, get_tracer  # noqa: F401
 
 __all__ = [
     "EventStream",
     "Histogram",
     "MetricsRegistry",
+    "NumericsRecorder",
+    "NumericsReport",
     "ProfileWindow",
     "SpanTracer",
     "get_events",
     "get_metrics",
     "get_tracer",
     "parse_events",
+    "parse_events_multi",
 ]

@@ -41,6 +41,8 @@ __all__ = [
     "NULL_EVENTS",
     "get_events",
     "parse_events",
+    "parse_events_multi",
+    "rank_files",
     "reset_events",
 ]
 
@@ -138,6 +140,40 @@ def parse_events(path: str) -> List[dict]:
             if isinstance(ev, dict):
                 out.append(ev)
     return out
+
+
+def rank_files(path: str) -> List[str]:
+    """The stream files one ``GS_EVENTS=path`` setting produced,
+    rank-merged: the bare path (single-process runs) plus every
+    ``path.rank<N>`` sibling a multi-process run wrote (``rank_path``
+    suffixing), N-sorted. Works for any of the ``.rank``-suffixed
+    artifact families (events, metrics, stats) — the reader-side
+    inverse of the writer-side suffixing."""
+    import glob
+    import re
+
+    out = [path] if os.path.isfile(path) else []
+    ranked = []
+    for p in glob.glob(f"{glob.escape(path)}.rank*"):
+        m = re.fullmatch(r"\.rank(\d+)", p[len(path):])
+        if m:
+            ranked.append((int(m.group(1)), p))
+    return out + [p for _, p in sorted(ranked)]
+
+
+def parse_events_multi(path: str) -> List[dict]:
+    """One merged, time-ordered event list from every rank's stream
+    file (:func:`rank_files`): the reader-side join of a multi-process
+    run's per-rank ``GS_EVENTS`` files — each record keeps its
+    ``proc``, so a report can attribute per process while telling one
+    chronological story. Sort is stable on the wall-clock ``ts`` every
+    record carries (ranks share the coordinator's clock domain on a
+    pod; sub-ms skew reorders nothing a human reads)."""
+    events: List[dict] = []
+    for p in rank_files(path):
+        events.extend(parse_events(p))
+    events.sort(key=lambda e: e.get("ts") or 0)
+    return events
 
 
 _stream = None

@@ -1,0 +1,327 @@
+"""In-graph numerics probes: per-field statistics from inside the step.
+
+The health guard (``resilience/health.py``) answers one question at
+write boundaries — "is the field still finite?" — with a fused
+isfinite+range reduction riding the snapshot-copy jit. This module
+generalizes that seam into a continuous numerics telemetry baseline:
+per-field **min / max / mean / L2 / non-finite-count** reductions fused
+into the same device program, resolved host-side into gauges, a
+``numerics`` record per probe on the unified event stream
+(``GS_EVENTS``), and a windowed **drift** signal (relative change of
+each statistic against a trailing reference window) whose trips land as
+``drift`` records and route through the precision-policy gate
+(``resilience.health.DriftGate`` — the hook ROADMAP item 1's
+mixed-precision work gates on).
+
+Knob (``GS_NUMERICS`` env / ``numerics`` TOML key):
+
+``off`` (default)
+    No probe is traced, no recorder is built — the driver's hot path
+    pays one ``is not None`` check (zero allocations, asserted in
+    tier-1, matching the PR-8 metrics contract).
+``boundary``
+    The probe is fused into the snapshot-copy jit at every
+    output/checkpoint boundary — the fields are read from HBM once for
+    copy, health, and numerics together; the scalars ride the
+    boundary's existing D2H.
+``every_round``
+    A probe-only jitted reduction additionally runs after every fused
+    step round (boundaries included), so rounds between write
+    boundaries are covered too.
+
+Hard contract (asserted in tier-1 for all four registered models):
+arming the probe changes NOTHING about the trajectory or the stores —
+the reductions only read the fields; bitwise identity on vs off.
+
+Host-side pieces (resolver, reports, recorder, drift math) are stdlib
+only and importable without JAX, like the rest of ``obs/``; only
+:func:`device_numerics_probe` imports ``jax.numpy``, lazily, when
+traced.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "DRIFT_STATS",
+    "MODES",
+    "NULL_NUMERICS",
+    "NumericsRecorder",
+    "NumericsReport",
+    "STATS",
+    "device_numerics_probe",
+    "resolve_numerics",
+    "resolve_report",
+    "resolve_window",
+]
+
+MODES = ("off", "boundary", "every_round")
+
+#: Per-field statistics, in the order :func:`device_numerics_probe`
+#: returns them (one group of scalars per field, declaration order).
+STATS = ("min", "max", "mean", "l2", "nonfinite")
+
+#: The statistics the drift signal tracks — ``nonfinite`` is excluded
+#: (the health guard owns finiteness; a relative change of a count
+#: that is almost always zero is not a meaningful ratio).
+DRIFT_STATS = ("min", "max", "mean", "l2")
+
+
+def resolve_numerics(settings=None) -> str:
+    """``GS_NUMERICS`` env wins over the ``numerics`` TOML key; default
+    ``off``. Unknown values raise at startup, mirroring
+    ``health.resolve_policy``."""
+    mode = os.environ.get("GS_NUMERICS")
+    if mode is None and settings is not None:
+        mode = getattr(settings, "numerics", "")
+    mode = (mode or "off").lower()
+    if mode not in MODES:
+        raise ValueError(
+            f"Unsupported numerics mode: {mode!r}. "
+            f"Supported: {', '.join(MODES)}"
+        )
+    return mode
+
+
+def resolve_window(default: int = 8) -> int:
+    """Reference-window length for the drift signal
+    (``GS_NUMERICS_WINDOW``, default 8 probes)."""
+    raw = os.environ.get("GS_NUMERICS_WINDOW", "").strip()
+    if not raw:
+        return default
+    try:
+        w = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"GS_NUMERICS_WINDOW must be an integer, got {raw!r}"
+        ) from e
+    if w < 1:
+        raise ValueError(f"GS_NUMERICS_WINDOW must be >= 1, got {w}")
+    return w
+
+
+def device_numerics_probe(*fields):
+    """The fused device-side reduction: for each field, ``(min, max,
+    mean, l2, nonfinite_count)`` as 0-d device arrays, flattened in
+    declaration order. Traced inside the snapshot-copy jit
+    (``Simulation.snapshot_async(numerics=True)``) or a probe-only jit
+    (``Simulation.numerics_stats``) so XLA fuses the reductions with
+    whatever else touches the fields — statistics are computed in
+    float32 regardless of the field dtype, the accumulation width the
+    future bf16 path needs. Statistics cover the stored (padded) grid,
+    like the health probe."""
+    import jax.numpy as jnp
+
+    out = ()
+    for f in fields:
+        g = f.astype(jnp.float32)
+        out += (
+            g.min(),
+            g.max(),
+            g.mean(),
+            jnp.sqrt((g * g).sum()),
+            (~jnp.isfinite(g)).sum().astype(jnp.int32),
+        )
+    return out
+
+
+def resolve_report(raw, names) -> "NumericsReport":
+    """Host-resolve one probe's flat scalar tuple into a
+    :class:`NumericsReport` (blocks only on the probe's few scalars)."""
+    n = len(STATS)
+    fields: Dict[str, dict] = {}
+    for i, name in enumerate(names):
+        vals = raw[i * n:(i + 1) * n]
+        fields[name] = {
+            "min": float(vals[0]),
+            "max": float(vals[1]),
+            "mean": float(vals[2]),
+            "l2": float(vals[3]),
+            "nonfinite": int(vals[4]),
+        }
+    return NumericsReport(fields)
+
+
+class NumericsReport:
+    """One probe's resolved per-field statistics.
+
+    ``fields`` maps each model field name to its stats dict
+    (:data:`STATS` keys). ``members``, when set (ensembles), holds one
+    such mapping per member; ``fields`` then carries the cross-member
+    aggregate (min of mins, max of maxs, mean of means, root of the
+    summed squares, summed non-finite count) so single-run consumers —
+    gauges, the drift window — read an ensemble report transparently,
+    exactly like ``EnsembleHealthReport``.
+    """
+
+    def __init__(self, fields: Dict[str, dict],
+                 members: Optional[List[Dict[str, dict]]] = None):
+        self.fields = fields
+        self.members = members
+
+    @classmethod
+    def aggregate_members(cls, members: List[Dict[str, dict]]
+                          ) -> "NumericsReport":
+        names = list(members[0])
+        agg = {}
+        for name in names:
+            rows = [m[name] for m in members]
+            agg[name] = {
+                "min": min(r["min"] for r in rows),
+                "max": max(r["max"] for r in rows),
+                "mean": sum(r["mean"] for r in rows) / len(rows),
+                "l2": sum(r["l2"] ** 2 for r in rows) ** 0.5,
+                "nonfinite": sum(r["nonfinite"] for r in rows),
+            }
+        return cls(agg, members=members)
+
+    @property
+    def finite(self) -> bool:
+        return all(r["nonfinite"] == 0 for r in self.fields.values())
+
+    def describe(self) -> dict:
+        out = {"fields": self.fields}
+        if self.members is not None:
+            out["members"] = self.members
+        return out
+
+
+class _NullNumericsRecorder:
+    """Shared no-op recorder for ``GS_NUMERICS=off`` — the same
+    zero-allocation off-switch shape as ``metrics.NULL_METRIC``."""
+
+    __slots__ = ()
+    enabled = False
+
+    def observe(self, step, report, boundary=False) -> None:
+        pass
+
+    def describe(self) -> Optional[dict]:
+        return None
+
+
+NULL_NUMERICS = _NullNumericsRecorder()
+
+
+class NumericsRecorder:
+    """Boundary-time consumer of resolved probes: gauges, events, drift.
+
+    Per probe it mirrors every field statistic into the metrics
+    registry (``numerics_<stat>{field=...}`` gauges), appends one
+    ``numerics`` record to the unified event stream, updates the
+    trailing reference window, and exposes each statistic's **drift** —
+    the bounded relative change vs the window mean (see
+    :meth:`_drift`) over the last ``window`` probes — as
+    ``numerics_drift{field,stat}`` gauges. Trips
+    (any |drift| above the gate's limit) route through the
+    :class:`~..resilience.health.DriftGate` and land as ``drift``
+    events; the gate is the seam the future precision policy plugs
+    into (ROADMAP item 1).
+    """
+
+    enabled = True
+
+    def __init__(self, names, *, metrics=None, events=None, gate=None,
+                 log=None, labels=None, window: Optional[int] = None):
+        self.names = tuple(names)
+        self.metrics = metrics
+        self.events = events
+        self.gate = gate
+        self.log = log
+        self.labels = dict(labels or {})
+        self.window = resolve_window() if window is None else int(window)
+        self.probes = 0
+        self.drift_trips = 0
+        self.last: Optional[NumericsReport] = None
+        self.max_drift: Dict[str, float] = {}
+        self._hist: Dict[tuple, deque] = {}
+
+    # ------------------------------------------------------------ drift
+
+    def _drift(self, field: str, stat: str, value: float
+               ) -> Optional[float]:
+        """Bounded relative change of ``value`` vs the trailing
+        window's mean: ``(value - ref) / max(|ref|, |value|)`` — 0.5
+        means the statistic doubled, -0.5 that it halved, ±1 that it
+        appeared from (or collapsed to) zero, beyond ±1 that it
+        crossed sign (the bound is ±2) — instead of exploding when a
+        near-zero statistic (a field minimum during pattern formation)
+        moves by an epsilon. None until a reference exists; the
+        current value joins the window AFTER the comparison, so the
+        reference never includes the probe being judged."""
+        key = (field, stat)
+        hist = self._hist.get(key)
+        if hist is None:
+            hist = self._hist[key] = deque(maxlen=self.window)
+        drift = None
+        if hist:
+            ref = sum(hist) / len(hist)
+            drift = (value - ref) / max(abs(ref), abs(value), 1e-30)
+        hist.append(value)
+        return drift
+
+    # ---------------------------------------------------------- observe
+
+    def observe(self, step, report, boundary: bool = False) -> None:
+        """Consume one resolved probe (a :class:`NumericsReport`)."""
+        if report is None:
+            return
+        self.probes += 1
+        self.last = report
+        m = self.metrics
+        drifts: Dict[str, float] = {}
+        for field, stats in report.fields.items():
+            if m is not None:
+                for stat in STATS:
+                    m.gauge(f"numerics_{stat}", field=field,
+                            **self.labels).set(stats[stat])
+            for stat in DRIFT_STATS:
+                d = self._drift(field, stat, stats[stat])
+                if d is None:
+                    continue
+                key = f"{field}.{stat}"
+                drifts[key] = round(d, 9)
+                prev = self.max_drift.get(key)
+                if prev is None or abs(d) > abs(prev):
+                    self.max_drift[key] = round(d, 9)
+                if m is not None:
+                    m.gauge("numerics_drift", field=field, stat=stat,
+                            **self.labels).set(round(d, 9))
+        if self.events is not None:
+            self.events.emit(
+                "numerics", phase="io" if boundary else "step_round",
+                step=step, **report.describe(),
+            )
+        if self.gate is not None and drifts:
+            event = self.gate.check(step, drifts)
+            if event is not None:
+                self.drift_trips += 1
+                if self.log is not None:
+                    tripped = event.get("tripped", {})
+                    self.log.warn(
+                        f"numerics drift at step {step}: "
+                        + ", ".join(
+                            f"{k}={v:+.3f}" for k, v in tripped.items()
+                        )
+                        + f" (|drift| > {event.get('limit')}, "
+                        f"policy={event.get('policy')})"
+                    )
+                if self.events is not None:
+                    self.events.emit("drift", step=step, **event)
+
+    # ----------------------------------------------------------- export
+
+    def describe(self) -> dict:
+        """The RunStats ``numerics`` section: probe count, the last
+        per-field statistics, and each statistic's worst observed
+        drift."""
+        return {
+            "probes": self.probes,
+            "window": self.window,
+            "drift_trips": self.drift_trips,
+            "last": self.last.describe() if self.last else None,
+            "max_drift": dict(self.max_drift),
+        }

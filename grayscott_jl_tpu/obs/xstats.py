@@ -1,0 +1,254 @@
+"""Executable analytics: what XLA actually built, per compile.
+
+The runtime telemetry (spans, metrics, events — PR 8) sees the run
+from the host; this module captures the *compiler's* side of the story
+at the moment each step runner is compiled: ``cost_analysis()`` flops
+and bytes, ``memory_analysis()`` buffer sizes, the HLO collective
+census (how many collective-permutes/all-reduces the schedule really
+carries — the number the HLO regression tests and the icimodel
+calibration loop reason about), compile wall time, and the persistent
+compilation cache outcome (hit/miss) per executable. Records land in
+three places at capture time: ``sim.executables`` (merged into the
+RunStats ``executables`` section by the driver), one ``executable``
+record on the unified event stream, and the
+``compiles``/``compile_cache_hits``/``compile_cache_misses`` counters
+plus a ``compile_s_total`` gauge in the metrics registry.
+
+Knob: ``GS_XSTATS`` env / ``xstats`` TOML key (on/off, default off).
+Capture is also armed implicitly whenever the persistent compilation
+cache is (``GS_COMPILE_CACHE``) — the cache's hit/miss story should
+never be invisible just because nobody asked for full analytics
+(previously ``simulation._enable_compile_cache`` had no success-path
+observability at all).
+
+Contract: armed capture routes the runner through the same
+``lower().compile()`` AOT path ``Simulation.compile_chunk`` already
+uses — the identical program, so trajectories and stores stay bitwise
+identical (asserted in tier-1 for all four models). Off costs one
+``if`` per runner construction, nothing on the step path. Every
+analytics query is best-effort: a jax whose AOT surface drifted
+degrades to a partial record, never a failed run.
+
+Module is importable without JAX (it only touches the compiled objects
+handed to it), like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Optional
+
+__all__ = [
+    "capture",
+    "collective_counts",
+    "cache_listing",
+    "instrument_compile",
+    "publish",
+    "resolve_xstats",
+    "summarize",
+]
+
+_TRUTHY = ("1", "on", "true", "yes")
+_FALSY = ("0", "off", "false", "no", "")
+
+
+def resolve_xstats(settings=None) -> bool:
+    """``GS_XSTATS`` env wins over the ``xstats`` TOML key; default
+    off. Unknown values raise at startup."""
+    raw = os.environ.get("GS_XSTATS")
+    if raw is None and settings is not None:
+        raw = getattr(settings, "xstats", "")
+    raw = (raw or "").strip().lower()
+    if raw in _TRUTHY:
+        return True
+    if raw in _FALSY:
+        return False
+    raise ValueError(
+        f"GS_XSTATS / xstats must be on or off, got {raw!r}"
+    )
+
+
+#: HLO instruction names that move data between devices — the census
+#: the collective-count regression tests (test_overlap) key on.
+_COLLECTIVE_RE = re.compile(
+    r"\b(collective-permute|all-reduce|all-gather|all-to-all|"
+    r"reduce-scatter)\b"
+)
+
+
+def collective_counts(hlo_text: str) -> dict:
+    """Occurrences of each collective op family in an HLO dump.
+    ``-start``/``-done`` async pairs count under their family (the
+    family name is a prefix of both halves)."""
+    counts: dict = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def cache_listing(path: Optional[str]) -> Optional[frozenset]:
+    """Entries of the persistent compile cache directory, or None when
+    no cache is armed / the directory is unreadable."""
+    if not path:
+        return None
+    try:
+        return frozenset(os.listdir(path))
+    except OSError:
+        return None
+
+
+#: cost_analysis keys worth keeping — the raw dict carries hundreds of
+#: per-operand ``bytes accessedN{}`` entries that would bloat every
+#: stats file.
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals",
+              "optimal_seconds", "utilization")
+
+#: memory_analysis attributes present across the jax versions we care
+#: about (each read defensively — absence is recorded as absence).
+_MEMORY_ATTRS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def capture(compiled, *, name: str, compile_s: float,
+            cache_dir: Optional[str] = None,
+            cache_before: Optional[frozenset] = None,
+            extra: Optional[dict] = None) -> dict:
+    """One executable's analytics record, from a ``jax`` AOT-compiled
+    object. Every query is individually best-effort."""
+    rec = {"name": name, "compile_s": round(compile_s, 6)}
+    if extra:
+        rec.update(extra)
+
+    try:
+        cost = compiled.cost_analysis()
+        # Older jax returns a one-dict list (per partition), newer the
+        # dict itself.
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if isinstance(cost, dict):
+            rec["cost"] = {
+                k.replace(" ", "_"): round(float(cost[k]), 3)
+                for k in _COST_KEYS if k in cost
+            }
+    except Exception:  # noqa: BLE001 — optional AOT surface
+        pass
+
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out = {}
+            for attr in _MEMORY_ATTRS:
+                v = getattr(mem, attr, None)
+                if v is not None:
+                    out[attr] = int(v)
+            if out:
+                # The operator-facing single number: everything the
+                # executable holds live at once (args + outputs +
+                # temps), the HBM envelope a capacity planner needs.
+                peak = sum(
+                    out.get(k, 0)
+                    for k in ("argument_size_in_bytes",
+                              "output_size_in_bytes",
+                              "temp_size_in_bytes")
+                )
+                out["peak_bytes_estimate"] = peak
+                rec["memory"] = out
+    except Exception:  # noqa: BLE001
+        pass
+
+    try:
+        rec["collectives"] = collective_counts(compiled.as_text())
+    except Exception:  # noqa: BLE001
+        pass
+
+    if cache_dir is not None:
+        after = cache_listing(cache_dir)
+        if cache_before is None or after is None:
+            rec["cache"] = "unknown"
+        else:
+            # A compile that wrote a new cache entry was a miss; one
+            # that left the directory untouched was served from it.
+            rec["cache"] = "miss" if after - cache_before else "hit"
+    return rec
+
+
+def publish(rec: dict, *, metrics=None, events=None) -> None:
+    """Mirror one capture into the metrics registry and the unified
+    event stream (both no-ops when their sinks are off)."""
+    if events is not None:
+        events.emit("executable", phase="compile", **rec)
+    if metrics is None:
+        return
+    metrics.counter("compiles").inc()
+    g = metrics.gauge("compile_s_last")
+    g.set(rec.get("compile_s"))
+    cache = rec.get("cache")
+    if cache == "hit":
+        metrics.counter("compile_cache_hits").inc()
+    elif cache == "miss":
+        metrics.counter("compile_cache_misses").inc()
+
+
+def summarize(records) -> dict:
+    """Aggregate view of a run's capture list — the header of the
+    RunStats ``executables`` section."""
+    records = list(records)
+    cache = [r.get("cache") for r in records]
+    return {
+        "compiles": len(records),
+        "compile_s_total": round(
+            sum(r.get("compile_s", 0.0) for r in records), 6
+        ),
+        "compile_cache_hits": cache.count("hit"),
+        "compile_cache_misses": cache.count("miss"),
+    }
+
+
+def instrument_compile(sim, fn, nsteps: int):
+    """AOT-compile a runner with analytics capture.
+
+    Returns the compiled executable (stored by the caller in place of
+    the jit wrapper, exactly like ``Simulation.compile_chunk``), or the
+    wrapper unchanged if anything about the instrumented path fails —
+    capture must never take a run down.
+    """
+    import jax.numpy as jnp
+
+    cache_dir = sim.compile_cache_dir
+    before = cache_listing(cache_dir)
+    try:
+        t0 = time.perf_counter()
+        lowered = fn.lower(
+            *sim.fields, sim.base_key, jnp.int32(sim.step), sim.params
+        )
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001 — never break the run
+        import sys
+
+        print(
+            f"gray-scott: warning: executable analytics capture failed "
+            f"for the {nsteps}-step runner ({e}); running uninstrumented",
+            file=sys.stderr,
+        )
+        return fn
+    rec = capture(
+        compiled, name=f"runner[{nsteps}]", compile_s=compile_s,
+        cache_dir=cache_dir, cache_before=before,
+        extra={"nsteps": nsteps,
+               "kernel": sim.kernel_language,
+               "model": sim.model.name},
+    )
+    sim.executables.append(rec)
+    from .events import get_events
+    from .metrics import get_metrics
+
+    publish(rec, metrics=get_metrics(), events=get_events())
+    return compiled

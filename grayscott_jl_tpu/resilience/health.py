@@ -32,7 +32,9 @@ import os
 from typing import NamedTuple, Optional
 
 __all__ = [
+    "DRIFT_POLICIES",
     "POLICIES",
+    "DriftGate",
     "EnsembleHealthReport",
     "HealthError",
     "HealthGuard",
@@ -42,6 +44,66 @@ __all__ = [
 ]
 
 POLICIES = ("abort", "rollback", "warn", "off")
+
+DRIFT_POLICIES = ("warn", "off")
+
+
+class DriftGate:
+    """Policy gate over the windowed numerics-drift signal
+    (``obs/numerics.py``): the precision-policy seam ROADMAP item 1's
+    mixed-precision work plugs into ("health probes gate precision
+    drift" needs a baseline to drift *from* — the numerics recorder —
+    and a place to act on it — this gate).
+
+    Today's policies (``GS_DRIFT_POLICY``): ``warn`` (default — trips
+    are logged, land as ``drift`` events on the unified stream, and
+    count in the RunStats ``numerics`` section) and ``off``. The
+    future bf16 path adds an action that demotes/escalates precision
+    here; the call shape (per-statistic relative drifts at a boundary
+    step) is already what that decision needs. ``GS_DRIFT_LIMIT``
+    (default 0.5) is the relative-change trip threshold.
+    """
+
+    def __init__(self, policy: str = "warn", limit: float = 0.5):
+        if policy not in DRIFT_POLICIES:
+            raise ValueError(
+                f"Unsupported drift policy: {policy!r}. "
+                f"Supported: {', '.join(DRIFT_POLICIES)}"
+            )
+        if limit <= 0:
+            raise ValueError(f"drift limit must be > 0, got {limit}")
+        self.policy = policy
+        self.limit = float(limit)
+
+    @classmethod
+    def from_env(cls, settings=None) -> "DriftGate":
+        policy = (os.environ.get("GS_DRIFT_POLICY") or "warn").lower()
+        raw = os.environ.get("GS_DRIFT_LIMIT", "").strip()
+        try:
+            limit = float(raw) if raw else 0.5
+        except ValueError as e:
+            raise ValueError(
+                f"GS_DRIFT_LIMIT must be a number, got {raw!r}"
+            ) from e
+        return cls(policy, limit)
+
+    def check(self, step: int, drifts: dict) -> Optional[dict]:
+        """Judge one probe's per-statistic drifts (``"field.stat" ->
+        relative change``). Returns an event-able dict when any
+        statistic exceeds the limit under an active policy, else
+        None."""
+        if self.policy == "off":
+            return None
+        tripped = {
+            k: v for k, v in drifts.items() if abs(v) > self.limit
+        }
+        if not tripped:
+            return None
+        return {
+            "policy": self.policy,
+            "limit": self.limit,
+            "tripped": tripped,
+        }
 
 
 class HealthReport:

@@ -280,7 +280,7 @@ class FieldSnapshot:
     """
 
     def __init__(self, parts, step: int, health=None,
-                 field_names=("u", "v")):
+                 field_names=("u", "v"), numerics=None):
         #: Simulation step the snapshot was taken at.
         self.step = step
         self._parts = parts  # [(offsets, true_sizes, *field_devs), ...]
@@ -291,6 +291,10 @@ class FieldSnapshot:
         #: (``resilience/health.device_probe``) when the snapshot was
         #: taken with ``health=True``; resolved by :meth:`health_report`.
         self._health = health
+        #: Device scalars of the fused numerics probe
+        #: (``obs/numerics.device_numerics_probe``) when taken with
+        #: ``numerics=True``; resolved by :meth:`numerics_report`.
+        self._numerics = numerics
 
     def health_report(self):
         """Resolved :class:`~.resilience.health.HealthReport` for this
@@ -304,6 +308,19 @@ class FieldSnapshot:
         return HealthReport(
             bool(finite), *(float(x) for x in minmax),
             names=self.field_names,
+        )
+
+    def numerics_report(self):
+        """Resolved :class:`~.obs.numerics.NumericsReport` for this
+        snapshot, or None when no numerics probe was requested. Blocks
+        only on the probe's scalars — the block D2H stays in flight,
+        like :meth:`health_report`."""
+        if self._numerics is None:
+            return None
+        from .obs import numerics as obs_numerics
+
+        return obs_numerics.resolve_report(
+            self._numerics, self.field_names
         )
 
     def blocks(self):
@@ -645,8 +662,21 @@ class Simulation:
         #: layout; None for fresh runs and same-shape resumes. Echoed
         #: into the RunStats config by the driver.
         self.reshard = None
+        #: Executable analytics (``obs/xstats.py``): armed by GS_XSTATS
+        #: / the ``xstats`` key, or implicitly whenever the persistent
+        #: compile cache is — its hit/miss story must be observable.
+        #: Each instrumented runner compile appends its record here;
+        #: the driver merges the list into the RunStats ``executables``
+        #: section.
+        from .obs import xstats as obs_xstats
+
+        self.xstats_enabled = (
+            obs_xstats.resolve_xstats(settings)
+            or bool(self.compile_cache_dir)
+        )
+        self.executables: list = []
         self._runners: Dict[int, object] = {}
-        self._snapshot_fns: Dict[bool, object] = {}
+        self._snapshot_fns: Dict[Tuple[bool, bool], object] = {}
 
         self._build_mesh(devices, backend)
         #: The model's field arrays, declaration order (a tuple — the
@@ -720,6 +750,14 @@ class Simulation:
         from .resilience.health import device_probe
 
         return device_probe
+
+    def _numerics_probe_fn(self):
+        """The device-side numerics probe (per-field min/max/mean/L2/
+        finite reductions) fused into the snapshot copy — or run alone
+        per round under ``GS_NUMERICS=every_round``."""
+        from .obs.numerics import device_numerics_probe
+
+        return device_numerics_probe
 
     def _build_mesh(self, devices, backend: str) -> None:
         """Construct ``self.mesh`` / ``self.field_sharding`` (or pin
@@ -1229,6 +1267,20 @@ class Simulation:
         else:
             fn = local
         fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
+        return self._register_runner(nsteps, fn)
+
+    def _register_runner(self, nsteps: int, fn):
+        """Cache a freshly-built runner — under executable analytics
+        (``obs/xstats.py``) it is AOT-compiled here (the same
+        ``lower().compile()`` path :meth:`compile_chunk` uses — the
+        identical program, so trajectories are unchanged) with compile
+        wall time, cost/memory analysis, collective counts, and the
+        persistent-cache outcome captured per executable. Off means
+        one boolean check; the jit wrapper is stored untouched."""
+        if self.xstats_enabled:
+            from .obs import xstats as obs_xstats
+
+            fn = obs_xstats.instrument_compile(self, fn, nsteps)
         self._runners[nsteps] = fn
         return fn
 
@@ -1302,7 +1354,9 @@ class Simulation:
             )
         return parts
 
-    def snapshot_async(self, *, health: bool = False) -> FieldSnapshot:
+    def snapshot_async(
+        self, *, health: bool = False, numerics: bool = False
+    ) -> FieldSnapshot:
         """Capture the current (u, v) for overlapped output: returns a
         :class:`FieldSnapshot` with non-blocking D2H transfers already
         in flight, so the caller can hand it to a background writer and
@@ -1321,38 +1375,66 @@ class Simulation:
         inside the SAME jitted program — the fields are read from HBM
         once for both copy and probe, and the five scalars ride the
         boundary's existing D2H (``FieldSnapshot.health_report``).
+        ``numerics=True`` fuses the per-field min/max/mean/L2/finite
+        reductions (``obs/numerics.device_numerics_probe``) into the
+        same program the same way (``FieldSnapshot.numerics_report``).
         """
-        fn = self._snapshot_fns.get(health)
+        key = (health, numerics)
+        fn = self._snapshot_fns.get(key)
         if fn is None:
             # +0 forces a real output buffer (no donation, so XLA never
             # aliases inputs into outputs); sharding follows the inputs.
-            if health:
-                device_probe = self._probe_fn()
+            device_probe = self._probe_fn() if health else None
+            num_probe = self._numerics_probe_fn() if numerics else None
 
-                def copy(*fields):
-                    return (
-                        tuple(f + jnp.zeros((), f.dtype) for f in fields),
-                        device_probe(*fields),
-                    )
-            else:
-                def copy(*fields):
-                    return tuple(
-                        f + jnp.zeros((), f.dtype) for f in fields
-                    )
-            fn = self._snapshot_fns[health] = jax.jit(copy)
-        if health:
-            copies, probe = fn(*self.fields)
+            def copy(*fields):
+                out = [tuple(
+                    f + jnp.zeros((), f.dtype) for f in fields
+                )]
+                if device_probe is not None:
+                    out.append(device_probe(*fields))
+                if num_probe is not None:
+                    out.append(num_probe(*fields))
+                return tuple(out) if len(out) > 1 else out[0]
+
+            fn = self._snapshot_fns[key] = jax.jit(copy)
+        res = fn(*self.fields)
+        if health or numerics:
+            copies, *extras = res
+            probe = extras.pop(0) if health else None
+            nums = extras.pop(0) if numerics else None
         else:
-            copies = fn(*self.fields)
-            probe = None
+            copies, probe, nums = res, None, None
         parts = self._shard_parts(*copies)
         for part in parts:
             for dev in part[2:]:
                 dev.copy_to_host_async()
         return self.snapshot_cls(
-            parts, self.step, health=probe,
+            parts, self.step, health=probe, numerics=nums,
             field_names=self.model.field_names,
         )
+
+    def numerics_stats(self):
+        """One probe-only numerics reduction over the live fields,
+        resolved to a :class:`~.obs.numerics.NumericsReport` — the
+        ``GS_NUMERICS=every_round`` path, for rounds that end at no
+        write boundary (boundaries get the probe fused into the
+        snapshot copy instead). A pure read of the field buffers: the
+        trajectory is untouched."""
+        fn = getattr(self, "_numerics_fn", None)
+        if fn is None:
+            probe = self._numerics_probe_fn()
+
+            def run(*fields):
+                return probe(*fields)
+
+            fn = self._numerics_fn = jax.jit(run)
+        return self._resolve_numerics_host(fn(*self.fields))
+
+    def _resolve_numerics_host(self, raw):
+        from .obs import numerics as obs_numerics
+
+        return obs_numerics.resolve_report(raw, self.model.field_names)
 
     def poison_nan(self, field="u") -> None:
         """Chaos/testing hook (``resilience/faults.py`` kind ``nan``):

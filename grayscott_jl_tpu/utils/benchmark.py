@@ -11,6 +11,17 @@ import time
 from typing import Dict
 
 
+def _utc_stamp() -> str:
+    """UTC ISO capture timestamp (mirrors ``benchmarks/artifacts.py``;
+    duplicated so this module stays importable from the jax-free
+    ``bench.py`` parent without a benchmarks/ path hack)."""
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
 #: XLA flags that let the split-phase halo exchange actually overlap on
 #: hardware (docs/OVERLAP.md): async collective-permute turns each
 #: ppermute into a start/done pair, and the latency-hiding scheduler
@@ -195,6 +206,11 @@ def bench_one(
     from ..parallel import icimodel
 
     out = {
+        # Capture timestamp (UTC ISO): the staleness anchor for the
+        # last-good-TPU provenance scan (bench._last_tpu_provenance).
+        # File mtimes are checkout times on a fresh clone — only a
+        # stamp INSIDE the record survives the trip through git.
+        "t": _utc_stamp(),
         "L": L,
         "precision": precision,
         "kernel": lang,

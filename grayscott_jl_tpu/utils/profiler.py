@@ -78,6 +78,16 @@ class RunStats:
         #: (trace path + event/span counts, event-stream path, metrics
         #: path/interval) — a stats reader can find the companion files.
         self.obs: Optional[dict] = None
+        #: Executable analytics (``obs/xstats.py``): per-compile cost /
+        #: memory / collective-count records with compile wall time and
+        #: the persistent-cache outcome, plus the model-vs-measured
+        #: projection residual — the compiler's side of the run story.
+        self.executables: Optional[dict] = None
+        #: In-graph numerics telemetry (``obs/numerics.py``): probe
+        #: count, the last per-field statistics, and each statistic's
+        #: worst windowed drift — the baseline the precision policy
+        #: (ROADMAP item 1) will gate against.
+        self.numerics: Optional[dict] = None
         #: Per-member ensemble section (``ensemble/``, docs/ENSEMBLE.md):
         #: member params + seeds, the member-axis mesh split, and the
         #: latest per-member health probe — one stats file tells which
@@ -141,6 +151,16 @@ class RunStats:
         metrics ``describe()`` dicts) to the summary."""
         self.obs = dict(info) if info else None
 
+    def record_executables(self, info: Optional[dict]) -> None:
+        """Attach the executable-analytics section (``xstats.summarize``
+        header + per-compile records + projection residual)."""
+        self.executables = dict(info) if info else None
+
+    def record_numerics(self, info: Optional[dict]) -> None:
+        """Attach the numerics-telemetry section
+        (``NumericsRecorder.describe()``)."""
+        self.numerics = dict(info) if info else None
+
     def record_ensemble(self, info: Optional[dict]) -> None:
         """Attach the per-member ensemble section
         (``EnsembleSettings.describe()`` + resolved seeds)."""
@@ -180,6 +200,8 @@ class RunStats:
             "faults": self.faults,
             "metrics": self.metrics,
             "obs": self.obs,
+            "executables": self.executables,
+            "numerics": self.numerics,
             "ensemble": self.ensemble,
             "counters": dict(self.counters),
             # Aggregate across ensemble members (members == 1 solo).

@@ -119,6 +119,9 @@ run "$WORK/sup" \
   GS_TRACE="$WORK/sup/trace.json" \
   GS_EVENTS="$WORK/sup/events.jsonl" \
   GS_METRICS="$WORK/sup/metrics.jsonl" \
+  GS_NUMERICS=boundary \
+  GS_XSTATS=1 \
+  GS_TPU_STATS="$WORK/sup/stats.json" \
   > "$WORK/sup.log" 2>&1
 
 grep -a "supervisor:" "$WORK/sup.log" > /dev/null || {
@@ -138,10 +141,66 @@ grep -aq '"kind": "recovery"' "$WORK/sup/events.jsonl" || {
   echo "chaos_smoke: FAIL — recovery decision missing from the event stream" >&2
   exit 1
 }
+# Device-side flight recorder (docs/OBSERVABILITY.md): the in-graph
+# numerics probes and executable analytics ride along (the store
+# byte-identity above doubles as THEIR transparency contract too);
+# both record kinds must be on the stream and validate.
+grep -aq '"kind": "numerics"' "$WORK/sup/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no numerics records on the event stream" >&2
+  exit 1
+}
+grep -aq '"kind": "executable"' "$WORK/sup/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no executable records on the event stream" >&2
+  exit 1
+}
 PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
   "${REPO}/scripts/gs_report.py" --check \
-  --trace "$WORK/sup/trace.json" --events "$WORK/sup/events.jsonl" || {
+  --trace "$WORK/sup/trace.json" --events "$WORK/sup/events.jsonl" \
+  --stats "$WORK/sup/stats.json" || {
   echo "chaos_smoke: FAIL — gs_report.py --check rejected the obs artifacts" >&2
+  exit 1
+}
+
+# Perf-regression sentinel (benchmarks/regression_gate.py) over this
+# run's own artifact: distill the chaos run's step-latency stats into
+# one artifact row, gate it against itself-as-history (plumbing smoke —
+# must pass), then assert a synthetic 2x slowdown flips the exit code
+# and names the culprit metric. The committed-history comparison runs
+# in tier-1 and tune_sweep --calibrate; this exercises the tripwire
+# end to end on freshly-measured data.
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 - \
+  "$WORK/sup/stats.json" "$WORK/sup/chaos_perf.jsonl" <<'EOF'
+import json, sys
+
+stats = json.load(open(sys.argv[1]))
+hist = next(h for h in stats["metrics"]["histograms"]
+            if h["name"] == "step_latency_us")
+cfg = stats["config"]
+row = {
+    "ab": "chaos_smoke", "platform": "cpu", "model": cfg["model"],
+    "L": stats["L"], "mesh": cfg["mesh_dims"],
+    "devices": cfg["n_devices"], "kernel": cfg["kernel_language"],
+    "median_us_per_step": hist["p50"],
+}
+with open(sys.argv[2], "w") as f:
+    for _ in range(4):  # 3 history rows + the judged row (--self)
+        f.write(json.dumps(row) + "\n")
+EOF
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/benchmarks/regression_gate.py" \
+  --fresh "$WORK/sup/chaos_perf.jsonl" --history --self || {
+  echo "chaos_smoke: FAIL — regression_gate flagged an unregressed run" >&2
+  exit 1
+}
+if PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/benchmarks/regression_gate.py" \
+  --fresh "$WORK/sup/chaos_perf.jsonl" --history --self \
+  --inject-slowdown 2 2> "$WORK/sup/gate2x.log"; then
+  echo "chaos_smoke: FAIL — regression_gate missed the injected 2x slowdown" >&2
+  exit 1
+fi
+grep -aq "median_us_per_step" "$WORK/sup/gate2x.log" || {
+  echo "chaos_smoke: FAIL — regression_gate did not name the culprit metric" >&2
   exit 1
 }
 

@@ -32,7 +32,10 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-from grayscott_jl_tpu.obs.events import parse_events  # noqa: E402
+from grayscott_jl_tpu.obs.events import (  # noqa: E402
+    parse_events_multi,
+    rank_files,
+)
 from grayscott_jl_tpu.obs.trace import validate_trace  # noqa: E402
 
 
@@ -40,9 +43,49 @@ def _fmt_s(v) -> str:
     return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
 
 
-def check(trace_path, events_path, stats_path) -> int:
+#: Per-kind attribute requirements of the extended record kinds
+#: (docs/OBSERVABILITY.md): a producer that drops these has broken the
+#: schema the report sections below render from.
+EVENT_ATTR_SCHEMA = {
+    "numerics": ("fields",),
+    "drift": ("tripped", "limit", "policy"),
+    "executable": ("name", "compile_s"),
+}
+
+
+def _check_event(path, i, e, problems) -> None:
+    missing = [k for k in ("ts", "kind") if k not in e]
+    if missing:
+        problems.append(
+            f"events {path}: record {i} missing {missing}"
+        )
+        return
+    required = EVENT_ATTR_SCHEMA.get(e.get("kind"))
+    if required:
+        attrs = e.get("attrs") or {}
+        missing = [k for k in required if k not in attrs]
+        if missing:
+            problems.append(
+                f"events {path}: {e['kind']} record {i} missing "
+                f"attrs {missing}"
+            )
+        if e.get("kind") == "numerics" and "fields" not in missing:
+            for fname, stats in (attrs["fields"] or {}).items():
+                bad = [s for s in ("min", "max", "mean", "l2",
+                                   "nonfinite")
+                       if not isinstance(stats.get(s), (int, float))]
+                if bad:
+                    problems.append(
+                        f"events {path}: numerics record {i} field "
+                        f"{fname!r} missing stats {bad}"
+                    )
+
+
+def check(trace_path, events_path, stats_path,
+          metrics_path=None) -> int:
     """Schema validation (the chaos_smoke / CI entry): returns the
-    process exit code."""
+    process exit code. Multi-process runs are validated across every
+    ``.rank<N>`` sibling of the named events/metrics path."""
     problems = []
     if trace_path:
         try:
@@ -59,18 +102,33 @@ def check(trace_path, events_path, stats_path) -> int:
                 problems.append(f"trace {trace_path}: no spans")
     if events_path:
         try:
-            events = parse_events(events_path)
+            events = parse_events_multi(events_path)
         except OSError as e:
             problems.append(f"events {events_path}: unreadable ({e})")
         else:
             if not events:
                 problems.append(f"events {events_path}: no events")
             for i, e in enumerate(events):
-                missing = [k for k in ("ts", "kind") if k not in e]
+                _check_event(events_path, i, e, problems)
+    if metrics_path:
+        files = rank_files(metrics_path)
+        if not files:
+            problems.append(f"metrics {metrics_path}: no such file")
+        for p in files:
+            try:
+                records = _read_metrics(p)
+            except (OSError, json.JSONDecodeError) as e:
+                problems.append(f"metrics {p}: unreadable ({e})")
+                continue
+            if not records:
+                problems.append(f"metrics {p}: no records")
+            for i, rec in enumerate(records):
+                missing = [k for k in ("ts", "proc", "counters",
+                                       "gauges", "histograms")
+                           if k not in rec]
                 if missing:
                     problems.append(
-                        f"events {events_path}: record {i} missing "
-                        f"{missing}"
+                        f"metrics {p}: record {i} missing {missing}"
                     )
     if stats_path:
         try:
@@ -99,6 +157,24 @@ def check(trace_path, events_path, stats_path) -> int:
     if not problems:
         print("gs_report: OK — artifacts validate")
     return 1 if problems else 0
+
+
+def _read_metrics(path: str) -> list:
+    """Interval snapshot records of one metrics JSONL file (torn tail
+    lines skipped, like the event stream)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
 
 
 def report_stats(stats: dict) -> None:
@@ -146,6 +222,88 @@ def report_stats(stats: dict) -> None:
                 print(f"  p50={h.get('p50')}us p95={h.get('p95')}us "
                       f"p99={h.get('p99')}us mean={h.get('mean')}us "
                       f"over {h.get('count')} rounds")
+    report_numerics(stats.get("numerics"))
+    report_executables(stats.get("executables"))
+
+
+def report_numerics(num) -> None:
+    """The in-graph numerics section: last per-field statistics plus
+    each statistic's worst windowed drift (docs/OBSERVABILITY.md)."""
+    if not num:
+        return
+    print(f"== numerics (mode={num.get('mode')}, "
+          f"{num.get('probes')} probes, window={num.get('window')}, "
+          f"drift trips={num.get('drift_trips')}) ==")
+    last = (num.get("last") or {}).get("fields") or {}
+    drift = num.get("max_drift") or {}
+    for field, s in last.items():
+        print(f"  {field:<6} min={s.get('min'):.6g} "
+              f"max={s.get('max'):.6g} mean={s.get('mean'):.6g} "
+              f"l2={s.get('l2'):.6g} nonfinite={s.get('nonfinite')}")
+        worst = {k.split(".", 1)[1]: v for k, v in drift.items()
+                 if k.startswith(field + ".")}
+        if worst:
+            print("         max drift: " + " ".join(
+                f"{k}={v:+.3f}" for k, v in worst.items()
+            ))
+
+
+def report_executables(ex) -> None:
+    """The executable-analytics table: per-compile cost / memory /
+    collective counts, cache outcome, and the model-vs-measured
+    residual (docs/OBSERVABILITY.md)."""
+    if not ex:
+        return
+    print(f"== executables ({ex.get('compiles')} compiles, "
+          f"{_fmt_s(ex.get('compile_s_total'))} compiling, cache "
+          f"{ex.get('compile_cache_hits')} hit / "
+          f"{ex.get('compile_cache_misses')} miss) ==")
+    for r in ex.get("records") or []:
+        cost = r.get("cost") or {}
+        mem = r.get("memory") or {}
+        coll = r.get("collectives") or {}
+        coll_s = (", ".join(f"{k}x{v}" for k, v in sorted(coll.items()))
+                  or "none")
+        print(f"  {r.get('name', '?'):<14} "
+              f"compile={_fmt_s(r.get('compile_s'))} "
+              f"flops={cost.get('flops', '-')} "
+              f"bytes={cost.get('bytes_accessed', '-')} "
+              f"peakB={mem.get('peak_bytes_estimate', '-')} "
+              f"collectives={coll_s} "
+              f"cache={r.get('cache', '-')}")
+    proj = ex.get("model_projected_step_us")
+    p50 = ex.get("observed_p50_us")
+    res = ex.get("model_vs_measured_residual_us")
+    if proj is not None or p50 is not None:
+        print(f"  model projected {proj}us/step vs observed p50 "
+              f"{round(p50, 1) if isinstance(p50, (int, float)) else '-'}"
+              f"us -> residual {res}us")
+
+
+def report_metrics_files(path: str) -> None:
+    """Per-process metrics summary from (rank-merged) interval JSONL
+    files: the final snapshot's headline counters and the step-latency
+    percentiles, attributed per proc."""
+    files = rank_files(path)
+    if not files:
+        return
+    print(f"== metrics ({len(files)} file(s)) ==")
+    for p in files:
+        records = _read_metrics(p)
+        if not records:
+            continue
+        last = records[-1]
+        counters = {c.get("name"): c.get("value")
+                    for c in last.get("counters", [])}
+        line = (f"  proc {last.get('proc')}: "
+                f"{len(records)} snapshot(s), steps="
+                f"{counters.get('steps')} rounds="
+                f"{counters.get('step_rounds')}")
+        for h in last.get("histograms", []):
+            if h.get("name") == "step_latency_us":
+                line += (f", step p50={h.get('p50')}us "
+                         f"p99={h.get('p99')}us")
+        print(line)
 
 
 def report_attempts(events) -> None:
@@ -166,11 +324,16 @@ def report_attempts(events) -> None:
 
 
 def report_timeline(events, top: int) -> None:
-    """The fault/recovery story, oldest first, with relative times."""
+    """The fault/recovery story, oldest first, with relative times —
+    one chronological timeline; multi-process streams (rank-merged by
+    the caller) get a per-record proc column so every line is
+    attributed."""
     interesting = [e for e in events if e.get("kind") not in
-                   ("output", "checkpoint")]
+                   ("output", "checkpoint", "numerics")]
     if not interesting:
         return
+    procs = {e.get("proc") for e in events if e.get("proc") is not None}
+    multi = len(procs) > 1
     t0 = interesting[0].get("ts") or 0
     print("== timeline ==")
     for e in interesting:
@@ -184,8 +347,17 @@ def report_timeline(events, top: int) -> None:
             extra += f" error={attrs['error']}"
         if attrs.get("cache"):
             extra += f" cache={attrs['cache']}"
+        if attrs.get("tripped"):
+            extra += " " + ",".join(
+                f"{k}={v:+.3f}" for k, v in attrs["tripped"].items()
+            )
+        if e.get("kind") == "executable":
+            extra += (f" {attrs.get('name')} "
+                      f"compile={_fmt_s(attrs.get('compile_s'))}"
+                      f" cache={attrs.get('cache', '-')}")
         step = e.get("step")
-        print(f"  +{(e.get('ts') or t0) - t0:8.3f}s  "
+        proc_col = f"p{e.get('proc', '?')} " if multi else ""
+        print(f"  +{(e.get('ts') or t0) - t0:8.3f}s  {proc_col}"
               f"{e.get('kind', '?'):<20} "
               f"{'step ' + str(step) if step is not None else '':<10}"
               f"{extra}")
@@ -211,16 +383,24 @@ def main() -> int:
     )
     ap.add_argument("--stats", help="GS_TPU_STATS summary JSON")
     ap.add_argument("--trace", help="GS_TRACE Chrome trace JSON")
-    ap.add_argument("--events", help="GS_EVENTS unified stream JSONL")
+    ap.add_argument("--events",
+                    help="GS_EVENTS unified stream JSONL (multi-"
+                    "process .rank<N> siblings are merged in "
+                    "automatically)")
+    ap.add_argument("--metrics",
+                    help="GS_METRICS interval JSONL (.rank<N> "
+                    "siblings merged, summarized per proc)")
     ap.add_argument("--check", action="store_true",
                     help="validate schemas only; no report")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest rounds to list (default 5)")
     args = ap.parse_args()
-    if not (args.stats or args.trace or args.events):
-        ap.error("need at least one of --stats / --trace / --events")
+    if not (args.stats or args.trace or args.events or args.metrics):
+        ap.error("need at least one of --stats / --trace / --events "
+                 "/ --metrics")
     if args.check:
-        return check(args.trace, args.events, args.stats)
+        return check(args.trace, args.events, args.stats,
+                     args.metrics)
 
     stats = None
     if args.stats:
@@ -235,9 +415,11 @@ def main() -> int:
             print(f"gs_report: warning — trace has "
                   f"{len(problems)} schema problem(s)", file=sys.stderr)
         report_slow_rounds(doc, args.top)
+    if args.metrics:
+        report_metrics_files(args.metrics)
     events = []
     if args.events:
-        events = parse_events(args.events)
+        events = parse_events_multi(args.events)
     elif stats and stats.get("faults"):
         events = stats["faults"]
     if events:

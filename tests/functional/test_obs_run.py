@@ -114,6 +114,12 @@ def test_supervised_chaos_run_single_merged_timeline(tmp_path):
         "GS_WATCHDOG": "on",
         "GS_WATCHDOG_STEP_ROUND_S": "3",
         "GS_HANG_BOUND_S": "40",
+        # The device-side flight recorder rides the same chaos run:
+        # per-boundary numerics + drift on the stream, per-compile
+        # executable analytics, residual gauge — all of it must
+        # survive two supervised restarts as ONE merged story.
+        "GS_NUMERICS": "boundary",
+        "GS_XSTATS": "1",
     }
     res = run_cli(d, cfg, extra_env=env)
     assert res.returncode == 0, res.stderr + res.stdout
@@ -151,17 +157,45 @@ def test_supervised_chaos_run_single_merged_timeline(tmp_path):
         assert set(e) == {"ts", "proc", "kind", "phase", "step",
                           "attrs"}
 
+    # -- flight recorder: numerics records at every write boundary,
+    #    executable analytics per compile — on the SAME stream
+    num_events = [e for e in events if e["kind"] == "numerics"]
+    assert num_events, kinds
+    assert all(set(e["attrs"]["fields"]) == {"u", "v"}
+               for e in num_events)
+    exe_events = [e for e in events if e["kind"] == "executable"]
+    assert exe_events and all(
+        "compile_s" in e["attrs"] for e in exe_events
+    )
+
     # -- stats: metrics + obs provenance merged, attempt-tagged
     stats = json.loads((d / "stats.json").read_text())
     assert stats["config"]["attempt"] == 2
     assert stats["watchdog"]["attempt"] == 2
     names = {m["name"] for m in stats["metrics"]["counters"]}
-    assert {"steps", "restarts", "io_steps_written"} <= names
+    assert {"steps", "restarts", "io_steps_written",
+            "compiles"} <= names
     hist = next(h for h in stats["metrics"]["histograms"]
                 if h["name"] == "step_latency_us")
     assert hist["count"] > 0 and hist["p50"] is not None
     assert stats["obs"]["trace"]["enabled"] is True
     assert any(e["event"] == "attempt_phases" for e in stats["faults"])
+
+    # -- stats: numerics section (per-boundary stats + drift) and the
+    #    executables section (cost/memory per compile + the
+    #    model-vs-measured residual the gauge showed live)
+    num = stats["numerics"]
+    assert num["mode"] == "boundary" and num["probes"] > 0
+    assert set(num["last"]["fields"]) == {"u", "v"}
+    assert num["max_drift"]  # a chaos run's fields move
+    ex = stats["executables"]
+    assert ex["compiles"] >= 1 and ex["records"]
+    rec = ex["records"][0]
+    assert rec["compile_s"] > 0 and rec["cost"]["flops"] > 0
+    assert ex["model_vs_measured_residual_us"] is not None
+    gauges = {g["name"] for g in stats["metrics"]["gauges"]}
+    assert {"model_vs_measured_residual_us", "numerics_mean",
+            "numerics_drift"} <= gauges
 
     # -- gs_report --check agrees (the CI entry point)
     proc = subprocess.run(
@@ -191,6 +225,162 @@ def test_autotune_decision_reaches_event_stream(tmp_path):
     assert tune["phase"] == "compile"
     assert tune["attrs"]["mode"] == "cached"
     assert tune["attrs"]["cache"] == "miss"
+
+
+@pytest.mark.parametrize("model",
+                         ["grayscott", "brusselator", "fhn", "heat"])
+def test_flight_recorder_transparency_all_models(tmp_path, model):
+    """The flight-recorder transparency contract, every registered
+    model: GS_NUMERICS=every_round (the most intrusive mode — a
+    probe-only jit after every round) plus GS_XSTATS (runners routed
+    through the instrumented AOT compile) write stores bitwise
+    identical to an unobserved run."""
+
+    def model_cfg(d):
+        lines = [
+            "L = 16", "steps = 12", "plotgap = 4", "noise = 0.1",
+            'output = "gs.bp"', "checkpoint = true",
+            "checkpoint_freq = 6", 'checkpoint_output = "ckpt.bp"',
+            'precision = "Float32"', 'backend = "CPU"',
+            'kernel_language = "Plain"',
+            "dt = 1.0" if model == "grayscott" else "dt = 0.05",
+            "[model]", f'name = "{model}"',
+        ]
+        p = d / "config.toml"
+        p.write_text("\n".join(lines) + "\n")
+        return p
+
+    off = tmp_path / "off"
+    off.mkdir()
+    res = run_cli(off, model_cfg(off))
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    on = tmp_path / "on"
+    on.mkdir()
+    env = {
+        "GS_NUMERICS": "every_round",
+        "GS_XSTATS": "1",
+        "GS_EVENTS": str(on / "events.jsonl"),
+        "GS_METRICS": str(on / "metrics.jsonl"),
+        "GS_TPU_STATS": str(on / "stats.json"),
+    }
+    res = run_cli(on, model_cfg(on), extra_env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(off / store, on / store)
+
+    # the probes really ran, with the model's own field names
+    from grayscott_jl_tpu import models
+
+    stats = json.loads((on / "stats.json").read_text())
+    fields = set(models.get_model(model).field_names)
+    assert set(stats["numerics"]["last"]["fields"]) == fields
+    assert stats["numerics"]["probes"] >= 3  # every round
+    assert stats["executables"]["compiles"] >= 1
+
+
+#: Worker for the 2-process rank-merge test: bring up jax.distributed
+#: over a localhost coordinator (the REAL 2-process path the
+#: KV-rendezvous consensus test uses — no XLA collectives needed) and
+#: write events + metrics through the process-wide singletons, whose
+#: paths rank-suffix because process_count() == 2.
+_RANK_WORKER = """\
+import os, time
+import jax
+
+jax.distributed.initialize(
+    coordinator_address=os.environ["GS_TPU_COORDINATOR"],
+    num_processes=int(os.environ["GS_TPU_NUM_PROCESSES"]),
+    process_id=int(os.environ["GS_TPU_PROCESS_ID"]),
+)
+from grayscott_jl_tpu.obs.events import get_events
+from grayscott_jl_tpu.obs.metrics import get_metrics
+
+pid = jax.process_index()
+es = get_events()
+es.emit("run_start", step=0, attempt=0)
+time.sleep(0.05 * (pid + 1))  # deterministic cross-rank time order
+es.emit("output", phase="io", step=10)
+m = get_metrics()
+m.counter("steps").inc(10 + pid)
+m.histogram("step_latency_us").observe(100.0 + pid)
+m.maybe_flush(force=True)
+print("OBSOK", es.path)
+"""
+
+
+def test_two_process_rank_merged_report(tmp_path):
+    """Multi-rank stream merging end to end: a real 2-process run
+    (jax.distributed over a localhost coordinator) writes
+    ``.rank<N>``-suffixed GS_EVENTS/GS_METRICS files; gs_report.py
+    --check validates them and the rendered report is ONE ordered,
+    per-proc-attributed timeline."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    events_path = tmp_path / "events.jsonl"
+    metrics_path = tmp_path / "metrics.jsonl"
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": str(REPO) + os.pathsep
+            + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "GS_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "GS_TPU_NUM_PROCESSES": "2",
+            "GS_TPU_PROCESS_ID": str(pid),
+            "GS_EVENTS": str(events_path),
+            "GS_METRICS": str(metrics_path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _RANK_WORKER], cwd=tmp_path,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, out + err
+        assert "OBSOK" in out
+
+    # the singletons rank-suffixed their paths; nothing wrote the bare one
+    assert not events_path.exists()
+    for rank in (0, 1):
+        assert (tmp_path / f"events.jsonl.rank{rank}").exists()
+        assert (tmp_path / f"metrics.jsonl.rank{rank}").exists()
+
+    # reader-side join: one time-ordered, per-proc-attributed list
+    from grayscott_jl_tpu.obs.events import parse_events_multi
+
+    merged = parse_events_multi(str(events_path))
+    assert sorted(e["proc"] for e in merged) == [0, 0, 1, 1]
+    assert [e["ts"] for e in merged] == sorted(
+        e["ts"] for e in merged
+    )
+
+    # --check accepts the rank families; the report renders one
+    # timeline with a proc column and a per-proc metrics summary
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--check", "--events", str(events_path),
+         "--metrics", str(metrics_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--events", str(events_path),
+         "--metrics", str(metrics_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "p0 " in proc.stdout and "p1 " in proc.stdout
+    assert "proc 0" in proc.stdout and "proc 1" in proc.stdout
 
 
 @pytest.mark.slow

@@ -20,6 +20,8 @@ from grayscott_jl_tpu.obs.events import (
     NULL_EVENTS,
     EventStream,
     parse_events,
+    parse_events_multi,
+    rank_files,
 )
 from grayscott_jl_tpu.obs.metrics import (
     NULL_METRIC,
@@ -344,6 +346,45 @@ def test_event_stream_breaks_quietly_on_io_error(tmp_path, capsys):
 def test_null_event_stream_is_inert():
     assert NULL_EVENTS.enabled is False
     assert NULL_EVENTS.emit("anything", step=1, x=2) is None
+
+
+def test_rank_files_discovery(tmp_path):
+    base = tmp_path / "events.jsonl"
+    assert rank_files(str(base)) == []
+    (tmp_path / "events.jsonl.rank1").write_text("")
+    (tmp_path / "events.jsonl.rank0").write_text("")
+    (tmp_path / "events.jsonl.rank10").write_text("")
+    (tmp_path / "events.jsonl.rankX").write_text("")  # not a rank file
+    assert rank_files(str(base)) == [
+        str(tmp_path / "events.jsonl.rank0"),
+        str(tmp_path / "events.jsonl.rank1"),
+        str(tmp_path / "events.jsonl.rank10"),
+    ]
+    base.write_text("")  # bare file (single-process) leads the list
+    assert rank_files(str(base))[0] == str(base)
+
+
+def test_parse_events_multi_merges_ranks_time_ordered(tmp_path):
+    """The multi-rank join: two processes' .rank<N> streams read back
+    as ONE chronological, per-proc-attributed list."""
+    base = tmp_path / "events.jsonl"
+    r0 = EventStream(str(base) + ".rank0", proc=0)
+    r1 = EventStream(str(base) + ".rank1", proc=1)
+    # interleave writes so per-file order != global time order
+    e0 = r0.emit("run_start", step=0)
+    e2 = r1.emit("run_start", step=0)
+    e3 = r1.emit("output", phase="io", step=10)
+    e1 = r0.emit("output", phase="io", step=10)
+    # force a deterministic time order for the assertion
+    for i, e in enumerate((e0, e2, e3, e1)):
+        e["ts"] = 1000.0 + i
+    for path, evs in ((r0.path, (e0, e1)), (r1.path, (e2, e3))):
+        with open(path, "w", encoding="utf-8") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+    merged = parse_events_multi(str(base))
+    assert [e["ts"] for e in merged] == [1000.0, 1001.0, 1002.0, 1003.0]
+    assert [e["proc"] for e in merged] == [0, 1, 1, 0]
 
 
 # -------------------------------------------------------- profile window

@@ -1,0 +1,156 @@
+"""Unit: the perf-regression sentinel (``benchmarks/regression_gate.py``).
+
+The two acceptance behaviors, fast and deterministic: the gate exits 0
+over the committed ``benchmarks/results/`` history (both per-artifact
+self mode and a synthetic fresh row against a healthy population), and
+a synthetic 2x slowdown flips the exit code with the culprit metric
+named. Plus the noise model itself: MAD-scaled thresholds widen with
+history spread, the relative floor keeps a noiseless history from
+flagging jitter, and config keys never cross-contaminate.
+"""
+
+import glob
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+BENCH = pathlib.Path(__file__).resolve().parents[2] / "benchmarks"
+RESULTS = BENCH / "results"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "regression_gate", BENCH / "regression_gate.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+rg = _load()
+
+BASE = {"ab": "autotune", "platform": "cpu", "model": "grayscott",
+        "kernel": "xla", "L": 32, "devices": 8, "mesh": [2, 2, 2],
+        "fuse": 2}
+
+
+def _rows(values, **extra):
+    return [{**BASE, **extra, "median_us_per_step": v} for v in values]
+
+
+def _write(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+# ------------------------------------------------------------ mechanics
+
+
+def test_config_key_separates_and_matches():
+    a = rg.config_key({**BASE})
+    assert rg.config_key({**BASE}) == a
+    assert rg.config_key({**BASE, "fuse": 3}) != a
+    assert rg.config_key({**BASE, "model": "heat"}) != a
+    # list fields hash (mesh)
+    assert rg.config_key({**BASE, "mesh": [1, 2, 2]}) != a
+
+
+def test_pick_metric_preference_and_absence():
+    assert rg.pick_metric({"median_us_per_step": 10.0,
+                           "us_per_step": 5.0}) == \
+        ("median_us_per_step", 10.0)
+    assert rg.pick_metric({"us_per_step": 5.0}) == ("us_per_step", 5.0)
+    assert rg.pick_metric({"speedup_vs_k1": 1.3}) is None
+    assert rg.pick_metric({"median_us_per_step": None}) is None
+
+
+def test_threshold_mad_scaling_and_floor():
+    # noisy history -> wide gate (MAD term dominates)
+    limit, med, spread = rg.threshold(
+        [100, 140, 80, 120, 60], nsigma=4.0, rel_floor=0.25
+    )
+    assert med == 100 and spread == 20
+    assert limit == pytest.approx(100 + 4 * 1.4826 * 20)
+    # noiseless history -> the relative floor keeps slack
+    limit, med, spread = rg.threshold(
+        [100, 100, 100], nsigma=4.0, rel_floor=0.25
+    )
+    assert spread == 0 and limit == pytest.approx(125.0)
+
+
+def test_gate_pass_regress_and_skip():
+    history = _rows([100, 104, 98, 101, 99])
+    fresh = _rows([110])
+    res = rg.gate(fresh, history)
+    assert res["passed"] and not res["regressions"]
+    res = rg.gate(fresh, history, inject_slowdown=2.0)
+    (r,) = res["regressions"]
+    assert r["metric"] == "median_us_per_step"
+    assert r["fresh"] == 220.0 and r["history_n"] == 5
+    # a different config key has no history -> skipped, never failed
+    res = rg.gate(_rows([500], fuse=7), history)
+    assert res["skipped"] and not res["regressions"]
+    # tiny population -> skipped
+    res = rg.gate(fresh, history[:2])
+    assert res["skipped"][0]["reason"].startswith("history has 2")
+
+
+def test_improvement_never_flags():
+    res = rg.gate(_rows([50]), _rows([100, 101, 99]))
+    assert res["passed"] and not res["regressions"]
+
+
+# ------------------------------------------------------------- CLI path
+
+
+def test_cli_pass_then_injected_slowdown_flags(tmp_path, capsys):
+    hist = _write(tmp_path / "hist.jsonl", _rows([100, 102, 98, 101]))
+    fresh = _write(tmp_path / "fresh.jsonl", _rows([103]))
+    assert rg.main(["--fresh", fresh, "--history", hist]) == 0
+    assert rg.main(["--fresh", fresh, "--history", hist,
+                    "--inject-slowdown", "2"]) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err and "median_us_per_step" in err
+
+
+def test_cli_self_mode_excludes_judged_row(tmp_path):
+    # 4 identical rows: last is judged against the first three
+    art = _write(tmp_path / "art.jsonl", _rows([100, 100, 100, 100]))
+    assert rg.main(["--fresh", art, "--history", "--self"]) == 0
+    assert rg.main(["--fresh", art, "--history", "--self",
+                    "--inject-slowdown", "2"]) == 1
+
+
+def test_cli_missing_fresh_is_usage_error(tmp_path):
+    assert rg.main(["--fresh", str(tmp_path / "nope.jsonl")]) == 2
+
+
+# ------------------------------------------------- committed history
+
+
+def test_committed_history_passes_in_self_mode():
+    """The acceptance criterion: the sentinel exits 0 over every
+    committed benchmarks/results artifact."""
+    artifacts = sorted(glob.glob(str(RESULTS / "*.jsonl")))
+    assert artifacts, "no committed artifacts to gate"
+    for art in artifacts:
+        assert rg.main(["--fresh", art, "--self"]) == 0, art
+
+
+def test_committed_history_flags_synthetic_slowdown(tmp_path):
+    """A fresh row matching a committed config but 2x slower must
+    flag once enough committed history exists; with the sparse
+    single-row-per-key history of today the gate SKIPS (never
+    silently passes a judged key) — asserted both ways so this test
+    tracks the history as it accumulates."""
+    committed = []
+    for art in sorted(glob.glob(str(RESULTS / "*.jsonl"))):
+        committed.extend(rg.load_history([art]))
+    rows = [r for r in committed if rg.pick_metric(r)]
+    assert rows
+    fresh = _write(tmp_path / "fresh.jsonl", [dict(rows[0])])
+    rc = rg.main(["--fresh", fresh, "--history", str(RESULTS),
+                  "--inject-slowdown", "2", "--min-history", "1"])
+    assert rc == 1  # with the population floor at 1, 2x must flag
