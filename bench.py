@@ -56,23 +56,41 @@ import subprocess
 import sys
 import time
 
-L = int(os.environ.get("GS_BENCH_L", "256"))
-STEPS_PER_ROUND = int(os.environ.get("GS_BENCH_STEPS", "100"))
-ROUNDS = int(os.environ.get("GS_BENCH_ROUNDS", "16"))
+
+# Local knob resolvers (the env-knobs gslint contract: every GS_* read
+# goes through a resolver helper). bench.py deliberately avoids
+# importing the package at module scope — the TPU probe must happen in
+# a subprocess before this process ever touches JAX — so it carries
+# its own three-liners instead of config/env.py's accessors.
+def _resolve_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _resolve_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def _resolve_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
+L = _resolve_int("GS_BENCH_L", 256)
+STEPS_PER_ROUND = _resolve_int("GS_BENCH_STEPS", 100)
+ROUNDS = _resolve_int("GS_BENCH_ROUNDS", 16)
 # The tunnel chip's clock/HBM state wanders on a minutes timescale
 # (BASELINE.md; the r3 envelope probe measured HBM streaming varying ~3x
 # between states, uncorrelated with load). Spacing the timing rounds out
 # samples more clock states, which is what decides the best-of-N — ~16
 # rounds x ~8s spacing spreads the sample over ~2 minutes for ~no extra
 # compute cost.
-ROUND_SLEEP = float(os.environ.get("GS_BENCH_ROUND_SLEEP", "8"))
-KERNEL = os.environ.get("GS_BENCH_KERNEL", "Pallas")
+ROUND_SLEEP = _resolve_float("GS_BENCH_ROUND_SLEEP", 8.0)
+KERNEL = _resolve_str("GS_BENCH_KERNEL", "Pallas")
 # Which registered model to measure (--model flag wins over the env):
 # per-model perf baselines accumulate in the artifacts, keyed by the
 # "model" field every result row now carries. Non-Gray-Scott models run
 # the XLA kernel (the Pallas kernel is Gray-Scott-gated).
-MODEL = os.environ.get("GS_BENCH_MODEL", "grayscott")
-PROBE_TIMEOUT = float(os.environ.get("GS_BENCH_PROBE_TIMEOUT", "75"))
+MODEL = _resolve_str("GS_BENCH_MODEL", "grayscott")
+PROBE_TIMEOUT = _resolve_float("GS_BENCH_PROBE_TIMEOUT", 75.0)
 # A SIGKILLed tunnel client wedges the chip grant server-side for
 # HOURS (measured r3, BASELINE.md). Round-4 wedge strategy: two quick
 # front-loaded probes decide the fast path; on failure the CPU
@@ -82,17 +100,17 @@ PROBE_TIMEOUT = float(os.environ.get("GS_BENCH_PROBE_TIMEOUT", "75"))
 # recovery still converts into a hardware headline instead of a lost
 # round (the r3 failure mode: all probes spent in the first 9 minutes
 # of a multi-hour wedge).
-PROBE_RETRIES = int(os.environ.get("GS_BENCH_PROBE_RETRIES", "2"))
-PROBE_DELAY = float(os.environ.get("GS_BENCH_PROBE_DELAY", "45"))
-TPU_HORIZON = float(os.environ.get("GS_BENCH_TPU_HORIZON", "1080"))
-REPROBE_DELAY = float(os.environ.get("GS_BENCH_REPROBE_DELAY", "120"))
+PROBE_RETRIES = _resolve_int("GS_BENCH_PROBE_RETRIES", 2)
+PROBE_DELAY = _resolve_float("GS_BENCH_PROBE_DELAY", 45.0)
+TPU_HORIZON = _resolve_float("GS_BENCH_TPU_HORIZON", 1080.0)
+REPROBE_DELAY = _resolve_float("GS_BENCH_REPROBE_DELAY", 120.0)
 # Wall cap on the late-probe loop itself (sleeps + probe dials), inside
 # the horizon: r05 spent >19 minutes re-dialing an absent TPU (5 probes
 # x ~195 s each against a wedged tunnel) for nothing — the horizon
 # bounds when probing may END, this bounds how much it may COST.
-PROBE_BUDGET = float(os.environ.get("GS_BENCH_PROBE_BUDGET", "360"))
-RUN_TIMEOUT = float(os.environ.get("GS_BENCH_RUN_TIMEOUT", "900"))
-SUSTAIN_SECONDS = float(os.environ.get("GS_BENCH_SUSTAIN_SECONDS", "10"))
+PROBE_BUDGET = _resolve_float("GS_BENCH_PROBE_BUDGET", 360.0)
+RUN_TIMEOUT = _resolve_float("GS_BENCH_RUN_TIMEOUT", 900.0)
+SUSTAIN_SECONDS = _resolve_float("GS_BENCH_SUSTAIN_SECONDS", 10.0)
 BASELINE_CELL_UPDATES = 5.6e10  # upper anchor, see module docstring
 REF_KERNEL_MODEL = 7.0e9  # lower anchor: the reference kernel as written
 
@@ -491,7 +509,7 @@ def main() -> None:
         from grayscott_jl_tpu.resilience.supervisor import FaultJournal
         from grayscott_jl_tpu.resilience.watchdog import Watchdog
 
-        journal = FaultJournal(os.environ.get("GS_FAULT_JOURNAL"))
+        journal = FaultJournal(_resolve_str("GS_FAULT_JOURNAL", "") or None)
         wd = Watchdog(
             {"probe_loop": PROBE_BUDGET + PROBE_TIMEOUT},
             journal=journal, grace_s=0,

@@ -212,12 +212,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ``GS_TPU_NUM_PROCESSES`` copies, each with its own
     ``GS_TPU_PROCESS_ID``; each worker reads its x-share via selection
     and writes its block into ONE shared multi-writer output store."""
-    import os
     import sys
 
+    from ..config.env import env_int
+
     ns = parse_arguments(sys.argv[1:] if argv is None else argv)
-    rank = int(os.environ.get("GS_TPU_PROCESS_ID", "0"))
-    size = int(os.environ.get("GS_TPU_NUM_PROCESSES", "1"))
+    rank = env_int("GS_TPU_PROCESS_ID", 0)
+    size = env_int("GS_TPU_NUM_PROCESSES", 1)
     if not 0 <= rank < size:
         raise SystemExit(
             f"pdfcalc: GS_TPU_PROCESS_ID={rank} out of range for "

@@ -55,6 +55,7 @@ import os
 import time
 from typing import List, Optional
 
+from .config.env import env_int, env_raw, env_str
 from .config.settings import Settings, get_settings
 from .simulation import Simulation, finalize
 from .utils.log import Logger
@@ -80,14 +81,14 @@ def maybe_initialize_distributed() -> None:
 
     import jax
 
-    coord = os.environ.get("GS_TPU_COORDINATOR")
+    coord = env_raw("GS_TPU_COORDINATOR")
     if coord:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ["GS_TPU_NUM_PROCESSES"]),
-            process_id=int(os.environ["GS_TPU_PROCESS_ID"]),
+            num_processes=env_int("GS_TPU_NUM_PROCESSES"),
+            process_id=env_int("GS_TPU_PROCESS_ID"),
         )
-    elif os.environ.get("GS_TPU_DISTRIBUTED") == "auto":
+    elif env_raw("GS_TPU_DISTRIBUTED") == "auto":
         jax.distributed.initialize()
 
 
@@ -103,10 +104,8 @@ def main(args: List[str], *, n_devices: Optional[int] = None, seed: int = 0):
     call — e.g. to launch the solo-run equivalent of ensemble member k
     (seed ``base + k``; docs/ENSEMBLE.md).
     """
-    import os
-
     settings = get_settings(list(args))
-    env_seed = os.environ.get("GS_SEED", "").strip()
+    env_seed = env_str("GS_SEED", "").strip()
     if env_seed:
         seed = int(env_seed)
 
@@ -764,7 +763,7 @@ def _run_once_inner(
         )
         _refresh_device_gauges()
         metrics.maybe_flush(force=True)
-        prom = os.environ.get("GS_METRICS_PROM")
+        prom = env_raw("GS_METRICS_PROM")
         if prom:
             metrics.write_prometheus(prom)
         if metrics.enabled:

@@ -389,10 +389,10 @@ class EnsembleSimulation(Simulation):
         ``GS_FAULT_MEMBER``, else member 0) — the per-member health
         attribution scenario: the guard must name this member, and the
         other members' trajectories must stay untouched."""
-        import os
+        from ..config.env import env_int
 
         if member is None:
-            member = int(os.environ.get("GS_FAULT_MEMBER", "0"))
+            member = env_int("GS_FAULT_MEMBER", 0)
         member %= self.n_members
         i = self._field_index(field)
         arr = self.fields[i]

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import os
 
+from ..config.env import env_str
+
 
 def _real_bp_evidence(path: str) -> bool:
     """Is ``path`` a real ADIOS2 BP store (vs BP-lite, possibly
@@ -131,7 +133,7 @@ def count_steps_upto(path: str, sim_step: int):
 
 def _bplite_writer(path, *, writer_id, nwriters, append, keep_steps):
     """The BP-lite engine chain (native C++ if built, else Python)."""
-    if os.environ.get("GS_TPU_NATIVE_IO", "1") != "0":
+    if env_str("GS_TPU_NATIVE_IO", "1") != "0":
         from . import native
 
         if native.available():
@@ -179,7 +181,7 @@ def open_writer(
         sidecar.remove_sidecar(path)
     if (
         prefer_adios2
-        and os.environ.get("GS_TPU_ADIOS2", "1") != "0"
+        and env_str("GS_TPU_ADIOS2", "1") != "0"
         and nwriters == 1
     ):
         from . import adios
@@ -272,7 +274,7 @@ def open_writer(
                     "single-writer (this is a multi-process run); "
                     "multi-writer append is a BP-lite feature"
                 )
-            elif os.environ.get("GS_TPU_ADIOS2", "1") == "0":
+            elif env_str("GS_TPU_ADIOS2", "1") == "0":
                 why = (
                     "a real ADIOS2 BP store but GS_TPU_ADIOS2=0 disables "
                     "the adios2 engine; unset it to append to this store"

@@ -1,0 +1,159 @@
+"""Pass ``donation`` — recompile and donated-buffer hazards.
+
+Best-effort *warnings* (the other passes are contracts; these are the
+two jit footguns that cost silent performance or correctness):
+
+* **jit-in-loop** — constructing ``jax.jit`` (or ``shard_map``)
+  inside a ``for``/``while`` body builds a fresh traced callable per
+  iteration: at best a cache lookup per step, at worst a recompile.
+  Runner construction belongs outside the loop (cached, like
+  ``Simulation._runner``).
+* **use-after-donate** — a call through a callable built with
+  ``donate_argnums`` invalidates the donated argument buffers; a
+  later read of the same Python name in the same function is a
+  use-after-free on device memory (XLA may have aliased the buffer
+  into the output).  Reassignment (the canonical
+  ``fields = runner(*fields)``) clears the hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from . import Finding
+from .context import LintContext, SourceFile
+from .astutil import dotted, iter_functions
+
+PASS_ID = "donation"
+
+
+def run(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.package_files():
+        findings.extend(_jit_in_loop(sf))
+        for qual, fnode, parents in iter_functions(sf.tree):
+            findings.extend(_use_after_donate(sf, qual, fnode))
+    return findings
+
+
+def _jit_in_loop(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, loop_depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            d = loop_depth
+            if isinstance(child, (ast.For, ast.While)):
+                d += 1
+            elif isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                # A def inside a loop runs at call time, not per
+                # iteration of this loop.
+                d = 0
+            if isinstance(child, ast.Call) and d > 0:
+                name = dotted(child.func)
+                tail = name.split(".")[-1] if name else None
+                if tail in ("jit", "shard_map"):
+                    findings.append(Finding(
+                        PASS_ID, sf.rel, child.lineno,
+                        f"{name} constructed inside a loop — every "
+                        f"iteration rebuilds the traced callable",
+                        hint="hoist construction out of the loop and "
+                             "cache the compiled callable",
+                        severity="warning",
+                    ))
+            walk(child, d)
+
+    walk(sf.tree, 0)
+    return findings
+
+
+def _donating_locals(fnode: ast.AST) -> Dict[str, Sequence[int]]:
+    """Local names bound to ``jax.jit(..., donate_argnums=...)``."""
+    out: Dict[str, Sequence[int]] = {}
+    for node in ast.walk(fnode):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        name = dotted(call.func)
+        if not name or name.split(".")[-1] != "jit":
+            continue
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            positions: List[int] = []
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(
+                v.value, int
+            ):
+                positions = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        positions.append(e.value)
+            if positions:
+                out[node.targets[0].id] = positions
+    return out
+
+
+def _use_after_donate(
+    sf: SourceFile, qual: str, fnode: ast.AST
+) -> List[Finding]:
+    donors = _donating_locals(fnode)
+    if not donors:
+        return []
+    findings: List[Finding] = []
+    # Donation call sites: donated positional args that are bare
+    # names.
+    donated: List[Tuple[int, str]] = []  # (call line, var name)
+    stores: Dict[str, List[int]] = {}
+    loads: Dict[str, List[int]] = {}
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Name):
+            target = (
+                stores if isinstance(node.ctx, ast.Store) else loads
+            )
+            target.setdefault(node.id, []).append(node.lineno)
+        if not isinstance(node, ast.Call):
+            continue
+        cname = dotted(node.func)
+        if not cname or cname not in donors:
+            continue
+        for pos in donors[cname]:
+            if pos < len(node.args) and isinstance(
+                node.args[pos], ast.Name
+            ):
+                donated.append(
+                    (node.lineno, node.args[pos].id)
+                )
+    for call_line, var in donated:
+        # The donated name is dead until reassigned; any load after
+        # the donating call and before the next store is a hazard.
+        # The canonical rebind stores on the donating call's own line
+        # (``u = runner(u, v)``), so the clearing store scan is >=.
+        next_store = min(
+            (ln for ln in stores.get(var, ()) if ln >= call_line),
+            default=None,
+        )
+        for ln in loads.get(var, ()):
+            if ln <= call_line:
+                continue
+            if next_store is not None and ln >= next_store:
+                continue
+            findings.append(Finding(
+                PASS_ID, sf.rel, ln,
+                f"{var!r} was donated to a jit call at line "
+                f"{call_line} in {qual!r} and read again here — its "
+                f"device buffer may already be aliased",
+                hint="rebind the result (x = runner(x)) or drop "
+                     "donate_argnums for this argument",
+                severity="warning",
+            ))
+    return findings

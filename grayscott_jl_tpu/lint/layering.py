@@ -1,0 +1,217 @@
+"""Pass ``layering`` — the import-graph contracts of the package.
+
+Three checks, all structural replacements for what used to be grep:
+
+* **model isolation** — ``ops/`` and ``parallel/`` are model-generic
+  execution machinery: they must not import concrete ``models/*``
+  modules (``models.base``, the declaration protocol, is allowed).
+  One sanctioned exception, mirrored from the models-as-data test:
+  ``ops/pallas_stencil.py`` may import ``models.grayscott`` — it IS
+  the Gray-Scott model's hand-fused form — but never redefine it.
+* **JAX-free at import** — the modules the docs promise are importable
+  without JAX (``obs/*``, ``models/*``, ``config/*``, ``lint/*``,
+  ``reshard/plan``, ``parallel/domain``) must keep every import-time
+  import either non-JAX third-party/stdlib or inside the JAX-free set
+  itself (so the property holds transitively).  ``TYPE_CHECKING``
+  blocks and function-local imports are exempt — that is exactly how
+  a lazy JAX dependency is spelled.
+* **model-literal scan** — the original grep assertion, kept verbatim
+  as a pass check: no model seeding constants or boundary-value
+  definitions in shared code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Tuple
+
+from . import Finding
+from .context import LintContext, SourceFile
+from .astutil import resolve_imports
+
+PASS_ID = "layering"
+
+#: Layered subpackages that must stay model-generic.
+SHARED_SUBPACKAGES = ("grayscott_jl_tpu.ops", "grayscott_jl_tpu.parallel")
+
+#: (importing module, imported module) pairs sanctioned by the
+#: models-as-data contract (see ``tests/unit/test_models.py``).
+SANCTIONED_MODEL_IMPORTS = {
+    ("grayscott_jl_tpu.ops.pallas_stencil",
+     "grayscott_jl_tpu.models.grayscott"),
+}
+
+#: Modules promised importable without JAX (docs/ANALYSIS.md).
+JAXFREE_PREFIXES = (
+    "grayscott_jl_tpu.obs",
+    "grayscott_jl_tpu.lint",
+    "grayscott_jl_tpu.models",
+    "grayscott_jl_tpu.config",
+)
+JAXFREE_EXACT = (
+    "grayscott_jl_tpu.reshard.plan",
+    "grayscott_jl_tpu.parallel.domain",
+)
+
+#: The literal-scan regexes (kept from the original grep test body).
+_BANNED_TOKENS = re.compile(
+    r"\bSEED_HALF_WIDTH\b|\bSEED_U\b|\bSEED_V\b|\bSEED_T\b"
+)
+_BOUNDARY_DEF = re.compile(r"^\s*[UVTW]_BOUNDARY\s*=")
+_UNQUALIFIED_BOUNDARY = re.compile(r"(?<![\w.])[UVT]_BOUNDARY\b")
+
+
+def _in_jaxfree_set(module: str) -> bool:
+    """True for modules in the JAX-free set — and for names *inside*
+    one (``reshard.plan.shard_boxes`` is a function import, vouched
+    for by its module)."""
+    if any(
+        module == e or module.startswith(e + ".")
+        for e in JAXFREE_EXACT
+    ):
+        return True
+    return any(
+        module == p or module.startswith(p + ".")
+        for p in JAXFREE_PREFIXES
+    )
+
+
+def _is_type_checking_if(node: ast.AST) -> bool:
+    if not isinstance(node, ast.If):
+        return False
+    t = node.test
+    return (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+        isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+    )
+
+
+def _import_time_imports(
+    sf: SourceFile,
+) -> List[Tuple[ast.AST, List[str]]]:
+    """Imports executed when the module is imported: everything except
+    function bodies and ``TYPE_CHECKING`` blocks."""
+    out: List[Tuple[ast.AST, List[str]]] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                continue
+            if _is_type_checking_if(child):
+                continue
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                out.append((child, resolve_imports(sf, child)))
+            else:
+                walk(child)
+
+    walk(sf.tree)
+    return out
+
+
+def _all_imports(sf: SourceFile) -> List[Tuple[ast.AST, List[str]]]:
+    out: List[Tuple[ast.AST, List[str]]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            out.append((node, resolve_imports(sf, node)))
+    return out
+
+
+def run(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.package_files():
+        if any(
+            sf.module.startswith(p + ".") for p in SHARED_SUBPACKAGES
+        ):
+            findings.extend(_check_model_isolation(sf))
+            findings.extend(_check_literals(sf))
+        if _in_jaxfree_set(sf.module):
+            findings.extend(_check_jaxfree(sf))
+    return findings
+
+
+def _check_model_isolation(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node, names in _all_imports(sf):
+        for name in names:
+            if not name.startswith("grayscott_jl_tpu.models."):
+                continue
+            if name == "grayscott_jl_tpu.models.base":
+                continue
+            if (sf.module, name) in SANCTIONED_MODEL_IMPORTS:
+                continue
+            findings.append(Finding(
+                PASS_ID, sf.rel, node.lineno,
+                f"shared code imports concrete model module "
+                f"{name!r} — ops/ and parallel/ must stay "
+                f"model-generic",
+                hint="consume the declaration passed in as the "
+                     "`model` argument instead of importing one",
+            ))
+    return findings
+
+
+def _check_jaxfree(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node, names in _import_time_imports(sf):
+        for name in names:
+            top = name.split(".", 1)[0]
+            if top in ("jax", "jaxlib"):
+                findings.append(Finding(
+                    PASS_ID, sf.rel, node.lineno,
+                    f"{sf.module} must be importable without JAX but "
+                    f"imports {name!r} at module scope",
+                    hint="move the import inside the function that "
+                         "needs it",
+                ))
+            elif top == "grayscott_jl_tpu" and not _in_jaxfree_set(
+                name
+            ):
+                # Importing a sibling that is itself allowed to pull
+                # JAX breaks the property transitively.
+                findings.append(Finding(
+                    PASS_ID, sf.rel, node.lineno,
+                    f"JAX-free module {sf.module} imports {name!r}, "
+                    f"which is outside the JAX-free set",
+                    hint="import it lazily, or add the target to the "
+                         "JAX-free set if it genuinely avoids JAX at "
+                         "import",
+                ))
+    return findings
+
+
+def _check_literals(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    in_parallel = sf.module.startswith("grayscott_jl_tpu.parallel.")
+    sanctioned = sf.module == "grayscott_jl_tpu.ops.pallas_stencil"
+    for i, line in enumerate(sf.lines, start=1):
+        if _BANNED_TOKENS.search(line):
+            findings.append(Finding(
+                PASS_ID, sf.rel, i,
+                "model seeding constants belong in models/",
+                hint="read them from the model declaration",
+            ))
+        if _BOUNDARY_DEF.search(line):
+            findings.append(Finding(
+                PASS_ID, sf.rel, i,
+                "boundary values are model declarations — shared "
+                "code must not define them",
+                hint="thread the model's boundary constants through "
+                     "the call instead",
+            ))
+        elif in_parallel and "BOUNDARY" in line:
+            findings.append(Finding(
+                PASS_ID, sf.rel, i,
+                "parallel/ must receive boundaries via the model "
+                "declaration, not name them",
+            ))
+        elif (not in_parallel and not sanctioned
+              and _UNQUALIFIED_BOUNDARY.search(line)):
+            findings.append(Finding(
+                PASS_ID, sf.rel, i,
+                "boundary constants must come from the model "
+                "declaration (qualified reads only)",
+            ))
+    return findings

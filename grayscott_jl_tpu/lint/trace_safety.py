@@ -1,0 +1,312 @@
+"""Pass ``trace-safety`` — no host syncs inside compiled step bodies.
+
+The known jit roots (``simulation.py`` runners / snapshot and
+numerics probes, ``ensemble/engine.py``'s vmapped member bodies) are
+discovered structurally: every callable handed to ``jax.jit`` /
+``shard_map`` / ``jax.vmap`` / ``jax.pmap`` in the package, resolved
+through local assignments and ``functools.partial``.  From those
+roots the pass walks a call/reference closure over the package's
+functions and flags host-sync and host-effect hazards inside it:
+
+* ``.item()`` / ``.tolist()`` / ``jax.device_get`` /
+  ``.block_until_ready()`` — device->host syncs that stall or break
+  the trace;
+* ``np.asarray`` / ``np.array`` — silent host materialization of a
+  traced value;
+* ``print(...)`` — executes at trace time (misleading) or forces a
+  callback;
+* host clocks (``time.time`` etc.) — trace-time constants in
+  disguise;
+* ``float(x)`` / ``int(x)`` applied to a *parameter* of a traced
+  function — concretization that raises (or silently syncs) under
+  tracing.  ``float()`` on host-side Python scalars never fires: host
+  code is simply not reachable from a jit root.
+
+Reachability follows only plausible function links — bare names
+resolved in the referencing file (or through its in-package imports),
+``self.method`` within the same file, ``module_alias.fn`` for
+in-package module aliases, and the model-protocol tails
+``reaction``/``init``.  Generic attribute tails (``somedict.get``,
+``queue.put``) are not links; following them would drag the host side
+of the codebase into the traced set.  A deliberate trace-time
+exception takes a one-line ``# gslint: disable=trace-safety``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding
+from .context import LintContext, SourceFile
+from .astutil import dotted, iter_functions
+
+PASS_ID = "trace-safety"
+
+#: Callable-wrapping entry points whose argument becomes device code.
+_TRACE_WRAPPERS = {"jit", "vmap", "pmap", "shard_map"}
+
+_SYNC_TAILS = {"item", "tolist", "block_until_ready"}
+_HOST_MATERIALIZE = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+}
+_HOST_CLOCKS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.sleep",
+}
+
+#: Attribute tails always followed: the model protocol's callables
+#: are traced whenever the machinery that receives a ``model`` is.
+_PROTOCOL_TAILS = {"reaction", "init"}
+
+#: Host-only subpackages: never traced, and full of legitimate host
+#: constructs that would only feed name-collision noise.
+_HOST_ONLY_PREFIXES = (
+    "grayscott_jl_tpu.lint",
+    "grayscott_jl_tpu.analysis",
+)
+
+FuncEntry = Tuple[SourceFile, str, ast.AST]
+
+
+class _Index:
+    """Function definitions, resolvable per-file or package-wide."""
+
+    def __init__(self, ctx: LintContext):
+        self.by_file: Dict[str, Dict[str, List[FuncEntry]]] = {}
+        self.global_: Dict[str, List[FuncEntry]] = {}
+        self.aliases: Dict[str, Set[str]] = {}
+        for sf in _device_files(ctx):
+            per = self.by_file.setdefault(sf.rel, {})
+            for qual, fnode, parents in iter_functions(sf.tree):
+                e = (sf, qual, fnode)
+                per.setdefault(fnode.name, []).append(e)
+                self.global_.setdefault(fnode.name, []).append(e)
+            self.aliases[sf.rel] = _module_aliases(sf)
+
+    def resolve(
+        self, name: str, sf: SourceFile, scope: str
+    ) -> List[FuncEntry]:
+        """Targets a reference may denote.  ``scope`` is ``"file"``
+        (bare names, ``self.X``: same file, or an imported name) or
+        ``"global"`` (module-alias attributes, protocol tails)."""
+        if scope == "global":
+            return self.global_.get(name, [])
+        local = self.by_file.get(sf.rel, {}).get(name)
+        if local:
+            return local
+        if name in self.aliases.get(sf.rel, ()):
+            return self.global_.get(name, [])
+        return []
+
+
+def _device_files(ctx: LintContext) -> List[SourceFile]:
+    return [
+        sf for sf in ctx.package_files()
+        if not any(
+            sf.module == p or sf.module.startswith(p + ".")
+            for p in _HOST_ONLY_PREFIXES
+        )
+    ]
+
+
+def _module_aliases(sf: SourceFile) -> Set[str]:
+    """Names this file binds via in-package imports — module aliases
+    (``from .ops import pallas_stencil``) and imported functions
+    (``from .noise import plane_seed``) alike."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.level > 0 or (
+                node.module or ""
+            ).startswith("grayscott_jl_tpu"):
+                for alias in node.names:
+                    out.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("grayscott_jl_tpu"):
+                    out.add(
+                        alias.asname or alias.name.split(".")[0]
+                    )
+    return out
+
+
+def _references(
+    fnode: ast.AST, sf: SourceFile, index: _Index
+) -> List[FuncEntry]:
+    out: List[FuncEntry] = []
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Name):
+            out.extend(index.resolve(node.id, sf, "file"))
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _PROTOCOL_TAILS:
+                out.extend(index.resolve(node.attr, sf, "global"))
+            elif isinstance(node.value, ast.Name):
+                base = node.value.id
+                if base == "self":
+                    out.extend(
+                        index.resolve(node.attr, sf, "file")
+                    )
+                elif base in index.aliases.get(sf.rel, ()):
+                    out.extend(
+                        index.resolve(node.attr, sf, "global")
+                    )
+    return out
+
+
+def _callable_entries(
+    expr: ast.AST,
+    sf: SourceFile,
+    scope: Optional[ast.AST],
+    index: _Index,
+    lambdas: List[Tuple[SourceFile, ast.Lambda]],
+    depth: int = 0,
+) -> List[FuncEntry]:
+    """Function definitions an expression may denote (through partial
+    and one level of local assignment)."""
+    if depth > 4:
+        return []
+    if isinstance(expr, ast.Lambda):
+        lambdas.append((sf, expr))
+        return []
+    if isinstance(expr, ast.Call):
+        name = dotted(expr.func)
+        if name and name.split(".")[-1] == "partial" and expr.args:
+            return _callable_entries(
+                expr.args[0], sf, scope, index, lambdas, depth + 1
+            )
+        return []
+    if isinstance(expr, ast.Attribute):
+        return index.resolve(expr.attr, sf, "file")
+    if isinstance(expr, ast.Name):
+        direct = index.resolve(expr.id, sf, "file")
+        if direct:
+            return direct
+        if scope is not None:
+            out: List[FuncEntry] = []
+            for stmt in ast.walk(scope):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == expr.id):
+                    out.extend(_callable_entries(
+                        stmt.value, sf, scope, index, lambdas,
+                        depth + 1,
+                    ))
+            return out
+    return []
+
+
+def _roots(
+    ctx: LintContext, index: _Index
+) -> Tuple[List[FuncEntry], List[Tuple[SourceFile, ast.Lambda]]]:
+    roots: List[FuncEntry] = []
+    lambdas: List[Tuple[SourceFile, ast.Lambda]] = []
+    for sf in _device_files(ctx):
+        encl: Dict[int, ast.AST] = {}
+        for qual, fnode, parents in iter_functions(sf.tree):
+            for node in ast.walk(fnode):
+                encl.setdefault(id(node), fnode)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name:
+                continue
+            if name.split(".")[-1] not in _TRACE_WRAPPERS:
+                continue
+            if not node.args:
+                continue
+            roots.extend(_callable_entries(
+                node.args[0], sf, encl.get(id(node)), index, lambdas
+            ))
+    return roots, lambdas
+
+
+def run(ctx: LintContext) -> List[Finding]:
+    index = _Index(ctx)
+    roots, lambdas = _roots(ctx, index)
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    work = list(roots)
+    while work:
+        sf, qual, fnode = work.pop()
+        if id(fnode) in seen:
+            continue
+        seen.add(id(fnode))
+        findings.extend(_scan(sf, qual, fnode))
+        work.extend(_references(fnode, sf, index))
+    for sf, lam in lambdas:
+        if id(lam) not in seen:
+            seen.add(id(lam))
+            findings.extend(_scan(sf, "<lambda>", lam))
+    return findings
+
+
+def _params(fnode: ast.AST) -> Set[str]:
+    if isinstance(
+        fnode, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        a = fnode.args
+        names = {
+            p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+        }
+        names.discard("self")
+        names.discard("cls")
+        return names
+    return set()
+
+
+def _scan(
+    sf: SourceFile, qual: str, fnode: ast.AST
+) -> List[Finding]:
+    findings: List[Finding] = []
+    params = _params(fnode)
+    for node in ast.walk(fnode):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        tail = name.split(".")[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else None
+        )
+        msg = hint = None
+        if tail in _SYNC_TAILS and not node.args:
+            msg = (f".{tail}() inside jit-reachable {qual!r} forces "
+                   f"a device->host sync")
+            hint = ("return the value and resolve it host-side at "
+                    "the call boundary")
+        elif name and tail == "device_get" and name.startswith(
+            "jax"
+        ):
+            msg = (f"jax.device_get inside jit-reachable {qual!r} "
+                   f"is a host transfer")
+            hint = "move the transfer outside the traced body"
+        elif name in _HOST_MATERIALIZE:
+            msg = (f"{name} inside jit-reachable {qual!r} "
+                   f"materializes a traced value on host")
+            hint = "use jnp equivalents inside traced code"
+        elif name == "print":
+            msg = (f"print() inside jit-reachable {qual!r} runs at "
+                   f"trace time, not per step")
+            hint = ("use jax.debug.print for runtime values, or log "
+                    "at the call boundary")
+        elif name in _HOST_CLOCKS:
+            msg = (f"{name}() inside jit-reachable {qual!r} is a "
+                   f"trace-time constant (and a hidden host "
+                   f"dependency)")
+            hint = "time at the call boundary instead"
+        elif name in ("float", "int") and len(node.args) == 1 and (
+            isinstance(node.args[0], ast.Name)
+            and node.args[0].id in params
+        ):
+            msg = (f"{name}() on traced argument "
+                   f"{node.args[0].id!r} of {qual!r} concretizes "
+                   f"under jit")
+            hint = ("cast with .astype()/jnp, or hoist the scalar "
+                    "out of the traced signature")
+        if msg:
+            findings.append(Finding(
+                PASS_ID, sf.rel, node.lineno, msg, hint=hint or ""
+            ))
+    return findings

@@ -70,6 +70,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
+from ..config.env import env_raw, env_str
 # The Pallas kernel IS the Gray-Scott model's hand-fused form: its
 # reaction math and boundary constants come from the model declaration
 # (models/grayscott.py); other registered models take the XLA path
@@ -134,11 +135,15 @@ _WARNED: set = set()
 
 
 def _warn_once(msg: str) -> None:
+    # Deliberately fires at trace time: gate/fallback decisions are
+    # made while building the kernel call, and the operator must see
+    # them exactly once per process.
     if msg not in _WARNED:
         _WARNED.add(msg)
         import sys
 
-        print(f"gray-scott: warning: {msg}", file=sys.stderr)
+        print(f"gray-scott: warning: {msg}",  # gslint: disable=trace-safety
+              file=sys.stderr)
 
 
 def _vmem_budget() -> int:
@@ -247,9 +252,7 @@ def pick_block_planes(
         return _slab_fits(bx, nx, ny, nz, itemsize, fuse, mid_itemsize,
                           budget)
 
-    import os
-
-    override = os.environ.get("GS_BX", "")
+    override = env_str("GS_BX", "")
     if override:
         try:
             bx = int(override)
@@ -278,10 +281,8 @@ def mid_itemsize_for(dtype) -> int:
     reads ``GS_MID_BF16`` exactly the way :func:`fused_step` does, so
     the dispatch-side depth cap agrees with the kernel-side fit (bf16
     mids halve the mid scratch and can admit a deeper chain)."""
-    import os
-
     dt = jnp.dtype(dtype)
-    mid_bf16 = os.environ.get("GS_MID_BF16") == "1" and dt == jnp.float32
+    mid_bf16 = env_raw("GS_MID_BF16") == "1" and dt == jnp.float32
     return jnp.dtype(_mid_store_dtype(dt, mid_bf16)).itemsize
 
 
@@ -882,15 +883,13 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
                     f"got {f.shape}"
                 )
 
-    import os
-
     # GS_MID_BF16=1: store f32 configs' mid buffers as bf16 — an opt-in
     # speed/accuracy trade for benchmark A/B (see _mid_store_dtype; the
     # envelope probe showed mid-buffer VMEM movement is the kernel's
     # binding cost). bf16 fields get bf16 mids unconditionally (bitwise
     # identical to the old rounded f32 storage).
     mid_bf16 = (
-        os.environ.get("GS_MID_BF16") == "1" and dtype == jnp.float32
+        env_raw("GS_MID_BF16") == "1" and dtype == jnp.float32
     )
     mid_item = jnp.dtype(_mid_store_dtype(dtype, mid_bf16)).itemsize
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse,

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+
+from ..config.env import env_str
 from typing import List, Tuple
 
 
@@ -85,7 +87,7 @@ class CartDomain:
         x-sharded decomposition whose halos feed the Pallas kernel's
         in-kernel fused chain — the fastest pod-slice layout for the
         Pallas language at <=16 chips, see BASELINE.md)."""
-        override = os.environ.get("GS_TPU_MESH_DIMS", "")
+        override = env_str("GS_TPU_MESH_DIMS", "")
         if n_devices == 1:
             # A single device has exactly one decomposition; ignoring
             # the override here lets a pod config export

@@ -527,7 +527,7 @@ _FABRIC_DEFAULT = (90.0, 6)
 def fabric_for(device_kind: str):
     """(link_gbps, links) for a device-kind string, env-overridable via
     ``GS_AUTO_LINK_GBPS`` / ``GS_AUTO_LINKS``."""
-    import os
+    from ..config.env import env_float, env_int
 
     kind = (device_kind or "").lower()
     gbps, links = _FABRIC_DEFAULT
@@ -535,8 +535,8 @@ def fabric_for(device_kind: str):
         if sub in kind:
             gbps, links = fab
             break
-    gbps = float(os.environ.get("GS_AUTO_LINK_GBPS", gbps))
-    links = int(os.environ.get("GS_AUTO_LINKS", links))
+    gbps = env_float("GS_AUTO_LINK_GBPS", float(gbps))
+    links = env_int("GS_AUTO_LINKS", int(links))
     return gbps, links
 
 
@@ -590,9 +590,9 @@ def select_kernel(
     ``GS_COMM_OVERLAP=off`` so the pick reflects fully-exposed comm,
     or any explicit fraction for sensitivity studies.
     """
-    import os
+    from ..config.env import env_str
 
-    objective = objective or os.environ.get(
+    objective = objective or env_str(
         "GS_AUTO_OBJECTIVE", "efficiency"
     )
     if objective not in ("efficiency", "throughput"):

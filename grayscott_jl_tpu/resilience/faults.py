@@ -238,7 +238,9 @@ def injected_hang_wait(
     writes). ``GS_HANG_BOUND_S`` defaults to 30 s.
     """
     if bound_s is None:
-        bound_s = float(os.environ.get("GS_HANG_BOUND_S", "30"))
+        from ..config.env import env_float
+
+        bound_s = env_float("GS_HANG_BOUND_S", 30.0)
     t0 = time.monotonic()
     while time.monotonic() - t0 < bound_s:
         time.sleep(0.05)

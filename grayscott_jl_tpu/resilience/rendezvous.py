@@ -48,6 +48,8 @@ import time
 import zlib
 from typing import List, Optional, Tuple
 
+from ..config.env import env_raw, env_str
+
 __all__ = [
     "FileRendezvous",
     "KVRendezvous",
@@ -281,7 +283,7 @@ def from_env(settings) -> Optional[_Rendezvous]:
     proc = jax.process_index()
     timeout_s = resolve_timeout_s()
 
-    forced_dir = os.environ.get("GS_RENDEZVOUS_DIR")
+    forced_dir = env_raw("GS_RENDEZVOUS_DIR")
     if not forced_dir:
         client = None
         try:
@@ -297,7 +299,7 @@ def from_env(settings) -> Optional[_Rendezvous]:
     # Namespace rounds by launch so a relaunch (fresh supervisor, round
     # counter back at 0) never matches a previous launch's files. The
     # coordinator address is the natural shared-but-per-launch token.
-    coord = os.environ.get("GS_TPU_COORDINATOR", "")
+    coord = env_str("GS_TPU_COORDINATOR", "")
     launch_id = f"{zlib.crc32(coord.encode()):08x}" if coord else "0"
     return FileRendezvous(
         directory, nprocs, proc, timeout_s=timeout_s, launch_id=launch_id

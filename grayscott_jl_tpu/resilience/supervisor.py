@@ -56,6 +56,7 @@ import time
 import zlib
 from typing import List, Optional
 
+from ..config.env import env_float, env_raw, env_str
 from .faults import (
     FaultPlan,
     GracefulShutdown,
@@ -90,7 +91,7 @@ _FALSY = {"0", "false", "no", "off"}
 
 def supervision_enabled(settings=None) -> bool:
     """``GS_SUPERVISE`` env, else the ``supervise`` TOML key."""
-    raw = os.environ.get("GS_SUPERVISE")
+    raw = env_raw("GS_SUPERVISE")
     if raw is not None:
         val = raw.strip().lower()
         if val in _TRUTHY:
@@ -127,7 +128,7 @@ def restart_backoff(attempt: int, kind: str) -> float:
     from crc32(attempt:kind) — spread-out restarts without an RNG, so a
     replayed chaos run sleeps the same schedule every time.
     """
-    base = float(os.environ.get("GS_RESTART_BACKOFF_S", "0.5"))
+    base = env_float("GS_RESTART_BACKOFF_S", 0.5)
     if base < 0:
         raise ValueError(
             f"GS_RESTART_BACKOFF_S must be >= 0, got {base}"
@@ -171,7 +172,7 @@ class FaultJournal:
         under supervision, in-memory only otherwise. In multi-process
         runs the path gets a ``.rank<N>`` suffix (mirroring
         ``GS_TPU_STATS``) and events are tagged with the rank."""
-        path = os.environ.get("GS_FAULT_JOURNAL")
+        path = env_raw("GS_FAULT_JOURNAL")
         if not path and settings is not None and supervision_enabled(settings):
             path = settings.output + ".faults.jsonl"
         proc = None
@@ -422,7 +423,7 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
         # elastic restore path then reshards to it.
         import jax
 
-        forced = os.environ.get("GS_TPU_MESH_DIMS", "")
+        forced = env_str("GS_TPU_MESH_DIMS", "")
         proposal = (
             tuple(int(x) for x in forced.split(",")) if forced else None
         )

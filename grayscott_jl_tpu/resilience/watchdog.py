@@ -47,6 +47,7 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from ..config.env import env_raw
 from .faults import EXIT_HANG
 
 __all__ = [
@@ -203,7 +204,7 @@ class Watchdog:
         #: the no-jax-in-bench-parent rule holds).
         self._tracer = tracer
         if grace_s is None:
-            raw = os.environ.get("GS_WATCHDOG_GRACE_S")
+            raw = env_raw("GS_WATCHDOG_GRACE_S")
             if raw is None or raw.strip() == "":
                 grace_s = 60.0
             else:

@@ -60,6 +60,7 @@ _SHARD_MAP_CHECK_FLAG = (
 )
 
 from .config import settings as config
+from .config.env import env_float, env_raw, env_str
 from .config.settings import Settings
 from .models import get_model
 from .ops import noise as noise_ops
@@ -81,9 +82,7 @@ def default_fuse() -> int:
     ``GS_FUSE`` overrides; off-TPU the interpreter pays per-stage
     simulation cost, so tests keep the historical depth 2.
     """
-    import os
-
-    v = os.environ.get("GS_FUSE", "")
+    v = env_str("GS_FUSE", "")
     if v:
         try:
             return max(1, int(v))
@@ -212,7 +211,7 @@ def select_devices(platform: str):
                 file=sys.stderr,
             )
     elif platform == "tpu" and platform not in _reached_platforms:
-        timeout = float(os.environ.get("GS_TPU_PROBE_TIMEOUT", "60"))
+        timeout = env_float("GS_TPU_PROBE_TIMEOUT", 60.0)
         if timeout > 0:
             probe_err = _bounded_tpu_probe(timeout)
             if probe_err is not None:
@@ -391,9 +390,9 @@ class Simulation:
 
         self.compile_cache_dir = config.resolve_compile_cache(settings)
         if self.compile_cache_dir and backend == "cpu" and (
-            _os.environ.get("GS_COMPILE_CACHE_FORCE") != "1"
+            env_raw("GS_COMPILE_CACHE_FORCE") != "1"
         ):
-            if _os.environ.get("GS_COMPILE_CACHE") or settings.compile_cache:
+            if env_raw("GS_COMPILE_CACHE") or settings.compile_cache:
                 # Explicitly requested — refuse loudly, not silently.
                 import sys as _sys
 
@@ -463,7 +462,7 @@ class Simulation:
                 kind = devices[0].device_kind
             except Exception:
                 kind = ""
-            mesh_forced = bool(_os.environ.get("GS_TPU_MESH_DIMS", ""))
+            mesh_forced = bool(env_str("GS_TPU_MESH_DIMS", ""))
             if not self.model.pallas_capable:
                 # Pallas gate (docs/MODELS.md): the hand-fused kernel
                 # implements the Gray-Scott reaction only, so Auto
@@ -515,7 +514,7 @@ class Simulation:
                             self.kernel_selection["adopted_mesh"] = (
                                 list(picked)
                             )
-                    if not _os.environ.get("GS_FUSE", ""):
+                    if not env_str("GS_FUSE", ""):
                         # Honor the winning row's swept depth for BOTH
                         # languages — the projection that justified the
                         # pick assumed it (still capped by the runner's
@@ -570,7 +569,7 @@ class Simulation:
             self.kernel_selection["autotune"] = decision.provenance
             if decision.provenance.get("source") in ("cache", "measured"):
                 self.kernel_language = decision.kernel
-                if decision.fuse is not None and not _os.environ.get(
+                if decision.fuse is not None and not env_str(
                         "GS_FUSE", ""):
                     self._auto_fuse = decision.fuse
                 if (decision.comm_overlap is not None and self.sharded
@@ -580,7 +579,7 @@ class Simulation:
                 if (decision.halo_depth is not None
                         and not self._halo_depth_pinned):
                     self.halo_depth = max(1, int(decision.halo_depth))
-                if decision.bx is not None and not _os.environ.get(
+                if decision.bx is not None and not env_str(
                         "GS_BX", ""):
                     # GS_BX is read at kernel-trace time; an env pin is
                     # the one channel that reaches it. Process-wide by

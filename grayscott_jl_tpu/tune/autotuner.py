@@ -29,6 +29,7 @@ import time
 from typing import Callable, Optional
 
 from . import cache, candidates, measure
+from ..config.env import env_int, env_str
 
 MODES = ("off", "cached", "quick", "full")
 
@@ -61,7 +62,7 @@ def resolve_budget_s() -> float:
 
 
 def _top_n(mode: str) -> int:
-    raw = os.environ.get("GS_AUTOTUNE_TOPN", "")
+    raw = env_str("GS_AUTOTUNE_TOPN", "")
     if raw:
         return max(1, int(raw))
     return _TOP_N[mode]
@@ -239,9 +240,9 @@ def autotune(
         ensemble=ensemble, member_shards=member_shards,
         pallas_allowed=pallas_allowed, halo_depth=halo_depth,
     )
-    steps = int(os.environ.get("GS_AUTOTUNE_STEPS", "20"))
-    rounds = int(os.environ.get("GS_AUTOTUNE_ROUNDS",
-                                "2" if mode == "quick" else "3"))
+    steps = env_int("GS_AUTOTUNE_STEPS", 20)
+    rounds = env_int("GS_AUTOTUNE_ROUNDS",
+                     2 if mode == "quick" else 3)
     ms, skipped = measure.measure_candidates(
         settings, cands, dims=dims, n_devices=n_devices, seed=seed,
         deadline=t0 + budget_s, steps=steps, rounds=rounds, timer=timer,

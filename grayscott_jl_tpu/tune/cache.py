@@ -25,6 +25,8 @@ import os
 import sys
 from typing import Optional
 
+from ..config.env import env_str
+
 #: Bump when the record layout or the meaning of a measurement changes;
 #: every existing cache entry becomes invisible (they live under the
 #: old version's subdirectory). v2: the key grew the ``ensemble``
@@ -52,7 +54,7 @@ SCHEMA_VERSION = 5
 def cache_dir() -> str:
     """Cache root: ``GS_AUTOTUNE_CACHE`` env, else
     ``~/.cache/grayscott_tune``."""
-    raw = os.environ.get("GS_AUTOTUNE_CACHE", "").strip()
+    raw = env_str("GS_AUTOTUNE_CACHE", "").strip()
     if raw:
         return os.path.expanduser(raw)
     return os.path.join(os.path.expanduser("~"), ".cache",

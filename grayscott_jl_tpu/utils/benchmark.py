@@ -69,7 +69,9 @@ def setup_platform(cpu: bool, devices: int = 1) -> str:
 
         jax.config.update("jax_platforms", "cpu")
     else:
-        if os.environ.get("GS_COMM_OVERLAP", "").strip().lower() not in (
+        from ..config.env import env_str
+
+        if env_str("GS_COMM_OVERLAP", "").strip().lower() not in (
             "off", "0", "false", "no"
         ):
             inject_overlap_xla_flags()

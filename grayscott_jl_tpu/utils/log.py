@@ -22,6 +22,8 @@ import sys
 import time
 from typing import Optional
 
+from ..config.env import env_str
+
 #: Cached "is this process rank 0" answer. Before JAX initializes the
 #: answer could change (a later ``jax.distributed.initialize`` assigns
 #: ranks), so the pre-init True is NOT cached — only a successful
@@ -63,7 +65,7 @@ class Logger:
         self.verbose = verbose
         self.stream = stream or sys.stdout
         if fmt is None:
-            fmt = os.environ.get("GS_LOG_FORMAT", "text")
+            fmt = env_str("GS_LOG_FORMAT", "text")
         fmt = (fmt or "text").strip().lower()
         if fmt not in LOG_FORMATS:
             raise ValueError(

@@ -17,6 +17,8 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+
+from ..config.env import env_raw
 import time
 from typing import Dict, Optional
 
@@ -218,7 +220,7 @@ class RunStats:
         the path gets a ``.rank<N>`` suffix so ranks don't clobber each
         other's file.
         """
-        path = os.environ.get("GS_TPU_STATS")
+        path = env_raw("GS_TPU_STATS")
         if not path:
             return None
         import jax
@@ -234,7 +236,7 @@ class RunStats:
 @contextlib.contextmanager
 def trace():
     """``jax.profiler`` trace of the run when ``GS_TPU_PROFILE`` is set."""
-    out = os.environ.get("GS_TPU_PROFILE")
+    out = env_raw("GS_TPU_PROFILE")
     if not out:
         yield
         return

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Pre-push gate: gslint + ruff + mypy (when installed) + quick pytest.
+#
+#   scripts/check.sh          # full chain
+#   scripts/check.sh --fast   # static checks only, no pytest
+#
+# Mirrors tests/unit/test_static_suite.py — the same steps run in
+# tier-1, so a green check.sh is a green static gate in CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== gslint =="
+python scripts/gslint.py grayscott_jl_tpu scripts bench.py
+
+if python -m ruff --version >/dev/null 2>&1; then
+    echo "== ruff =="
+    python -m ruff check .
+    python -m ruff format --check grayscott_jl_tpu/lint
+else
+    echo "== ruff: not installed, skipping =="
+fi
+
+if python -m mypy --version >/dev/null 2>&1; then
+    echo "== mypy --strict (JAX-free modules) =="
+    python -m mypy --strict \
+        grayscott_jl_tpu/models/base.py \
+        grayscott_jl_tpu/obs/events.py \
+        grayscott_jl_tpu/reshard/plan.py \
+        grayscott_jl_tpu/lint
+else
+    echo "== mypy: not installed, skipping =="
+fi
+
+if [[ "${1:-}" != "--fast" ]]; then
+    echo "== quick pytest (unit, not slow) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/unit -q -m 'not slow' \
+        -p no:cacheprovider
+fi
+echo "check.sh: OK"

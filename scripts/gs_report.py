@@ -43,13 +43,39 @@ def _fmt_s(v) -> str:
     return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
 
 
-#: Per-kind attribute requirements of the extended record kinds
-#: (docs/OBSERVABILITY.md): a producer that drops these has broken the
-#: schema the report sections below render from.
-EVENT_ATTR_SCHEMA = {
+#: The full GS_EVENTS kind registry: every kind a producer in the tree
+#: can emit, mapped to the attrs it must carry (docs/OBSERVABILITY.md).
+#: Kept in sync with the producers by the ``event-schema`` gslint pass
+#: (docs/ANALYSIS.md) — an emit of a kind missing here, or an entry
+#: here nothing emits, fails ``scripts/gslint.py``.  Journal-mirrored
+#: kinds (``FaultJournal.record``) carry their failure-taxonomy
+#: ``kind`` as the ``fault`` attr.
+EVENT_KIND_SCHEMA = {
+    # driver lifecycle
+    "run_start": ("model", "L", "steps", "kernel", "mesh"),
+    "output": ("output_step",),
+    "checkpoint": (),
+    "run_complete": ("wall_s", "steps", "attempt"),
+    "run_error": ("error", "attempt"),
+    "shutdown_requested": ("signum",),
+    # tuning / observability producers
+    "autotune": ("mode", "source", "kernel"),
     "numerics": ("fields",),
     "drift": ("tripped", "limit", "policy"),
     "executable": ("name", "compile_s"),
+    # resilience (journal-mirrored)
+    "injected": ("fault", "planned_step"),
+    "health": ("fault", "policy", "action"),
+    "recovery": ("fault", "attempt", "action"),
+    "gave_up": ("fault", "attempt", "error"),
+    "attempt_phases": ("attempt", "phases_s", "steps"),
+    "rendezvous": ("round", "attempt", "procs"),
+    "mesh_agreement": ("round", "devices", "procs"),
+    "graceful_shutdown": ("signal",),
+    "hang": ("fault", "deadline_s", "threads"),
+    "hang_exit": ("fault", "exit_code"),
+    # elastic resharding
+    "reshard": ("members",),
 }
 
 
@@ -60,7 +86,13 @@ def _check_event(path, i, e, problems) -> None:
             f"events {path}: record {i} missing {missing}"
         )
         return
-    required = EVENT_ATTR_SCHEMA.get(e.get("kind"))
+    if e["kind"] not in EVENT_KIND_SCHEMA:
+        problems.append(
+            f"events {path}: record {i} has unknown kind "
+            f"{e['kind']!r} (not in EVENT_KIND_SCHEMA)"
+        )
+        return
+    required = EVENT_KIND_SCHEMA[e["kind"]]
     if required:
         attrs = e.get("attrs") or {}
         missing = [k for k in required if k not in attrs]

@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""gslint — the framework's contracts, statically enforced.
+
+    python scripts/gslint.py [paths...]          # default: whole tree
+    python scripts/gslint.py --json [paths...]   # stable tooling output
+    python scripts/gslint.py --list              # pass catalog
+    python scripts/gslint.py --select env-knobs,layering [paths...]
+
+Runs without JAX (stdlib + the JAX-free ``grayscott_jl_tpu.lint``
+package).  Exit code: 0 when no error-severity findings remain after
+per-line suppressions and the (always-empty, committed) baseline at
+``gslint-baseline.json``; 1 otherwise.  Warnings print but do not
+fail.  See docs/ANALYSIS.md for the pass catalog, the suppression
+syntax, and the ``--json`` schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from grayscott_jl_tpu import lint  # noqa: E402
+
+#: Default lint surface: the package, the operator scripts, and the
+#: bench entry point (mirrors the tier-1 self-check).
+DEFAULT_TARGETS = ("grayscott_jl_tpu", "scripts", "bench.py")
+
+BASELINE = os.path.join(REPO, "gslint-baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gslint",
+        description="JAX-aware static analysis for grayscott_jl_tpu",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to lint (default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the stable gslint/1 JSON document to stdout",
+    )
+    ap.add_argument(
+        "--list", action="store_true",
+        help="list available passes and exit",
+    )
+    ap.add_argument(
+        "--select", default="",
+        help="comma-separated pass ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline", default=BASELINE,
+        help="baseline file of finding keys to ignore "
+             "(committed empty by contract)",
+    )
+    ap.add_argument(
+        "--root", default=REPO,
+        help="repo root paths are resolved against (default: the "
+             "checkout containing this script)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for pass_id in sorted(lint.PASSES):
+            doc = (sys.modules[lint.PASSES[pass_id].__module__]
+                   .__doc__ or "").strip().splitlines()
+            print(f"{pass_id:<14} {doc[0] if doc else ''}")
+        return 0
+
+    targets = args.paths or list(DEFAULT_TARGETS)
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    baseline = []
+    if args.baseline and os.path.isfile(args.baseline):
+        baseline = lint.load_baseline(args.baseline)
+
+    findings = lint.run_lint(
+        args.root, targets, select=select or None, baseline=baseline
+    )
+    errors = [f for f in findings if f.severity == "error"]
+    if args.as_json:
+        print(json.dumps(
+            lint.findings_to_json(findings, args.root, targets),
+            indent=2, sort_keys=True,
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        n_warn = len(findings) - len(errors)
+        print(
+            f"gslint: {len(errors)} error(s), {n_warn} warning(s) "
+            f"over {len(targets)} target(s)"
+        )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

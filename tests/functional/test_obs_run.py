@@ -299,9 +299,10 @@ from grayscott_jl_tpu.obs.metrics import get_metrics
 
 pid = jax.process_index()
 es = get_events()
-es.emit("run_start", step=0, attempt=0)
+es.emit("run_start", step=0, attempt=0, model="grayscott", L=16,
+        steps=10, kernel="xla", mesh=[1, 1, 1], restart=False)
 time.sleep(0.05 * (pid + 1))  # deterministic cross-rank time order
-es.emit("output", phase="io", step=10)
+es.emit("output", phase="io", step=10, output_step=1)
 m = get_metrics()
 m.counter("steps").inc(10 + pid)
 m.histogram("step_latency_us").observe(100.0 + pid)

@@ -1,0 +1,561 @@
+"""Per-pass gslint fixtures: every pass has at least one fixture that
+makes it fire (true positive) and one that proves it stays silent
+(false-positive guard).  Fixture trees mimic the package layout under
+tmp_path because the passes key on ``grayscott_jl_tpu.*`` module
+paths."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from grayscott_jl_tpu import lint
+from grayscott_jl_tpu.lint import findings_to_json, run_lint
+
+PKG = "grayscott_jl_tpu"
+
+
+def make_repo(tmp_path, files, docs=None):
+    """Write ``files`` (relpath -> source) under a fresh fixture root
+    and return it."""
+    root = tmp_path / "repo"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    for rel, text in (docs or {}).items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return str(root)
+
+
+def lint_pass(root, pass_id, targets=(PKG,)):
+    return run_lint(root, list(targets), select=[pass_id])
+
+
+# ----------------------------------------------------------- trace-safety
+
+JIT_HOST_SYNC = """
+import jax
+
+def step(x):
+    y = x.item()
+    return y
+
+runner = jax.jit(step)
+"""
+
+JIT_CONCRETIZE = """
+import jax
+
+def body(u, v):
+    scale = float(u)
+    return u * scale, v
+
+runner = jax.jit(body, donate_argnums=(0, 1))
+"""
+
+HOST_ONLY_FLOAT = """
+def summarize(stats):
+    # float() on a Python scalar in host code: no jit root reaches
+    # this function, so the pass must not fire.
+    return float(stats["mean"]) + int(stats["count"])
+
+def report(stats):
+    print("mean:", summarize(stats))
+"""
+
+JIT_VIA_PARTIAL_CHAIN = """
+import jax
+from functools import partial
+
+def kernel(u, n):
+    print("tracing", n)
+    return u * n
+
+class Sim:
+    def _runner(self, n):
+        local = partial(kernel, n=n)
+        fn = jax.jit(local)
+        return fn
+"""
+
+
+def test_trace_safety_fires_on_item_sync(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": JIT_HOST_SYNC})
+    found = lint_pass(root, "trace-safety")
+    assert len(found) == 1
+    assert ".item()" in found[0].message
+    assert found[0].path == f"{PKG}/ops/hot.py"
+
+
+def test_trace_safety_fires_on_float_of_traced_arg(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": JIT_CONCRETIZE})
+    found = lint_pass(root, "trace-safety")
+    assert any("float()" in f.message for f in found)
+
+
+def test_trace_safety_follows_partial_and_assignment(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/simulation.py": JIT_VIA_PARTIAL_CHAIN}
+    )
+    found = lint_pass(root, "trace-safety")
+    assert any("print()" in f.message for f in found)
+
+
+def test_trace_safety_silent_on_host_code(tmp_path):
+    # The false-positive guard from the contract: float()/int()/print
+    # in functions no jit root reaches must not fire.
+    root = make_repo(
+        tmp_path, {f"{PKG}/utils/report.py": HOST_ONLY_FLOAT}
+    )
+    assert lint_pass(root, "trace-safety") == []
+
+
+def test_trace_safety_suppression(tmp_path):
+    src = JIT_HOST_SYNC.replace(
+        "y = x.item()",
+        "y = x.item()  # gslint: disable=trace-safety",
+    )
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": src})
+    assert lint_pass(root, "trace-safety") == []
+
+
+# ---------------------------------------------------------------- purity
+
+IMPURE_MODEL = """
+import os
+
+def reaction(fields, laps, noise, params):
+    gain = float(os.environ.get("MY_GAIN", "1.0"))
+    return tuple(f * gain for f in fields)
+
+def init(L, dtype, offsets, sizes):
+    with open("/tmp/seed.bin", "rb") as f:
+        return f.read()
+"""
+
+PURE_MODEL = """
+SEED_HALF_WIDTH = 4  # module constants are the declaration: fine
+U_BOUNDARY = 1.0
+
+def _poly(u, v):
+    return u * v * v
+
+def reaction(fields, laps, noise, params):
+    u, v = fields
+    return (-_poly(u, v), _poly(u, v))
+
+def init(L, dtype, offsets, sizes):
+    return None
+
+def dump_debug(path):
+    # impure, but not reachable from reaction/init: must not fire
+    print("debug", path)
+"""
+
+
+def test_purity_fires_on_env_and_io(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/models/evil.py": IMPURE_MODEL}
+    )
+    found = lint_pass(root, "purity")
+    msgs = "\n".join(f.message for f in found)
+    assert "os.environ" in msgs
+    assert "open()" in msgs
+
+
+def test_purity_silent_on_pure_declaration(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/models/nice.py": PURE_MODEL}
+    )
+    assert lint_pass(root, "purity") == []
+
+
+# -------------------------------------------------------------- layering
+
+OPS_IMPORTS_MODEL = """
+from ..models import grayscott
+
+def fused(u):
+    return u + grayscott.U_BOUNDARY
+"""
+
+PARALLEL_BOUNDARY_LITERAL = """
+U_BOUNDARY = 1.0
+
+def exchange(u):
+    return u
+"""
+
+OBS_IMPORTS_JAX = """
+import jax
+
+def snapshot():
+    return jax.devices()
+"""
+
+OBS_LAZY_JAX = """
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import jax
+
+def capture():
+    import jax
+
+    return jax.devices()
+"""
+
+
+def test_layering_fires_on_model_import_in_ops(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/ops/custom.py": OPS_IMPORTS_MODEL,
+            f"{PKG}/models/grayscott.py": "U_BOUNDARY = 1.0\n",
+        },
+    )
+    found = lint_pass(root, "layering")
+    assert any("concrete model module" in f.message for f in found)
+
+
+def test_layering_sanctions_pallas_gs_import(tmp_path):
+    # The one sanctioned exception from the models-as-data contract.
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/ops/pallas_stencil.py":
+                "from ..models import grayscott as _gs_model\n",
+            f"{PKG}/models/grayscott.py": "U_BOUNDARY = 1.0\n",
+        },
+    )
+    found = lint_pass(root, "layering")
+    assert not any(
+        "concrete model module" in f.message for f in found
+    )
+
+
+def test_layering_literal_scan_fires(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {f"{PKG}/parallel/custom.py": PARALLEL_BOUNDARY_LITERAL},
+    )
+    found = lint_pass(root, "layering")
+    assert any("boundary" in f.message.lower() for f in found)
+
+
+def test_layering_jaxfree_fires_on_module_scope_jax(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/obs/probe.py": OBS_IMPORTS_JAX}
+    )
+    found = lint_pass(root, "layering")
+    assert any("without JAX" in f.message for f in found)
+
+
+def test_layering_jaxfree_allows_lazy_and_type_checking(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/obs/probe.py": OBS_LAZY_JAX}
+    )
+    assert lint_pass(root, "layering") == []
+
+
+# -------------------------------------------------------------- env-knobs
+
+KNOB_RESOLVER = """
+import os
+
+def resolve_widget():
+    return os.environ.get("GS_WIDGET", "")
+"""
+
+KNOB_RAW_READ = """
+import os
+
+def hot_loop():
+    return os.environ.get("GS_WIDGET", "")
+"""
+
+KNOB_SETTINGS_RESOLVER = """
+import os
+
+def widget_mode(settings):
+    # raw read, non-resolver name — allowed because config/settings.py
+    # IS the resolver module (the contract's named exception).
+    return os.environ.get("GS_WIDGET")
+"""
+
+DOCS_WITH_WIDGET = "Knobs: `GS_WIDGET` toggles the widget.\n"
+DOCS_WITH_DEAD = (
+    "Knobs: `GS_WIDGET` toggles the widget. `GS_GHOST_KNOB` is "
+    "documented here but read nowhere.\n"
+)
+
+
+def test_env_knobs_undocumented_fires(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/config/extra.py": KNOB_RESOLVER},
+        docs={"README.md": "no knob table here\n"},
+    )
+    found = lint_pass(root, "env-knobs")
+    assert any(
+        "GS_WIDGET" in f.message and "no knob table" in f.message
+        for f in found
+    )
+
+
+def test_env_knobs_documented_resolver_read_is_clean(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/config/extra.py": KNOB_RESOLVER},
+        docs={"README.md": DOCS_WITH_WIDGET},
+    )
+    assert lint_pass(root, "env-knobs") == []
+
+
+def test_env_knobs_dead_knob_fires(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/config/extra.py": KNOB_RESOLVER},
+        docs={"README.md": DOCS_WITH_DEAD},
+    )
+    found = lint_pass(root, "env-knobs")
+    assert any(
+        "GS_GHOST_KNOB" in f.message and "dead" in f.message
+        for f in found
+    )
+
+
+def test_env_knobs_raw_read_outside_resolver_fires(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/ops/hot.py": KNOB_RAW_READ},
+        docs={"README.md": DOCS_WITH_WIDGET},
+    )
+    found = lint_pass(root, "env-knobs")
+    assert any("outside a resolver" in f.message for f in found)
+
+
+def test_env_knobs_settings_module_is_resolver_context(tmp_path):
+    # The contract's false-positive guard: os.environ in
+    # config/settings.py resolvers is allowed.
+    root = make_repo(
+        tmp_path,
+        {f"{PKG}/config/settings.py": KNOB_SETTINGS_RESOLVER},
+        docs={"README.md": DOCS_WITH_WIDGET},
+    )
+    assert lint_pass(root, "env-knobs") == []
+
+
+def test_env_knobs_fstring_family_and_doc_prefix(tmp_path):
+    src = (
+        "import os\n\n"
+        "def resolve_phase_deadline(phase):\n"
+        "    key = f\"GS_WIDGET_{phase.upper()}_S\"\n"
+        "    return os.environ.get(key)\n"
+    )
+    docs = "Per-phase knobs: `GS_WIDGET_<PHASE>_S` (seconds).\n"
+    root = make_repo(
+        tmp_path, {f"{PKG}/config/extra.py": src},
+        docs={"README.md": docs},
+    )
+    assert lint_pass(root, "env-knobs") == []
+
+
+# ------------------------------------------------------------ event-schema
+
+EMITTER = """
+def tell(stream):
+    stream.emit("zap", value=1)
+"""
+
+REPORT_WITH_REGISTRY = """
+EVENT_KIND_SCHEMA = {
+    "zap": ("value",),
+}
+"""
+
+REPORT_WITH_DEAD_KIND = """
+EVENT_KIND_SCHEMA = {
+    "zap": ("value",),
+    "unemitted": (),
+}
+"""
+
+
+def test_event_schema_missing_registry_fires(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/obs/custom_events.py": EMITTER}
+    )
+    found = lint_pass(root, "event-schema")
+    assert found and "no --check validator registry" in (
+        found[0].message
+    )
+
+
+def test_event_schema_unregistered_kind_fires(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/obs/custom_events.py": EMITTER,
+            "scripts/gs_report.py": "EVENT_KIND_SCHEMA = {}\n",
+        },
+    )
+    found = lint_pass(root, "event-schema")
+    assert any(
+        "'zap'" in f.message and "no validator" in f.message
+        for f in found
+    )
+
+
+def test_event_schema_dead_validator_fires(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/obs/custom_events.py": EMITTER,
+            "scripts/gs_report.py": REPORT_WITH_DEAD_KIND,
+        },
+    )
+    found = lint_pass(root, "event-schema")
+    assert any("'unemitted'" in f.message for f in found)
+
+
+def test_event_schema_synced_is_clean(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/obs/custom_events.py": EMITTER,
+            "scripts/gs_report.py": REPORT_WITH_REGISTRY,
+        },
+    )
+    assert lint_pass(root, "event-schema") == []
+
+
+def test_event_schema_sees_journal_record_kinds(tmp_path):
+    src = (
+        "def fail(journal):\n"
+        "    journal.record(event=\"boom\", step=3)\n"
+    )
+    root = make_repo(
+        tmp_path,
+        {
+            f"{PKG}/resilience/custom.py": src,
+            "scripts/gs_report.py": "EVENT_KIND_SCHEMA = {}\n",
+        },
+    )
+    found = lint_pass(root, "event-schema")
+    assert any("'boom'" in f.message for f in found)
+
+
+# ---------------------------------------------------------------- donation
+
+JIT_IN_LOOP = """
+import jax
+
+def sweep(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)
+        out.append(f(x))
+    return out
+"""
+
+USE_AFTER_DONATE = """
+import jax
+
+def drive(u, v):
+    runner = jax.jit(step, donate_argnums=(0,))
+    out = runner(u, v)
+    return out + u  # u's buffer was donated
+
+def step(u, v):
+    return u + v
+"""
+
+REBIND_AFTER_DONATE = """
+import jax
+
+def drive(u, v):
+    runner = jax.jit(step, donate_argnums=(0,))
+    u = runner(u, v)
+    return u  # canonical rebind: no hazard
+
+def step(u, v):
+    return u + v
+"""
+
+
+def test_donation_fires_on_jit_in_loop(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/tune/sweep.py": JIT_IN_LOOP})
+    found = lint_pass(root, "donation")
+    assert any("inside a loop" in f.message for f in found)
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_donation_fires_on_use_after_donate(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/ops/drive.py": USE_AFTER_DONATE}
+    )
+    found = lint_pass(root, "donation")
+    assert any("donated" in f.message for f in found)
+
+
+def test_donation_silent_on_rebind(tmp_path):
+    root = make_repo(
+        tmp_path, {f"{PKG}/ops/drive.py": REBIND_AFTER_DONATE}
+    )
+    assert lint_pass(root, "donation") == []
+
+
+# ----------------------------------------------------- harness mechanics
+
+def test_unknown_pass_id_raises(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/ops/x.py": "A = 1\n"})
+    with pytest.raises(ValueError, match="unknown pass"):
+        run_lint(root, [PKG], select=["no-such-pass"])
+
+
+def test_baseline_filters_by_key(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": JIT_HOST_SYNC})
+    found = lint_pass(root, "trace-safety")
+    assert found
+    again = run_lint(
+        root, [PKG], select=["trace-safety"],
+        baseline=[f.key() for f in found],
+    )
+    assert again == []
+
+
+def test_json_document_schema(tmp_path):
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": JIT_HOST_SYNC})
+    found = run_lint(root, [PKG])
+    doc = findings_to_json(found, root, [PKG])
+    assert doc["schema"] == "gslint/1"
+    assert set(doc["passes"]) == set(lint.PASSES)
+    assert doc["errors"] >= 1
+    for f in doc["findings"]:
+        assert {"pass_id", "path", "line", "message", "hint",
+                "severity"} <= set(f)
+    json.dumps(doc)  # must be serializable as-is
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    import subprocess
+    import sys
+
+    repo = Path(__file__).resolve().parents[2]
+    root = make_repo(tmp_path, {f"{PKG}/ops/hot.py": JIT_HOST_SYNC})
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "gslint.py"),
+         "--root", root, "--json", "--select", "trace-safety", PKG],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["errors"] >= 1
+    clean = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "gslint.py"),
+         "--root", root, "--select", "donation", PKG],
+        capture_output=True, text=True,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr

@@ -24,7 +24,6 @@ Contracts asserted here, in rough order of load-bearing-ness:
 
 import os
 import pathlib
-import re
 
 import numpy as np
 import pytest
@@ -483,40 +482,26 @@ def test_ensemble_presets_are_model_namespaced():
 # ------------------------------------------------- models-as-data hygiene
 
 def test_no_model_literals_in_shared_code():
-    """ops/ and parallel/ must contain no model-specific constants: no
-    seeding constants, no boundary-value definitions. The one sanctioned
-    reference is the Pallas kernel (ops/pallas_stencil.py) — the
-    Gray-Scott model's own hand-fused form — which may IMPORT the model
-    declaration (qualified ``_gs_model.`` reads) but never redefine it."""
-    banned_tokens = re.compile(
-        r"\bSEED_HALF_WIDTH\b|\bSEED_U\b|\bSEED_V\b|\bSEED_T\b"
+    """ops/ and parallel/ must stay model-generic: no imports of
+    concrete ``models/*`` modules (the gslint ``layering`` pass
+    resolves the import graph structurally, so the invariant survives
+    file renames and string-formatting changes) and no model literals
+    (the original grep-era scan lives on as one check of the same
+    pass).  The one sanctioned reference is the Pallas kernel
+    (ops/pallas_stencil.py) — the Gray-Scott model's own hand-fused
+    form — which may IMPORT the model declaration but never redefine
+    it (``lint.layering.SANCTIONED_MODEL_IMPORTS``)."""
+    from grayscott_jl_tpu.lint import run_lint
+
+    findings = run_lint(
+        str(REPO),
+        ["grayscott_jl_tpu/ops", "grayscott_jl_tpu/parallel"],
+        select=["layering"],
     )
-    boundary_def = re.compile(
-        r"^\s*[UVTW]_BOUNDARY\s*=", re.MULTILINE
+    assert findings == [], (
+        "model literals or concrete model imports in shared code:\n"
+        + "\n".join(f.render() for f in findings)
     )
-    unqualified_boundary = re.compile(
-        r"(?<![\w.])[UVT]_BOUNDARY\b"
-    )
-    pkg = REPO / "grayscott_jl_tpu"
-    for sub in ("ops", "parallel"):
-        for path in sorted((pkg / sub).glob("*.py")):
-            src = path.read_text()
-            assert not banned_tokens.search(src), (
-                f"{path}: model seeding constants belong in models/"
-            )
-            assert not boundary_def.search(src), (
-                f"{path}: boundary values are model declarations"
-            )
-            if sub == "parallel":
-                assert "BOUNDARY" not in src, (
-                    f"{path}: parallel/ must receive boundaries via "
-                    "the model declaration, not name them"
-                )
-            elif path.name != "pallas_stencil.py":
-                assert not unqualified_boundary.search(src), (
-                    f"{path}: boundary constants must come from the "
-                    "model declaration"
-                )
 
 
 # ------------------------------------------------------------- CLI smoke

@@ -1,0 +1,97 @@
+"""The static-analysis gate (tier-1): gslint over the whole tree must
+report ZERO findings with the committed (empty) baseline, and the
+optional tools (ruff, mypy) run behind importorskip with the
+pyproject-tuned configs.  ``scripts/check.sh`` chains the same steps
+for pre-push use."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from grayscott_jl_tpu import lint
+from grayscott_jl_tpu.lint import run_lint
+
+REPO = Path(__file__).resolve().parents[2]
+
+#: The lint surface (mirrors scripts/gslint.py DEFAULT_TARGETS).
+TARGETS = ["grayscott_jl_tpu", "scripts", "bench.py"]
+
+#: The modules the docs promise are importable without JAX; mypy
+#: --strict runs over exactly these (pyproject [tool.mypy]).
+MYPY_TARGETS = [
+    "grayscott_jl_tpu/models/base.py",
+    "grayscott_jl_tpu/obs/events.py",
+    "grayscott_jl_tpu/reshard/plan.py",
+    "grayscott_jl_tpu/lint",
+]
+
+
+def test_gslint_zero_findings_over_tree():
+    """The self-check: every pass over the whole package, scripts, and
+    bench.py — zero findings, errors AND warnings."""
+    findings = run_lint(str(REPO), TARGETS)
+    assert findings == [], (
+        "gslint found contract violations:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_committed_baseline_is_empty():
+    """The baseline exists (the mechanism stays exercised) and is
+    empty (real findings get fixed, not baselined)."""
+    path = REPO / "gslint-baseline.json"
+    assert path.is_file()
+    assert lint.load_baseline(str(path)) == []
+
+
+def test_gslint_cli_json_contract():
+    """The CLI exits 0 over the tree and emits the stable gslint/1
+    JSON document tooling consumes (docs/ANALYSIS.md)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gslint.py"),
+         "--json"] + TARGETS,
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == "gslint/1"
+    assert doc["errors"] == 0 and doc["warnings"] == 0
+    assert doc["findings"] == []
+    assert set(doc["passes"]) == set(lint.PASSES)
+
+
+def test_pass_catalog_is_stable():
+    """The six contract passes the docs catalog names exist."""
+    assert set(lint.PASSES) == {
+        "trace-safety", "purity", "layering", "env-knobs",
+        "event-schema", "donation",
+    }
+
+
+def test_ruff_clean():
+    pytest.importorskip("ruff")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", "."],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fmt = subprocess.run(
+        [sys.executable, "-m", "ruff", "format", "--check",
+         "grayscott_jl_tpu/lint"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert fmt.returncode == 0, fmt.stdout + fmt.stderr
+
+
+def test_mypy_strict_on_jaxfree_modules():
+    pytest.importorskip("mypy")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--strict"] + MYPY_TARGETS,
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
