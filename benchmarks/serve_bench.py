@@ -1,0 +1,261 @@
+#!/usr/bin/env python3
+"""Load harness for the serve front door (docs/SERVICE.md).
+
+Drives N concurrent synthetic clients against a REAL in-process
+service (HTTP submit + status polling, the full scheduler/worker/store
+path) once per packing factor, and reports:
+
+* **p50/p99 request-to-first-step latency** (the serve SLO metric:
+  admission -> first evidence of completed compute on the event
+  stream) against ``--slo-s``;
+* **aggregate cell-updates/s** (sum of L^3 x steps over completed
+  jobs / campaign wall) — the number that must RISE with packing
+  factor: a request is just a member, so packing amortizes
+  launch + compile overhead across the batch exactly as the ensemble
+  engine's launch-level A/B measured (docs/ENSEMBLE.md);
+* ``median_us_per_step`` (campaign wall per member-step) — the
+  lower-is-better metric ``regression_gate.py`` gates, so every
+  committed row doubles as tomorrow's regression baseline.
+
+Rows land in the shared artifacts schema (``benchmarks/artifacts.py``)
+keyed by ``metric=packN_cM`` so different load shapes never compare
+against each other::
+
+    python benchmarks/serve_bench.py --clients 64 --rounds 4 \
+        --out benchmarks/results/serve_cpu_$(date +%F).jsonl
+    python benchmarks/regression_gate.py --fresh <out> --self
+
+The tier-1 functional test (``tests/functional/test_serve_run.py``)
+runs the 64-client variant of this harness in-process; ``-m slow``
+scales to O(1k) clients.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+
+def _post(base: str, path: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _get(base: str, path: str) -> dict:
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+def _job_spec(i: int, *, L: int, steps: int, plotgap: int,
+              tenants: int) -> dict:
+    """Synthetic client i's request: one Gray-Scott scenario with a
+    per-client F (a real multi-tenant parameter sweep — every job is a
+    distinct simulation, all pack-compatible)."""
+    return {
+        "tenant": f"tenant{i % tenants}",
+        "model": "grayscott",
+        "L": L,
+        "steps": steps,
+        "plotgap": plotgap,
+        "checkpoint_freq": 0,
+        "params": {
+            "F": 0.01 + 0.05 * (i % 97) / 97.0,
+            "k": 0.062, "Du": 0.2, "Dv": 0.1,
+        },
+        "dt": 1.0,
+        "noise": 0.1,
+        "seed": i,
+    }
+
+
+def run_campaign(*, clients: int, pack_max: int, L: int, steps: int,
+                 plotgap: int, state_dir: str, workers: int = 1,
+                 pack_window_s: float = 0.05,
+                 timeout_s: float = 1800.0) -> dict:
+    """One load campaign at one packing factor against a fresh
+    in-process service. Returns the measurement dict (latencies in
+    ms, aggregate throughput, warm-cache counters)."""
+    from grayscott_jl_tpu.obs.metrics import quantile
+    from grayscott_jl_tpu.serve.scheduler import ServeConfig
+    from grayscott_jl_tpu.serve.server import ServeService
+
+    tenants = max(4, clients // 16)
+    cfg = ServeConfig(
+        port=0,
+        workers=workers,
+        queue_depth=max(256, 2 * clients),
+        tenant_quota=max(64, clients),
+        pack_max=pack_max,
+        pack_window_s=pack_window_s,
+        state_dir=state_dir,
+        supervise=False,  # no restarts in a clean bench
+        slo_s=timeout_s,
+    )
+    svc = ServeService(cfg).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    jobs: List[Optional[str]] = [None] * clients
+    errors: List[str] = []
+
+    def client(i: int) -> None:
+        try:
+            jobs[i] = _post(base, "/v1/jobs", _job_spec(
+                i, L=L, steps=steps, plotgap=plotgap, tenants=tenants,
+            ))["job"]
+        except Exception as e:  # noqa: BLE001 — collected for the report
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        svc.close()
+        raise RuntimeError(
+            f"{len(errors)} submissions failed: {errors[:3]}"
+        )
+
+    deadline = time.time() + timeout_s
+    records: List[dict] = []
+    while time.time() < deadline:
+        records = [_get(base, f"/v1/jobs/{j}") for j in jobs]
+        if all(r["state"] in ("complete", "failed", "cancelled")
+               for r in records):
+            break
+        time.sleep(0.1)
+    wall = time.perf_counter() - t0
+    health = _get(base, "/v1/healthz")
+    svc.close()
+
+    done = [r for r in records if r["state"] == "complete"]
+    failed = [r for r in records if r["state"] != "complete"]
+    rtfs_ms = sorted(
+        r["request_to_first_step_s"] * 1e3 for r in done
+        if r.get("request_to_first_step_s") is not None
+    )
+    cells = L**3 * steps * len(done)
+    member_steps = steps * max(len(done), 1)
+    return {
+        "clients": clients,
+        "pack_max": pack_max,
+        "completed": len(done),
+        "failed": len(failed),
+        "wall_s": round(wall, 3),
+        "p50_request_to_first_step_ms": round(
+            quantile(rtfs_ms, 50), 1) if rtfs_ms else None,
+        "p99_request_to_first_step_ms": round(
+            quantile(rtfs_ms, 99), 1) if rtfs_ms else None,
+        "agg_cell_updates_per_s": round(cells / max(wall, 1e-9), 1),
+        "median_us_per_step": round(wall / member_steps * 1e6, 3),
+        "launches": health.get("launches"),
+        "warm_hits": health.get("warm_hits"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serve front-door load harness"
+    )
+    ap.add_argument("--clients", type=int, default=64,
+                    help="concurrent synthetic clients per campaign "
+                    "(default 64; the slow tier drives O(1k))")
+    ap.add_argument("--pack-factors", default="1,4,8",
+                    help="comma list of GS_SERVE_PACK_MAX values to "
+                    "sweep (default 1,4,8)")
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="campaigns per factor (history depth for the "
+                    "regression gate; default 1)")
+    ap.add_argument("--l", type=int, default=8, dest="L",
+                    help="job domain size (default 8)")
+    ap.add_argument("--steps", type=int, default=16,
+                    help="steps per job (default 16)")
+    ap.add_argument("--plotgap", type=int, default=8,
+                    help="output cadence per job (default 8)")
+    ap.add_argument("--slo-s", type=float, default=60.0,
+                    help="p99 request-to-first-step SLO (default 60)")
+    ap.add_argument("--state-dir", default=None,
+                    help="service state root (default: a temp dir)")
+    ap.add_argument("--out", default=None,
+                    help="artifact JSONL (default "
+                    "benchmarks/results/serve_cpu_<date>.jsonl)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    factors = [int(f) for f in args.pack_factors.split(",") if f]
+    import tempfile
+
+    state_root = args.state_dir or tempfile.mkdtemp(prefix="gs-serve-")
+    out = args.out or artifacts.default_out("serve", "cpu")
+
+    worst_p99 = 0.0
+    base_tput = None
+    for pack in factors:
+        for rnd in range(args.rounds):
+            m = run_campaign(
+                clients=args.clients, pack_max=pack, L=args.L,
+                steps=args.steps, plotgap=args.plotgap,
+                state_dir=os.path.join(
+                    state_root, f"pack{pack}_r{rnd}"
+                ),
+            )
+            row = {
+                "ab": "serve",
+                "platform": "cpu",
+                "model": "grayscott",
+                "L": args.L,
+                "members": pack,
+                "metric": f"pack{pack}_c{args.clients}",
+                "t": artifacts.utc_stamp(),
+                "slo_s": args.slo_s,
+                **m,
+            }
+            artifacts.append_row(out, row)
+            print(json.dumps(row))
+            if m["p99_request_to_first_step_ms"] is not None:
+                worst_p99 = max(
+                    worst_p99, m["p99_request_to_first_step_ms"]
+                )
+            if pack == factors[0] and rnd == 0:
+                base_tput = m["agg_cell_updates_per_s"]
+            last_tput = m["agg_cell_updates_per_s"]
+
+    print(
+        f"serve_bench: {args.clients} clients, factors {factors}: "
+        f"worst p99 request-to-first-step "
+        f"{worst_p99:.0f}ms (SLO {args.slo_s * 1e3:.0f}ms), "
+        f"aggregate {base_tput} -> {last_tput} cell-updates/s "
+        f"across the packing sweep -> {out}",
+        file=sys.stderr,
+    )
+    if worst_p99 > args.slo_s * 1e3:
+        print(
+            f"serve_bench: FAIL — p99 {worst_p99:.0f}ms exceeds the "
+            f"{args.slo_s * 1e3:.0f}ms SLO", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,7 @@ def run_once(
     n_devices: Optional[int] = None,
     seed: int = 0,
     context=None,
+    sim_factory=None,
 ):
     """One supervised-or-not simulation attempt.
 
@@ -182,6 +183,14 @@ def run_once(
     plan + journal across attempts, degradation provenance); standalone
     runs build their own from the environment. Raises on failure —
     classification and recovery live in the supervisor, not here.
+
+    ``sim_factory`` (optional) supplies the Simulation instead of the
+    constructors below — the warm-ensemble seam the serve worker fleet
+    uses (``serve/worker.py``): a factory can hand back an
+    already-compiled :class:`~.ensemble.engine.EnsembleSimulation`
+    rebound to this launch's members (``repack``), so a packed batch
+    pays zero recompilation. Called as
+    ``sim_factory(settings, n_devices=..., seed=...)``.
     """
     from .resilience.faults import (
         FaultPlan,
@@ -221,7 +230,7 @@ def run_once(
         return _run_once_inner(
             settings, n_devices=n_devices, seed=seed, context=context,
             plan=plan, journal=journal, guard=guard, wd=wd,
-            shutdown=shutdown,
+            shutdown=shutdown, sim_factory=sim_factory,
         )
     except BaseException as exc:
         # A watchdog expiry unwinds as KeyboardInterrupt (the monitor's
@@ -261,6 +270,7 @@ def _run_once_inner(
     guard,
     wd,
     shutdown,
+    sim_factory=None,
 ):
     import jax
 
@@ -298,7 +308,11 @@ def _run_once_inner(
 
     _mark("compile")
     ens = getattr(settings, "ensemble", None)
-    if ens is not None:
+    if sim_factory is not None:
+        # The serve worker's warm-ensemble seam: the factory may hand
+        # back an already-compiled engine rebound to this launch.
+        sim = sim_factory(settings, n_devices=n_devices, seed=seed)
+    elif ens is not None:
         # Batched ensemble run (docs/ENSEMBLE.md): one compiled launch
         # advances every member; stores are member-indexed.
         from .ensemble.engine import EnsembleSimulation
@@ -725,7 +739,9 @@ def _run_once_inner(
             pipe.close()
 
         elapsed = time.perf_counter() - t0
-        members = ens.n if ens is not None else 1
+        # Idle pack slots never count toward the work actually served
+        # (docs/SERVICE.md): only ACTIVE members scale the aggregate.
+        members = ens.active_n if ens is not None else 1
         cells = settings.L**3 * (settings.steps - restart_step) * members
         if ens is not None:
             log.info(

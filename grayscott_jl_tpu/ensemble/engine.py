@@ -63,11 +63,18 @@ class EnsembleFieldSnapshot(FieldSnapshot):
     """A member-stacked snapshot: blocks carry a leading member axis
     and the health probe resolves per member."""
 
+    #: Per-slot activity mask stamped by
+    #: :meth:`EnsembleSimulation.snapshot_async`; None = every slot is
+    #: a real member. Idle pack slots (docs/SERVICE.md) are excluded
+    #: from health/numerics aggregation but still resolve per index.
+    member_active = None
+
     def health_report(self):
         """Per-member :class:`~..resilience.health.EnsembleHealthReport`
         (or None) — each member's fused isfinite+range probe, so one
         diverging member is attributed by index instead of anonymously
-        aborting the whole sweep."""
+        aborting the whole sweep. Idle pack slots are masked out of the
+        aggregate verdict and the bad-member attribution."""
         if self._health is None:
             return None
         from ..resilience.health import EnsembleHealthReport, HealthReport
@@ -80,13 +87,14 @@ class EnsembleFieldSnapshot(FieldSnapshot):
                 names=self.field_names,
             )
             for i in range(finite.shape[0])
-        ))
+        ), active=self.member_active)
 
     def numerics_report(self):
         """Per-member numerics statistics aggregated into one
         :class:`~..obs.numerics.NumericsReport` (``members`` carries
-        the per-member rows; ``fields`` the cross-member aggregate) —
-        the same attribution shape as the per-member health probe."""
+        the per-member rows; ``fields`` the cross-member aggregate over
+        the ACTIVE slots) — the same attribution shape as the
+        per-member health probe."""
         if self._numerics is None:
             return None
         from ..obs import numerics as obs_numerics
@@ -98,7 +106,9 @@ class EnsembleFieldSnapshot(FieldSnapshot):
             ).fields
             for i in range(vals[0].shape[0])
         ]
-        return obs_numerics.NumericsReport.aggregate_members(members)
+        return obs_numerics.NumericsReport.aggregate_members(
+            members, active=self.member_active
+        )
 
 
 def member_blocks(blocks, member: int, member_offset: int = 0):
@@ -146,7 +156,20 @@ class EnsembleSimulation(Simulation):
         self.n_members = ens.n
         self.member_shards = int(ens.member_shards)
         self.member_seeds = ensemble_spec.resolve_seeds(ens, seed)
+        #: Per-slot activity mask (None = all real): idle pack slots
+        #: (docs/SERVICE.md) advance inside the same compiled program
+        #: but write no stores and never pollute health attribution or
+        #: the aggregate cell-updates/s.
+        self.member_active = (
+            None if all(ens.active) else tuple(ens.active)
+        )
         super().__init__(settings, n_devices=n_devices, seed=seed)
+
+    @property
+    def active_member_count(self) -> int:
+        """Real (non-idle) members — the count aggregate throughput
+        and the driver's completion line are scaled by."""
+        return self.ens.active_n
 
     # ------------------------------------------------- construction hooks
 
@@ -249,7 +272,17 @@ class EnsembleSimulation(Simulation):
             ).fields
             for i in range(vals[0].shape[0])
         ]
-        return obs_numerics.NumericsReport.aggregate_members(members)
+        return obs_numerics.NumericsReport.aggregate_members(
+            members, active=self.member_active
+        )
+
+    def snapshot_async(self, **kw):
+        """Member-stacked snapshot with the activity mask stamped on,
+        so the health/numerics resolution downstream (async writer
+        thread, health guard) knows which slots are real members."""
+        snap = super().snapshot_async(**kw)
+        snap.member_active = self.member_active
+        return snap
 
     # ------------------------------------------------------------ fields
 
@@ -402,6 +435,67 @@ class EnsembleSimulation(Simulation):
         self.fields = (
             self.fields[:i] + (poisoned,) + self.fields[i + 1:]
         )
+
+    # ------------------------------------------------------------ repack
+
+    def repack(self, settings: Settings, *, seed: int = 0) -> None:
+        """Rebind this (already-compiled) ensemble to a NEW member set
+        — the warm-launch seam the serve scheduler packs requests onto
+        (docs/SERVICE.md).
+
+        Member parameters, PRNG keys, and seeds are runtime *inputs* of
+        the compiled step program (``_make_params`` stacks them as
+        arrays the jitted runner takes as arguments), so a batch with
+        the same shape signature — member count, member_shards, model,
+        L, precision, halo/overlap schedule, and noise tracing — reuses
+        every cached executable in ``self._runners`` with zero
+        recompilation. Anything that would change the traced program is
+        refused loudly; the caller (``serve/worker.py``) keys its warm
+        cache so that never happens in practice.
+        """
+        ens = getattr(settings, "ensemble", None)
+        if ens is None:
+            raise ValueError("repack needs settings.ensemble")
+        if ens.n != self.n_members or int(ens.member_shards) != (
+            self.member_shards
+        ):
+            raise ValueError(
+                f"repack shape mismatch: compiled for "
+                f"{self.n_members} members x {self.member_shards} "
+                f"shards, got {ens.n} x {ens.member_shards}"
+            )
+        if ens.model != self.ens.model:
+            raise ValueError(
+                f"repack model mismatch: compiled for "
+                f"{self.ens.model!r}, got {ens.model!r}"
+            )
+        if settings.L != self.settings.L:
+            raise ValueError(
+                f"repack L mismatch: compiled for L={self.settings.L}, "
+                f"got L={settings.L}"
+            )
+        old_ens = self.ens
+        self.ens = ens
+        if self._resolve_use_noise() != self.use_noise:
+            self.ens = old_ens
+            raise ValueError(
+                "repack noise-tracing mismatch: the compiled program "
+                f"{'draws' if self.use_noise else 'draws no'} noise; "
+                "pack batches keyed by noise as serve/scheduler does"
+            )
+        self.settings = settings
+        self.n_members = ens.n
+        self.member_seeds = ensemble_spec.resolve_seeds(ens, seed)
+        self.member_active = (
+            None if all(ens.active) else tuple(ens.active)
+        )
+        self.params = self._make_params()
+        self.base_key = self._make_base_key(seed)
+        self.fields = self._init_fields()
+        self.step = 0
+        # Per-launch provenance: a previous batch's elastic-restore
+        # plan must not leak into the next batch's RunStats.
+        self.reshard = None
 
     # ----------------------------------------------------------- restore
 

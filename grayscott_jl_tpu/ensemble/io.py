@@ -108,23 +108,29 @@ class EnsembleStream:
         from ..io.stream import SimStream
 
         self.n = settings.ensemble.n
-        self.members: List[SimStream] = [
+        # Idle pack slots (docs/SERVICE.md) get NO stores at all: a
+        # padded member must leave zero filesystem footprint — the
+        # member==solo byte-identity contract is about real members.
+        self.members: List[Optional[SimStream]] = [
             SimStream(
                 member_settings(settings, i), domain, dtype,
                 writer_id=writer_id, nwriters=nwriters,
                 resume_step=resume_step,
             )
+            if settings.ensemble.members[i].active else None
             for i in range(self.n)
         ]
 
     def write_step(self, step: int, blocks) -> None:
         blocks = list(blocks)
         for i, stream in enumerate(self.members):
-            stream.write_step(step, member_blocks(blocks, i))
+            if stream is not None:
+                stream.write_step(step, member_blocks(blocks, i))
 
     def close(self) -> None:
         for stream in self.members:
-            stream.close()
+            if stream is not None:
+                stream.close()
 
 
 class EnsembleCheckpointWriter:
@@ -146,23 +152,28 @@ class EnsembleCheckpointWriter:
         # The SAME (spatial) layout record goes to every member store —
         # it is exactly what an equivalent solo run would write, which
         # preserves the member==solo store byte-identity contract.
-        self.members: List[CheckpointWriter] = [
+        # Idle pack slots checkpoint nothing (their restore action is
+        # re-initialization, reshard/plan.member_map).
+        self.members: List[Optional[CheckpointWriter]] = [
             CheckpointWriter(
                 member_settings(settings, i), dtype,
                 writer_id=writer_id, nwriters=nwriters,
                 resume_step=resume_step, layout=layout,
             )
+            if settings.ensemble.members[i].active else None
             for i in range(self.n)
         ]
 
     def save(self, step: int, blocks) -> None:
         blocks = list(blocks)
         for i, writer in enumerate(self.members):
-            writer.save(step, member_blocks(blocks, i))
+            if writer is not None:
+                writer.save(step, member_blocks(blocks, i))
 
     def close(self) -> None:
         for writer in self.members:
-            writer.close()
+            if writer is not None:
+                writer.close()
 
 
 def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
@@ -199,14 +210,21 @@ def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
     from ..reshard.restore import layout_of
 
     n = settings.ensemble.n
+    active = settings.ensemble.active
+    # Idle pack slots never wrote a store and never will: their restore
+    # action is re-initialization, not a selection read.
     latest = [
         latest_durable_step(member_path(settings.restart_input, i, n))
+        if active[i] else None
         for i in range(n)
     ]
-    mapping = plan_mod.member_map([s is not None for s in latest], n)
+    mapping = plan_mod.member_map(
+        [s is not None for s in latest], n, active=active
+    )
     restored = [i for action, i in mapping if action == "restore"]
     grown = [i for action, i in mapping if action == "init"]
-    if grown and allow == "off":
+    grown_real = [i for i in grown if active[i]]
+    if grown_real and allow == "off":
         raise plan_mod.ReshardError(
             f"resuming {len(restored)} checkpointed members as {n} "
             "(ensemble grow) is an elastic resume and reshard='off' "
@@ -239,10 +257,14 @@ def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
     plan = plan_mod.plan_restore(
         old, layout_of(sim), L=settings.L, allow=allow
     )
-    members = {"restored": len(restored), "grown": len(grown),
+    members = {"restored": len(restored), "grown": len(grown_real),
                "new_n": n}
+    idle = n - sum(1 for a in active if a)
+    if idle:
+        members["idle"] = idle
     plan = _dc.replace(
-        plan, members=members, changed=plan.changed or bool(grown)
+        plan, members=members,
+        changed=plan.changed or bool(grown_real),
     )
     sim.restore_members(blocks, want)
     return want, plan

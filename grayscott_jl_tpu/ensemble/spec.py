@@ -117,6 +117,14 @@ class MemberSpec:
     values: Tuple[Tuple[str, float], ...]
     seed: Optional[int] = None
     name: str = ""
+    #: False marks an IDLE pack slot (``serve/scheduler.py`` pads a
+    #: partially-filled batch up to a canonical executable shape so the
+    #: warm-compile cache stays warm): the member still advances inside
+    #: the vmapped launch (one program for all slots), but it writes no
+    #: stores, is excluded from health attribution and from the
+    #: aggregate cell-updates/s, and restores by re-initialization.
+    #: TOML-declared members are always active.
+    active: bool = True
 
     def params(self) -> Dict[str, float]:
         return dict(self.values)
@@ -143,6 +151,8 @@ class MemberSpec:
             d["seed"] = self.seed
         if self.name:
             d["name"] = self.name
+        if not self.active:
+            d["idle"] = True
         return d
 
 
@@ -160,10 +170,23 @@ class EnsembleSettings:
     def n(self) -> int:
         return len(self.members)
 
+    @property
+    def active(self) -> Tuple[bool, ...]:
+        """Per-slot activity mask (``MemberSpec.active``); idle pack
+        slots (scheduler padding) read False."""
+        return tuple(m.active for m in self.members)
+
+    @property
+    def active_n(self) -> int:
+        """Real members only — what health attribution and aggregate
+        throughput are scaled by; idle pack slots never count."""
+        return sum(1 for m in self.members if m.active)
+
     def describe(self) -> dict:
         return {
             "model": self.model,
             "members": self.n,
+            "active_members": self.active_n,
             "member_shards": self.member_shards,
             "params": [m.describe() for m in self.members],
         }

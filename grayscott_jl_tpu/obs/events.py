@@ -23,10 +23,18 @@ Contract: emitting is best-effort — a full disk under the event stream
 marks the stream broken and keeps the run alive (the journal, which IS
 allowed to fail loudly, still records). stdlib only; importable
 without JAX.
+
+In-process consumers (the serve front door's SSE fan-out and job
+tracker, ``serve/``, docs/SERVICE.md) read the SAME records live via
+:meth:`EventStream.subscribe` — no second telemetry path — and
+:func:`bound` stamps thread-local attrs (e.g. the serve batch id) onto
+every record the calling thread emits, so a multi-tenant process can
+attribute interleaved runs' events without touching the emitters.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -39,12 +47,32 @@ from .trace import _proc_index, rank_path
 __all__ = [
     "EventStream",
     "NULL_EVENTS",
+    "bound",
     "get_events",
     "parse_events",
     "parse_events_multi",
     "rank_files",
     "reset_events",
 ]
+
+#: Thread-local attrs merged into every record the thread emits while
+#: inside a :func:`bound` block (explicit emit attrs win on collision).
+_BOUND = threading.local()
+
+
+@contextlib.contextmanager
+def bound(**attrs):
+    """Bind default attrs to every event THIS thread emits inside the
+    block — the serve worker runs a whole batch launch under
+    ``bound(batch=...)`` so the driver's lifecycle records
+    (run_start/output/run_complete) carry the batch id without the
+    driver knowing the service exists. Nests; inner bindings win."""
+    prev = getattr(_BOUND, "attrs", None)
+    _BOUND.attrs = {**(prev or {}), **attrs}
+    try:
+        yield
+    finally:
+        _BOUND.attrs = prev
 
 #: The flat record fields; everything else an emitter passes rides in
 #: ``attrs`` so readers can rely on the top-level shape.
@@ -59,6 +87,10 @@ class _NullEventStream:
 
     def emit(self, kind, phase=None, step=None, **attrs):
         return None
+
+    def subscribe(self, fn):
+        """No events will ever flow; the unsubscribe is a no-op."""
+        return lambda: None
 
     def describe(self) -> dict:
         return {"enabled": False}
@@ -79,14 +111,36 @@ class EventStream:
         self.emitted = 0
         self.broken: Optional[str] = None
         self._lock = threading.Lock()
+        self._subscribers: List = []
+
+    def subscribe(self, fn):
+        """Register an in-process consumer: ``fn(record)`` is called
+        (on the emitting thread — keep it cheap, e.g. a queue put) for
+        every event AFTER it is written. Returns the unsubscribe
+        callable. Subscriber exceptions are swallowed: a slow or dead
+        SSE client must never take the run down, same contract as the
+        file sink."""
+        self._subscribers.append(fn)
+
+        def _unsubscribe():
+            try:
+                self._subscribers.remove(fn)
+            except ValueError:
+                pass
+
+        return _unsubscribe
 
     def emit(self, kind, phase=None, step=None, **attrs):
         """Record one event; returns the record dict (or None once the
         stream is broken). Thread-safe — called from the driver thread,
         the async writer's worker, the watchdog monitor (via the
-        journal), and signal handlers."""
+        journal), and signal handlers. Thread-bound attrs
+        (:func:`bound`) merge in under the explicit ones."""
         if self.broken is not None:
             return None
+        tl = getattr(_BOUND, "attrs", None)
+        if tl:
+            attrs = {**tl, **attrs}
         event = {
             "ts": round(time.time(), 6),
             "proc": self.proc,
@@ -115,6 +169,11 @@ class EventStream:
                   f"failed ({self.broken}); further events are dropped",
                   file=sys.stderr)
             return None
+        for fn in list(self._subscribers):
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — consumer must not kill the run
+                pass
         return event
 
     def describe(self) -> dict:

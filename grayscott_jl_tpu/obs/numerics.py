@@ -163,12 +163,21 @@ class NumericsReport:
         self.members = members
 
     @classmethod
-    def aggregate_members(cls, members: List[Dict[str, dict]]
-                          ) -> "NumericsReport":
+    def aggregate_members(cls, members: List[Dict[str, dict]],
+                          active=None) -> "NumericsReport":
+        """Cross-member aggregate; ``members`` keeps every slot's rows
+        for per-index attribution, while ``active`` (an optional bool
+        mask) excludes IDLE pack slots (docs/SERVICE.md) from the
+        aggregate statistics — padding must not perturb the drift
+        signal real members are gated by."""
+        live = (
+            members if active is None or all(active)
+            else [m for i, m in enumerate(members) if active[i]]
+        )
         names = list(members[0])
         agg = {}
         for name in names:
-            rows = [m[name] for m in members]
+            rows = [m[name] for m in live]
             agg[name] = {
                 "min": min(r["min"] for r in rows),
                 "max": max(r["max"] for r in rows),

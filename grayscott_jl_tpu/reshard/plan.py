@@ -285,7 +285,8 @@ def plan_restore(
 
 
 def member_map(
-    present: Sequence[bool], new_n: int
+    present: Sequence[bool], new_n: int,
+    active: Optional[Sequence[bool]] = None,
 ) -> List[Tuple[str, int]]:
     """The elastic ensemble member plan: ``[("restore"|"init", i)]``
     for each of the ``new_n`` members of the resuming run.
@@ -298,16 +299,32 @@ def member_map(
     present one — is a loud :class:`ReshardError`: that is a lost or
     corrupt member, not a grow, and silently re-initializing it would
     fork the ensemble's history.
+
+    ``active`` masks IDLE pack slots (``serve/scheduler.py`` padding,
+    docs/SERVICE.md): an idle slot deliberately wrote no store, so its
+    absence is never a gap and its action is always ``"init"`` — a
+    requeued packed batch resumes its real members from the store
+    quorum while the padding just re-initializes.
     """
-    present = [bool(p) for p in present[:new_n]]
-    if not any(present):
+    present_l = [bool(p) for p in present[:new_n]]
+    present_l += [False] * (new_n - len(present_l))
+    if active is None:
+        active_l = [True] * new_n
+    else:
+        active_l = [bool(a) for a in list(active)[:new_n]]
+        active_l += [True] * (new_n - len(active_l))
+    eff = [p and a for p, a in zip(present_l, active_l)]
+    if not any(eff):
         raise ReshardError(
             "no member checkpoint store holds a durable step — nothing "
             "to resume (delete restart=true to start from scratch)"
         )
-    n_restore = sum(present)
-    if present[:n_restore] != [True] * n_restore:
-        missing = [i for i, p in enumerate(present) if not p]
+    last_present = max(i for i, e in enumerate(eff) if e)
+    missing = [
+        i for i in range(last_present)
+        if active_l[i] and not present_l[i]
+    ]
+    if missing:
         raise ReshardError(
             f"member checkpoint stores {missing} are missing or hold no "
             f"durable step while later members exist — a gap is a lost "
@@ -315,6 +332,6 @@ def member_map(
             "ensemble back"
         )
     return [
-        ("restore" if i < n_restore else "init", i)
+        ("restore" if eff[i] else "init", i)
         for i in range(new_n)
     ]

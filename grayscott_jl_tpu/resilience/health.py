@@ -182,17 +182,34 @@ class EnsembleHealthReport(NamedTuple):
     (:attr:`bad_members`) in the health report, the ``HealthError``
     message, and the FaultJournal event, instead of anonymously
     aborting a 64-member sweep.
+
+    ``active`` masks IDLE pack slots (``serve/scheduler.py`` pads a
+    partially-filled batch; docs/SERVICE.md): an idle slot's probe
+    result never pollutes the aggregate verdict, the ranges, or the
+    bad-member attribution — a padded member blowing up is a
+    non-event, a real member blowing up still names its index. None
+    (the solo-ensemble default) means every slot is real.
     """
 
     members: tuple  # of HealthReport
+    active: Optional[tuple] = None  # of bool, None = all active
+
+    def _active(self, i: int) -> bool:
+        return self.active is None or bool(self.active[i])
+
+    @property
+    def active_members(self) -> list:
+        return [m for i, m in enumerate(self.members)
+                if self._active(i)]
 
     @property
     def finite(self) -> bool:
-        return all(m.finite for m in self.members)
+        return all(m.finite for m in self.active_members)
 
     @property
     def bad_members(self) -> list:
-        return [i for i, m in enumerate(self.members) if not m.finite]
+        return [i for i, m in enumerate(self.members)
+                if self._active(i) and not m.finite]
 
     # Aggregate ranges so single-report consumers (log lines, the
     # HealthError message core) read an ensemble report transparently.
@@ -202,29 +219,30 @@ class EnsembleHealthReport(NamedTuple):
 
     @property
     def ranges(self) -> tuple:
+        live = self.active_members
         return tuple(
             (
-                min(m.ranges[i][0] for m in self.members),
-                max(m.ranges[i][1] for m in self.members),
+                min(m.ranges[i][0] for m in live),
+                max(m.ranges[i][1] for m in live),
             )
             for i in range(len(self.members[0].ranges))
         )
 
     @property
     def u_min(self) -> float:
-        return min(m.u_min for m in self.members)
+        return min(m.u_min for m in self.active_members)
 
     @property
     def u_max(self) -> float:
-        return max(m.u_max for m in self.members)
+        return max(m.u_max for m in self.active_members)
 
     @property
     def v_min(self) -> float:
-        return min(m.v_min for m in self.members)
+        return min(m.v_min for m in self.active_members)
 
     @property
     def v_max(self) -> float:
-        return max(m.v_max for m in self.members)
+        return max(m.v_max for m in self.active_members)
 
     def range_summary(self) -> str:
         return ", ".join(
@@ -233,7 +251,7 @@ class EnsembleHealthReport(NamedTuple):
         )
 
     def describe(self) -> dict:
-        return {
+        out = {
             "finite": self.finite,
             "members": len(self.members),
             "bad_members": self.bad_members,
@@ -242,6 +260,9 @@ class EnsembleHealthReport(NamedTuple):
                 for n, (lo, hi) in zip(self.names, self.ranges)
             },
         }
+        if self.active is not None and not all(self.active):
+            out["active_members"] = len(self.active_members)
+        return out
 
 
 class HealthError(RuntimeError):
@@ -330,8 +351,11 @@ class HealthGuard:
         members = getattr(report, "members", None)
         if members is not None:
             bad = report.bad_members
+            active = getattr(report, "active", None)
             metrics.gauge("ensemble_members_bad").set(len(bad))
             for i, m in enumerate(members):
+                if active is not None and not active[i]:
+                    continue  # idle pack slot: not a real member
                 metrics.gauge(
                     "ensemble_member_finite", member=str(i)
                 ).set(int(m.finite))

@@ -377,7 +377,8 @@ def _apply_resume(settings, resume: Optional[int], actions: list) -> None:
             actions.append("restarted_from_configured_checkpoint")
 
 
-def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
+def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
+              sim_factory=None):
     """Run ``driver.run_once`` under the restart loop; returns the
     completed attempt's :class:`~..simulation.Simulation`.
 
@@ -385,7 +386,10 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
     kernel language) — the supervisor owns the run's lifecycle, and the
     final settings describe how the run actually finished. Multi-host
     runs agree on every restart through :mod:`.rendezvous` (cluster-max
-    attempt counter, cluster-min checkpoint quorum).
+    attempt counter, cluster-min checkpoint quorum). ``sim_factory``
+    passes through to ``run_once`` (the serve worker fleet's
+    warm-ensemble seam, ``serve/worker.py``) — every restart attempt
+    asks the factory again, so a warm engine is rebound per attempt.
     """
     from ..driver import run_once
     from ..utils.log import Logger
@@ -470,7 +474,8 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0):
         )
         try:
             return run_once(
-                settings, n_devices=n_devices, seed=seed, context=ctx
+                settings, n_devices=n_devices, seed=seed, context=ctx,
+                sim_factory=sim_factory,
             )
         except BaseException as exc:  # noqa: BLE001 — classify, then re-raise
             if isinstance(exc, GracefulShutdown):

@@ -1,0 +1,30 @@
+"""Simulation-as-a-service: the persistent multi-tenant front door.
+
+Everything below this package turns one CLI launch into one job; this
+package turns a long-lived process into a *service* (ROADMAP item 4,
+docs/SERVICE.md): clients submit JSON job specs over HTTP, the
+scheduler packs compatible requests — keyed by ``(model, L, mesh,
+dtype, halo_depth, ...)`` — onto **warm batched ensembles** (the
+vmapped member axis from the ensemble engine IS the batcher: a request
+is just a member), a supervised worker fleet runs the launches through
+the unchanged resilience stack, and progress streams back to clients
+off the existing GS_EVENTS stream and metrics registry — no second
+telemetry path.
+
+Layering: ``protocol`` and ``scheduler`` are stdlib-only and JAX-free
+to import (like ``config/`` and ``obs/``); ``worker`` pulls in the
+engine lazily at launch time; ``server`` is the stdlib
+``http.server`` front. The scheduler's admission control (queue depth,
+per-tenant quotas, size caps) and the worker's requeue path (a killed
+worker's in-flight members resume from their member-store quorum step,
+``ensemble/io.restore_ensemble`` + ``reshard/plan``) are what make the
+process safe to leave running.
+"""
+
+from .protocol import JobSpec, pack_key, parse_job  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Job,
+    Scheduler,
+    ServeConfig,
+    resolve_serve_config,
+)

@@ -1,0 +1,573 @@
+"""Admission control, per-tenant quotas, priorities, and request packing.
+
+The scheduler is the queue between the HTTP front door
+(``serve/server.py``) and the worker fleet (``serve/worker.py``). Its
+one structural idea (ROADMAP item 4): a request is just a MEMBER of a
+batched ensemble, so "batching" is not a new execution path — the
+scheduler groups compatible requests (same :func:`~.protocol.pack_key`)
+into one ``[ensemble]``-shaped launch, pads the batch up to a
+canonical power-of-two slot count so the worker's warm engine cache
+stays warm (idle slots are masked: no stores, no health/stats
+pollution — ``ensemble/spec.MemberSpec.active``), and the ensemble
+engine does the rest.
+
+Admission control happens at submit time, loudly:
+
+* spec validation (``protocol.parse_job``) raises ``SettingsError``
+  -> HTTP 400 with the message;
+* a full queue (``GS_SERVE_QUEUE_DEPTH``) or an exhausted per-tenant
+  quota (``GS_SERVE_TENANT_QUOTA``) records a REJECTED job (so the
+  client can still query why) and emits ``job_rejected`` -> HTTP 429.
+
+Every lifecycle edge lands on the unified GS_EVENTS stream
+(``job_submitted`` / ``job_packed`` / ``job_requeued`` /
+``job_complete`` / ``job_rejected``; schema in
+``scripts/gs_report.py``) and in the shared metrics registry — the
+service invents no second telemetry path (docs/SERVICE.md).
+
+Stdlib-only and JAX-free to import; thread-safe (the HTTP handler
+threads, the worker threads, and the event subscriber all call in).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config.env import env_flag, env_float, env_int, env_str
+from ..models.base import SettingsError
+from . import protocol
+
+__all__ = [
+    "Batch",
+    "Job",
+    "JOB_STATES",
+    "Scheduler",
+    "ServeConfig",
+    "resolve_serve_config",
+]
+
+#: Lifecycle states a job can be in (``Job.state``).
+JOB_STATES = (
+    "queued", "packed", "running", "complete", "failed", "cancelled",
+    "rejected",
+)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Resolved service configuration (the ``GS_SERVE_*`` knob family,
+    docs/SERVICE.md)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8642
+    workers: int = 1
+    queue_depth: int = 256
+    tenant_quota: int = 32
+    pack_max: int = 8
+    pack_window_s: float = 0.05
+    slo_s: float = 60.0
+    max_l: int = 256
+    max_steps: int = 100_000
+    state_dir: str = "serve-state"
+    supervise: bool = True
+    max_requeues: int = 2
+    chaos: str = ""
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def resolve_serve_config(settings=None) -> ServeConfig:
+    """The ``GS_SERVE_*`` env knobs -> :class:`ServeConfig`.
+
+    Env-only (there is no ``[serve]`` TOML table yet: the service is
+    launched by ``scripts/gs_serve.py``, not by a simulation config);
+    defaults match the dataclass. ``GS_SERVE_CHAOS`` arms a
+    consume-once fault-plan string (``resilience/faults.py`` syntax)
+    applied to the FIRST batch launched — the worker-kill chaos hook
+    ``scripts/chaos_smoke.sh`` scenario 6 drives.
+    """
+    cfg = ServeConfig(
+        host=env_str("GS_SERVE_HOST", "127.0.0.1"),
+        port=env_int("GS_SERVE_PORT", 8642),
+        workers=env_int("GS_SERVE_WORKERS", 1),
+        queue_depth=env_int("GS_SERVE_QUEUE_DEPTH", 256),
+        tenant_quota=env_int("GS_SERVE_TENANT_QUOTA", 32),
+        pack_max=env_int("GS_SERVE_PACK_MAX", 8),
+        pack_window_s=env_float("GS_SERVE_PACK_WINDOW_S", 0.05),
+        slo_s=env_float("GS_SERVE_SLO_S", 60.0),
+        max_l=env_int("GS_SERVE_MAX_L", 256),
+        max_steps=env_int("GS_SERVE_MAX_STEPS", 100_000),
+        state_dir=env_str("GS_SERVE_STATE_DIR", "serve-state"),
+        supervise=env_flag("GS_SERVE_SUPERVISE", True),
+        max_requeues=env_int("GS_SERVE_MAX_REQUEUES", 2),
+        chaos=env_str("GS_SERVE_CHAOS", ""),
+    )
+    if cfg.workers < 1:
+        raise ValueError(f"GS_SERVE_WORKERS must be >= 1, got {cfg.workers}")
+    if cfg.pack_max < 1:
+        raise ValueError(f"GS_SERVE_PACK_MAX must be >= 1, got {cfg.pack_max}")
+    if cfg.queue_depth < 1:
+        raise ValueError(
+            f"GS_SERVE_QUEUE_DEPTH must be >= 1, got {cfg.queue_depth}"
+        )
+    if cfg.tenant_quota < 1:
+        raise ValueError(
+            f"GS_SERVE_TENANT_QUOTA must be >= 1, got {cfg.tenant_quota}"
+        )
+    if cfg.pack_window_s < 0:
+        raise ValueError(
+            f"GS_SERVE_PACK_WINDOW_S must be >= 0, got {cfg.pack_window_s}"
+        )
+    return cfg
+
+
+class AdmissionError(Exception):
+    """A structurally valid job the service refuses to queue (full
+    queue, exhausted tenant quota, drain). Carries the rejected
+    :class:`Job` record so the HTTP layer can return its id."""
+
+    def __init__(self, job: "Job", reason: str):
+        super().__init__(reason)
+        self.job = job
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Job:
+    """One request's full lifecycle record."""
+
+    id: str
+    tenant: str
+    spec: protocol.JobSpec
+    state: str = "queued"
+    seq: int = 0
+    batch_id: Optional[str] = None
+    slot: Optional[int] = None
+    attempts: int = 0
+    error: Optional[str] = None
+    submitted_t: float = 0.0
+    packed_t: Optional[float] = None
+    started_t: Optional[float] = None
+    first_step_t: Optional[float] = None
+    finished_t: Optional[float] = None
+    store: Optional[str] = None
+    checkpoint_store: Optional[str] = None
+
+    def describe(self) -> dict:
+        out = {
+            "job": self.id,
+            "tenant": self.tenant,
+            "state": self.state,
+            "priority": self.spec.priority,
+            "model": self.spec.model,
+            "L": self.spec.L,
+            "steps": self.spec.steps,
+            "batch": self.batch_id,
+            "slot": self.slot,
+            "attempts": self.attempts,
+            "error": self.error,
+            "submitted_t": self.submitted_t,
+            "packed_t": self.packed_t,
+            "started_t": self.started_t,
+            "first_step_t": self.first_step_t,
+            "finished_t": self.finished_t,
+            "store": self.store,
+        }
+        if self.first_step_t is not None:
+            out["request_to_first_step_s"] = round(
+                self.first_step_t - self.submitted_t, 6
+            )
+        return out
+
+
+@dataclasses.dataclass
+class Batch:
+    """One packed launch: the jobs riding it (slot order) plus the
+    launch Settings the worker hands to the driver."""
+
+    id: str
+    jobs: List[Job]
+    key: Tuple
+    n_slots: int
+    settings: object  # config.settings.Settings
+    dir: str
+    supervise: bool = True
+    attempt: int = 0
+    warm: bool = False
+    created_t: float = 0.0
+
+    @property
+    def job_ids(self) -> List[str]:
+        return [j.id for j in self.jobs]
+
+
+def _pow2_slots(n: int, cap: int) -> int:
+    """Canonical slot count: the smallest power of two >= n, capped at
+    the pack limit — so a 3-job batch runs the same executable shape
+    as a 4-job one and the worker's warm cache keeps hitting."""
+    slots = 1
+    while slots < n:
+        slots *= 2
+    return min(slots, max(cap, n))
+
+
+class Scheduler:
+    """The multi-tenant queue + packer (docs/SERVICE.md)."""
+
+    def __init__(self, cfg: ServeConfig, *, events=None, metrics=None):
+        self.cfg = cfg
+        if events is None:
+            from ..obs import events as obs_events
+
+            events = obs_events.get_events()
+        if metrics is None:
+            from ..obs import metrics as obs_metrics
+
+            metrics = obs_metrics.get_metrics()
+        self.events = events
+        self.metrics = metrics
+        self.jobs: Dict[str, Job] = {}
+        self.batches: Dict[str, Batch] = {}
+        self._queue: List[Job] = []  # pending, FIFO within priority
+        self._resume: List[Batch] = []  # requeued batches, FIFO
+        self._cond = threading.Condition()
+        # Launch nonce: job/batch ids must stay unique across service
+        # restarts appending to ONE events file, or the per-tenant
+        # report would merge two lives of "j000001" into nonsense.
+        self._nonce = os.urandom(3).hex()
+        self._seq = 0
+        self._batch_seq = 0
+        self._closed = False
+        self._chaos_pending = cfg.chaos.strip()
+        self._unsubscribe = None
+
+    # ------------------------------------------------------------ events
+
+    def attach_events(self):
+        """Subscribe to the unified event stream to track run progress
+        (``run_start`` -> running, first ``output``/``checkpoint`` ->
+        first-step timestamp) for batches carrying our batch-id bound
+        attr. Returns self for chaining; idempotent."""
+        if self._unsubscribe is None and self.events.enabled:
+            self._unsubscribe = self.events.subscribe(self._on_event)
+        return self
+
+    def detach_events(self):
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def _on_event(self, record: dict) -> None:
+        kind = record.get("kind")
+        if kind not in ("run_start", "output", "checkpoint",
+                        "run_complete"):
+            return
+        batch_id = (record.get("attrs") or {}).get("batch")
+        if not batch_id:
+            return
+        with self._cond:
+            batch = self.batches.get(batch_id)
+            if batch is None:
+                return
+            ts = record.get("ts") or time.time()
+            for job in batch.jobs:
+                if kind == "run_start" and job.state == "packed":
+                    job.state = "running"
+                    job.started_t = job.started_t or ts
+                elif kind in ("output", "checkpoint", "run_complete"):
+                    # The first evidence of completed compute: the SLO
+                    # clock's stop mark (docs/SERVICE.md, "SLO
+                    # definitions").
+                    if job.first_step_t is None and job.state in (
+                        "packed", "running",
+                    ):
+                        job.first_step_t = ts
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, payload) -> Job:
+        """Admit one client payload. Raises
+        :class:`~..models.base.SettingsError` on an invalid spec (HTTP
+        400) and :class:`AdmissionError` on a valid-but-refused one
+        (HTTP 429); otherwise returns the QUEUED job."""
+        spec = protocol.parse_job(
+            payload, max_l=self.cfg.max_l, max_steps=self.cfg.max_steps
+        )
+        with self._cond:
+            self._seq += 1
+            job = Job(
+                id=f"j{self._nonce}-{self._seq:05d}",
+                tenant=spec.tenant,
+                spec=spec,
+                seq=self._seq,
+                submitted_t=time.time(),
+            )
+            reason = self._admission_reason(job)
+            if reason is not None:
+                job.state = "rejected"
+                job.error = reason
+                job.finished_t = time.time()
+                self.jobs[job.id] = job
+                self.metrics.counter(
+                    "serve_jobs_rejected", reason=reason
+                ).inc()
+                self.events.emit(
+                    "job_rejected", job=job.id, tenant=job.tenant,
+                    reason=reason,
+                )
+                raise AdmissionError(job, reason)
+            self.jobs[job.id] = job
+            self._queue.append(job)
+            self._queue.sort(key=lambda j: (-j.spec.priority, j.seq))
+            self.metrics.counter("serve_jobs_submitted").inc()
+            self.metrics.gauge("serve_queue_depth").set(
+                len(self._queue)
+            )
+            self.events.emit(
+                "job_submitted", job=job.id, tenant=job.tenant,
+                priority=spec.priority, model=spec.model, L=spec.L,
+                steps=spec.steps,
+            )
+            self._cond.notify_all()
+            return job
+
+    def _admission_reason(self, job: Job) -> Optional[str]:
+        if self._closed:
+            return "shutting_down"
+        if len(self._queue) >= self.cfg.queue_depth:
+            return "queue_full"
+        live = sum(
+            1 for j in self.jobs.values()
+            if j.tenant == job.tenant
+            and j.state in ("queued", "packed", "running")
+        )
+        if live >= self.cfg.tenant_quota:
+            return "tenant_quota"
+        return None
+
+    # ------------------------------------------------------------ cancel
+
+    def cancel(self, job_id: str) -> bool:
+        """Cancel a QUEUED job (packed/running jobs are committed to a
+        launch and refuse — HTTP 409). True on success."""
+        with self._cond:
+            job = self.jobs.get(job_id)
+            if job is None or job.state != "queued":
+                return False
+            self._queue.remove(job)
+            job.state = "cancelled"
+            job.finished_t = time.time()
+            self.metrics.gauge("serve_queue_depth").set(
+                len(self._queue)
+            )
+            self.events.emit(
+                "job_complete", job=job.id, tenant=job.tenant,
+                status="cancelled",
+            )
+            return True
+
+    # ------------------------------------------------------------- pack
+
+    def next_batch(self, timeout: float = 0.5) -> Optional[Batch]:
+        """The worker-facing pop: a requeued batch if one is waiting,
+        else a freshly packed one. Blocks up to ``timeout`` for work,
+        then up to ``GS_SERVE_PACK_WINDOW_S`` more for compatible
+        requests to fill the batch — the latency/packing trade the SLO
+        budget pays for throughput."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cond:
+            while True:
+                if self._resume:
+                    return self._resume.pop(0)
+                if self._queue:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    return None
+                self._cond.wait(remaining)
+            head = self._queue[0]
+            key = protocol.pack_key(head.spec)
+            window_end = time.monotonic() + self.cfg.pack_window_s
+            while True:
+                compatible = [
+                    j for j in self._queue
+                    if protocol.pack_key(j.spec) == key
+                ]
+                if len(compatible) >= self.cfg.pack_max:
+                    break
+                remaining = window_end - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+                if head.state != "queued":
+                    # cancelled out from under us — restart the pop
+                    return self.next_batch(timeout=0.0)
+            jobs = compatible[: self.cfg.pack_max]
+            for j in jobs:
+                self._queue.remove(j)
+            self.metrics.gauge("serve_queue_depth").set(
+                len(self._queue)
+            )
+            return self._build_batch(jobs, key)
+
+    def _build_batch(self, jobs: List[Job], key: Tuple) -> Batch:
+        self._batch_seq += 1
+        batch_id = f"b{self._nonce}-{self._batch_seq:04d}"
+        n_slots = _pow2_slots(len(jobs), self.cfg.pack_max)
+        bdir = os.path.join(self.cfg.state_dir, "batches", batch_id)
+        os.makedirs(bdir, exist_ok=True)
+        settings = protocol.batch_settings(
+            [j.spec for j in jobs],
+            n_slots=n_slots,
+            output=os.path.join(bdir, "gs.bp"),
+            checkpoint_output=os.path.join(bdir, "ckpt.bp"),
+            names=[j.id for j in jobs],
+            supervise=self.cfg.supervise,
+        )
+        supervise = self.cfg.supervise
+        if self._chaos_pending:
+            # Consume-once worker-kill chaos (GS_SERVE_CHAOS,
+            # chaos_smoke scenario 6): the injected fault models the
+            # worker process dying, so the launch runs UNsupervised —
+            # recovery must come from the scheduler requeue, not from
+            # an in-place supervisor restart.
+            settings.faults = self._chaos_pending
+            settings.supervise = False
+            supervise = False
+            self._chaos_pending = ""
+        batch = Batch(
+            id=batch_id, jobs=jobs, key=key, n_slots=n_slots,
+            settings=settings, dir=bdir, supervise=supervise,
+            created_t=time.time(),
+        )
+        self.batches[batch_id] = batch
+        from ..ensemble.io import member_path
+
+        now = time.time()
+        for slot, job in enumerate(jobs):
+            job.state = "packed"
+            job.batch_id = batch_id
+            job.slot = slot
+            job.packed_t = now
+            job.attempts += 1
+            job.store = member_path(settings.output, slot, n_slots)
+            if settings.checkpoint:
+                job.checkpoint_store = member_path(
+                    settings.checkpoint_output, slot, n_slots
+                )
+            self.events.emit(
+                "job_packed", job=job.id, tenant=job.tenant,
+                batch=batch_id, slot=slot, members=len(jobs),
+                slots=n_slots,
+            )
+        self.metrics.histogram("serve_pack_members").observe(
+            float(len(jobs))
+        )
+        return batch
+
+    # ---------------------------------------------------------- requeue
+
+    def requeue(self, batch: Batch, fault: str) -> None:
+        """A worker died under this batch (or its launch failed with a
+        classified-recoverable fault): hand the WHOLE batch back to the
+        queue as a resume unit. The relaunching worker resumes every
+        member from the member-store checkpoint quorum
+        (``ensemble/io.restore_ensemble``) — or from scratch when no
+        checkpoint exists yet; either way the member stores finish
+        byte-identical to an uninterrupted run (docs/SERVICE.md)."""
+        with self._cond:
+            batch.attempt += 1
+            # The chaos fault plan is consume-once at SERVICE level
+            # (it modelled the worker that just died); a relaunch with
+            # the plan still armed would re-kill itself forever.
+            if getattr(batch.settings, "faults", ""):
+                batch.settings.faults = ""
+            for job in batch.jobs:
+                job.state = "packed"
+                job.attempts += 1
+                self.events.emit(
+                    "job_requeued", job=job.id, tenant=job.tenant,
+                    batch=batch.id, fault=fault,
+                    attempt=batch.attempt,
+                )
+            self.metrics.counter(
+                "serve_batches_requeued", fault=fault
+            ).inc()
+            self._resume.append(batch)
+            self._cond.notify_all()
+
+    # --------------------------------------------------------- complete
+
+    def complete(self, batch: Batch, *, ok: bool,
+                 error: Optional[str] = None,
+                 wall_s: Optional[float] = None) -> None:
+        """Worker-reported batch outcome -> per-job terminal states +
+        ``job_complete`` events."""
+        with self._cond:
+            now = time.time()
+            for job in batch.jobs:
+                job.state = "complete" if ok else "failed"
+                job.error = None if ok else error
+                job.finished_t = now
+                if job.first_step_t is None and ok:
+                    job.first_step_t = now
+                self.events.emit(
+                    "job_complete", job=job.id, tenant=job.tenant,
+                    batch=batch.id,
+                    status=job.state,
+                    wall_s=(
+                        round(wall_s, 3) if wall_s is not None else None
+                    ),
+                )
+                if ok and job.first_step_t is not None:
+                    self.metrics.histogram(
+                        "serve_request_to_first_step_ms"
+                    ).observe(
+                        (job.first_step_t - job.submitted_t) * 1e3
+                    )
+            self.metrics.counter(
+                "serve_batches_complete", ok=str(ok).lower()
+            ).inc()
+            self._cond.notify_all()
+
+    # ----------------------------------------------------------- status
+
+    def status(self, job_id: str) -> Optional[dict]:
+        with self._cond:
+            job = self.jobs.get(job_id)
+            return None if job is None else job.describe()
+
+    def drain(self) -> None:
+        """Stop admitting; queued jobs stay queued for workers to
+        finish. Submit rejects with ``shutting_down``."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def idle(self) -> bool:
+        """No queued work and no in-flight batches."""
+        with self._cond:
+            if self._queue or self._resume:
+                return False
+            return not any(
+                j.state in ("packed", "running")
+                for j in self.jobs.values()
+            )
+
+    def describe(self) -> dict:
+        with self._cond:
+            states: Dict[str, int] = {}
+            for j in self.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+            return {
+                "queued": len(self._queue),
+                "resume_batches": len(self._resume),
+                "jobs": states,
+                "batches": len(self.batches),
+                "config": self.cfg.describe(),
+            }

@@ -1,0 +1,206 @@
+"""The serve worker fleet: batches in, supervised launches out.
+
+Each worker is a thread looping ``scheduler.next_batch`` ->
+``driver.run_once`` (under ``resilience.supervisor.supervise`` when
+the batch is supervised — in-place restart of classified transient
+failures, exactly the solo CLI's resilience story). Two serve-specific
+pieces live here:
+
+**Warm ensembles.** Member parameters, seeds, and PRNG keys are
+runtime *inputs* of the compiled ensemble program, so a worker keeps
+one :class:`~..ensemble.engine.EnsembleSimulation` per executable
+shape (model x L x slots x precision x schedule — :func:`warm_key`)
+and rebinds it to each new batch via ``repack`` — the second batch of
+a shape pays ZERO recompilation. This is why the scheduler pads
+batches to canonical power-of-two slot counts. The cache is
+per-worker: compiled engines are never shared across threads.
+
+**Requeue on worker death.** A launch failure that escapes supervision
+(or a kill of the unsupervised kind — ``GS_SERVE_CHAOS`` models it) is
+classified with the supervisor's taxonomy and handed BACK to the
+scheduler as a batch-granular requeue: the relaunching worker resumes
+every member from the member-store checkpoint quorum
+(``ensemble/io.restore_ensemble`` + ``reshard/plan``; layout-agnostic,
+so the resuming worker may sit on a different slice shape), or from
+scratch when nothing durable exists yet — the member stores finish
+byte-identical to an uninterrupted run either way (asserted in tier-1
+and chaos_smoke scenario 6).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..utils.log import Logger
+from .scheduler import Batch, Scheduler, ServeConfig
+
+__all__ = ["WorkerFleet", "warm_key"]
+
+
+def warm_key(settings) -> Tuple:
+    """The executable-shape signature a warm engine can be rebound
+    across (everything ``EnsembleSimulation.repack`` refuses to
+    change): model, L, slot count, member sharding, precision, the
+    halo/overlap schedule, and whether noise is traced."""
+    ens = settings.ensemble
+    return (
+        ens.model,
+        settings.L,
+        ens.n,
+        ens.member_shards,
+        settings.precision,
+        settings.kernel_language,
+        settings.halo_depth,
+        settings.comm_overlap,
+        any(m.value("noise") != 0.0 for m in ens.members),
+    )
+
+
+class WorkerFleet:
+    """``cfg.workers`` threads draining one :class:`Scheduler`."""
+
+    def __init__(self, scheduler: Scheduler, cfg: ServeConfig,
+                 *, log: Optional[Logger] = None):
+        self.scheduler = scheduler
+        self.cfg = cfg
+        self.log = log or Logger(verbose=False)
+        self._threads: list = []
+        self._stop = threading.Event()
+        # Per-worker warm engine cache; a compiled engine belongs to
+        # exactly one thread for its whole life.
+        self._warm: Dict[int, Dict[Tuple, object]] = {}
+        self.launches = 0
+        self.warm_hits = 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "WorkerFleet":
+        for i in range(self.cfg.workers):
+            t = threading.Thread(
+                target=self._run, args=(i,),
+                name=f"gs-serve-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self, worker_id: int) -> None:
+        while not self._stop.is_set():
+            batch = self.scheduler.next_batch(timeout=0.2)
+            if batch is None:
+                continue
+            self._launch(worker_id, batch)
+
+    def _factory(self, worker_id: int, batch: Batch):
+        """The driver's ``sim_factory`` seam: hand back a warm engine
+        rebound to this batch when the shape matches, else compile a
+        fresh one and keep it warm."""
+
+        def factory(settings, *, n_devices=None, seed: int = 0):
+            from ..ensemble.engine import EnsembleSimulation
+
+            cache = self._warm.setdefault(worker_id, {})
+            key = warm_key(settings)
+            sim = cache.get(key)
+            if sim is not None:
+                try:
+                    sim.repack(settings, seed=seed)
+                    batch.warm = True
+                    self.warm_hits += 1
+                    return sim
+                except ValueError:
+                    # Shape drifted out from under the key (should not
+                    # happen — the key covers repack's refusals); fall
+                    # through to a fresh compile.
+                    cache.pop(key, None)
+            sim = EnsembleSimulation(
+                settings, n_devices=n_devices, seed=seed
+            )
+            cache[key] = sim
+            return sim
+
+        return factory
+
+    def _launch(self, worker_id: int, batch: Batch) -> None:
+        from ..obs import events as obs_events
+        from ..resilience.supervisor import (
+            classify_failure,
+            latest_durable_checkpoint,
+        )
+
+        settings = batch.settings
+        if batch.attempt > 0:
+            # Requeued batch: resume from the member-store checkpoint
+            # quorum when one exists (restore_ensemble rolls every
+            # member back to the last step ALL of them hold durably,
+            # idle pack slots re-initialize); a batch that never
+            # checkpointed replays from scratch — deterministic, so the
+            # stores come out identical either way.
+            resume = (
+                latest_durable_checkpoint(settings)
+                if settings.checkpoint else None
+            )
+            if resume is not None:
+                settings.restart = True
+                settings.restart_input = settings.checkpoint_output
+                settings.restart_step = -1
+            else:
+                settings.restart = False
+        self.launches += 1
+        t0 = time.time()
+        try:
+            # Every event the launch emits from this thread (driver
+            # lifecycle, journal mirrors) carries the batch id — the
+            # scheduler's progress tracker and the SSE fan-out key on
+            # it (obs/events.bound).
+            with obs_events.bound(batch=batch.id):
+                if batch.supervise:
+                    from ..resilience.supervisor import supervise
+
+                    supervise(
+                        settings, seed=0,
+                        sim_factory=self._factory(worker_id, batch),
+                    )
+                else:
+                    from ..driver import run_once
+
+                    run_once(
+                        settings, seed=0,
+                        sim_factory=self._factory(worker_id, batch),
+                    )
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            kind = classify_failure(exc)
+            label = kind or f"fatal:{type(exc).__name__}"
+            if kind is not None and batch.attempt < (
+                self.cfg.max_requeues
+            ):
+                self.log.warn(
+                    f"serve worker {worker_id}: batch {batch.id} died "
+                    f"({label}); requeueing "
+                    f"(attempt {batch.attempt + 1})"
+                )
+                self.scheduler.requeue(batch, fault=label)
+                return
+            self.log.warn(
+                f"serve worker {worker_id}: batch {batch.id} FAILED "
+                f"({type(exc).__name__}: {exc})"
+            )
+            self.scheduler.complete(
+                batch, ok=False,
+                error=f"{type(exc).__name__}: {exc}",
+                wall_s=time.time() - t0,
+            )
+            return
+        self.scheduler.complete(
+            batch, ok=True, wall_s=time.time() - t0
+        )

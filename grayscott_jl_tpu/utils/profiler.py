@@ -185,8 +185,14 @@ class RunStats:
         total = time.perf_counter() - self._t0
         steps = self.counters.get("steps", 0)
         compute = self.phases.get("compute", total)
+        # ACTIVE members only: idle pack slots (docs/SERVICE.md) ride
+        # in the vmapped launch but do no work anyone asked for — the
+        # aggregate throughput must not credit padding.
         members = (
-            int(self.ensemble.get("members", 1)) if self.ensemble else 1
+            int(self.ensemble.get(
+                "active_members", self.ensemble.get("members", 1)
+            ))
+            if self.ensemble else 1
         )
         return {
             "L": self.L,

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# Chaos smoke test, five scenarios (1-3 against one uninterrupted
+# Chaos smoke test, six scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
-# elastic — resume on a DIFFERENT mesh / member count than the kill):
+# elastic — resume on a DIFFERENT mesh / member count than the kill,
+# 6 serve — a worker killed mid-batch under the service front door):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -27,7 +28,14 @@
 #      value-identical to the uninterrupted (2,2,2) run; then the
 #      scenario-4 ensemble wreckage is resumed GROWN 2 -> 3 members on
 #      the (2,2,2,1)-member layout, surviving member stores
-#      byte-identical, the new member joining at the resume step.
+#      byte-identical, the new member joining at the resume step;
+#   6. simulation-as-a-service (docs/SERVICE.md): three jobs packed
+#      onto one batched launch, GS_SERVE_CHAOS kills the worker
+#      mid-batch -> scheduler requeues -> relaunch resumes from the
+#      member-store checkpoint quorum -> every member store
+#      byte-identical to an uninterrupted service run; the merged
+#      event stream (job_* lifecycle kinds included) validates via
+#      gs_report.py --check.
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -419,7 +427,107 @@ done
   exit 1
 }
 
-echo "chaos_smoke: PASS — all five scenarios recovered byte-identical" \
+echo "chaos_smoke: [6/6] serve — worker kill mid-batch, scheduler requeue..."
+# Simulation-as-a-service edition (docs/SERVICE.md): three jobs packed
+# onto one batched launch, GS_SERVE_CHAOS kills the worker mid-batch
+# (preempt at the seeded step), the scheduler requeues the batch, the
+# relaunch resumes from the member-store checkpoint quorum — and every
+# member store must be byte-identical to the same jobs served by an
+# UNinterrupted service. The merged event stream (job_* lifecycle +
+# run events) must validate via gs_report.py --check.
+mkdir -p "$WORK/serve"
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS=cpu \
+  CHAOS_PREEMPT="$PREEMPT" \
+  SERVE_WORK="$WORK/serve" \
+  python3 - <<'EOF'
+import filecmp, glob, json, os, time, urllib.request
+
+work = os.environ["SERVE_WORK"]
+preempt = max(4, int(os.environ["CHAOS_PREEMPT"]) % 20)
+os.environ["GS_SERVE_PORT"] = "0"
+os.environ["GS_SERVE_PACK_MAX"] = "4"
+os.environ["GS_SERVE_PACK_WINDOW_S"] = "0.2"
+os.environ["GS_EVENTS"] = os.path.join(work, "events.jsonl")
+
+from grayscott_jl_tpu.serve.scheduler import resolve_serve_config
+from grayscott_jl_tpu.serve.server import ServeService
+
+
+def post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode()
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+SPECS = [
+    {
+        "tenant": "chaos", "model": "grayscott", "L": 16, "steps": 24,
+        "plotgap": 8, "checkpoint_freq": 8, "dt": 1.0, "noise": 0.1,
+        "seed": 11 + i,
+        "params": {"F": 0.03 + 0.005 * i, "k": 0.062,
+                   "Du": 0.2, "Dv": 0.1},
+    }
+    for i in range(3)
+]
+
+
+def run_service(state_dir, chaos=""):
+    os.environ["GS_SERVE_STATE_DIR"] = os.path.join(work, state_dir)
+    os.environ["GS_SERVE_CHAOS"] = chaos
+    svc = ServeService(resolve_serve_config()).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    jobs = [post(base, "/v1/jobs", s)["job"] for s in SPECS]
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        st = [get(base, f"/v1/jobs/{j}")["state"] for j in jobs]
+        if all(s in ("complete", "failed") for s in st):
+            break
+        time.sleep(0.3)
+    stores = [get(base, f"/v1/jobs/{j}")["store"] for j in jobs]
+    svc.close()
+    assert all(s == "complete" for s in st), f"job states: {st}"
+    return stores
+
+
+chaos_stores = run_service("killed", chaos=f"step={preempt}:kind=preempt")
+ref_stores = run_service("ref")
+
+events = [json.loads(l) for l in
+          open(os.path.join(work, "events.jsonl"))]
+kinds = {e["kind"] for e in events}
+assert "job_requeued" in kinds, f"no job_requeued on the stream: {kinds}"
+assert "injected" in kinds, "the worker-kill fault never fired"
+
+for a, b in zip(chaos_stores, ref_stores):
+    for suffix in ("", ".vtk"):
+        pa, pb = a.replace(".bp", suffix or ".bp"), b.replace(
+            ".bp", suffix or ".bp")
+        cmp = filecmp.dircmp(pa, pb)
+        same = not (cmp.left_only or cmp.right_only or cmp.diff_files)
+        assert same and all(
+            open(os.path.join(pa, f), "rb").read()
+            == open(os.path.join(pb, f), "rb").read()
+            for f in cmp.common_files
+        ), f"{pa} differs from uninterrupted {pb}"
+print(f"serve chaos: worker killed at step {preempt}, requeued, "
+      f"{len(chaos_stores)} member stores byte-identical")
+EOF
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/serve/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the serve events" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all six scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

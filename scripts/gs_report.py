@@ -76,6 +76,15 @@ EVENT_KIND_SCHEMA = {
     "hang_exit": ("fault", "exit_code"),
     # elastic resharding
     "reshard": ("members",),
+    # simulation-as-a-service job lifecycle (serve/, docs/SERVICE.md);
+    # every record carries the tenant so the per-tenant timeline below
+    # can attribute multi-tenant traffic from one stream.
+    "job_submitted": ("job", "tenant", "priority", "model", "L",
+                      "steps"),
+    "job_packed": ("job", "tenant", "batch", "slot", "members"),
+    "job_requeued": ("job", "tenant", "batch", "fault"),
+    "job_complete": ("job", "tenant", "status"),
+    "job_rejected": ("job", "tenant", "reason"),
 }
 
 
@@ -355,6 +364,66 @@ def report_attempts(events) -> None:
               f"compute={_fmt_s(phases.get('compute'))}")
 
 
+def report_tenants(events) -> None:
+    """The serve-side story (docs/SERVICE.md): per-tenant job
+    timelines distilled from the ``job_*`` lifecycle kinds — submit ->
+    packed (batch/slot) -> requeues -> terminal state, with the
+    queue-wait and end-to-end latencies that make quota and SLO
+    conversations concrete."""
+    job_events = [e for e in events
+                  if str(e.get("kind", "")).startswith("job_")]
+    if not job_events:
+        return
+    tenants: dict = {}
+    for e in job_events:
+        attrs = e.get("attrs") or {}
+        jid = attrs.get("job", "?")
+        tenant = attrs.get("tenant", "?")
+        job = tenants.setdefault(tenant, {}).setdefault(jid, {
+            "requeues": 0, "status": None, "batch": None,
+        })
+        kind, ts = e.get("kind"), e.get("ts")
+        if kind == "job_submitted":
+            job["submitted"] = ts
+            job["model"] = attrs.get("model")
+            job["L"] = attrs.get("L")
+            job["priority"] = attrs.get("priority")
+        elif kind == "job_packed":
+            job.setdefault("packed", ts)
+            job["batch"] = attrs.get("batch")
+            job["slot"] = attrs.get("slot")
+        elif kind == "job_requeued":
+            job["requeues"] += 1
+        elif kind == "job_rejected":
+            job["status"] = f"rejected({attrs.get('reason')})"
+            job["finished"] = ts
+        elif kind == "job_complete":
+            job["status"] = attrs.get("status")
+            job["finished"] = ts
+    print("== tenants ==")
+    for tenant in sorted(tenants):
+        jobs = tenants[tenant]
+        done = sum(1 for j in jobs.values()
+                   if j.get("status") == "complete")
+        print(f"  {tenant}: {len(jobs)} job(s), {done} complete")
+        for jid in sorted(jobs):
+            j = jobs[jid]
+            sub, packed = j.get("submitted"), j.get("packed")
+            fin = j.get("finished")
+            wait = (f"wait={packed - sub:.3f}s"
+                    if packed is not None and sub is not None else "")
+            total = (f"total={fin - sub:.3f}s"
+                     if fin is not None and sub is not None else "")
+            req = (f" requeues={j['requeues']}" if j["requeues"]
+                   else "")
+            batch = (f" batch={j['batch']}/s{j.get('slot')}"
+                     if j.get("batch") else "")
+            print(f"    {jid:<10} {j.get('model', '?'):<12} "
+                  f"L={j.get('L', '?'):<5} "
+                  f"{j.get('status') or 'in-flight':<18}"
+                  f"{batch}{req} {wait} {total}")
+
+
 def report_timeline(events, top: int) -> None:
     """The fault/recovery story, oldest first, with relative times —
     one chronological timeline; multi-process streams (rank-merged by
@@ -456,6 +525,7 @@ def main() -> int:
         events = stats["faults"]
     if events:
         report_attempts(events)
+        report_tenants(events)
         report_timeline(events, args.top)
     return 0
 

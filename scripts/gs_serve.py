@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Launch the simulation service (docs/SERVICE.md).
+
+    GS_SERVE_PORT=8642 python scripts/gs_serve.py
+
+All configuration rides the ``GS_SERVE_*`` env knob family (resolved
+by ``grayscott_jl_tpu.serve.scheduler.resolve_serve_config``; table in
+docs/SERVICE.md and README). SIGTERM/SIGINT drain the service: no new
+admissions, in-flight batches finish, then the process exits.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from grayscott_jl_tpu.serve.server import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

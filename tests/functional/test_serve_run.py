@@ -1,0 +1,372 @@
+"""Functional: the simulation service end to end (docs/SERVICE.md).
+
+The acceptance contracts of ISSUE 13:
+
+* HTTP front door: submit -> pack -> run -> result, with loud 400s for
+  bad specs and 429s for admission refusals;
+* **packed-member equality**: member k of a dynamically packed batch
+  is byte-identical (store level) to its solo CLI run;
+* **chaos**: a worker killed mid-batch -> scheduler requeue -> resume
+  from the member-store checkpoint quorum -> every member store
+  byte-identical to an uninterrupted service run; the merged event
+  stream (all job_* kinds included) validates via gs_report --check;
+* **load**: >= 64 concurrent synthetic clients meet the p99
+  request-to-first-step SLO on CPU, and aggregate cell-updates/s
+  RISES with packing factor (O(1k) clients under ``-m slow``);
+* SSE streaming delivers the lifecycle + progress frames.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+
+REPO = Path(__file__).resolve().parents[2]
+
+SPECS = [
+    {
+        "tenant": "alice", "model": "grayscott", "L": 16, "steps": 24,
+        "plotgap": 8, "checkpoint_freq": 8, "dt": 1.0, "noise": 0.1,
+        "seed": 11 + i,
+        "params": {"F": 0.03 + 0.005 * i, "k": 0.062,
+                   "Du": 0.2, "Dv": 0.1},
+    }
+    for i in range(3)
+]
+
+SOLO_CONFIG = """\
+L = {L}
+Du = {Du}
+Dv = {Dv}
+F = {F}
+k = {k}
+dt = {dt}
+plotgap = {plotgap}
+steps = {steps}
+noise = {noise}
+output = "gs.bp"
+checkpoint = true
+checkpoint_freq = {checkpoint_freq}
+checkpoint_output = "ckpt.bp"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "Plain"
+"""
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post_err(base, path, payload):
+    try:
+        return _post(base, path, payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture
+def serve_env(tmp_path, monkeypatch):
+    """Fresh event/metrics singletons pointed into tmp_path; restored
+    after the test so the rest of the suite sees its own env."""
+    from grayscott_jl_tpu.obs import events as obs_events
+    from grayscott_jl_tpu.obs import metrics as obs_metrics
+
+    events_path = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GS_EVENTS", str(events_path))
+    obs_events.reset_events()
+    obs_metrics.reset_metrics()
+    yield events_path
+    obs_events.reset_events()
+    obs_metrics.reset_metrics()
+
+
+def start_service(tmp_path, name, **cfg_kw):
+    from grayscott_jl_tpu.serve.scheduler import ServeConfig
+    from grayscott_jl_tpu.serve.server import ServeService
+
+    defaults = dict(
+        port=0, workers=1, pack_max=4, pack_window_s=0.2,
+        state_dir=str(tmp_path / name), supervise=False,
+    )
+    defaults.update(cfg_kw)
+    svc = ServeService(ServeConfig(**defaults))
+    svc.start()
+    return svc, f"http://127.0.0.1:{svc.port}"
+
+
+def wait_terminal(base, jobs, timeout=300):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        records = [_get(base, f"/v1/jobs/{j}")[1] for j in jobs]
+        if all(r["state"] in ("complete", "failed", "cancelled")
+               for r in records):
+            return records
+        time.sleep(0.2)
+    raise AssertionError(
+        f"jobs never finished: "
+        f"{[(r['job'], r['state']) for r in records]}"
+    )
+
+
+def run_solo(tmp_path, name, spec):
+    d = tmp_path / name
+    d.mkdir()
+    cfg = d / "config.toml"
+    cfg.write_text(SOLO_CONFIG.format(
+        **{**spec, "Du": spec["params"]["Du"],
+           "Dv": spec["params"]["Dv"], "F": spec["params"]["F"],
+           "k": spec["params"]["k"]}
+    ))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GS_SEED"] = str(spec["seed"])
+    env.pop("GS_EVENTS", None)
+    res = subprocess.run(
+        [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+        cwd=d, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    return d
+
+
+def test_serve_packed_members_equal_solo_runs(tmp_path, serve_env):
+    """The packed-member equality contract end to end: three jobs of
+    one tenant pack into one batched launch (4 slots, 1 idle) and each
+    member's stores come out byte-identical to its solo CLI run."""
+    svc, base = start_service(tmp_path, "svc")
+    try:
+        jobs = [_post(base, "/v1/jobs", s)[1]["job"] for s in SPECS]
+        records = wait_terminal(base, jobs)
+        assert [r["state"] for r in records] == ["complete"] * 3
+        # one batch, slots in submit order, idle slot wrote nothing
+        assert len({r["batch"] for r in records}) == 1
+        stores = [r["store"] for r in records]
+        assert stores[0].endswith("gs.m00.bp")
+        batch_dir = Path(stores[0]).parent
+        assert not (batch_dir / "gs.m03.bp").exists()
+        code, health = _get(base, "/v1/healthz")
+        assert health["jobs"] == {"complete": 3}
+        # field slice endpoint serves the latest durable plane
+        code, plane = _get(
+            base, f"/v1/jobs/{jobs[0]}/field?field=u&z=8&stride=4"
+        )
+        assert code == 200 and plane["shape"] == [4, 4]
+        assert plane["sim_step"] == 24
+    finally:
+        svc.close()
+
+    for i, spec in enumerate(SPECS):
+        solo = run_solo(tmp_path, f"solo{i}", spec)
+        _assert_trees_byte_identical(
+            solo / "gs.bp", Path(records[i]["store"])
+        )
+        _assert_trees_byte_identical(
+            solo / "gs.vtk",
+            Path(records[i]["store"].replace(".bp", ".vtk")),
+        )
+        _assert_trees_byte_identical(
+            solo / "ckpt.bp",
+            Path(records[i]["store"].replace("gs.", "ckpt.")),
+        )
+
+
+def test_serve_admission_errors_over_http(tmp_path, serve_env):
+    svc, base = start_service(
+        tmp_path, "svc", queue_depth=2, tenant_quota=2,
+        pack_window_s=10.0, workers=1,
+    )
+    try:
+        code, body = _post_err(base, "/v1/jobs",
+                               {**SPECS[0], "model": "nope"})
+        assert code == 400 and "Unknown model" in body["error"]
+        code, body = _post_err(
+            base, "/v1/jobs",
+            {**SPECS[0], "params": {"Fx": 1.0}},
+        )
+        assert code == 400 and "unknown parameter" in body["error"]
+
+        # fill the queue (the 10s pack window holds the head batch
+        # open, so these stay queued)
+        _post(base, "/v1/jobs", dict(SPECS[0], tenant="bob"))
+        _post(base, "/v1/jobs", dict(SPECS[1], tenant="bob"))
+        code, body = _post_err(
+            base, "/v1/jobs", dict(SPECS[2], tenant="bob"))
+        assert code == 429
+        assert body["reason"] in ("queue_full", "tenant_quota")
+        # unknown job id is a clean 404
+        code, body = _post_err(base, "/v1/jobs/zzz/cancel", {})
+        assert code == 404
+    finally:
+        svc.close()
+
+
+def test_serve_chaos_worker_kill_requeue_byte_identical(
+    tmp_path, serve_env,
+):
+    """Chaos scenario 6 in-process: GS_SERVE_CHAOS kills the worker
+    mid-batch, the scheduler requeues, the relaunch resumes from the
+    member-store quorum, and every member store is byte-identical to
+    an uninterrupted service's. The merged stream validates with all
+    job_* kinds present."""
+    svc, base = start_service(
+        tmp_path, "killed", chaos="step=8:kind=preempt",
+    )
+    try:
+        jobs = [_post(base, "/v1/jobs", s)[1]["job"] for s in SPECS]
+        records = wait_terminal(base, jobs)
+        assert [r["state"] for r in records] == ["complete"] * 3
+        assert all(r["attempts"] == 2 for r in records)
+    finally:
+        svc.close()
+
+    svc2, base2 = start_service(tmp_path, "ref")
+    try:
+        jobs2 = [_post(base2, "/v1/jobs", s)[1]["job"] for s in SPECS]
+        ref_records = wait_terminal(base2, jobs2)
+        assert [r["state"] for r in ref_records] == ["complete"] * 3
+    finally:
+        svc2.close()
+
+    for chaos_rec, ref_rec in zip(records, ref_records):
+        for ext in (".bp", ".vtk"):
+            _assert_trees_byte_identical(
+                Path(ref_rec["store"].replace(".bp", ext)),
+                Path(chaos_rec["store"].replace(".bp", ext)),
+            )
+
+    events = [
+        json.loads(line)
+        for line in serve_env.read_text().splitlines() if line
+    ]
+    kinds = {e["kind"] for e in events}
+    assert {"job_submitted", "job_packed", "job_requeued",
+            "job_complete", "injected"} <= kinds
+    requeued = [e for e in events if e["kind"] == "job_requeued"]
+    assert len(requeued) == 3
+    assert requeued[0]["attrs"]["fault"] == "preemption"
+
+    # the merged stream (job_* kinds included) passes --check, and the
+    # report renders the per-tenant timeline
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--check", "--events", str(serve_env)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--events", str(serve_env)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 0
+    assert "== tenants ==" in res.stdout
+    assert "alice" in res.stdout
+
+
+def test_serve_sse_streams_lifecycle(tmp_path, serve_env):
+    """SSE: a client connected before completion sees state, progress
+    (output events off the unified stream), and the terminal frame."""
+    import http.client
+
+    svc, base = start_service(tmp_path, "svc", pack_window_s=0.0)
+    try:
+        job = _post(base, "/v1/jobs", SPECS[0])[1]["job"]
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", svc.port, timeout=120,
+        )
+        conn.request("GET", f"/v1/jobs/{job}/events")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        seen = []
+        buf = b""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            chunk = resp.read1(4096)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                for line in frame.decode().splitlines():
+                    if line.startswith("event: "):
+                        seen.append(line[len("event: "):])
+            if "done" in seen:
+                break
+        conn.close()
+        assert seen[0] == "state"
+        assert "job_complete" in seen
+        assert seen[-1] == "done"
+    finally:
+        svc.close()
+
+
+def _load(tmp_path, clients, factors, steps=8):
+    sys.path.insert(0, str(REPO / "benchmarks"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    out = {}
+    for pack in factors:
+        out[pack] = serve_bench.run_campaign(
+            clients=clients, pack_max=pack, L=8, steps=steps,
+            plotgap=4,
+            state_dir=str(tmp_path / f"pack{pack}"),
+        )
+    return out
+
+
+def test_serve_load_64_clients_meets_slo(tmp_path, serve_env):
+    """The acceptance load shape in tier-1: 64 concurrent synthetic
+    clients on CPU, p99 request-to-first-step under the SLO, aggregate
+    cell-updates/s rising with the packing factor."""
+    slo_s = 60.0
+    res = _load(tmp_path, clients=64, factors=(1, 8))
+    for pack, m in res.items():
+        assert m["completed"] == 64, (pack, m)
+        assert m["p99_request_to_first_step_ms"] <= slo_s * 1e3, m
+    # packing factor 8 amortizes launch overhead across the batch:
+    # strictly more aggregate throughput than pack=1, fewer launches.
+    assert res[8]["agg_cell_updates_per_s"] > (
+        res[1]["agg_cell_updates_per_s"]
+    )
+    assert res[8]["launches"] < res[1]["launches"]
+    # warm engines: after the first launch of the shape, every launch
+    # rebinds a cached executable
+    assert res[8]["warm_hits"] == res[8]["launches"] - 1
+
+
+@pytest.mark.slow
+def test_serve_load_1k_clients(tmp_path, serve_env):
+    """O(1k) concurrent clients (ROADMAP item 4 acceptance): all
+    complete inside the SLO with packing engaged."""
+    res = _load(tmp_path, clients=1000, factors=(8,), steps=8)
+    m = res[8]
+    assert m["completed"] == 1000
+    assert m["p99_request_to_first_step_ms"] <= 300 * 1e3
+    assert m["warm_hits"] == m["launches"] - 1

@@ -1,0 +1,477 @@
+"""Unit coverage for the serve subsystem (docs/SERVICE.md).
+
+Protocol validation (loud SettingsError back to the client), pack-key
+semantics, batch building with idle padding, scheduler admission /
+quotas / priorities / packing / requeue, and the idle-slot masking
+contract (satellite of ISSUE 13): a padded member must not pollute
+per-member health attribution, the numerics aggregate, or the
+aggregate cell-updates/s.
+"""
+
+import dataclasses
+
+import pytest
+
+from grayscott_jl_tpu.models.base import SettingsError
+from grayscott_jl_tpu.resilience.health import (
+    EnsembleHealthReport,
+    HealthReport,
+)
+from grayscott_jl_tpu.reshard.plan import ReshardError, member_map
+from grayscott_jl_tpu.serve import protocol
+from grayscott_jl_tpu.serve.scheduler import (
+    AdmissionError,
+    Scheduler,
+    ServeConfig,
+    _pow2_slots,
+)
+
+SPEC = {
+    "tenant": "alice",
+    "model": "grayscott",
+    "L": 16,
+    "steps": 24,
+    "plotgap": 8,
+    "checkpoint_freq": 8,
+    "params": {"F": 0.03, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "dt": 1.0,
+    "noise": 0.1,
+    "seed": 11,
+}
+
+
+def spec(**kw):
+    payload = {**SPEC, **kw}
+    params = payload.pop("params_override", None)
+    if params is not None:
+        payload["params"] = params
+    return payload
+
+
+# ------------------------------------------------------------- protocol
+
+
+def test_parse_job_roundtrip():
+    job = protocol.parse_job(spec())
+    assert job.tenant == "alice"
+    assert job.model == "grayscott"
+    assert job.L == 16 and job.steps == 24
+    assert dict(job.params)["F"] == 0.03
+    assert job.priority == protocol.PRIORITIES["normal"]
+    d = job.describe()
+    assert d["params"]["Du"] == 0.2 and d["seed"] == 11
+
+
+def test_parse_job_priority_spellings():
+    assert protocol.parse_job(spec(priority="high")).priority == 8
+    assert protocol.parse_job(spec(priority=3)).priority == 3
+    with pytest.raises(SettingsError, match="priority"):
+        protocol.parse_job(spec(priority="urgent"))
+    with pytest.raises(SettingsError, match="priority"):
+        protocol.parse_job(spec(priority=17))
+
+
+@pytest.mark.parametrize("mutation, match", [
+    ({"tenant": ""}, "tenant"),
+    ({"model": "nope"}, "Unknown model"),
+    ({"params_override": {"Fx": 1.0}}, "unknown parameter"),
+    ({"params_override": {"F": "hot"}}, "must be a number"),
+    ({"L": 1 << 20}, r"'L' must be in"),
+    ({"steps": 0}, "steps"),
+    ({"dt": 0.0}, "dt"),
+    ({"wormhole": 1}, "unknown keys"),
+    ({"precision": "Float128"}, "precision"),
+])
+def test_parse_job_rejects_loudly(mutation, match):
+    with pytest.raises(SettingsError, match=match):
+        protocol.parse_job(spec(**mutation))
+
+
+def test_parse_job_size_caps():
+    protocol.parse_job(spec(L=64), max_l=64)
+    with pytest.raises(SettingsError, match="'L' must be in"):
+        protocol.parse_job(spec(L=65), max_l=64)
+    with pytest.raises(SettingsError, match="steps"):
+        protocol.parse_job(spec(steps=1001), max_steps=1000)
+
+
+def test_pack_key_axes():
+    base = protocol.parse_job(spec())
+    # runtime data never splits a pack...
+    same = [
+        spec(params_override={"F": 0.055, "k": 0.06,
+                              "Du": 0.2, "Dv": 0.1}),
+        spec(seed=99),
+        spec(dt=0.5),
+        spec(noise=0.7),
+        spec(tenant="bob", priority="high"),
+    ]
+    for s in same:
+        assert protocol.pack_key(protocol.parse_job(s)) == (
+            protocol.pack_key(base)
+        )
+    # ...program/schedule shape does
+    different = [
+        spec(L=32), spec(steps=48), spec(plotgap=4),
+        spec(checkpoint_freq=0), spec(precision="Float64"),
+        spec(halo_depth=2), spec(noise=0.0), spec(model="heat",
+                                                  params_override={}),
+    ]
+    for s in different:
+        assert protocol.pack_key(protocol.parse_job(s)) != (
+            protocol.pack_key(base)
+        )
+
+
+def test_batch_settings_members_and_padding(tmp_path):
+    jobs = [
+        protocol.parse_job(spec(seed=11)),
+        protocol.parse_job(spec(
+            seed=12,
+            params_override={"F": 0.04, "k": 0.06, "Du": 0.2,
+                             "Dv": 0.1},
+        )),
+        protocol.parse_job(spec(seed=13)),
+    ]
+    s = protocol.batch_settings(
+        jobs, n_slots=4, output=str(tmp_path / "gs.bp"),
+        checkpoint_output=str(tmp_path / "ckpt.bp"),
+        names=["a", "b", "c"],
+    )
+    ens = s.ensemble
+    assert ens.n == 4 and ens.active_n == 3
+    assert ens.active == (True, True, True, False)
+    assert [m.seed for m in ens.members] == [11, 12, 13, 0]
+    assert ens.members[1].value("F") == 0.04
+    # the pad copies slot 0's params and is marked idle
+    assert ens.members[3].value("F") == ens.members[0].value("F")
+    assert ens.members[3].describe()["idle"] is True
+    assert ens.describe()["active_members"] == 3
+    # headless-worker safety + schedule from the head spec
+    assert s.watchdog == "off" and s.graceful_shutdown is False
+    assert s.checkpoint is True and s.checkpoint_freq == 8
+    assert s.steps == 24 and s.L == 16
+
+
+def test_batch_settings_refuses_mixed_keys(tmp_path):
+    a = protocol.parse_job(spec())
+    b = protocol.parse_job(spec(L=32))
+    with pytest.raises(SettingsError, match="pack key"):
+        protocol.batch_settings(
+            [a, b], n_slots=2, output=str(tmp_path / "gs.bp"),
+            checkpoint_output=str(tmp_path / "ckpt.bp"),
+        )
+
+
+def test_pow2_slots():
+    assert _pow2_slots(1, 8) == 1
+    assert _pow2_slots(3, 8) == 4
+    assert _pow2_slots(5, 8) == 8
+    assert _pow2_slots(3, 2) == 3  # cap below n: never truncate jobs
+    assert _pow2_slots(8, 8) == 8
+
+
+# ------------------------------------------------------------ scheduler
+
+
+def make_scheduler(tmp_path, **kw) -> Scheduler:
+    from grayscott_jl_tpu.obs.events import NULL_EVENTS
+
+    defaults = dict(
+        state_dir=str(tmp_path / "state"), pack_window_s=0.0,
+        supervise=False,
+    )
+    defaults.update(kw)
+    return Scheduler(ServeConfig(**defaults), events=NULL_EVENTS)
+
+
+def test_scheduler_admission_queue_depth(tmp_path):
+    sched = make_scheduler(tmp_path, queue_depth=2)
+    sched.submit(spec())
+    sched.submit(spec())
+    with pytest.raises(AdmissionError) as exc:
+        sched.submit(spec())
+    assert exc.value.reason == "queue_full"
+    rejected = sched.jobs[exc.value.job.id]
+    assert rejected.state == "rejected"
+    assert rejected.error == "queue_full"
+
+
+def test_scheduler_tenant_quota(tmp_path):
+    sched = make_scheduler(tmp_path, tenant_quota=2, queue_depth=100)
+    sched.submit(spec())
+    sched.submit(spec())
+    with pytest.raises(AdmissionError) as exc:
+        sched.submit(spec())
+    assert exc.value.reason == "tenant_quota"
+    # another tenant still admits
+    sched.submit(spec(tenant="bob"))
+
+
+def test_scheduler_invalid_spec_records_nothing(tmp_path):
+    sched = make_scheduler(tmp_path)
+    with pytest.raises(SettingsError):
+        sched.submit(spec(model="nope"))
+    assert not sched.jobs
+
+
+def test_scheduler_priority_and_packing(tmp_path):
+    sched = make_scheduler(tmp_path, pack_max=4)
+    low = sched.submit(spec(priority="low"))
+    hi1 = sched.submit(spec(priority="high"))
+    hi2 = sched.submit(spec(priority="high", seed=12))
+    incompatible = sched.submit(spec(priority="high", L=32))
+    batch = sched.next_batch(timeout=0.0)
+    # high-priority head; the compatible low-priority job rides along;
+    # the incompatible (different L) high-priority job does not.
+    ids = {j.id for j in batch.jobs}
+    assert ids == {hi1.id, hi2.id, low.id}
+    assert incompatible.id not in ids
+    assert batch.n_slots == 4  # 3 jobs pad to the next power of two
+    assert batch.jobs[0].state == "packed"
+    assert batch.jobs[0].store.endswith(".m00.bp")
+    nxt = sched.next_batch(timeout=0.0)
+    assert {j.id for j in nxt.jobs} == {incompatible.id}
+
+
+def test_scheduler_cancel_semantics(tmp_path):
+    sched = make_scheduler(tmp_path)
+    job = sched.submit(spec())
+    assert sched.cancel(job.id) is True
+    assert sched.jobs[job.id].state == "cancelled"
+    assert sched.next_batch(timeout=0.0) is None
+    job2 = sched.submit(spec())
+    sched.next_batch(timeout=0.0)
+    assert sched.cancel(job2.id) is False  # packed: committed
+
+
+def test_scheduler_requeue_resume_first(tmp_path):
+    sched = make_scheduler(tmp_path, pack_max=1)
+    a = sched.submit(spec())
+    b = sched.submit(spec(seed=12))
+    batch = sched.next_batch(timeout=0.0)
+    assert [j.id for j in batch.jobs] == [a.id]
+    batch.settings.faults = "step=5:kind=preempt"
+    sched.requeue(batch, fault="preemption")
+    assert batch.attempt == 1
+    assert batch.settings.faults == ""  # chaos is consume-once
+    # the requeued batch outranks fresh queue work
+    again = sched.next_batch(timeout=0.0)
+    assert again is batch
+    fresh = sched.next_batch(timeout=0.0)
+    assert [j.id for j in fresh.jobs] == [b.id]
+
+
+def test_scheduler_complete_and_status(tmp_path):
+    sched = make_scheduler(tmp_path)
+    job = sched.submit(spec())
+    batch = sched.next_batch(timeout=0.0)
+    sched.complete(batch, ok=True, wall_s=1.0)
+    st = sched.status(job.id)
+    assert st["state"] == "complete"
+    assert st["request_to_first_step_s"] is not None
+    assert sched.status("nope") is None
+    assert sched.idle()
+
+
+def test_scheduler_drain_rejects(tmp_path):
+    sched = make_scheduler(tmp_path)
+    sched.drain()
+    with pytest.raises(AdmissionError) as exc:
+        sched.submit(spec())
+    assert exc.value.reason == "shutting_down"
+
+
+# -------------------------------------------- idle-slot masking contract
+
+
+def test_ensemble_health_report_masks_idle_slots():
+    good = HealthReport(True, 0.1, 1.0, 0.0, 0.5)
+    bad = HealthReport(False, float("nan"), float("nan"), -9.0, 9.0)
+    # the idle slot blew up: aggregate verdict unaffected
+    masked = EnsembleHealthReport((good, bad),
+                                  active=(True, False))
+    assert masked.finite is True
+    assert masked.bad_members == []
+    assert masked.ranges[1] == (0.0, 0.5)  # idle never widens ranges
+    assert masked.describe()["active_members"] == 1
+    # a REAL member blowing up still attributes by index
+    exploded = EnsembleHealthReport((bad, good),
+                                    active=(True, False))
+    assert exploded.finite is False
+    assert exploded.bad_members == [0]
+    # default mask = every slot real (solo-ensemble behavior unchanged)
+    legacy = EnsembleHealthReport((good, bad))
+    assert legacy.finite is False
+    assert legacy.bad_members == [1]
+
+
+def test_member_map_idle_slots():
+    # idle tail slot with no store: init, never a gap
+    mapping = member_map(
+        [True, True, False], 3, active=(True, True, False)
+    )
+    assert mapping == [("restore", 0), ("restore", 1), ("init", 2)]
+    # idle slot BETWEEN present actives: still not a gap
+    mapping = member_map(
+        [True, False, True], 3, active=(True, False, True)
+    )
+    assert mapping == [("restore", 0), ("init", 1), ("restore", 2)]
+    # a missing ACTIVE slot before a present one stays a loud gap
+    with pytest.raises(ReshardError, match="gap"):
+        member_map([False, True], 2, active=(True, True))
+    # mask preserved the legacy behavior when omitted
+    assert member_map([True, False], 2) == [
+        ("restore", 0), ("init", 1),
+    ]
+
+
+def test_runstats_aggregate_excludes_idle_slots():
+    from grayscott_jl_tpu.utils.profiler import RunStats
+
+    stats = RunStats(8)
+    stats.record_ensemble(
+        {"members": 4, "active_members": 3, "member_shards": 1}
+    )
+    stats.count("steps", 10)
+    stats.phases["compute"] = 2.0
+    # 8^3 cells * 10 steps * 3 ACTIVE members / 2 s
+    assert stats.summary()["cell_updates_per_s"] == pytest.approx(
+        8**3 * 10 * 3 / 2.0
+    )
+
+
+def test_packed_launch_with_idle_slot_masks_health_and_stores(tmp_path):
+    """Satellite contract end to end at engine level: one idle pack
+    slot poisoned with NaN — health verdict clean, bad_members empty,
+    stores only for the real members."""
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+    from grayscott_jl_tpu.ensemble.io import (
+        EnsembleCheckpointWriter,
+        EnsembleStream,
+    )
+
+    jobs = [
+        protocol.parse_job(spec(seed=11)),
+        protocol.parse_job(spec(
+            seed=12,
+            params_override={"F": 0.04, "k": 0.06, "Du": 0.2,
+                             "Dv": 0.1},
+        )),
+        protocol.parse_job(spec(seed=13)),
+    ]
+    settings = protocol.batch_settings(
+        jobs, n_slots=4, output=str(tmp_path / "gs.bp"),
+        checkpoint_output=str(tmp_path / "ckpt.bp"),
+    )
+    sim = EnsembleSimulation(settings, n_devices=1)
+    assert sim.member_active == (True, True, True, False)
+    assert sim.active_member_count == 3
+    sim.iterate(4)
+    sim.poison_nan(member=3)  # the IDLE slot diverges
+    snap = sim.snapshot_async(health=True)
+    report = snap.health_report()
+    assert report.active == (True, True, True, False)
+    assert report.finite is True
+    assert report.bad_members == []
+    # ...but a REAL member diverging still attributes
+    sim.poison_nan(member=1)
+    report = sim.snapshot_async(health=True).health_report()
+    assert report.finite is False
+    assert report.bad_members == [1]
+
+    # idle slots write no stores at all
+    stream = EnsembleStream(settings, sim.domain, sim.dtype)
+    ckpt = EnsembleCheckpointWriter(settings, sim.dtype,
+                                    layout=sim.layout())
+    snap2 = sim.snapshot_async()
+    stream.write_step(0, snap2.blocks())
+    ckpt.save(0, snap2.blocks())
+    stream.close()
+    ckpt.close()
+    for i in range(3):
+        assert (tmp_path / f"gs.m0{i}.bp").exists()
+        assert (tmp_path / f"ckpt.m0{i}.bp").exists()
+    assert not (tmp_path / "gs.m03.bp").exists()
+    assert not (tmp_path / "ckpt.m03.bp").exists()
+
+
+def test_repack_rebinds_warm_engine(tmp_path):
+    """The warm-launch seam: repack swaps members/params/seeds without
+    touching the compiled runner cache; shape changes refuse."""
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+
+    jobs = [protocol.parse_job(spec(seed=11)),
+            protocol.parse_job(spec(seed=12))]
+    s1 = protocol.batch_settings(
+        jobs, n_slots=2, output=str(tmp_path / "a" / "gs.bp"),
+        checkpoint_output=str(tmp_path / "a" / "ckpt.bp"),
+    )
+    sim = EnsembleSimulation(s1, n_devices=1)
+    sim.iterate(4)
+    runners = sim._runners
+    assert runners  # compiled
+
+    jobs2 = [
+        protocol.parse_job(spec(
+            seed=21,
+            params_override={"F": 0.05, "k": 0.061, "Du": 0.2,
+                             "Dv": 0.1},
+        )),
+        protocol.parse_job(spec(seed=22)),
+    ]
+    s2 = protocol.batch_settings(
+        jobs2, n_slots=2, output=str(tmp_path / "b" / "gs.bp"),
+        checkpoint_output=str(tmp_path / "b" / "ckpt.bp"),
+    )
+    sim.repack(s2, seed=0)
+    assert sim.step == 0
+    assert sim._runners is runners  # the warm part: cache survives
+    assert sim.member_seeds == [21, 22]
+    import numpy as np
+
+    np.testing.assert_allclose(
+        np.asarray(sim.params.F), [0.05, 0.03]
+    )
+    sim.iterate(4)  # runs on the cached executable
+
+    # shape mismatches refuse loudly
+    s3 = protocol.batch_settings(
+        jobs2, n_slots=4, output=str(tmp_path / "c" / "gs.bp"),
+        checkpoint_output=str(tmp_path / "c" / "ckpt.bp"),
+    )
+    with pytest.raises(ValueError, match="shape mismatch"):
+        sim.repack(s3)
+    noisy_off = [
+        protocol.parse_job(spec(seed=31, noise=0.0)),
+        protocol.parse_job(spec(seed=32, noise=0.0)),
+    ]
+    s4 = protocol.batch_settings(
+        noisy_off, n_slots=2, output=str(tmp_path / "d" / "gs.bp"),
+        checkpoint_output=str(tmp_path / "d" / "ckpt.bp"),
+    )
+    with pytest.raises(ValueError, match="noise-tracing"):
+        sim.repack(s4)
+
+
+def test_serve_config_resolution(monkeypatch):
+    from grayscott_jl_tpu.serve.scheduler import resolve_serve_config
+
+    cfg = resolve_serve_config()
+    assert cfg.port == 8642 and cfg.workers == 1
+    monkeypatch.setenv("GS_SERVE_PORT", "7000")
+    monkeypatch.setenv("GS_SERVE_PACK_MAX", "16")
+    monkeypatch.setenv("GS_SERVE_SUPERVISE", "0")
+    cfg = resolve_serve_config()
+    assert cfg.port == 7000
+    assert cfg.pack_max == 16
+    assert cfg.supervise is False
+    monkeypatch.setenv("GS_SERVE_WORKERS", "0")
+    with pytest.raises(ValueError, match="GS_SERVE_WORKERS"):
+        resolve_serve_config()
+
+
+def test_job_spec_dataclass_is_frozen():
+    job = protocol.parse_job(spec())
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        job.L = 99
