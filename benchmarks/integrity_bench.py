@@ -1,0 +1,178 @@
+"""A/B the data-integrity layer's overhead on the output-dominated
+CPU config (docs/RESILIENCE.md "Data integrity").
+
+Runs the real CLI on the L=64 output-heavy configuration three ways —
+``GS_CKPT_VERIFY=off`` (no CRC verification, no device checksum, no
+scrub: the pre-integrity cost floor), the default ``read`` mode, and
+the everything-armed ``full`` + ``GS_SCRUB=1`` mode — and emits one
+summary row per mode as JSONL artifact rows in the shared
+``artifacts.py`` schema (``ab = "integrity"``), so committed results
+double as regression-sentinel history (``regression_gate.py``).
+
+Usage::
+
+    python benchmarks/integrity_bench.py [--L 64] [--steps 40]
+        [--plotgap 2] [--ckpt-freq 10] [--rounds 3]
+        [--out benchmarks/results/...jsonl] [--max-overhead 0.10]
+
+``--max-overhead`` gates the run (exit 1) when the ``full``+scrub
+mode's median wall exceeds the ``off`` floor by more than the given
+fraction — the documented bound the integrity layer must stay within.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+REPO = Path(__file__).resolve().parents[1]
+
+CONFIG = """\
+L = {L}
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = {plotgap}
+steps = {steps}
+noise = 0.1
+output = "gs.bp"
+checkpoint = true
+checkpoint_freq = {ckpt_freq}
+checkpoint_output = "ckpt.bp"
+mesh_type = "image"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "Plain"
+verbose = false
+"""
+
+#: The three measured integrity postures: the pre-integrity floor, the
+#: always-on default, and everything armed (device checksum +
+#: read-back verify + boundary scrub over both replicas... replicas
+#: stay at 1 here so the A/B isolates checksum+scrub cost; replica
+#: fan-out cost is linear and obvious).
+MODES = (
+    ("off", {"GS_CKPT_VERIFY": "off"}),
+    ("read", {"GS_CKPT_VERIFY": "read"}),
+    ("full+scrub", {"GS_CKPT_VERIFY": "full", "GS_SCRUB": "1"}),
+)
+
+
+def run_once(args, mode_env: dict) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        cfg = Path(td) / "config.toml"
+        cfg.write_text(CONFIG.format(
+            L=args.L, steps=args.steps, plotgap=args.plotgap,
+            ckpt_freq=args.ckpt_freq,
+        ))
+        stats_path = Path(td) / "stats.json"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["GS_TPU_STATS"] = str(stats_path)
+        env.update(mode_env)
+        t0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+            cwd=td, env=env, capture_output=True, text=True,
+        )
+        wall = time.perf_counter() - t0
+        if res.returncode != 0:
+            raise RuntimeError(res.stderr)
+        stats = json.loads(stats_path.read_text())
+    return {
+        "process_wall_s": round(wall, 3),
+        "driver_wall_s": stats["wall_s"],
+        "us_per_step": stats["wall_s"] / args.steps * 1e6,
+        "compute_s": stats["phases_s"].get("compute"),
+        "integrity": stats["config"].get("integrity"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--L", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--plotgap", type=int, default=2)
+    ap.add_argument("--ckpt-freq", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="append artifact rows here (default: the "
+                    "committed results naming convention)")
+    ap.add_argument("--max-overhead", type=float, default=None,
+                    help="fail (exit 1) when full+scrub exceeds the "
+                    "off floor by more than this fraction")
+    args = ap.parse_args(argv)
+
+    out = args.out or artifacts.default_out("integrity", "cpu")
+    walls = {}
+    for mode, env in MODES:
+        runs = [run_once(args, env) for _ in range(args.rounds)]
+        med = statistics.median(r["driver_wall_s"] for r in runs)
+        walls[mode] = med
+        row = {
+            "ab": "integrity",
+            "t": artifacts.utc_stamp(),
+            "platform": "cpu",
+            "model": "grayscott",
+            "kernel": "xla",
+            "L": args.L,
+            "mesh": [1, 1, 1],
+            "devices": 1,
+            "precision": "Float32",
+            # `metric` is a regression_gate KEY FIELD: each verify
+            # posture is its own config key, so the sentinel never
+            # compares a full+scrub row against the off floor.
+            "metric": f"integrity_{mode}",
+            "mode": mode,
+            "steps": args.steps,
+            "plotgap": args.plotgap,
+            "ckpt_freq": args.ckpt_freq,
+            "rounds": args.rounds,
+            "median_wall_s": round(med, 3),
+            "median_us_per_step": round(
+                statistics.median(r["us_per_step"] for r in runs), 1
+            ),
+            "rounds_us_per_step": [
+                round(r["us_per_step"], 1) for r in runs
+            ],
+        }
+        if mode != "off" and walls.get("off"):
+            row["overhead_vs_off"] = round(
+                med / walls["off"] - 1.0, 4
+            )
+        artifacts.append_row(out, row)
+        print(json.dumps(row))
+
+    if args.max_overhead is not None and walls.get("off"):
+        overhead = walls["full+scrub"] / walls["off"] - 1.0
+        if overhead > args.max_overhead:
+            print(
+                f"integrity_bench: FAIL — full+scrub overhead "
+                f"{overhead:.1%} exceeds the {args.max_overhead:.0%} "
+                "bound",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"integrity_bench: full+scrub overhead {overhead:.1%} "
+              f"within the {args.max_overhead:.0%} bound")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

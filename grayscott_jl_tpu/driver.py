@@ -144,6 +144,17 @@ def _close_quietly(store) -> None:
         pass
 
 
+def _with_checksums(fn, checksums):
+    """Bind the boundary's device-side field checksums onto a write
+    target (``write_step``/``save`` grow an optional ``checksums``
+    kwarg) — runs on the async writer's worker thread."""
+
+    def wrapped(step, blocks):
+        return fn(step, blocks, checksums=checksums)
+
+    return wrapped
+
+
 def _with_io_fault(plan, journal, fn):
     """Wrap an ``AsyncStepWriter`` target so a due ``io_error`` fault
     raises inside it — surfacing on the driver thread as a transient
@@ -465,6 +476,22 @@ def _run_once_inner(
         if num_mode != "off" else None
     )
     stats.config["numerics"] = num_mode
+    # Data-integrity layer (resilience/integrity.py,
+    # docs/RESILIENCE.md): GS_CKPT_VERIFY=full fuses the device-side
+    # field checksum into the snapshot-copy jit (verified host-side
+    # before any store write; single-process only — the host can only
+    # recompute over its local shards); GS_SCRUB arms the boundary
+    # scrubber over every checkpoint replica.
+    from .resilience import integrity as integ
+
+    icfg = integ.resolve_config(settings)
+    stats.config["integrity"] = dict(icfg)
+    snapshot_checksum = icfg["verify"] == "full" and nprocs == 1
+    scrubber = (
+        integ.Scrubber(settings, journal=journal,
+                       every=icfg["scrub_every"])
+        if icfg["scrub"] and ckpt is not None else None
+    )
     # The reference side of the live model-vs-measured residual gauge:
     # what the ICI model projects one step should cost on this exact
     # config. Computed once — the observed p50 moves, the projection
@@ -675,16 +702,39 @@ def _run_once_inner(
                         (phase, _with_io_fault(plan, journal, fn))
                         for phase, fn in targets
                     ]
+                # The bitflip fault corrupts THIS boundary's snapshot
+                # copy on device (write-path silent corruption; the
+                # live trajectory is untouched) — the device-side
+                # checksum must catch it before anything is written.
+                bitflip = None
+                fault = plan.take("bitflip", step)
+                if fault is not None:
+                    journal.record(
+                        event="injected", kind="bitflip", step=step,
+                        planned_step=fault.step,
+                    )
+                    bitflip = True
                 with stats.phase("device_to_host", step=step):
                     snap = sim.snapshot_async(
                         health=guard.enabled,
                         numerics=num_mode == "boundary",
+                        checksum=snapshot_checksum,
+                        bitflip=bitflip,
                     )
                     if pipe.synchronous:
                         # Depth 0 reproduces the reference's flow
                         # exactly: D2H resolves here, writes run inline
                         # in submit.
                         snap.blocks()
+                if snap.has_checksums():
+                    # Stamp the boundary's device checksums into the
+                    # stores' integrity sidecars (per-step, per-field
+                    # provenance next to the block CRCs).
+                    cksums = snap.checksum_report()
+                    targets = [
+                        (phase, _with_checksums(fn, cksums))
+                        for phase, fn in targets
+                    ]
                 if guard.enabled:
                     # Unhealthy + abort/rollback raises BEFORE the
                     # poisoned step is submitted — it never reaches the
@@ -722,6 +772,22 @@ def _run_once_inner(
                     stats.count("checkpoints")
                     evs.emit("checkpoint", phase="io", step=step)
                     log.info(f"Checkpoint accepted at step {step}")
+                # The ckpt_corrupt fault flips one payload byte of the
+                # latest DURABLE checkpoint entry in the primary store
+                # (CRCs untouched — exactly the silent corruption the
+                # verify/scrub/failover machinery exists to catch).
+                fault = plan.take("ckpt_corrupt", step)
+                if fault is not None and ckpt is not None:
+                    info = integ.corrupt_store_byte(
+                        integ.primary_checkpoint_path(settings)
+                    )
+                    journal.record(
+                        event="injected", kind="ckpt_corrupt",
+                        step=step, planned_step=fault.step,
+                        **(info or {"corrupted": False}),
+                    )
+                if scrubber is not None and at_ckpt:
+                    scrubber.maybe_scrub(step)
                 # Interval metrics record (metrics_interval_s TOML /
                 # GS_METRICS_INTERVAL_S): boundary-time only, with the
                 # expensive device gauges refreshed just-in-time.
@@ -768,6 +834,10 @@ def _run_once_inner(
             # Re-record with the final heartbeat count (the pre-loop
             # record only captured the armed deadlines).
             stats.record_watchdog({**wd.describe(), "attempt": attempt})
+        if scrubber is not None:
+            # Scrub provenance next to the armed knobs: how many
+            # audits ran and whether anything was quarantined.
+            stats.config["integrity"].update(scrubber.describe())
         if journal.events:
             stats.record_faults(journal.events)
         if profile is not None:

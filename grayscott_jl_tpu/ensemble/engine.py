@@ -110,6 +110,52 @@ class EnsembleFieldSnapshot(FieldSnapshot):
             members, active=self.member_active
         )
 
+    def checksum_report(self):
+        """Per-member device checksums ``[{field: int}, ...]`` (the
+        vmapped integrity probe resolves one value per member per
+        field) — the ensemble writers route member ``k``'s record to
+        member ``k``'s store, keeping member stores byte-identical to
+        solo stores."""
+        if self._checksums is None:
+            return None
+        vals = [np.asarray(c) for c in self._checksums]
+        return [
+            {n: int(v[i]) for n, v in zip(self.field_names, vals)}
+            for i in range(vals[0].shape[0])
+        ]
+
+    def _verify_checksums(self, host_parts) -> None:
+        """Member-resolved verification: the device checksum of each
+        member slice is recomputed from that member's landed host
+        bytes, so silent write-path corruption is attributed by member
+        index — the same attribution shape as the health probe."""
+        from ..resilience.integrity import (
+            CorruptionError,
+            host_field_checksum,
+        )
+
+        vals = [np.asarray(c) for c in self._checksums]
+        n = vals[0].shape[0]
+        totals = [[0] * n for _ in self.field_names]
+        for part in host_parts:
+            m_off = part[0][0]
+            for fi, arr in enumerate(part[2:]):
+                for j in range(arr.shape[0]):
+                    totals[fi][m_off + j] = (
+                        totals[fi][m_off + j]
+                        + host_field_checksum(arr[j])
+                    ) % (1 << 32)
+        for fi, name in enumerate(self.field_names):
+            for i in range(n):
+                want, got = int(vals[fi][i]), totals[fi][i]
+                if want != got:
+                    raise CorruptionError(
+                        "device-side field checksum mismatch: device "
+                        f"{want:#010x}, host {got:#010x} — snapshot "
+                        "bytes were silently corrupted in flight",
+                        step=self.step, var=name, member=i,
+                    )
+
 
 def member_blocks(blocks, member: int, member_offset: int = 0):
     """Extract one member's spatial ``(offsets, sizes, *fields)``
@@ -275,6 +321,27 @@ class EnsembleSimulation(Simulation):
         return obs_numerics.NumericsReport.aggregate_members(
             members, active=self.member_active
         )
+
+    def _checksum_probe_fn(self):
+        """Integrity checksums vmapped over the member axis — one
+        wrapped word sum per member per field, so corruption detection
+        attributes the bad member by index."""
+        from ..resilience.integrity import device_field_checksum
+
+        return jax.vmap(device_field_checksum)
+
+    def _apply_snapshot_bitflip(self, copies, field="u"):
+        """Member-addressable ``bitflip``: corrupt ONE member's slice
+        of the snapshot copy (member from ``GS_FAULT_MEMBER``, like
+        ``poison_nan``) — detection must name this member while the
+        other members' boundary bytes verify clean."""
+        from ..config.env import env_int
+        from ..resilience.integrity import apply_bitflip
+
+        member = env_int("GS_FAULT_MEMBER", 0) % self.n_members
+        i = self._field_index(field if field is not True else "u")
+        flipped = apply_bitflip(copies[i], (member, 0, 0, 0))
+        return copies[:i] + (flipped,) + copies[i + 1:]
 
     def snapshot_async(self, **kw):
         """Member-stacked snapshot with the activity mask stamped on,

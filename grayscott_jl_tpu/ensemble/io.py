@@ -121,11 +121,16 @@ class EnsembleStream:
             for i in range(self.n)
         ]
 
-    def write_step(self, step: int, blocks) -> None:
+    def write_step(self, step: int, blocks, checksums=None) -> None:
         blocks = list(blocks)
         for i, stream in enumerate(self.members):
             if stream is not None:
-                stream.write_step(step, member_blocks(blocks, i))
+                stream.write_step(
+                    step, member_blocks(blocks, i),
+                    checksums=(
+                        checksums[i] if checksums is not None else None
+                    ),
+                )
 
     def close(self) -> None:
         for stream in self.members:
@@ -164,11 +169,16 @@ class EnsembleCheckpointWriter:
             for i in range(self.n)
         ]
 
-    def save(self, step: int, blocks) -> None:
+    def save(self, step: int, blocks, checksums=None) -> None:
         blocks = list(blocks)
         for i, writer in enumerate(self.members):
             if writer is not None:
-                writer.save(step, member_blocks(blocks, i))
+                writer.save(
+                    step, member_blocks(blocks, i),
+                    checksums=(
+                        checksums[i] if checksums is not None else None
+                    ),
+                )
 
     def close(self) -> None:
         for writer in self.members:
@@ -201,20 +211,21 @@ def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
     """
     import dataclasses as _dc
 
-    from ..io.checkpoint import (
-        latest_durable_step,
-        open_checkpoint,
-        read_layout,
-    )
+    from ..io.checkpoint import open_checkpoint, read_layout
     from ..reshard import plan as plan_mod
     from ..reshard.restore import layout_of
+    from ..resilience import integrity
 
     n = settings.ensemble.n
     active = settings.ensemble.active
     # Idle pack slots never wrote a store and never will: their restore
-    # action is re-initialization, not a selection read.
+    # action is re-initialization, not a selection read. Each member's
+    # resumable step is the best any of its checkpoint REPLICAS can
+    # serve (docs/RESILIENCE.md "Data integrity").
     latest = [
-        latest_durable_step(member_path(settings.restart_input, i, n))
+        integrity.latest_durable_step_replicated(
+            member_path(settings.restart_input, i, n)
+        )
         if active[i] else None
         for i in range(n)
     ]
@@ -242,18 +253,31 @@ def restore_ensemble(sim, settings: Settings, *, allow: str = "auto"):
             blocks.append(sim.member_init_fields())
             continue
         ms = member_settings(settings, i)
-        reader, idx, step = open_checkpoint(ms.restart_input, ms, want)
-        try:
-            if old is None:
-                # Member 0 speaks for the ensemble's old spatial layout
-                # (member stores are solo-identical, so they all carry
-                # the same record).
-                old = read_layout(reader)
-            blocks.append(tuple(
-                reader.get(name, step=idx) for name in field_names
-            ))
-        finally:
-            reader.close()
+
+        def read_member(candidate, ms=ms):
+            reader, idx, step = open_checkpoint(candidate, ms, want)
+            try:
+                layout = read_layout(reader)
+                return layout, tuple(
+                    reader.get(name, step=idx)
+                    for name in field_names
+                )
+            finally:
+                reader.close()
+
+        # Replica failover per member store: a corrupt or unreadable
+        # primary fails over to its mirrors in health order
+        # (replica_failover events per skip); a sole corrupted member
+        # store refuses the whole ensemble restore loudly.
+        layout, fields = integrity.restore_with_failover(
+            ms.restart_input, read_member
+        )
+        if old is None:
+            # Member 0 speaks for the ensemble's old spatial layout
+            # (member stores are solo-identical, so they all carry
+            # the same record).
+            old = layout
+        blocks.append(fields)
     plan = plan_mod.plan_restore(
         old, layout_of(sim), L=settings.L, allow=allow
     )

@@ -70,8 +70,14 @@ def _foreign_dir(path: str) -> bool:
         return False
 
     def ours(n: str) -> bool:
-        # md.json / md.json.tmp / md.<w>.json[.tmp] / data.<w>
-        if n.startswith("md.") and n.endswith((".json", ".json.tmp")):
+        # md.json / md.json.tmp / md.<w>.json[.tmp] / data.<w>, plus
+        # the integrity/quarantine sidecar files (docs/RESILIENCE.md
+        # "Data integrity").
+        if n.startswith(("md.", "integrity")) and n.endswith(
+            (".json", ".json.tmp")
+        ):
+            return True
+        if n in ("quarantine.json", "quarantine.json.tmp"):
             return True
         return n.startswith("data.") and n[5:].isdigit()
 
@@ -194,8 +200,12 @@ def open_writer(
                 # run's data.
                 if os.path.isdir(path):
                     for name in os.listdir(path):
-                        if name == "md.json" or (
-                            name.startswith(("md.", "data."))
+                        if name in (
+                            "md.json", "quarantine.json"
+                        ) or (
+                            name.startswith(
+                                ("md.", "data.", "integrity")
+                            )
                             and not name.endswith(".bp")
                         ):
                             os.remove(os.path.join(path, name))

@@ -76,7 +76,14 @@ class AsyncIOError(RuntimeError):
 
         Classified here, where the failing write's exception is still
         first-hand, so the supervisor (``resilience/supervisor.py``)
-        never guesses from a formatted message.
+        never guesses from a formatted message. One ``RuntimeError``
+        subclass gets its own taxonomy slot upstream: a
+        :class:`~..resilience.integrity.CorruptionError` raised on
+        this thread (snapshot checksum verify in ``blocks()``, the
+        checkpoint read-back verify) is NOT transient-io — the
+        supervisor unwraps ``original`` and classifies it
+        ``corruption`` (restartable with replica failover, bounded to
+        one retry per corrupt site).
         """
         return isinstance(self.original, OSError)
 

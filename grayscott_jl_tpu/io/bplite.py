@@ -49,6 +49,20 @@ truncates the payload to the metadata-durable end, so rollback-resumed
 stores are byte-identical to uninterrupted ones. Both are load-bearing
 for the resilience subsystem's "latest durable checkpoint"
 (``resilience/supervisor.py``).
+
+Integrity (docs/RESILIENCE.md "Data integrity"): every payload block's
+CRC32 is recorded in a per-writer **integrity sidecar file**
+(``integrity[.<w>].json``) inside the store directory — sidecar
+metadata only, so the ``md.json`` schema and the payload bytes above
+stay exactly as documented and every byte-identity contract on stores
+is preserved. The reader recomputes the CRC on every block read
+(``GS_CKPT_VERIFY``, default ``read``) and raises
+:class:`~..resilience.integrity.CorruptionError` naming the file,
+offset, variable, and both CRCs instead of serving silently corrupt
+bytes; a store whose integrity sidecar is missing or torn degrades to
+the historical unverified read. Step entries quarantined by the
+scrubber (``quarantine.json``, ``resilience/integrity.py``) are hidden
+from readers like torn steps are.
 """
 
 from __future__ import annotations
@@ -57,6 +71,7 @@ import enum
 import json
 import os
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -74,6 +89,110 @@ class StepStatus(enum.Enum):
 
 def _md_path(path: str) -> str:
     return os.path.join(path, "md.json")
+
+
+def _integrity_path(path: str, writer_id: int = 0) -> str:
+    name = (
+        "integrity.json" if writer_id == 0
+        else f"integrity.{writer_id}.json"
+    )
+    return os.path.join(path, name)
+
+
+def read_integrity_crcs(path: str, writer_id: int = 0) -> dict:
+    """One writer's recorded block CRCs: ``(file, offset) -> crc32``.
+    A missing or torn sidecar degrades to an empty map (unverified
+    reads) — the sidecar is advisory metadata, never a read gate."""
+    try:
+        with open(_integrity_path(path, writer_id),
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+        out = {}
+        for key, val in (doc.get("crc") or {}).items():
+            fname, _, off = key.rpartition(":")
+            out[(fname, int(off))] = int(val[1])
+        return out
+    except (FileNotFoundError, NotADirectoryError, ValueError,
+            TypeError, AttributeError, json.JSONDecodeError):
+        return {}
+
+
+class IntegrityMeta:
+    """Writer-side ledger behind the integrity sidecar file.
+
+    ``crc`` maps ``"file:offset"`` to ``[nbytes, crc32]`` for every
+    payload block this writer committed; ``device`` is a list aligned
+    with this writer's step entries holding the in-graph device-side
+    field checksums recorded for that step (None when the boundary ran
+    without the device probe). Rewritten atomically at every
+    ``end_step`` — same discipline as ``md.json`` — and pruned on
+    rollback-append so a resumed store's sidecar is byte-identical to
+    an uninterrupted run's."""
+
+    def __init__(self, store: str, writer_id: int = 0):
+        self.path = _integrity_path(store, writer_id)
+        self.crc: Dict[str, list] = {}
+        self.device: List[Optional[dict]] = []
+        self._pending_device: Optional[dict] = None
+
+    def load(self) -> "IntegrityMeta":
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            self.crc = dict(doc.get("crc") or {})
+            self.device = list(doc.get("device") or [])
+        except (FileNotFoundError, NotADirectoryError, ValueError,
+                TypeError, json.JSONDecodeError):
+            self.crc, self.device = {}, []
+        return self
+
+    def prune(self, data_file: str, cut: Optional[int],
+              keep_steps: int) -> None:
+        """Rollback: drop CRC entries at-or-past the payload cut of
+        ``data_file`` and device records past the kept step count."""
+        if cut is not None:
+            self.crc = {
+                k: v for k, v in self.crc.items()
+                if not (k.rpartition(":")[0] == data_file
+                        and int(k.rpartition(":")[2]) >= cut)
+            }
+        self.device = self.device[:keep_steps]
+
+    def record_block(self, data_file: str, offset: int,
+                     data: bytes) -> None:
+        self.crc[f"{data_file}:{offset}"] = [
+            len(data), zlib.crc32(data) & 0xFFFFFFFF,
+        ]
+
+    def record_device(self, checksums: Optional[dict]) -> None:
+        """Device-side field checksums for the step currently being
+        written (flushed with that step's ``end_step``)."""
+        self._pending_device = (
+            {str(k): int(v) for k, v in checksums.items()}
+            if checksums else None
+        )
+
+    def note_step(self, n_steps: int) -> None:
+        """Align the device list with the writer's committed step
+        count (called at ``end_step``; pads boundaries that ran
+        without the device probe)."""
+        while len(self.device) < n_steps - 1:
+            self.device.append(None)
+        if len(self.device) < n_steps:
+            self.device.append(self._pending_device)
+        self._pending_device = None
+
+    def flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"crc": self.crc, "device": self.device}, f)
+        os.replace(tmp, self.path)
+
+    def remove(self) -> None:
+        try:
+            os.remove(self.path)
+        except (FileNotFoundError, NotADirectoryError):
+            pass
 
 
 def _block_nbytes(variables: dict, name: str, block: dict) -> Optional[int]:
@@ -191,6 +310,7 @@ class BpWriter:
             else os.path.join(path, f"md.{writer_id}.json")
         )
         self._data_path = os.path.join(path, f"data.{writer_id}")
+        self._integrity = IntegrityMeta(path, writer_id)
         if append and os.path.exists(self._md_path):
             with open(self._md_path, "r", encoding="utf-8") as f:
                 self._md = json.load(f)
@@ -214,6 +334,15 @@ class BpWriter:
             if cut is not None and cut < self._offset:
                 os.truncate(self._data_path, cut)
                 self._offset = cut
+            # Rollback the integrity sidecar in lockstep: CRC entries
+            # past the payload cut and device records past the kept
+            # steps vanish too, keeping the sidecar byte-identical to
+            # an uninterrupted run's.
+            self._integrity.load()
+            self._integrity.prune(
+                os.path.basename(self._data_path), cut,
+                len(self._md["steps"]),
+            )
         else:
             self._md = {
                 "format": FORMAT_NAME,
@@ -226,6 +355,14 @@ class BpWriter:
             with open(self._data_path, "wb"):
                 pass
             self._offset = 0
+            # Fresh store: stale integrity/quarantine markers from a
+            # previous run at this path would mis-verify the new bytes.
+            self._integrity.remove()
+            if writer_id == 0:
+                try:
+                    os.remove(os.path.join(path, "quarantine.json"))
+                except OSError:
+                    pass
         self._data = open(self._data_path, "ab")
         self._in_step = False
         self._step_blocks: Dict[str, List[dict]] = {}
@@ -310,9 +447,19 @@ class BpWriter:
             "count": [int(c) for c in count],
         }
         data = arr.tobytes()
+        self._integrity.record_block(
+            os.path.basename(self._data_path), self._offset, data
+        )
         self._data.write(data)
         self._offset += len(data)
         self._step_blocks.setdefault(name, []).append(block)
+
+    def record_device_checksums(self, step: int, checksums) -> None:
+        """Attach the boundary's in-graph device-side field checksums
+        (``resilience/integrity.device_field_checksum``) to the step
+        being written; they land in the integrity sidecar next to the
+        block CRCs as per-step provenance."""
+        self._integrity.record_device(checksums)
 
     def end_step(self) -> None:
         """Complete the step: payload is flushed, then the metadata index is
@@ -323,6 +470,12 @@ class BpWriter:
         self._data.flush()
         os.fsync(self._data.fileno())
         self._md["steps"].append(self._step_blocks)
+        # Sidecar before metadata: a crash between the two leaves CRC
+        # entries for a step the metadata never committed (harmless —
+        # keyed by payload offset, overwritten on the re-append) rather
+        # than a committed step with no CRCs (silently unverifiable).
+        self._integrity.note_step(len(self._md["steps"]))
+        self._integrity.flush()
         self._flush_md()
         self._in_step = False
         self._step_blocks = {}
@@ -366,7 +519,8 @@ class BpReader:
     the reference's pdfcalc loop relies on (``pdfcalc.jl:112-123``).
     """
 
-    def __init__(self, path: str, *, wait_for_writer: bool = False):
+    def __init__(self, path: str, *, wait_for_writer: bool = False,
+                 verify: Optional[str] = None):
         """``wait_for_writer=True`` tolerates a store that does not exist
         yet (no directory, or no committed ``md.json``): construction
         succeeds with zero visible steps and ``begin_step`` polls until
@@ -374,15 +528,25 @@ class BpReader:
         uses, where the reader may attach during the writer's first-step
         compile window (20-60 s). The default is strict (immediate
         ``FileNotFoundError``), the right behavior for checkpoint
-        restores where a missing store is an operator error."""
+        restores where a missing store is an operator error.
+
+        ``verify`` overrides the resolved ``GS_CKPT_VERIFY`` mode for
+        this reader (any non-``off`` mode recomputes the CRC of every
+        block read against the store's integrity sidecar)."""
         self.path = path
         self._wait_for_writer = wait_for_writer
+        if verify is None:
+            from ..resilience.integrity import resolve_verify
+
+            verify = resolve_verify()
+        self._verify = verify != "off"
         if not wait_for_writer and not os.path.isdir(path):
             raise FileNotFoundError(f"No such BP-lite store: {path}")
         self._consumed = 0
         self._current: Optional[dict] = None
         self._selections: Dict[str, Tuple[List[int], List[int]]] = {}
         self._md: dict = {}
+        self._crcs: Dict[Tuple[str, int], int] = {}
         self._load_md()
 
     def _load_md(self) -> None:
@@ -400,11 +564,16 @@ class BpReader:
             }
             return
         nwriters = int(md0.get("nwriters", 1))
+        if self._verify:
+            self._crcs = {}
+            for w in range(nwriters):
+                self._crcs.update(read_integrity_crcs(self.path, w))
         if nwriters == 1:
             # Publish only durable steps: a torn final entry (crash
             # between begin_step and a durable end_step) must not be
             # readable — it would raise mid-restore or return garbage.
             md0["steps"] = md0["steps"][:durable_step_count(md0, self.path)]
+            self._drop_quarantined(md0)
             self._md = md0
             return
         # Multi-writer store: merge. A step is visible only once EVERY
@@ -418,9 +587,14 @@ class BpReader:
             if md_w is None:  # writer not started yet: nothing visible
                 md_w = {"complete": False, "steps": []}
             mds.append(md_w)
-        for m in mds:
+        for w, m in enumerate(mds):
             # Peer metadata normally carries its own variables table; a
-            # (corrupt) one without falls back to writer 0's.
+            # (corrupt) one without falls back to writer 0's — LOUDLY:
+            # a writer whose variable registry vanished is a damaged
+            # store, and a silent fallback would hide the first symptom
+            # of the corruption the integrity layer exists to surface.
+            if w > 0 and m.get("steps") and not m.get("variables"):
+                self._warn_corrupt_writer_md(w)
             checked = (
                 m if m.get("variables")
                 else dict(m, variables=md0.get("variables", {}))
@@ -436,7 +610,7 @@ class BpReader:
                 for var, blocks in m["steps"][i].items():
                     merged.setdefault(var, []).extend(blocks)
             steps.append(merged)
-        self._md = {
+        merged = {
             "format": md0.get("format", FORMAT_NAME),
             "complete": all(m.get("complete") for m in mds),
             "nwriters": nwriters,
@@ -444,6 +618,44 @@ class BpReader:
             "variables": md0.get("variables", {}),
             "steps": steps,
         }
+        self._drop_quarantined(merged)
+        self._md = merged
+
+    def _drop_quarantined(self, md: dict) -> None:
+        """Hide step entries the scrubber quarantined
+        (``resilience/integrity.py``): a corrupt durable entry must
+        not be served, and hiding it here is what lets "latest durable
+        checkpoint" roll past it to the newest *healthy* entry."""
+        from ..resilience.integrity import read_quarantine
+
+        bad = read_quarantine(self.path)
+        if bad:
+            md["steps"] = [
+                s for i, s in enumerate(md["steps"]) if i not in bad
+            ]
+
+    def _warn_corrupt_writer_md(self, writer_id: int) -> None:
+        """One ``corruption`` event + warn per reader for a writer
+        whose metadata lost its variable registry (satellite fix for
+        the old silent writer-0 fallback)."""
+        if getattr(self, "_warned_writers", None) is None:
+            self._warned_writers: set = set()
+        if writer_id in self._warned_writers:
+            return
+        self._warned_writers.add(writer_id)
+        fname = f"md.{writer_id}.json"
+        detail = (
+            f"writer {writer_id} metadata {fname} has steps but no "
+            "variable registry; validating its payloads against "
+            "writer 0's registry"
+        )
+        from ..obs import events as obs_events
+        from ..utils.log import Logger
+
+        obs_events.get_events().emit(
+            "corruption", path=self.path, file=fname, detail=detail
+        )
+        Logger().warn(f"BP-lite store {self.path}: {detail}")
 
     def _load_one(self, path: str, *, required: bool):
         for _ in range(50):
@@ -524,7 +736,31 @@ class BpReader:
     ) -> np.ndarray:
         """Read variable ``name`` at the current (or given) step, honoring
         any selection (``start``/``count`` here override a stored
-        ``set_selection``). Assembles the box from the step's blocks."""
+        ``set_selection``). Assembles the box from the step's blocks.
+        A CRC-mismatching block surfaces as a
+        :class:`~..resilience.integrity.CorruptionError` naming the
+        variable and step entry alongside the file/offset/CRC pair."""
+        try:
+            return self._get(name, step=step, start=start, count=count)
+        except Exception as e:
+            from ..resilience.integrity import CorruptionError
+
+            if isinstance(e, CorruptionError) and e.var is None:
+                raise CorruptionError(
+                    e.detail, path=e.path or self.path, file=e.file,
+                    offset=e.offset, var=name,
+                    step=step if step is not None else self._consumed,
+                ) from e
+            raise
+
+    def _get(
+        self,
+        name: str,
+        *,
+        step: Optional[int] = None,
+        start: Optional[Sequence[int]] = None,
+        count: Optional[Sequence[int]] = None,
+    ) -> np.ndarray:
         if step is None:
             if self._current is None:
                 raise RuntimeError("get outside begin_step/end_step "
@@ -589,6 +825,24 @@ class BpReader:
             raise IOError(
                 f"Short read in {block['file']} at {block['offset']}"
             )
+        if self._verify:
+            # Verify-on-read: a payload whose recorded CRC mismatches
+            # is never served (blocks written before the integrity
+            # sidecar existed have no recorded CRC and read as before).
+            want = self._crcs.get(
+                (block["file"], int(block["offset"]))
+            )
+            if want is not None:
+                got = zlib.crc32(buf) & 0xFFFFFFFF
+                if got != want:
+                    from ..resilience.integrity import CorruptionError
+
+                    raise CorruptionError(
+                        f"payload CRC mismatch: recorded {want:#010x}, "
+                        f"read {got:#010x}",
+                        path=self.path, file=block["file"],
+                        offset=int(block["offset"]),
+                    )
         arr = np.frombuffer(buf, dtype=dtype)
         return arr.reshape(shape) if shape else arr[0]
 

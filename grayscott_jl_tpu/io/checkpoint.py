@@ -51,80 +51,120 @@ class CheckpointWriter:
         creation layout, so a resumed store's metadata stays
         byte-identical to an uninterrupted run's even when the resuming
         attempt adopted a different mesh (the per-step blocks say what
-        each attempt actually wrote)."""
-        L = settings.L
-        # On restart, append: truncating would destroy the very store the
-        # run just resumed from when checkpoint_output == restart_input.
-        # But entries past the resume point (rollback) are dropped so a
-        # later restart never sees two trajectories for the same step.
-        keep = None
-        if settings.restart and resume_step is not None:
-            from . import count_steps_upto
+        each attempt actually wrote).
 
-            keep = count_steps_upto(settings.checkpoint_output, resume_step)
-        # Layout attributes go on fresh stores only (checkpoints are
-        # always BP-lite, so rank-0 metadata presence decides "fresh").
-        fresh = not (
-            settings.restart
-            and os.path.isfile(_md_path(settings.checkpoint_output))
-        )
-        # Checkpoints stay on the BP-lite engines even when adios2 is
-        # importable: rollback-append and selection-restore are BP-lite
-        # semantics, and nothing downstream needs ADIOS2 byte
-        # compatibility for checkpoints (the visualization/analysis
-        # output store is where that matters).
-        self.writer = open_writer(
-            settings.checkpoint_output,
-            writer_id=writer_id,
-            nwriters=nwriters,
-            append=settings.restart,
-            keep_steps=keep,
-            prefer_adios2=False,
-        )
+        Replication (docs/RESILIENCE.md "Data integrity"):
+        ``GS_CKPT_REPLICAS=N`` mirrors every define/save/close to
+        ``<path>.r1`` .. ``<path>.r<N-1>`` — each mirror a full
+        independent BP-lite store a restore can fail over to. A mirror
+        that went missing between launches self-heals as a fresh store
+        holding the post-resume history. ``GS_CKPT_VERIFY=full``
+        additionally read-back-verifies every saved step against the
+        recorded CRCs before the boundary is declared written."""
+        from ..resilience import integrity
+
+        L = settings.L
         model = resolve_model(settings)
         #: Checkpoint variables are the model's declared field names
         #: (Gray-Scott keeps ``u``/``v``) — the restore path
         #: (``Simulation.restore_from_reader``) reads the same names.
         self.field_names = model.field_names
-        if writer_id == 0:
-            self.writer.define_attribute("L", settings.L)
-            self.writer.define_attribute("precision", settings.precision)
-            self.writer.define_attribute("model", model.name)
-            self.writer.define_attribute(
-                "fields", list(self.field_names)
-            )
-            if layout is not None and fresh:
-                from ..reshard.plan import layout_attrs
+        self._verify = integrity.resolve_verify(settings) == "full"
+        #: Replica store paths, primary first.
+        self.paths = integrity.replica_paths(
+            settings.checkpoint_output, integrity.resolve_replicas(settings)
+        )
+        self.writers = []
+        for path in self.paths:
+            # On restart, append: truncating would destroy the very
+            # store the run just resumed from when checkpoint_output ==
+            # restart_input. But entries past the resume point
+            # (rollback) are dropped so a later restart never sees two
+            # trajectories for the same step. The rollback point is
+            # computed per replica — a stale mirror keeps fewer steps.
+            keep = None
+            if settings.restart and resume_step is not None:
+                from . import count_steps_upto
 
-                for name, value in layout_attrs(
-                    mesh_dims=layout.mesh_dims,
-                    axis_names=layout.axis_names,
-                    process_count=layout.process_count,
-                    halo_depth=layout.halo_depth,
-                    chain_fuse=layout.chain_fuse,
-                    ensemble_size=layout.ensemble_size,
-                ).items():
-                    self.writer.define_attribute(name, value)
-        self.writer.define_variable("step", np.int32)
-        for name in self.field_names:
-            self.writer.define_variable(
-                name, np.dtype(dtype).name, (L, L, L)
+                keep = count_steps_upto(path, resume_step)
+            # Layout attributes go on fresh stores only (checkpoints
+            # are always BP-lite, so rank-0 metadata presence decides
+            # "fresh").
+            fresh = not (
+                settings.restart and os.path.isfile(_md_path(path))
             )
+            # Checkpoints stay on the BP-lite engines even when adios2
+            # is importable: rollback-append and selection-restore are
+            # BP-lite semantics, and nothing downstream needs ADIOS2
+            # byte compatibility for checkpoints (the visualization/
+            # analysis output store is where that matters).
+            w = open_writer(
+                path,
+                writer_id=writer_id,
+                nwriters=nwriters,
+                append=settings.restart,
+                keep_steps=keep,
+                prefer_adios2=False,
+            )
+            if writer_id == 0:
+                w.define_attribute("L", settings.L)
+                w.define_attribute("precision", settings.precision)
+                w.define_attribute("model", model.name)
+                w.define_attribute("fields", list(self.field_names))
+                if layout is not None and fresh:
+                    from ..reshard.plan import layout_attrs
 
-    def save(self, step: int, blocks) -> None:
+                    for name, value in layout_attrs(
+                        mesh_dims=layout.mesh_dims,
+                        axis_names=layout.axis_names,
+                        process_count=layout.process_count,
+                        halo_depth=layout.halo_depth,
+                        chain_fuse=layout.chain_fuse,
+                        ensemble_size=layout.ensemble_size,
+                    ).items():
+                        w.define_attribute(name, value)
+            w.define_variable("step", np.int32)
+            for name in self.field_names:
+                w.define_variable(name, np.dtype(dtype).name, (L, L, L))
+            self.writers.append(w)
+
+    @property
+    def writer(self):
+        """The primary store's writer (historical single-replica
+        accessor; the mirrors ride behind it)."""
+        return self.writers[0]
+
+    def save(self, step: int, blocks, checksums=None) -> None:
         """``blocks``: iterable of ``(offsets, sizes, *field_blocks)``
         in model declaration order — this process's shards
-        (``Simulation.local_blocks``)."""
-        w = self.writer
-        w.begin_step()
-        w.put("step", np.int32(step))
-        for offsets, sizes, *fblocks in blocks:
-            for name, fb in zip(self.field_names, fblocks):
-                w.put(name, fb, start=offsets, count=sizes)
-        w.end_step()
+        (``Simulation.local_blocks``). ``checksums`` (optional
+        ``{field: device checksum}``) is the boundary's in-graph
+        device-side record, stored in the integrity sidecar."""
+        blocks = list(blocks)
+        for w in self.writers:
+            w.begin_step()
+            w.put("step", np.int32(step))
+            if checksums is not None and hasattr(
+                    w, "record_device_checksums"):
+                w.record_device_checksums(step, checksums)
+            for offsets, sizes, *fblocks in blocks:
+                for name, fb in zip(self.field_names, fblocks):
+                    w.put(name, fb, start=offsets, count=sizes)
+            w.end_step()
+        if self._verify:
+            # Write-side read-back verify (GS_CKPT_VERIFY=full): the
+            # boundary is not "written" until the landed bytes re-read
+            # clean against the CRCs recorded at put time.
+            from ..resilience.integrity import verify_last_step
+
+            for w, path in zip(self.writers, self.paths):
+                if hasattr(w, "drain"):
+                    w.drain()  # native engine publishes asynchronously
+                verify_last_step(path)
 
     def close(self) -> None:
-        self.writer.close()
+        for w in self.writers:
+            w.close()
 
 
 def latest_durable_step(path: str) -> Optional[int]:

@@ -119,6 +119,12 @@ class NativeBpWriter:
         md_name = "md.json" if writer_id == 0 else f"md.{writer_id}.json"
         # variable registry mirrored host-side for dtype coercion/validation
         self._vars = {}
+        # Integrity sidecar (io/bplite.py IntegrityMeta): the native
+        # engine stages payloads in C++, but the CRC ledger is managed
+        # host-side from the staged offsets bpw_put returns — same
+        # sidecar file, same schema, byte-compatible across engines.
+        self._integrity = _py.IntegrityMeta(path, writer_id)
+        self._n_steps = 0
         prior = None
         if append and os.path.exists(os.path.join(path, md_name)):
             with open(os.path.join(path, md_name), "r", encoding="utf-8") as f:
@@ -145,11 +151,23 @@ class NativeBpWriter:
                 and cut < os.path.getsize(data_path)
             ):
                 os.truncate(data_path, cut)
+            self._integrity.load()
+            self._integrity.prune(data_name, cut, len(kept))
+            self._n_steps = len(kept)
         self._h = lib.bpw_open(
             path.encode(), writer_id, nwriters, 1 if append else 0
         )
         if not self._h:
             raise IOError(f"Cannot open BP-lite store at {path}")
+        if prior is None:
+            # Fresh store: drop stale integrity/quarantine markers from
+            # a previous run at this path (mirrors the Python engine).
+            self._integrity.remove()
+            if writer_id == 0:
+                try:
+                    os.remove(os.path.join(path, "quarantine.json"))
+                except OSError:
+                    pass
         if prior is not None:
             # Forward ALL prior state (steps, variables, attributes) before
             # the single publish — a streaming reader must never observe
@@ -235,11 +253,22 @@ class NativeBpWriter:
         )
         if rc < 0:
             raise RuntimeError(f"native put failed for {name!r}")
+        # rc is the staged payload offset this block will land at.
+        self._integrity.record_block(
+            f"data.{self.writer_id}", int(rc), arr.tobytes()
+        )
+
+    def record_device_checksums(self, step: int, checksums) -> None:
+        """Same contract as ``BpWriter.record_device_checksums``."""
+        self._integrity.record_device(checksums)
 
     def end_step(self) -> None:
         if self._lib.bpw_end_step(self._handle()) != 0:
             raise RuntimeError("end_step called outside a step")
         self._in_step = False
+        self._n_steps += 1
+        self._integrity.note_step(self._n_steps)
+        self._integrity.flush()
 
     def drain(self) -> None:
         """Block until all queued steps are durable on disk."""

@@ -22,6 +22,12 @@ The base store stays byte-valid for any external ADIOS2/Fides tool —
 such a tool just also shows the rolled-back tail (documented in
 docs/PARITY.md); tools going through this package see the truth.
 
+Integrity (docs/RESILIENCE.md "Data integrity"): the rollback sidecar
+is a normal BP-lite store, so it carries its OWN per-writer integrity
+ledger (``integrity[.<w>].json``) and its reads are CRC-verified like
+any other BP-lite read; the real-ADIOS2 base has no ledger and reads
+unverified (its own format carries no recorded CRCs to check).
+
 Reference anchor: the store contract being preserved is
 ``/root/reference/src/simulation/IO.jl:37-70``.
 """

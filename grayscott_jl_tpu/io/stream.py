@@ -153,16 +153,23 @@ class SimStream:
                     max_step=resume_step, names=self.var_names,
                 )
 
-    def write_step(self, step: int, blocks) -> None:
+    def write_step(self, step: int, blocks, checksums=None) -> None:
         """Write one output step (``IO.write_step!``, ``IO.jl:82-96``).
 
         ``blocks`` is an iterable of ``(offsets, sizes, *field_blocks)``
         — this process's shards of the global fields in model
-        declaration order (``Simulation.local_blocks``).
+        declaration order (``Simulation.local_blocks``). ``checksums``
+        (optional ``{field: device checksum}``,
+        ``GS_CKPT_VERIFY=full``) records the boundary's in-graph
+        device-side field checksums in the store's integrity sidecar
+        (real-ADIOS2 stores have no sidecar and skip the record).
         """
         w = self.writer
         w.begin_step()
         w.put("step", np.int32(step))
+        if checksums is not None and hasattr(
+                w, "record_device_checksums"):
+            w.record_device_checksums(step, checksums)
         blocks = list(blocks)
         for offsets, sizes, *fblocks in blocks:
             for name, fb in zip(self.var_names, fblocks):

@@ -3,7 +3,9 @@
 Every discrete thing that happens to a run — injected faults, health
 trips, watchdog expiries (stack dumps included), supervisor restart
 decisions, autotune cache hits/misses, graceful-shutdown markers,
-output/checkpoint boundaries — lands in ``GS_EVENTS=path`` as one JSONL
+output/checkpoint boundaries, and the data-integrity records
+(``corruption`` / ``replica_failover`` / ``scrub``,
+``resilience/integrity.py``) — lands in ``GS_EVENTS=path`` as one JSONL
 record per event with a single schema::
 
     {"ts": <unix seconds>, "proc": <rank>, "kind": <event kind>,

@@ -112,21 +112,34 @@ def restore_run(
         step, plan = restore_ensemble(sim, settings, allow=allow)
     else:
         from ..io.checkpoint import open_checkpoint, read_layout
+        from ..resilience import integrity
 
-        reader, idx, step = open_checkpoint(
-            settings.restart_input, settings, settings.restart_step
-        )
-        try:
-            old = read_layout(reader)
-            plan = plan_mod.plan_restore(
-                old, layout_of(sim), L=settings.L, allow=allow
+        def restore_from(candidate):
+            reader, idx, step = open_checkpoint(
+                candidate, settings, settings.restart_step
             )
-            # The reshard IS these selection reads: each process pulls
-            # exactly its NEW shards' (start, count) boxes out of the
-            # global store — plan.boxes enumerates them.
-            sim.restore_from_reader(reader, idx, step)
-        finally:
-            reader.close()
+            try:
+                old = read_layout(reader)
+                plan = plan_mod.plan_restore(
+                    old, layout_of(sim), L=settings.L, allow=allow
+                )
+                # The reshard IS these selection reads: each process
+                # pulls exactly its NEW shards' (start, count) boxes
+                # out of the global store — plan.boxes enumerates them.
+                sim.restore_from_reader(reader, idx, step)
+                return step, plan
+            finally:
+                reader.close()
+
+        # Replica failover (docs/RESILIENCE.md "Data integrity"): a
+        # corrupt or unreadable candidate — CRC mismatch mid-selection-
+        # read included — fails over to the next replica in health
+        # order; a sole corrupted store refuses loudly with the CRC
+        # mismatch named instead of resuming wrong.
+        step, plan = integrity.restore_with_failover(
+            settings.restart_input, restore_from, journal=journal,
+            log=log,
+        )
     sim.reshard = plan.describe() if plan.changed else None
     if plan.changed:
         _announce(sim, plan, log=log, journal=journal)

@@ -48,6 +48,24 @@ Fault kinds:
     stall unwinds as a :class:`~.watchdog.HangError` — the wedged-
     collective / dead-tunnel shape, chaos-testable without a real
     wedge.
+``bitflip``
+    Fail-silent corruption on the write path: XORs one bit of the
+    boundary snapshot's device-side COPY (field/member-addressable —
+    ``GS_FAULT_MEMBER`` picks the ensemble member, like ``nan``)
+    *after* the in-graph integrity checksum read the pristine fields
+    (``Simulation.snapshot_async(bitflip=...)``). The live trajectory
+    is untouched; with ``GS_CKPT_VERIFY=full`` the host-side
+    recomputation catches the mismatch before the poisoned step
+    reaches any store and the boundary unwinds as a
+    :class:`~.integrity.CorruptionError` (classified ``corruption``).
+``ckpt_corrupt``
+    Fail-silent durable corruption: flips one payload byte of the
+    latest durable checkpoint entry in the PRIMARY store
+    (``resilience/integrity.corrupt_store_byte`` — metadata and
+    recorded CRCs untouched). Detected by verify-on-read at the next
+    restore (replica failover when ``GS_CKPT_REPLICAS`` mirrors
+    exist; a loud refusal when not) or by the ``GS_SCRUB`` boundary
+    scrubber, which quarantines the entry.
 
 This module also hosts the preemption-aware graceful-shutdown pieces
 (they share the failure taxonomy): :class:`ShutdownListener` turns
@@ -82,7 +100,10 @@ __all__ = [
     "resolve_graceful_shutdown",
 ]
 
-FAULT_KINDS = ("io_error", "nan", "preempt", "kernel", "hang")
+FAULT_KINDS = (
+    "io_error", "nan", "preempt", "kernel", "hang", "bitflip",
+    "ckpt_corrupt",
+)
 
 #: Distinct process exit codes, chosen from the sysexits "temporary
 #: failure" neighborhood so generic tooling reads them as retryable:

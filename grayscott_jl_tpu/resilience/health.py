@@ -10,6 +10,15 @@ fuses it into the same jitted program as the snapshot's device copy, so
 the scalars ride the boundary's existing D2H and no extra HBM pass is
 spent between boundaries).
 
+The probe family sharing that fused pass has three members: this
+health probe (semantic validity — finite, in range), the numerics
+recorder (``obs/numerics.py`` — statistics and drift), and the
+integrity checksum (``resilience/integrity.device_field_checksum`` —
+bit-level identity of the bytes bound for the stores, armed by
+``GS_CKPT_VERIFY=full``). Health answers "is the trajectory sane",
+integrity answers "are these the same bits the device computed";
+a bitflip the checksum catches may be perfectly finite and in range.
+
 Policy (``GS_HEALTH_POLICY`` / ``health_policy`` TOML key):
 
 ``abort`` (default)

@@ -1,0 +1,649 @@
+"""Defense-in-depth data integrity: checksums, replicas, scrubbing.
+
+The resilience stack up to here recovers every *fail-stop* fault —
+preemption, hangs, I/O errors, killed workers — but a flipped bit in a
+field buffer or a scribbled checkpoint byte is *fail-silent*: the run
+either crashes an unsupervised restore or, worse, resumes wrong and
+every downstream contract "passes" on poisoned data. Long production
+campaigns make silent data corruption a when-not-if event (the
+Frontier end-to-end workflow paper, arXiv:2309.10292, motivates
+exactly this durability regime); a corrupt store must be a detected,
+attributed, and *survived* event — never a wrong answer. Three layers
+(docs/RESILIENCE.md "Data integrity"):
+
+**Checksums** — every BP-lite payload block gets a CRC32 recorded in a
+per-writer *integrity sidecar file* inside the store directory
+(``integrity.<w>.json`` — metadata only; the ``md.json`` format and
+the payload bytes are untouched, so every existing byte-identity
+contract on stores is preserved). The reader recomputes the CRC on
+every block read (``GS_CKPT_VERIFY=read``, the default) and raises
+:class:`CorruptionError` naming the file, offset, and both CRCs
+instead of serving poisoned bytes. ``GS_CKPT_VERIFY=full``
+additionally arms (a) a write-side read-back verify after every
+checkpoint save and (b) a cheap in-graph **device-side field
+checksum** (:func:`device_field_checksum`) fused into the snapshot-
+copy jit next to the health and numerics probes: the wrapped uint
+sum of the raw field bits is computed on device over the pristine
+fields, and re-derived on the host from the very bytes about to hit
+the stores — a mismatch means the data changed somewhere on the
+device-copy → D2H → serialization path, and the boundary raises
+*before* the poisoned step reaches any store.
+
+**Replicas** — ``GS_CKPT_REPLICAS=N`` mirrors every checkpoint write
+to ``<path>.r1`` .. ``<path>.r<N-1>`` (ensemble member stores
+included). Restore, elastic reshard, and serve-requeue all try the
+candidates in *health order* (most durable steps first, primary
+winning ties) and fail over on a corrupt or unreadable candidate,
+emitting a ``replica_failover`` event per skip; with a sole corrupted
+replica the restore refuses loudly instead of resuming wrong.
+
+**Scrubbing** — ``GS_SCRUB=1`` arms a boundary-time scrubber
+(:class:`Scrubber`) that audits the durable steps of every checkpoint
+replica against the recorded CRCs and *quarantines* corrupt step
+entries (``quarantine.json`` — the reader hides them, so "latest
+durable checkpoint" silently rolls past a rotten entry), emitting
+``scrub`` / ``corruption`` events.
+
+The supervisor classifies a detected corruption as
+restartable-with-failover, but repeated corruption of the *same step*
+is non-transient (gave_up, not an infinite restart loop) —
+``resilience/supervisor.py``. The fault matrix grows ``bitflip``
+(device-side, field/member-addressable — exercises the checksum
+detection end to end) and ``ckpt_corrupt`` (flips a byte in a durable
+checkpoint store — exercises verify-on-read, scrub, and failover);
+``resilience/faults.py``.
+
+Stdlib + numpy to import; JAX only inside the device-probe helpers.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.env import env_flag, env_int, env_str
+
+__all__ = [
+    "VERIFY_MODES",
+    "CorruptionError",
+    "Scrubber",
+    "corrupt_store_byte",
+    "device_field_checksum",
+    "host_field_checksum",
+    "latest_durable_step_replicated",
+    "quarantine_path",
+    "read_quarantine",
+    "recoverable_restore_error",
+    "replica_paths",
+    "resolve_config",
+    "resolve_replicas",
+    "resolve_scrub",
+    "resolve_verify",
+    "restore_candidates",
+    "restore_with_failover",
+    "scrub_store",
+    "verify_last_step",
+]
+
+VERIFY_MODES = ("off", "read", "full")
+
+_QUARANTINE = "quarantine.json"
+
+
+class CorruptionError(RuntimeError):
+    """Recorded and recomputed checksums disagree: the bytes changed
+    between write and read (or between device and host). Carries
+    enough attribution for the "named step + file + CRC mismatch"
+    contract; the supervisor classifies it as ``corruption``."""
+
+    def __init__(self, detail: str, *, path: Optional[str] = None,
+                 file: Optional[str] = None, offset: Optional[int] = None,
+                 step: Optional[int] = None, var: Optional[str] = None,
+                 member: Optional[int] = None):
+        where = []
+        if var is not None:
+            where.append(f"var {var!r}")
+        if step is not None:
+            where.append(f"step {step}")
+        if member is not None:
+            where.append(f"member {member}")
+        if file is not None:
+            where.append(f"file {file!r}"
+                         + (f" offset {offset}" if offset is not None
+                            else ""))
+        if path is not None:
+            where.append(f"store {path}")
+        suffix = f" ({', '.join(where)})" if where else ""
+        super().__init__(detail + suffix)
+        self.detail = detail
+        self.path = path
+        self.file = file
+        self.offset = offset
+        self.step = step
+        self.var = var
+        self.member = member
+
+
+# --------------------------------------------------------------- knobs
+
+
+def resolve_replicas(settings=None) -> int:
+    """``GS_CKPT_REPLICAS`` — total checkpoint store copies (primary
+    included), default 1 (no mirrors)."""
+    n = env_int("GS_CKPT_REPLICAS", 1)
+    if n < 1:
+        raise ValueError(
+            f"GS_CKPT_REPLICAS must be >= 1, got {n}"
+        )
+    return n
+
+
+def resolve_verify(settings=None) -> str:
+    """``GS_CKPT_VERIFY`` — ``off`` | ``read`` (default: recompute the
+    CRC of every BP-lite block read) | ``full`` (read + write-side
+    read-back verify + the in-graph device-side field checksum on the
+    snapshot path)."""
+    mode = (env_str("GS_CKPT_VERIFY", "read") or "read").strip().lower()
+    if mode not in VERIFY_MODES:
+        raise ValueError(
+            f"GS_CKPT_VERIFY must be one of {'|'.join(VERIFY_MODES)}, "
+            f"got {mode!r}"
+        )
+    return mode
+
+
+def resolve_scrub(settings=None) -> Tuple[bool, int]:
+    """``GS_SCRUB`` (default off) arms the boundary-time checkpoint
+    scrubber; ``GS_SCRUB_EVERY`` audits every N-th checkpoint boundary
+    (default 1 = every one)."""
+    every = env_int("GS_SCRUB_EVERY", 1)
+    if every < 1:
+        raise ValueError(f"GS_SCRUB_EVERY must be >= 1, got {every}")
+    return env_flag("GS_SCRUB", False), every
+
+
+def resolve_config(settings=None) -> dict:
+    """The resolved integrity configuration the driver echoes into
+    ``RunStats.config["integrity"]``."""
+    scrub, every = resolve_scrub(settings)
+    return {
+        "replicas": resolve_replicas(settings),
+        "verify": resolve_verify(settings),
+        "scrub": scrub,
+        "scrub_every": every,
+    }
+
+
+# ------------------------------------------------------------ checksums
+
+
+def file_crc(data: bytes) -> int:
+    """CRC32 of one payload block's bytes (zlib, unsigned)."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def host_field_checksum(arr) -> int:
+    """Host-side mirror of :func:`device_field_checksum` over one
+    array's raw bytes: the wrapped (mod 2^32) sum of the array viewed
+    as little-endian unsigned words. Word width follows the dtype
+    (2-byte dtypes sum 16-bit words, everything else 32-bit words) so
+    the value matches the device reduction bit for bit."""
+    a = np.ascontiguousarray(arr)
+    if a.size == 0:
+        return 0
+    word = "<u2" if a.dtype.itemsize == 2 else "<u4"
+    words = a.view(np.dtype(word))
+    return int(words.astype(np.uint64).sum() % (1 << 32))
+
+
+def device_field_checksum(*fields):
+    """The fused in-graph per-field checksum probe: one wrapped uint32
+    sum of each field's raw bits, traced inside the snapshot-copy jit
+    next to the health probe (``Simulation.snapshot_async``) so the
+    fields are read from HBM once for copy + health + checksum
+    together. Integer addition is associative and commutative mod
+    2^32, so the value is exact and layout-independent — no tolerance,
+    no reduction-order caveats."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    out = ()
+    for f in fields:
+        width = jnp.dtype(f.dtype).itemsize
+        bits = lax.bitcast_convert_type(
+            f, jnp.uint16 if width == 2 else jnp.uint32
+        )
+        out += (jnp.sum(bits.astype(jnp.uint32), dtype=jnp.uint32),)
+    return out
+
+
+def apply_bitflip(arr, index: Sequence[int]):
+    """XOR the lowest bit of one element's bit pattern — the
+    ``bitflip`` fault body, applied to the snapshot's device-side copy
+    (field/member-addressable via ``index``) so the live trajectory is
+    untouched while the bytes bound for the stores are silently wrong.
+    Any single-bit flip changes the wrapped word sum by a nonzero
+    delta, so :func:`device_field_checksum` detection is guaranteed,
+    not probabilistic."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def flip(x):
+        width = jnp.dtype(x.dtype).itemsize
+        word = jnp.uint16 if width == 2 else jnp.uint32
+        bits = lax.bitcast_convert_type(x, word)
+        idx = tuple(index) + (0,) * (bits.ndim - len(index))
+        flipped = bits.at[idx].set(bits[idx] ^ word(1))
+        return lax.bitcast_convert_type(flipped, x.dtype)
+
+    return jax.jit(flip)(arr)
+
+
+# ------------------------------------------------------------- replicas
+
+
+def replica_paths(path: str, n: Optional[int] = None) -> List[str]:
+    """The write-side replica set for a checkpoint store: the primary
+    plus ``<path>.r1`` .. ``<path>.r<n-1>`` mirror directories."""
+    if n is None:
+        n = resolve_replicas()
+    return [path] + [f"{path}.r{k}" for k in range(1, n)]
+
+
+def _existing_replicas(path: str) -> List[str]:
+    """Replica mirrors present on disk (discovered, not configured —
+    a relaunch with ``GS_CKPT_REPLICAS=1`` still fails over to
+    mirrors a previous launch wrote)."""
+    out = []
+    for p in glob.glob(glob.escape(path) + ".r*"):
+        tail = p[len(path) + 2:]
+        if p[len(path):].startswith(".r") and tail.isdigit():
+            out.append((int(tail), p))
+    return [p for _, p in sorted(out)]
+
+
+def restore_candidates(path: str) -> List[str]:
+    """Restore-side candidate stores in *health order*: primary plus
+    every on-disk mirror, ordered by latest durable step descending
+    (a stale or empty replica is tried last), the primary winning
+    ties. The first candidate is what a replication-unaware restore
+    would have used."""
+    from ..io.checkpoint import latest_durable_step
+
+    cands = [path] + _existing_replicas(path)
+    if len(cands) == 1:
+        return cands
+
+    def health(p: str) -> int:
+        s = latest_durable_step(p)
+        return -1 if s is None else s
+
+    return sorted(cands, key=health, reverse=True)  # stable: primary first
+
+
+def latest_durable_step_replicated(path: str) -> Optional[int]:
+    """The best "latest durable checkpoint step" any replica of
+    ``path`` can serve — the replicated form of
+    ``io.checkpoint.latest_durable_step`` the supervisor's resume
+    quorum consults (a half-written primary must not drag the quorum
+    down while a mirror holds the step)."""
+    from ..io.checkpoint import latest_durable_step
+
+    steps = [latest_durable_step(p)
+             for p in [path] + _existing_replicas(path)]
+    live = [s for s in steps if s is not None]
+    return max(live) if live else None
+
+
+def recoverable_restore_error(exc: BaseException) -> bool:
+    """Is this restore failure worth trying another replica for?
+    Corruption, unreadable stores, and missing/absent step entries
+    fail over; config-identity errors (wrong model/precision/L) would
+    fail identically on every replica and re-raise immediately."""
+    if isinstance(exc, CorruptionError):
+        return True
+    if isinstance(exc, (FileNotFoundError, OSError)):
+        return True
+    if isinstance(exc, RuntimeError):
+        return "Unreadable BP-lite metadata" in str(exc)
+    if isinstance(exc, ValueError):
+        msg = str(exc)
+        return ("contains no steps" in msg
+                or "no entry for simulation step" in msg)
+    return False
+
+
+def restore_with_failover(path: str, attempt, *, journal=None,
+                          log=None):
+    """Run ``attempt(candidate_path)`` against the replica candidates
+    of ``path`` in health order, failing over on recoverable errors
+    (:func:`recoverable_restore_error`) with a ``replica_failover``
+    event per skipped candidate. Exhausting every candidate re-raises
+    the LAST error — with ``GS_CKPT_REPLICAS=1`` and a corrupted sole
+    store that is the loud CRC-mismatch refusal, never a silent wrong
+    resume. This is the one failover implementation restore, elastic
+    reshard, and the serve requeue path all route through."""
+    candidates = restore_candidates(path)
+    last: Optional[BaseException] = None
+    for i, cand in enumerate(candidates):
+        if last is not None:
+            _announce_failover(path, cand, last, journal=journal,
+                               log=log)
+        try:
+            return attempt(cand)
+        except BaseException as exc:  # noqa: BLE001 — filtered below
+            if not recoverable_restore_error(exc) or (
+                    i == len(candidates) - 1):
+                raise
+            last = exc
+    raise last  # pragma: no cover — loop always returns or raises
+
+
+def _announce_failover(path: str, next_path: str, exc: BaseException,
+                       *, journal=None, log=None) -> None:
+    detail = f"{type(exc).__name__}: {exc}"
+    if journal is not None:
+        journal.record(event="replica_failover", path=path,
+                       next=next_path, detail=detail)
+    else:
+        from ..obs import events as obs_events
+
+        obs_events.get_events().emit(
+            "replica_failover", path=path, next=next_path, detail=detail
+        )
+    from ..utils.log import Logger
+
+    (log or Logger()).warn(
+        f"checkpoint replica failover: {detail}; trying {next_path}"
+    )
+
+
+# ----------------------------------------------------------- quarantine
+
+
+def quarantine_path(store: str) -> str:
+    return os.path.join(store, _QUARANTINE)
+
+
+def read_quarantine(store: str) -> frozenset:
+    """Quarantined step-entry indices of a store (raw ``md.json``
+    positions). A torn or malformed marker degrades to "nothing
+    quarantined" — quarantine is an availability optimization, the
+    per-read CRC verify still refuses corrupt payloads."""
+    try:
+        with open(quarantine_path(store), encoding="utf-8") as f:
+            doc = json.load(f)
+        return frozenset(int(i) for i in doc["quarantined"])
+    except (FileNotFoundError, NotADirectoryError, ValueError,
+            TypeError, KeyError):
+        return frozenset()
+
+
+def add_quarantine(store: str, indices) -> None:
+    """Atomically extend the store's quarantine marker."""
+    merged = sorted(read_quarantine(store) | {int(i) for i in indices})
+    tmp = quarantine_path(store) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"quarantined": merged}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, quarantine_path(store))
+
+
+def remove_quarantine(store: str) -> None:
+    try:
+        os.remove(quarantine_path(store))
+    except (FileNotFoundError, NotADirectoryError):
+        pass
+
+
+# ------------------------------------------------------------- scrubber
+
+
+def scrub_store(path: str, *, journal=None, quarantine: bool = True
+                ) -> Optional[dict]:
+    """Audit every durable, not-yet-quarantined step entry of a
+    BP-lite store against the recorded block CRCs; quarantine the
+    corrupt ones. Returns an audit summary (``None`` for a store with
+    no committed metadata yet). Runs off the raw metadata — the
+    on-disk truth — so it never disturbs a live writer (metadata is
+    replaced atomically) and never consumes reader state."""
+    from ..io import bplite
+
+    md_path = os.path.join(path, "md.json")
+    if not os.path.isfile(md_path):
+        return None
+    try:
+        with open(md_path, encoding="utf-8") as f:
+            md0 = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    nwriters = int(md0.get("nwriters", 1))
+    already = read_quarantine(path)
+    corrupt: Dict[int, str] = {}
+    audited = 0
+    checked = 0
+    for w in range(nwriters):
+        name = "md.json" if w == 0 else f"md.{w}.json"
+        try:
+            with open(os.path.join(path, name), encoding="utf-8") as f:
+                md = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not md.get("variables"):
+            md = dict(md, variables=md0.get("variables", {}))
+        crcs = bplite.read_integrity_crcs(path, w)
+        n = bplite.durable_step_count(md, path)
+        for i, step_blocks in enumerate(md.get("steps", [])[:n]):
+            if i in already or i in corrupt:
+                continue
+            if w == 0:
+                audited += 1
+            bad = _scrub_step(path, md, step_blocks, crcs)
+            checked += bad[1]
+            if bad[0] is not None:
+                corrupt[i] = bad[0]
+    report = {
+        "path": path,
+        "steps_audited": audited,
+        "blocks_checked": checked,
+        "corrupt": sorted(corrupt),
+    }
+    for i, detail in sorted(corrupt.items()):
+        if journal is not None:
+            journal.record(event="corruption", path=path, step_index=i,
+                           detail=detail)
+    if corrupt and quarantine:
+        add_quarantine(path, corrupt)
+    if journal is not None:
+        journal.record(event="scrub", path=path,
+                       steps_audited=audited,
+                       corrupt=len(corrupt))
+    return report
+
+
+def _scrub_step(path: str, md: dict, step_blocks: dict, crcs: dict
+                ) -> Tuple[Optional[str], int]:
+    """CRC-audit one step entry; returns ``(first mismatch detail or
+    None, blocks checked)``. Blocks without a recorded CRC (pre-
+    integrity stores, the real-ADIOS2 engine) are skipped, not
+    failed."""
+    from ..io.bplite import _block_nbytes
+
+    checked = 0
+    for var, blocks in step_blocks.items():
+        if var.startswith("_"):
+            continue
+        for b in blocks:
+            want = crcs.get((b.get("file"), int(b.get("offset", 0))))
+            if want is None:
+                continue
+            nbytes = _block_nbytes(md.get("variables", {}), var, b)
+            if nbytes is None:
+                continue
+            try:
+                with open(os.path.join(path, b["file"]), "rb") as f:
+                    f.seek(int(b["offset"]))
+                    data = f.read(nbytes)
+            except OSError as e:
+                return (f"unreadable payload for {var!r}: {e}", checked)
+            checked += 1
+            got = file_crc(data)
+            if got != int(want):
+                return (
+                    f"CRC mismatch for {var!r} in {b['file']} at "
+                    f"offset {b['offset']}: recorded "
+                    f"{int(want):#010x}, read {got:#010x}",
+                    checked,
+                )
+    return (None, checked)
+
+
+class Scrubber:
+    """Boundary-time audit of the run's checkpoint stores.
+
+    The driver calls :meth:`maybe_scrub` at every checkpoint boundary;
+    every ``GS_SCRUB_EVERY``-th call audits each checkpoint store the
+    run writes (every replica; every ensemble member) and quarantines
+    corrupt durable entries, so a rotten checkpoint is found while the
+    run is still alive — not at the 3 a.m. restore that needed it."""
+
+    def __init__(self, settings, *, journal=None, every: int = 1):
+        self.settings = settings
+        self.journal = journal
+        self.every = max(1, int(every))
+        self._boundaries = 0
+        self.reports: List[dict] = []
+
+    def _paths(self) -> List[str]:
+        out: List[str] = []
+        ens = getattr(self.settings, "ensemble", None)
+        root = self.settings.checkpoint_output
+        if ens is not None:
+            from ..ensemble.io import member_path
+
+            roots = [member_path(root, i, ens.n)
+                     for i in range(ens.n) if ens.members[i].active]
+        else:
+            roots = [root]
+        for r in roots:
+            out.extend([r] + _existing_replicas(r))
+        return out
+
+    def maybe_scrub(self, step: int) -> Optional[List[dict]]:
+        self._boundaries += 1
+        if (self._boundaries - 1) % self.every:
+            return None
+        reports = []
+        for p in self._paths():
+            rep = scrub_store(p, journal=self.journal)
+            if rep is not None:
+                rep["step"] = step
+                reports.append(rep)
+        self.reports.extend(reports)
+        return reports
+
+    def describe(self) -> dict:
+        return {
+            "every": self.every,
+            "audits": len(self.reports),
+            "corrupt_found": sum(
+                len(r["corrupt"]) for r in self.reports
+            ),
+        }
+
+
+# ------------------------------------------------------- write-side etc
+
+
+def verify_last_step(path: str) -> None:
+    """Write-side read-back verify (``GS_CKPT_VERIFY=full``): re-read
+    every variable of the store's last durable step through the
+    CRC-verified read path, raising :class:`CorruptionError` if the
+    bytes that landed do not match what was checksummed at ``put``
+    time. Catches the write-path silent corruptions (bad DMA, lying
+    disk cache) while the data is one boundary old, not one campaign
+    old."""
+    from ..io.bplite import BpReader
+
+    r = BpReader(path, verify="read")
+    try:
+        n = r.num_steps()
+        if n == 0:
+            return
+        for name in r.available_variables():
+            try:
+                r.get(name, step=n - 1)
+            except KeyError:
+                continue
+    finally:
+        r.close()
+
+
+def primary_checkpoint_path(settings) -> str:
+    """The PRIMARY checkpoint store a ``ckpt_corrupt`` fault targets:
+    the solo store, or — for ensembles — the faulted member's
+    (``GS_FAULT_MEMBER``, like the ``nan``/``bitflip`` kinds)."""
+    ens = getattr(settings, "ensemble", None)
+    root = settings.checkpoint_output
+    if ens is None:
+        return root
+    from ..ensemble.io import member_path
+
+    member = env_int("GS_FAULT_MEMBER", 0) % ens.n
+    return member_path(root, member, ens.n)
+
+
+def corrupt_store_byte(path: str) -> Optional[dict]:
+    """The ``ckpt_corrupt`` fault body: XOR one payload byte of the
+    latest durable step's first field block in store ``path`` —
+    metadata and recorded CRCs untouched, so the corruption is exactly
+    the silent kind the verify/scrub/failover machinery exists to
+    catch. Returns what was flipped (or None when the store has no
+    durable field payload yet)."""
+    from ..io import bplite
+
+    md_path = os.path.join(path, "md.json")
+    if not os.path.isfile(md_path):
+        return None
+    try:
+        with open(md_path, encoding="utf-8") as f:
+            md = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    n = bplite.durable_step_count(md, path)
+    for i in range(n - 1, -1, -1):
+        for var, blocks in md.get("steps", [])[i].items():
+            if var.startswith("_") or var == "step":
+                continue
+            for b in blocks:
+                nbytes = bplite._block_nbytes(
+                    md.get("variables", {}), var, b
+                )
+                if not nbytes:
+                    continue
+                offset = int(b.get("offset", 0)) + nbytes // 2
+                fpath = os.path.join(path, b["file"])
+                with open(fpath, "r+b") as f:
+                    f.seek(offset)
+                    byte = f.read(1)
+                    if not byte:
+                        continue
+                    f.seek(offset)
+                    f.write(bytes([byte[0] ^ 0x01]))
+                    f.flush()
+                    os.fsync(f.fileno())
+                return {
+                    "path": path,
+                    "file": b["file"],
+                    "offset": offset,
+                    "var": var,
+                    "step_index": i,
+                }
+    return None

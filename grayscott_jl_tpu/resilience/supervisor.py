@@ -11,10 +11,14 @@ runtime layer, not user code, must absorb these):
 * **classify** the failure — ``transient-io`` (an ``AsyncIOError``
   whose original is an OS-level error, or a bare ``OSError``),
   ``preemption`` (:class:`~.faults.PreemptionError`), ``health``
-  (:class:`~.health.HealthError` under the ``rollback`` policy), or
-  ``kernel`` (a Mosaic/Pallas runtime failure). Anything else — a
-  config error, a programming bug — re-raises immediately: retrying an
-  unclassified failure just burns accelerator time.
+  (:class:`~.health.HealthError` under the ``rollback`` policy),
+  ``kernel`` (a Mosaic/Pallas runtime failure), or ``corruption``
+  (:class:`~.integrity.CorruptionError` — a CRC or device-checksum
+  mismatch; restartable with replica failover, but the SAME corrupt
+  step recurring is non-transient and gives up instead of looping).
+  Anything else — a config error, a programming bug — re-raises
+  immediately: retrying an unclassified failure just burns
+  accelerator time.
 * **retry** with exponential backoff (base ``GS_RESTART_BACKOFF_S``,
   default 0.5 s, cap 30 s) plus deterministic jitter (crc32 of the
   attempt/kind, not a live RNG — replayable), up to ``GS_MAX_RESTARTS``.
@@ -291,6 +295,7 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     transience there, where the failing write happened).
     """
     from ..io.async_writer import AsyncIOError
+    from .integrity import CorruptionError
 
     if isinstance(exc, PreemptionError):
         # GracefulShutdown is a PreemptionError too: same taxonomy slot,
@@ -303,7 +308,19 @@ def classify_failure(exc: BaseException) -> Optional[str]:
         return "health" if exc.policy == "rollback" else None
     if isinstance(exc, InjectedKernelError):
         return "kernel"
+    if isinstance(exc, CorruptionError):
+        # Detected silent corruption (CRC/device-checksum mismatch):
+        # restartable — the restore fails over to a healthy replica,
+        # or a clean re-snapshot replaces the corrupted boundary. The
+        # restart loop itself refuses to spin on the SAME corrupt step
+        # twice (supervise() tracks it; repeated corruption of one
+        # step is a rotten store, not a transient).
+        return "corruption"
     if isinstance(exc, AsyncIOError):
+        if isinstance(exc.original, CorruptionError):
+            # Unwrap like transience: the corruption was detected on
+            # the writer thread (snapshot verify, read-back verify).
+            return "corruption"
         return "transient-io" if exc.transient else None
     if isinstance(exc, OSError):
         return "transient-io"
@@ -316,6 +333,22 @@ def classify_failure(exc: BaseException) -> Optional[str]:
         if any(m in msg for m in _KERNEL_MARKERS):
             return "kernel"
     return None
+
+
+def _corruption_signature(exc: BaseException):
+    """What exactly was corrupt — ``(step, var, file)`` pulled from
+    the (possibly async-wrapped) :class:`~.integrity.CorruptionError`.
+    The supervisor restarts a corruption ONCE per signature: the first
+    occurrence gets the failover/re-snapshot retry, a recurrence of
+    the same signature means the data itself is rotten on every
+    replica and retrying forever would just burn accelerator time."""
+    from ..io.async_writer import AsyncIOError
+    from .integrity import CorruptionError
+
+    e = exc.original if isinstance(exc, AsyncIOError) else exc
+    if isinstance(e, CorruptionError):
+        return (e.step, e.var, e.file)
+    return (getattr(exc, "step", None), None, None)
 
 
 def latest_durable_checkpoint(settings) -> Optional[int]:
@@ -334,14 +367,14 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
     """
     if not settings.checkpoint:
         return None
-    from ..io.checkpoint import latest_durable_step
+    from .integrity import latest_durable_step_replicated
 
     ens = getattr(settings, "ensemble", None)
     if ens is not None:
         from ..ensemble.io import member_path
 
         steps = [
-            latest_durable_step(
+            latest_durable_step_replicated(
                 member_path(settings.checkpoint_output, i, ens.n)
             )
             for i in range(ens.n)
@@ -349,7 +382,10 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
         if any(s is None for s in steps):
             return None
         return min(steps)
-    return latest_durable_step(settings.checkpoint_output)
+    # Per store, the best step ANY replica serves (docs/RESILIENCE.md
+    # "Data integrity"): a half-written or quarantined primary entry
+    # must not drag the resume point down while a mirror holds it.
+    return latest_durable_step_replicated(settings.checkpoint_output)
 
 
 def _resolved_language(settings) -> str:
@@ -402,6 +438,7 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
     rdv = rdv_mod.from_env(settings)
     attempt = 0
     degraded: Optional[dict] = None
+    corrupt_seen: set = set()
 
     def _agree(resume_local: Optional[int]):
         """Quorum (attempt, restart step) across hosts; single-process
@@ -507,6 +544,31 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
                     error=f"{type(exc).__name__}: {exc}",
                 )
                 raise
+
+            if kind == "corruption":
+                # Detected silent corruption is restartable WITH
+                # failover — but only once per corrupt site: the same
+                # step corrupting again means every replica (or the
+                # re-snapshot) served rotten data, and an infinite
+                # restart loop on a rotten store is the one recovery
+                # this layer must never attempt.
+                sig = _corruption_signature(exc)
+                journal.record(
+                    event="corruption",
+                    step=sig[0],
+                    detail=f"{type(exc).__name__}: {exc}",
+                )
+                if sig in corrupt_seen:
+                    journal.record(
+                        event="gave_up", kind=kind, attempt=attempt,
+                        error=f"{type(exc).__name__}: {exc}",
+                        reason=(
+                            "repeated corruption of the same step — "
+                            "non-transient, refusing to restart-loop"
+                        ),
+                    )
+                    raise
+                corrupt_seen.add(sig)
 
             # Cluster consensus BEFORE the budget check: the adopted
             # attempt counter is the cluster max, so GS_MAX_RESTARTS

@@ -24,7 +24,11 @@ every member from the member-store checkpoint quorum
 so the resuming worker may sit on a different slice shape), or from
 scratch when nothing durable exists yet — the member stores finish
 byte-identical to an uninterrupted run either way (asserted in tier-1
-and chaos_smoke scenario 6).
+and chaos_smoke scenario 6). Detected silent corruption rides the
+same taxonomy (``corruption``, docs/RESILIENCE.md "Data integrity"):
+a requeued batch's member restore goes through the replica-failover
+read path, so a corrupt member checkpoint costs a ``replica_failover``
+event, not a wrong answer served to a tenant.
 """
 
 from __future__ import annotations

@@ -279,7 +279,8 @@ class FieldSnapshot:
     """
 
     def __init__(self, parts, step: int, health=None,
-                 field_names=("u", "v"), numerics=None):
+                 field_names=("u", "v"), numerics=None,
+                 checksums=None):
         #: Simulation step the snapshot was taken at.
         self.step = step
         self._parts = parts  # [(offsets, true_sizes, *field_devs), ...]
@@ -294,6 +295,12 @@ class FieldSnapshot:
         #: (``obs/numerics.device_numerics_probe``) when taken with
         #: ``numerics=True``; resolved by :meth:`numerics_report`.
         self._numerics = numerics
+        #: Device scalars of the fused per-field integrity checksum
+        #: (``resilience/integrity.device_field_checksum``) when taken
+        #: with ``checksum=True``; re-derived host-side from the very
+        #: bytes bound for the stores in :meth:`blocks` — a mismatch
+        #: raises before anything is written.
+        self._checksums = checksums
 
     def health_report(self):
         """Resolved :class:`~.resilience.health.HealthReport` for this
@@ -322,17 +329,74 @@ class FieldSnapshot:
             self._numerics, self.field_names
         )
 
+    def has_checksums(self) -> bool:
+        return self._checksums is not None
+
+    def checksum_report(self):
+        """Resolved per-field device checksums ``{field: int}``, or
+        None when the probe was not requested — the values the store
+        writers record in the integrity sidecar."""
+        if self._checksums is None:
+            return None
+        return {
+            n: int(np.asarray(c))
+            for n, c in zip(self.field_names, self._checksums)
+        }
+
+    def _host_checksums(self, host_parts):
+        """Per-field checksums recomputed from the resolved host
+        arrays (full shard storage, pads included — the same elements
+        the device reduction covered)."""
+        from .resilience.integrity import host_field_checksum
+
+        totals = [0] * len(self.field_names)
+        for part in host_parts:
+            for fi, arr in enumerate(part[2:]):
+                totals[fi] = (
+                    totals[fi] + host_field_checksum(arr)
+                ) % (1 << 32)
+        return totals
+
+    def _verify_checksums(self, host_parts) -> None:
+        """Compare the in-graph device-side checksums against the
+        host-side recomputation over the landed bytes; a mismatch is
+        data that changed somewhere on the device-copy → D2H path and
+        raises before the poisoned step can reach any store."""
+        from .resilience.integrity import CorruptionError
+
+        host = self._host_checksums(host_parts)
+        for name, dev, got in zip(
+            self.field_names, self._checksums, host
+        ):
+            want = int(np.asarray(dev))
+            if got != want:
+                raise CorruptionError(
+                    f"device-side field checksum mismatch: device "
+                    f"{want:#010x}, host {got:#010x} — snapshot bytes "
+                    "were silently corrupted in flight",
+                    step=self.step, var=name,
+                )
+
     def blocks(self):
         """Host blocks ``[(offsets, sizes, *field_blocks), ...]``,
         clipped to the true domain; blocks until the in-flight D2H
-        transfers land (idempotent — resolved once, then cached)."""
+        transfers land (idempotent — resolved once, then cached).
+        Snapshots taken with ``checksum=True`` verify the landed bytes
+        against the fused device-side checksum first
+        (:class:`~.resilience.integrity.CorruptionError` on mismatch —
+        classified ``corruption`` by the supervisor)."""
         if self._blocks is None:
+            host_parts = [
+                (offsets, true) + tuple(np.asarray(d) for d in devs)
+                for offsets, true, *devs in self._parts
+            ]
+            if self._checksums is not None:
+                self._verify_checksums(host_parts)
             out = []
-            for offsets, true, *devs in self._parts:
+            for offsets, true, *hosts in host_parts:
                 sl = tuple(slice(0, t) for t in true)
                 out.append(
-                    (offsets, true)
-                    + tuple(np.asarray(d)[sl] for d in devs)
+                    (offsets, true) + tuple(h[sl] for h in hosts)
                 )
             self._blocks = out
             self._parts = None  # release the device buffers
@@ -1354,7 +1418,8 @@ class Simulation:
         return parts
 
     def snapshot_async(
-        self, *, health: bool = False, numerics: bool = False
+        self, *, health: bool = False, numerics: bool = False,
+        checksum: bool = False, bitflip=None,
     ) -> FieldSnapshot:
         """Capture the current (u, v) for overlapped output: returns a
         :class:`FieldSnapshot` with non-blocking D2H transfers already
@@ -1377,14 +1442,24 @@ class Simulation:
         ``numerics=True`` fuses the per-field min/max/mean/L2/finite
         reductions (``obs/numerics.device_numerics_probe``) into the
         same program the same way (``FieldSnapshot.numerics_report``).
+        ``checksum=True`` (``GS_CKPT_VERIFY=full``) fuses the per-field
+        integrity checksum
+        (``resilience/integrity.device_field_checksum``) in next to
+        them; ``FieldSnapshot.blocks`` re-derives it from the landed
+        host bytes and refuses a mismatching boundary. ``bitflip``
+        (chaos hook, the ``bitflip`` fault kind) flips one bit of the
+        device-side COPY after the probes ran — silent write-path
+        corruption, field/member-addressable, live trajectory
+        untouched.
         """
-        key = (health, numerics)
+        key = (health, numerics, checksum)
         fn = self._snapshot_fns.get(key)
         if fn is None:
             # +0 forces a real output buffer (no donation, so XLA never
             # aliases inputs into outputs); sharding follows the inputs.
             device_probe = self._probe_fn() if health else None
             num_probe = self._numerics_probe_fn() if numerics else None
+            ck_probe = self._checksum_probe_fn() if checksum else None
 
             def copy(*fields):
                 out = [tuple(
@@ -1394,24 +1469,47 @@ class Simulation:
                     out.append(device_probe(*fields))
                 if num_probe is not None:
                     out.append(num_probe(*fields))
+                if ck_probe is not None:
+                    out.append(ck_probe(*fields))
                 return tuple(out) if len(out) > 1 else out[0]
 
             fn = self._snapshot_fns[key] = jax.jit(copy)
         res = fn(*self.fields)
-        if health or numerics:
+        if health or numerics or checksum:
             copies, *extras = res
             probe = extras.pop(0) if health else None
             nums = extras.pop(0) if numerics else None
+            cksums = extras.pop(0) if checksum else None
         else:
-            copies, probe, nums = res, None, None
+            copies, probe, nums, cksums = res, None, None, None
+        if bitflip is not None:
+            copies = self._apply_snapshot_bitflip(copies, bitflip)
         parts = self._shard_parts(*copies)
         for part in parts:
             for dev in part[2:]:
                 dev.copy_to_host_async()
         return self.snapshot_cls(
             parts, self.step, health=probe, numerics=nums,
-            field_names=self.model.field_names,
+            checksums=cksums, field_names=self.model.field_names,
         )
+
+    def _checksum_probe_fn(self):
+        from .resilience.integrity import device_field_checksum
+
+        return device_field_checksum
+
+    def _apply_snapshot_bitflip(self, copies, field="u"):
+        """The ``bitflip`` fault body: XOR one bit of one field's
+        snapshot COPY (after the checksum probe read the pristine
+        fields) — exactly the silent write-path corruption the
+        device-side checksum exists to catch. The live field buffers
+        are untouched: the trajectory is unchanged, only this
+        boundary's bytes are wrong."""
+        from .resilience.integrity import apply_bitflip
+
+        i = self._field_index(field if field is not True else "u")
+        flipped = apply_bitflip(copies[i], (0,) * copies[i].ndim)
+        return copies[:i] + (flipped,) + copies[i + 1:]
 
     def numerics_stats(self):
         """One probe-only numerics reduction over the live fields,

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# Chaos smoke test, six scenarios (1-3 against one uninterrupted
+# Chaos smoke test, seven scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
 # elastic — resume on a DIFFERENT mesh / member count than the kill,
-# 6 serve — a worker killed mid-batch under the service front door):
+# 6 serve — a worker killed mid-batch under the service front door,
+# 7 integrity — silent checkpoint corruption survived by replica
+# failover):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -35,7 +37,16 @@
 #      member-store checkpoint quorum -> every member store
 #      byte-identical to an uninterrupted service run; the merged
 #      event stream (job_* lifecycle kinds included) validates via
-#      gs_report.py --check.
+#      gs_report.py --check;
+#   7. data integrity (docs/RESILIENCE.md "Data integrity"): a
+#      ckpt_corrupt fault flips a payload byte in the PRIMARY
+#      checkpoint replica's freshly-durable entry mid-run, a later
+#      preemption forces a restore -> verify-on-read detects the CRC
+#      mismatch -> the restore fails over to the .r1 mirror
+#      (replica_failover on GS_EVENTS, validated by gs_report.py
+#      --check) -> final output stores byte-identical to an
+#      uninterrupted run, and the surviving mirror byte-identical to
+#      the uninterrupted primary.
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -527,7 +538,64 @@ PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
   exit 1
 }
 
-echo "chaos_smoke: PASS — all six scenarios recovered byte-identical" \
+echo "chaos_smoke: [7/7] integrity — ckpt corruption -> replica failover..."
+# The fault must corrupt the entry the restore will read: checkpoint
+# boundaries land at 20/40, so a corrupt step in [31, 40] fires at
+# boundary 40 (right after that entry became durable — depth 0 keeps
+# the write inline) and the preemption at 45 forces a restore OF that
+# entry. Seeded like the other scenarios, printed for replay.
+CORRUPT="$(python3 -c "import zlib; print(31 + zlib.crc32(b'ckpt:${SEED}') % 10)")"
+echo "chaos_smoke: seed=${SEED} -> ckpt_corrupt at step ${CORRUPT}, preempt at 45"
+mkdir -p "$WORK/integref" "$WORK/integ"
+for d in integref integ; do write_config "$WORK/$d"; done
+# Both runs (reference included) share the integrity env: replicated
+# checkpoints + full verify, so the byte-identity assertion compares
+# like with like — integrity sidecars and device checksums included.
+run "$WORK/integref" \
+  GS_CKPT_REPLICAS=2 \
+  GS_CKPT_VERIFY=full \
+  GS_ASYNC_IO_DEPTH=0 \
+  > "$WORK/integref.log" 2>&1
+run "$WORK/integ" \
+  GS_SUPERVISE=1 \
+  GS_MAX_RESTARTS=5 \
+  GS_RESTART_BACKOFF_S=0.05 \
+  GS_CKPT_REPLICAS=2 \
+  GS_CKPT_VERIFY=full \
+  GS_ASYNC_IO_DEPTH=0 \
+  GS_EVENTS="$WORK/integ/events.jsonl" \
+  GS_FAULTS="step=${CORRUPT}:kind=ckpt_corrupt;step=45:kind=preempt" \
+  > "$WORK/integ.log" 2>&1
+
+grep -aq '"kind": "replica_failover"' "$WORK/integ/events.jsonl" || {
+  echo "chaos_smoke: FAIL — the restore never failed over to the mirror" >&2
+  exit 1
+}
+grep -aq 'CRC mismatch' "$WORK/integ/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no CRC-mismatch detection on the event stream" >&2
+  exit 1
+}
+# Output stores byte-identical to the uninterrupted integrity run; the
+# surviving mirror byte-identical to the uninterrupted primary (the
+# corrupted primary differs by exactly the injected byte).
+for store in gs.bp gs.vtk; do
+  if ! diff -r "$WORK/integref/$store" "$WORK/integ/$store" > /dev/null; then
+    echo "chaos_smoke: FAIL — $store differs after the corruption failover" >&2
+    exit 1
+  fi
+done
+if ! diff -r "$WORK/integref/ckpt.bp" "$WORK/integ/ckpt.bp.r1" > /dev/null; then
+  echo "chaos_smoke: FAIL — surviving mirror differs from uninterrupted primary" >&2
+  exit 1
+fi
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/integ/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the integrity events" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all seven scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

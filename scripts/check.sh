@@ -32,8 +32,13 @@ else
 fi
 
 if [[ "${1:-}" != "--fast" ]]; then
-    echo "== quick pytest (unit, not slow) =="
-    JAX_PLATFORMS=cpu python -m pytest tests/unit -q -m 'not slow' \
+    echo "== quick pytest (unit + integrity chaos, not slow) =="
+    # The functional integrity-chaos file rides along (mirrors
+    # .github/workflows/check.yml): the fail-silent contracts —
+    # bitflip detection, ckpt_corrupt failover, sole-replica refusal —
+    # hold on every push (docs/RESILIENCE.md "Data integrity").
+    JAX_PLATFORMS=cpu python -m pytest tests/unit \
+        tests/functional/test_integrity_run.py -q -m 'not slow' \
         -p no:cacheprovider
 fi
 echo "check.sh: OK"

@@ -76,6 +76,15 @@ EVENT_KIND_SCHEMA = {
     "hang_exit": ("fault", "exit_code"),
     # elastic resharding
     "reshard": ("members",),
+    # data integrity (resilience/integrity.py, docs/RESILIENCE.md):
+    # detected silent corruption (CRC / device-checksum mismatch,
+    # damaged writer metadata), a restore failing over to a healthy
+    # checkpoint replica, and the boundary scrubber's audit summary.
+    # The injected chaos kinds (`bitflip`, `ckpt_corrupt`) ride the
+    # `injected` record like every other fault, in its `fault` attr.
+    "corruption": ("detail",),
+    "replica_failover": ("path", "detail"),
+    "scrub": ("path", "steps_audited", "corrupt"),
     # simulation-as-a-service job lifecycle (serve/, docs/SERVICE.md);
     # every record carries the tenant so the per-tenant timeline below
     # can attribute multi-tenant traffic from one stream.
@@ -424,6 +433,50 @@ def report_tenants(events) -> None:
                   f"{batch}{req} {wait} {total}")
 
 
+def report_integrity(events) -> None:
+    """The data-integrity story (docs/RESILIENCE.md): detected
+    corruptions, replica failovers, and scrub audits distilled from
+    the stream — the section an operator checks to answer "did this
+    campaign ever serve or survive a corrupt byte?"."""
+    def kind_of(e):
+        return e.get("kind") or e.get("event")
+
+    corruptions = [e for e in events if kind_of(e) == "corruption"]
+    failovers = [e for e in events if kind_of(e) == "replica_failover"]
+    scrubs = [e for e in events if kind_of(e) == "scrub"]
+    injected = [
+        e for e in events
+        if kind_of(e) == "injected"
+        and (e.get("attrs", e).get("fault")
+             or e.get("attrs", e).get("kind"))
+        in ("bitflip", "ckpt_corrupt")
+    ]
+    if not (corruptions or failovers or scrubs or injected):
+        return
+    audited = sum(
+        (e.get("attrs", e).get("steps_audited") or 0) for e in scrubs
+    )
+    quarantined = sum(
+        (e.get("attrs", e).get("corrupt") or 0) for e in scrubs
+    )
+    print("== integrity ==")
+    print(f"  corruption events={len(corruptions)} "
+          f"replica failovers={len(failovers)} "
+          f"scrub audits={len(scrubs)} "
+          f"(steps audited={audited}, quarantined={quarantined}) "
+          f"injected faults={len(injected)}")
+    for e in corruptions:
+        attrs = e.get("attrs", e)
+        where = attrs.get("path") or attrs.get("file") or ""
+        step = e.get("step", attrs.get("step"))
+        print(f"  corruption {'step ' + str(step) + ' ' if step is not None else ''}"
+              f"{where}: {attrs.get('detail')}")
+    for e in failovers:
+        attrs = e.get("attrs", e)
+        print(f"  failover {attrs.get('path')} -> {attrs.get('next')} "
+              f"({attrs.get('detail')})")
+
+
 def report_timeline(events, top: int) -> None:
     """The fault/recovery story, oldest first, with relative times —
     one chronological timeline; multi-process streams (rank-merged by
@@ -526,6 +579,7 @@ def main() -> int:
     if events:
         report_attempts(events)
         report_tenants(events)
+        report_integrity(events)
         report_timeline(events, args.top)
     return 0
 

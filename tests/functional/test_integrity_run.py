@@ -1,0 +1,243 @@
+"""Functional: the data-integrity layer end to end (fail-silent chaos).
+
+The fast deterministic tier-1 variants of `chaos_smoke.sh` scenario 7
+(docs/RESILIENCE.md "Data integrity"):
+
+* an injected `bitflip` (silent write-path corruption of the boundary
+  snapshot) is DETECTED by the device-side field checksum at the next
+  boundary, classified `corruption`, recovered by a supervised restart
+  — and every recovered store is byte-identical to an uninterrupted
+  run's (the integrity sidecars included);
+* an injected `ckpt_corrupt` (a flipped payload byte in a durable
+  checkpoint entry) is detected by verify-on-read at restore time and
+  survived by **replica failover** (`GS_CKPT_REPLICAS=2`), again with
+  byte-identical final stores;
+* the negative path: with `GS_CKPT_REPLICAS=1` and a corrupted sole
+  checkpoint, the restore refuses loudly (named step + file + CRC
+  mismatch) and the supervisor gives up on the repeat instead of
+  restart-looping;
+* the `GS_SCRUB` boundary scrubber finds and quarantines the corrupt
+  durable entry while the run is still alive.
+"""
+
+import json
+
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import run_cli, write_config
+
+STEPS = 60
+
+#: Every run in this file (chaos and reference alike) shares the
+#: integrity env under test so byte-comparisons compare like with
+#: like — the sidecars' device-checksum records included.
+FULL_VERIFY = {"GS_CKPT_VERIFY": "full"}
+
+
+def _run(tmp_path, name, *, faults=None, supervised=False,
+         extra_env=None, **config_kw):
+    d = tmp_path / name
+    d.mkdir()
+    kw = dict(
+        noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    kw.update(config_kw)
+    cfg = write_config(d, **kw)
+    env = {"GS_TPU_STATS": str(d / "stats.json")}
+    if supervised:
+        env.update({
+            "GS_SUPERVISE": "1",
+            "GS_MAX_RESTARTS": "5",
+            "GS_RESTART_BACKOFF_S": "0.01",
+        })
+    if faults:
+        env["GS_FAULTS"] = faults
+    env.update(extra_env or {})
+    res = run_cli(d, cfg, extra_env=env)
+    return d, res
+
+
+def _journal(d):
+    return [
+        json.loads(line)
+        for line in (d / "gs.bp.faults.jsonl").read_text().splitlines()
+    ]
+
+
+def test_bitflip_detected_by_device_checksum_and_recovered(tmp_path):
+    """Chaos acceptance, fail-silent edition: the bitflipped snapshot
+    never reaches a store — the device-vs-host checksum mismatch
+    unwinds the boundary, the supervisor classifies `corruption` and
+    resumes from the durable checkpoint, and the finished stores are
+    byte-identical to an uninterrupted run's."""
+    ref, res = _run(tmp_path, "ref", extra_env=FULL_VERIFY)
+    assert res.returncode == 0, res.stderr + res.stdout
+    d, res = _run(
+        tmp_path, "chaos", faults="step=25:kind=bitflip",
+        supervised=True, extra_env=FULL_VERIFY,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(ref / store, d / store)
+
+    events = _journal(d)
+    kinds = [(e["event"], e.get("kind")) for e in events]
+    assert ("injected", "bitflip") in kinds
+    assert ("recovery", "corruption") in kinds
+    corruption = next(e for e in events if e["event"] == "corruption")
+    assert "checksum mismatch" in corruption["detail"]
+    # Detection at the first boundary at-or-after the planned step.
+    assert corruption["step"] == 30
+
+
+def test_ckpt_corrupt_survived_by_replica_failover(tmp_path):
+    """A flipped byte in the primary checkpoint store's durable entry:
+    the restore detects the CRC mismatch, fails over to the `.r1`
+    mirror (replica_failover on the stream), and finishes with output
+    stores byte-identical to an uninterrupted run — and the surviving
+    mirror byte-identical to the uninterrupted primary."""
+    env = {**FULL_VERIFY, "GS_CKPT_REPLICAS": "2",
+           "GS_ASYNC_IO_DEPTH": "0"}
+    ref, res = _run(tmp_path, "ref", extra_env=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    d, res = _run(
+        tmp_path, "chaos",
+        faults="step=21:kind=ckpt_corrupt;step=31:kind=preempt",
+        supervised=True,
+        extra_env={**env, "GS_EVENTS": "events.jsonl"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for store in ("gs.bp", "gs.vtk"):
+        _assert_trees_byte_identical(ref / store, d / store)
+    # The corrupted primary differs by exactly the injected byte; the
+    # mirror that served the restore matches the uninterrupted primary.
+    _assert_trees_byte_identical(ref / "ckpt.bp", d / "ckpt.bp.r1")
+
+    events = [
+        json.loads(line)
+        for line in (d / "events.jsonl").read_text().splitlines()
+    ]
+    failovers = [e for e in events if e["kind"] == "replica_failover"]
+    assert failovers and "CRC mismatch" in failovers[0]["attrs"]["detail"]
+    kinds = [(e["event"], e.get("kind")) for e in _journal(d)]
+    assert ("injected", "ckpt_corrupt") in kinds
+    assert ("recovery", "preemption") in kinds
+
+
+def test_sole_corrupt_checkpoint_refuses_loudly_and_gives_up(tmp_path):
+    """Negative path: GS_CKPT_REPLICAS=1 and a corrupted sole
+    checkpoint. The restore must refuse with the named step + file +
+    CRC mismatch (never resume wrong), and the supervisor must give up
+    on the repeated corruption instead of restart-looping."""
+    d, res = _run(
+        tmp_path, "sole",
+        faults="step=21:kind=ckpt_corrupt;step=31:kind=preempt",
+        supervised=True,
+        extra_env={"GS_ASYNC_IO_DEPTH": "0"},
+    )
+    assert res.returncode != 0
+    blob = res.stderr + res.stdout
+    assert "CRC mismatch" in blob and "data.0" in blob
+    assert "step" in blob and "CorruptionError" in blob
+
+    events = _journal(d)
+    gave_up = [e for e in events if e["event"] == "gave_up"]
+    assert len(gave_up) == 1
+    assert "repeated corruption" in gave_up[0]["reason"]
+    # Exactly ONE corruption restart was attempted — no loop: the
+    # recovery sequence is the preemption resume, then one corruption
+    # retry, then gave_up.
+    recoveries = [e["kind"] for e in events if e["event"] == "recovery"]
+    assert recoveries == ["preemption", "corruption"]
+
+
+def test_scrub_quarantines_corrupt_entry_mid_run(tmp_path):
+    """The boundary-time scrubber: a ckpt_corrupt injected mid-run is
+    found at the NEXT checkpoint boundary, quarantined, and reported
+    as scrub/corruption events — the run itself completes."""
+    d, res = _run(
+        tmp_path, "scrub", faults="step=21:kind=ckpt_corrupt",
+        extra_env={
+            "GS_SCRUB": "1",
+            "GS_ASYNC_IO_DEPTH": "0",
+            "GS_EVENTS": "events.jsonl",
+        },
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    events = [
+        json.loads(line)
+        for line in (d / "events.jsonl").read_text().splitlines()
+    ]
+    scrubs = [e for e in events if e["kind"] == "scrub"]
+    corruptions = [e for e in events if e["kind"] == "corruption"]
+    assert scrubs and corruptions
+    assert sum(e["attrs"]["corrupt"] for e in scrubs) == 1
+    assert (d / "ckpt.bp" / "quarantine.json").exists()
+    stats = json.loads((d / "stats.json").read_text())
+    integ = stats["config"]["integrity"]
+    assert integ["scrub"] is True and integ["corrupt_found"] == 1
+    # The quarantined entry is hidden: the store still serves the
+    # healthy checkpoints (20 corrupted -> 40, 60 remain).
+    from grayscott_jl_tpu.io.bplite import BpReader
+
+    r = BpReader(str(d / "ckpt.bp"))
+    steps = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+    r.close()
+    assert steps == [40, 60]
+
+
+@pytest.mark.parametrize("member", [1])
+def test_ensemble_bitflip_names_the_member(tmp_path, member):
+    """Ensemble edition: a member-addressed bitflip is detected by the
+    vmapped device checksum with the member index named, recovery
+    resumes from the member-store quorum, and every member store is
+    byte-identical to the uninterrupted ensemble run's."""
+    table = '\n[ensemble]\npresets = ["spots", "chaos"]\n'
+
+    def write_ens(d, **kw):
+        cfg = write_config(d, **kw)
+        cfg.write_text(cfg.read_text() + table)
+        return cfg
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    cfg = write_ens(
+        ref, noise=0.1, steps=40, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(ref, cfg, extra_env=FULL_VERIFY)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    d = tmp_path / "chaos"
+    d.mkdir()
+    cfg = write_ens(
+        d, noise=0.1, steps=40, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg, extra_env={
+        **FULL_VERIFY,
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+        "GS_FAULTS": "step=25:kind=bitflip",
+        "GS_FAULT_MEMBER": str(member),
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    for m in ("m00", "m01"):
+        for store in (f"gs.{m}.bp", f"gs.{m}.vtk", f"ckpt.{m}.bp"):
+            _assert_trees_byte_identical(ref / store, d / store)
+
+    events = [
+        json.loads(line)
+        for line in (d / "gs.bp.faults.jsonl").read_text().splitlines()
+    ]
+    corruption = next(e for e in events if e["event"] == "corruption")
+    assert f"member {member}" in corruption["detail"]
+    assert ("recovery", "corruption") in [
+        (e["event"], e.get("kind")) for e in events
+    ]

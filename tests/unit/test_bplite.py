@@ -430,3 +430,125 @@ def test_append_trims_torn_crash_tail(tmp_path):
     np.testing.assert_array_equal(
         r.get("U", step=3), np.full((4, 4, 4), 3, np.float32)
     )
+
+
+def test_torn_write_fuzz_every_tail_offset(tmp_path):
+    """Torn-write fuzz (docs/RESILIENCE.md "Data integrity"): truncate
+    the store at EVERY byte offset of the tail record and assert the
+    reader never raises and exposes only durable steps — then flip
+    every byte of the tail record in place and assert the reader never
+    serves a payload whose recorded CRC mismatches."""
+    import os
+
+    from grayscott_jl_tpu.resilience.integrity import CorruptionError
+
+    path = _store(tmp_path)
+    w = BpWriter(path)
+    w.define_variable("step", np.int32)
+    w.define_variable("U", np.float32, (3, 3))
+    for i in range(3):
+        w.begin_step()
+        w.put("step", np.int32(i))
+        w.put("U", np.full((3, 3), i, np.float32))
+        w.end_step()
+    w.close()
+
+    data = os.path.join(path, "data.0")
+    size = os.path.getsize(data)
+    tail_nbytes = 4 + 3 * 3 * 4  # step scalar + one U block
+    tail_start = size - tail_nbytes
+
+    def read_all(expect_steps):
+        r = BpReader(path, verify="read")
+        assert r.num_steps() == expect_steps
+        for s in range(expect_steps):
+            assert int(r.get("step", step=s)) == s
+            np.testing.assert_array_equal(
+                r.get("U", step=s), np.full((3, 3), s, np.float32)
+            )
+        r.close()
+
+    # Truncation sweep, deepest cut last: every cut inside the tail
+    # record hides exactly the torn final step, never raises.
+    payload = open(data, "rb").read()
+    for cut in range(size - 1, tail_start - 1, -1):
+        os.truncate(data, cut)
+        read_all(2)
+    # Restore and sweep single-byte flips across the tail record: the
+    # step stays visible (sizes check out) but any read of the flipped
+    # block must refuse with a CRC mismatch instead of serving it.
+    with open(data, "wb") as f:
+        f.write(payload)
+    read_all(3)
+    for off in range(tail_start, size):
+        with open(data, "r+b") as f:
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        r = BpReader(path, verify="read")
+        assert r.num_steps() == 3
+        var = "step" if off < tail_start + 4 else "U"
+        with pytest.raises(CorruptionError):
+            r.get(var, step=2)
+        r.close()
+        with open(data, "r+b") as f:  # heal for the next offset
+            f.seek(off)
+            f.write(byte)
+    read_all(3)
+
+
+def test_multiwriter_corrupt_peer_metadata_warns_and_emits(
+    tmp_path, capsys, monkeypatch
+):
+    """Satellite fix: a writer-k metadata set that lost its variable
+    registry used to fall back to writer 0's silently — now the reader
+    warns and emits a `corruption` event naming the writer and file,
+    while the fallback (the availability half of the old behavior)
+    still serves the merged steps."""
+    import json
+    import os
+
+    from grayscott_jl_tpu.obs import events as obs_events
+
+    path = _store(tmp_path)
+    writers = [
+        BpWriter(path, writer_id=w, nwriters=2) for w in range(2)
+    ]
+    for w, bw in enumerate(writers):
+        bw.define_variable("step", np.int32)
+        bw.define_variable("U", np.float32, (2, 4))
+        bw.begin_step()
+        if w == 0:
+            bw.put("step", np.int32(0))
+        bw.put(
+            "U", np.full((2, 2), w, np.float32),
+            start=(0, 2 * w), count=(2, 2),
+        )
+        bw.end_step()
+        bw.close()
+
+    md1 = os.path.join(path, "md.1.json")
+    doc = json.load(open(md1))
+    del doc["variables"]
+    json.dump(doc, open(md1, "w"))
+
+    stream = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("GS_EVENTS", stream)
+    obs_events.reset_events()
+    try:
+        r = BpReader(path)
+        assert r.num_steps() == 1
+        np.testing.assert_array_equal(
+            r.get("U", step=0)[:, 2:], np.ones((2, 2), np.float32)
+        )
+        r.close()
+    finally:
+        obs_events.reset_events()
+        monkeypatch.delenv("GS_EVENTS")
+
+    out = capsys.readouterr()
+    assert "md.1.json" in out.out and "writer 1" in out.out
+    events = [json.loads(line) for line in open(stream)]
+    assert [e["kind"] for e in events] == ["corruption"]
+    assert events[0]["attrs"]["file"] == "md.1.json"

@@ -1,0 +1,419 @@
+"""Unit: the data-integrity layer (``resilience/integrity.py``).
+
+Checksummed stores, replicated checkpoint writes, health-ordered
+restore failover, scrub + quarantine, the device/host field-checksum
+pair, and the supervisor's corruption taxonomy — the fail-silent half
+of docs/RESILIENCE.md, exercised at the module level. The end-to-end
+chaos proofs (bitflip detection, ckpt_corrupt failover, sole-replica
+refusal) live in ``tests/functional/test_integrity_run.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from grayscott_jl_tpu.io.bplite import (
+    BpReader,
+    BpWriter,
+    IntegrityMeta,
+    read_integrity_crcs,
+)
+from grayscott_jl_tpu.resilience import integrity
+from grayscott_jl_tpu.resilience.integrity import (
+    CorruptionError,
+    corrupt_store_byte,
+    host_field_checksum,
+    read_quarantine,
+    scrub_store,
+)
+
+
+def write_store(path, steps=3, shape=(4, 4), seed=0):
+    """A small single-writer Python-engine store with recorded CRCs."""
+    rng = np.random.default_rng(seed)
+    w = BpWriter(str(path))
+    w.define_variable("step", np.int32)
+    w.define_variable("u", np.float32, shape)
+    w.define_variable("v", np.float32, shape)
+    for i in range(steps):
+        w.begin_step()
+        w.put("step", np.int32(i))
+        w.put("u", rng.random(shape, dtype=np.float32))
+        w.put("v", rng.random(shape, dtype=np.float32))
+        w.end_step()
+    w.close()
+    return str(path)
+
+
+# ------------------------------------------------------------ knobs
+
+
+def test_resolve_knobs_defaults(monkeypatch):
+    for k in ("GS_CKPT_REPLICAS", "GS_CKPT_VERIFY", "GS_SCRUB",
+              "GS_SCRUB_EVERY"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = integrity.resolve_config()
+    assert cfg == {"replicas": 1, "verify": "read", "scrub": False,
+                   "scrub_every": 1}
+
+
+@pytest.mark.parametrize("knob,bad", [
+    ("GS_CKPT_REPLICAS", "0"),
+    ("GS_CKPT_VERIFY", "sometimes"),
+    ("GS_SCRUB_EVERY", "0"),
+])
+def test_resolve_knobs_invalid_raise(monkeypatch, knob, bad):
+    monkeypatch.setenv(knob, bad)
+    with pytest.raises(ValueError):
+        integrity.resolve_config()
+
+
+# -------------------------------------------------- CRC record/verify
+
+
+def test_crc_recorded_per_block_and_verified(tmp_path):
+    store = write_store(tmp_path / "s.bp")
+    crcs = read_integrity_crcs(store)
+    # 3 steps x (step scalar + u + v)
+    assert len(crcs) == 9
+    r = BpReader(store, verify="read")
+    for i in range(3):
+        r.get("u", step=i)
+        r.get("v", step=i)
+    r.close()
+
+
+def test_verify_on_read_refuses_corrupt_block(tmp_path):
+    store = write_store(tmp_path / "s.bp")
+    info = corrupt_store_byte(store)
+    assert info["var"] in ("u", "v")
+    r = BpReader(store, verify="read")
+    with pytest.raises(CorruptionError) as ei:
+        r.get(info["var"], step=info["step_index"])
+    msg = str(ei.value)
+    # The "named step + file + CRC mismatch" contract.
+    assert "CRC mismatch" in msg and info["file"] in msg
+    assert f"step {info['step_index']}" in msg
+    assert ei.value.var == info["var"]
+    # The untouched variable still reads clean.
+    r.get("step", step=info["step_index"])
+    r.close()
+
+
+def test_verify_off_serves_old_behavior(tmp_path):
+    store = write_store(tmp_path / "s.bp")
+    corrupt_store_byte(store)
+    r = BpReader(store, verify="off")
+    for i in range(3):  # documented escape hatch: no CRC checks at all
+        r.get("u", step=i)
+    r.close()
+
+
+def test_corrupt_store_byte_leaves_metadata_untouched(tmp_path):
+    store = write_store(tmp_path / "s.bp")
+    md_before = open(os.path.join(store, "md.json"), "rb").read()
+    crcs_before = read_integrity_crcs(store)
+    assert corrupt_store_byte(store) is not None
+    assert open(os.path.join(store, "md.json"), "rb").read() == md_before
+    assert read_integrity_crcs(store) == crcs_before
+
+
+def test_missing_or_torn_sidecar_degrades_to_unverified(tmp_path):
+    store = write_store(tmp_path / "s.bp")
+    with open(os.path.join(store, "integrity.json"), "w") as f:
+        f.write('{"crc": {"data.0')  # torn mid-write
+    r = BpReader(store, verify="read")
+    r.get("u", step=2)
+    r.close()
+    os.remove(os.path.join(store, "integrity.json"))
+    r = BpReader(store, verify="read")
+    r.get("u", step=2)
+    r.close()
+
+
+def test_rollback_append_prunes_sidecar_to_byte_identity(tmp_path):
+    """A keep_steps rollback-append that rewrites the same trajectory
+    must leave the integrity sidecar byte-identical to an
+    uninterrupted store's (the chaos byte-identity contract extended
+    to the sidecar)."""
+    rng = np.random.default_rng(1)
+    draws = [(rng.random((4, 4), dtype=np.float32),
+              rng.random((4, 4), dtype=np.float32)) for _ in range(3)]
+
+    def write(path, pairs, **kw):
+        w = BpWriter(str(path), **kw)
+        w.define_variable("step", np.int32)
+        w.define_variable("u", np.float32, (4, 4))
+        w.define_variable("v", np.float32, (4, 4))
+        for i, (u, v) in pairs:
+            w.begin_step()
+            w.put("step", np.int32(i))
+            w.put("u", u)
+            w.put("v", v)
+            w.end_step()
+        w.close()
+
+    write(tmp_path / "a.bp", list(enumerate(draws)))
+    write(tmp_path / "b.bp", list(enumerate(draws)))
+    # Roll b back to 2 steps and re-append the same third step.
+    w = BpWriter(str(tmp_path / "b.bp"), append=True, keep_steps=2)
+    w.begin_step()
+    w.put("step", np.int32(2))
+    w.put("u", draws[2][0])
+    w.put("v", draws[2][1])
+    w.end_step()
+    w.close()
+    ia = open(os.path.join(tmp_path / "a.bp", "integrity.json"),
+              "rb").read()
+    ib = open(os.path.join(tmp_path / "b.bp", "integrity.json"),
+              "rb").read()
+    assert ia == ib
+
+
+# -------------------------------------------- device/host checksums
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16])
+def test_device_and_host_checksums_agree(dtype):
+    # float64 needs jax x64 mode (else jnp silently downcasts and the
+    # pair diverges by construction) — covered host-side below.
+    jax = pytest.importorskip("jax")
+    rng = np.random.default_rng(3)
+    arr = rng.standard_normal((5, 4, 3)).astype(dtype)
+    arr.flat[0] = np.nan  # bit-level checksum must not care
+    dev = jax.jit(integrity.device_field_checksum)(jax.numpy.asarray(arr))
+    assert int(np.asarray(dev[0])) == host_field_checksum(arr)
+
+
+def test_host_checksum_float64_is_u32_word_sum():
+    arr = np.arange(6, dtype=np.float64).reshape(2, 3)
+    manual = int(
+        np.frombuffer(arr.tobytes(), dtype="<u4").astype(np.uint64)
+        .sum() % (1 << 32)
+    )
+    assert host_field_checksum(arr) == manual
+
+
+def test_host_checksum_splits_across_parts():
+    rng = np.random.default_rng(4)
+    arr = rng.standard_normal((6, 4)).astype(np.float32)
+    whole = host_field_checksum(arr)
+    split = (host_field_checksum(arr[:2]) + host_field_checksum(arr[2:])
+             ) % (1 << 32)
+    assert whole == split
+
+
+def test_apply_bitflip_changes_exactly_one_element_and_checksum():
+    jax = pytest.importorskip("jax")
+    arr = jax.numpy.ones((3, 3, 3), jax.numpy.float32)
+    flipped = integrity.apply_bitflip(arr, (1, 2, 0))
+    diff = np.asarray(arr) != np.asarray(flipped)
+    assert diff.sum() == 1 and diff[1, 2, 0]
+    assert host_field_checksum(np.asarray(arr)) != host_field_checksum(
+        np.asarray(flipped)
+    )
+
+
+def test_snapshot_checksum_detects_injected_flip(monkeypatch):
+    """The end-to-end snapshot contract at the Simulation level: a
+    bitflipped copy fails blocks() with the member/field named; a clean
+    snapshot verifies and serves blocks."""
+    pytest.importorskip("jax")
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+
+    s = Settings(L=8, steps=1, plotgap=1)
+    sim = Simulation(s, n_devices=1)
+    snap = sim.snapshot_async(checksum=True)
+    assert snap.checksum_report().keys() == {"u", "v"}
+    assert len(snap.blocks()) >= 1  # clean verify
+    bad = sim.snapshot_async(checksum=True, bitflip=True)
+    with pytest.raises(CorruptionError) as ei:
+        bad.blocks()
+    assert ei.value.var == "u" and "checksum mismatch" in str(ei.value)
+
+
+# ------------------------------------------------ replicas / failover
+
+
+def test_replica_paths_and_candidates_health_order(tmp_path):
+    primary = write_store(tmp_path / "c.bp", steps=1)
+    r1 = write_store(tmp_path / "c.bp.r1", steps=3)
+    assert integrity.replica_paths(str(tmp_path / "c.bp"), 3) == [
+        str(tmp_path / "c.bp"),
+        str(tmp_path / "c.bp") + ".r1",
+        str(tmp_path / "c.bp") + ".r2",
+    ]
+    # r1 holds MORE durable steps -> health order puts it first.
+    assert integrity.restore_candidates(primary) == [r1, primary]
+    assert integrity.latest_durable_step_replicated(primary) == 2
+
+
+def test_restore_with_failover_skips_corrupt_candidate(tmp_path):
+    primary = write_store(tmp_path / "c.bp", steps=2)
+    write_store(tmp_path / "c.bp.r1", steps=2)
+    corrupt_store_byte(primary)
+    tried = []
+
+    def attempt(path):
+        tried.append(path)
+        r = BpReader(path, verify="read")
+        try:
+            return [np.asarray(r.get("u", step=i)) for i in range(2)]
+        finally:
+            r.close()
+
+    out = integrity.restore_with_failover(primary, attempt)
+    assert len(out) == 2
+    assert tried == [primary, primary + ".r1"]
+
+
+def test_restore_with_failover_sole_replica_reraises(tmp_path):
+    primary = write_store(tmp_path / "c.bp", steps=2)
+    corrupt_store_byte(primary)
+
+    def attempt(path):
+        r = BpReader(path, verify="read")
+        try:
+            return [r.get("u", step=i) for i in range(2)]
+        finally:
+            r.close()
+
+    with pytest.raises(CorruptionError):
+        integrity.restore_with_failover(primary, attempt)
+
+
+def test_failover_never_retries_config_errors(tmp_path):
+    primary = write_store(tmp_path / "c.bp", steps=2)
+    write_store(tmp_path / "c.bp.r1", steps=2)
+    calls = []
+
+    def attempt(path):
+        calls.append(path)
+        raise ValueError("Checkpoint store holds model 'heat' ...")
+
+    with pytest.raises(ValueError):
+        integrity.restore_with_failover(primary, attempt)
+    assert calls == [primary]  # config errors re-raise immediately
+
+
+# --------------------------------------------------- scrub/quarantine
+
+
+def test_scrub_quarantines_and_reader_hides(tmp_path):
+    store = write_store(tmp_path / "s.bp", steps=3)
+    info = corrupt_store_byte(store)
+    rep = scrub_store(store)
+    assert rep["corrupt"] == [info["step_index"]]
+    assert read_quarantine(store) == {info["step_index"]}
+    r = BpReader(store, verify="read")
+    assert r.num_steps() == 2  # the corrupt entry is hidden
+    steps = [int(r.get("step", step=i)) for i in range(2)]
+    assert steps == [0, 1]
+    r.close()
+    # Clean store: audit finds nothing, nothing quarantined.
+    clean = write_store(tmp_path / "clean.bp", steps=2)
+    rep2 = scrub_store(clean)
+    assert rep2["corrupt"] == [] and read_quarantine(clean) == frozenset()
+
+
+def test_latest_durable_step_rolls_past_quarantined_entry(tmp_path):
+    from grayscott_jl_tpu.io.checkpoint import latest_durable_step
+
+    store = write_store(tmp_path / "s.bp", steps=3)
+    assert latest_durable_step(store) == 2
+    corrupt_store_byte(store)
+    scrub_store(store)
+    assert latest_durable_step(store) == 1
+
+
+def test_fresh_write_clears_quarantine_and_sidecar(tmp_path):
+    store = write_store(tmp_path / "s.bp", steps=2)
+    corrupt_store_byte(store)
+    scrub_store(store)
+    assert read_quarantine(store)
+    write_store(tmp_path / "s.bp", steps=1, seed=9)
+    assert read_quarantine(store) == frozenset()
+    assert len(read_integrity_crcs(store)) == 3
+
+
+def test_scrubber_audits_replicas(tmp_path):
+    class S:
+        checkpoint_output = str(tmp_path / "c.bp")
+        ensemble = None
+
+    write_store(tmp_path / "c.bp", steps=2)
+    write_store(tmp_path / "c.bp.r1", steps=2)
+    corrupt_store_byte(str(tmp_path / "c.bp.r1"))
+    sc = integrity.Scrubber(S(), every=2)
+    reports = sc.maybe_scrub(10)
+    assert [r["path"] for r in reports] == [
+        str(tmp_path / "c.bp"), str(tmp_path / "c.bp.r1"),
+    ]
+    assert sc.maybe_scrub(20) is None  # every=2 thins the cadence
+    assert sc.describe()["corrupt_found"] == 1
+
+
+# -------------------------------------------------------- supervisor
+
+
+def test_classify_corruption_direct_and_async_wrapped():
+    from grayscott_jl_tpu.io.async_writer import AsyncIOError
+    from grayscott_jl_tpu.resilience.supervisor import classify_failure
+
+    e = CorruptionError("CRC mismatch", step=30, var="u")
+    assert classify_failure(e) == "corruption"
+    assert classify_failure(AsyncIOError(30, e)) == "corruption"
+    assert classify_failure(
+        AsyncIOError(30, ValueError("shape"))
+    ) is None
+
+
+def test_corruption_signature_unwraps():
+    from grayscott_jl_tpu.io.async_writer import AsyncIOError
+    from grayscott_jl_tpu.resilience.supervisor import (
+        _corruption_signature,
+    )
+
+    e = CorruptionError("x", step=3, var="v", file="data.0")
+    assert _corruption_signature(e) == (3, "v", "data.0")
+    assert _corruption_signature(AsyncIOError(3, e)) == (3, "v", "data.0")
+
+
+def test_checkpoint_writer_replicates_and_readback_verifies(
+    tmp_path, monkeypatch
+):
+    pytest.importorskip("jax")
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.io.checkpoint import CheckpointWriter
+
+    monkeypatch.setenv("GS_CKPT_REPLICAS", "2")
+    monkeypatch.setenv("GS_CKPT_VERIFY", "full")
+    s = Settings(L=4, steps=1, checkpoint=True,
+                 checkpoint_output=str(tmp_path / "c.bp"))
+    w = CheckpointWriter(s, np.float32)
+    block = (
+        (0, 0, 0), (4, 4, 4),
+        np.ones((4, 4, 4), np.float32),
+        np.zeros((4, 4, 4), np.float32),
+    )
+    w.save(7, [block], checksums={"u": 123, "v": 456})
+    w.close()
+    for path in (str(tmp_path / "c.bp"), str(tmp_path / "c.bp.r1")):
+        r = BpReader(path, verify="read")
+        assert int(r.get("step", step=0)) == 7
+        np.testing.assert_array_equal(
+            r.get("u", step=0), np.ones((4, 4, 4), np.float32)
+        )
+        r.close()
+        side = json.load(open(os.path.join(path, "integrity.json")))
+        assert side["device"] == [{"u": 123, "v": 456}]
+    # Replicas are byte-identical stores.
+    for name in ("md.json", "data.0", "integrity.json"):
+        assert (
+            open(os.path.join(str(tmp_path / "c.bp"), name), "rb").read()
+            == open(os.path.join(str(tmp_path / "c.bp.r1"), name),
+                    "rb").read()
+        )
