@@ -23,9 +23,21 @@ STAMP=$(date +%F_%H%M)
 # itself and always exits 0 — an OUTER kill there would be the exact
 # mid-run client death the wedge postmortem forbids, so it runs bare.
 
-echo "== 1/5 hardware test suite (incl. xy-chain Mosaic lowering) =="
+echo "== 1/5 hardware test suite (xy-chain Mosaic lowering FIRST) =="
+# The xy-chain Mosaic lowering test settles compile-or-not for the
+# kernel every (n, m, 1) pod mesh launches — on a minutes-long grant
+# window that answer must land before anything else can time out the
+# grant (VERDICT weak #6). Run it alone first, then the rest of the
+# suite without re-running it.
+GS_TPU_TESTS=1 timeout -k 30 900 python -m pytest \
+    tests/unit/test_tpu_hardware.py::test_xy_chain_kernel_on_hardware \
+    -q 2>&1 \
+    | tee "benchmarks/results/hw_tests_xychain_${STAMP}.log" | tail -3
 GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
-    tests/unit/test_tpu_hardware.py -q 2>&1 \
+    tests/unit/test_tpu_hardware.py -q \
+    --deselect \
+    tests/unit/test_tpu_hardware.py::test_xy_chain_kernel_on_hardware \
+    2>&1 \
     | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
 
 echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="

@@ -1,0 +1,216 @@
+"""A/B the mixed-precision + compressed-output postures end to end
+(docs/PRECISION.md).
+
+Runs the real CLI on the output-dominated L>=256 CPU configuration
+(plotgap=1: every step is an output boundary — the regime where D2H +
+serialization + disk volume, not compute, is the wall clock) three
+ways:
+
+* ``f32`` — the exact baseline (today's default posture),
+* ``bf16_f32acc`` — bf16 fields/stores, f32 accumulation
+  (``GS_COMPUTE_PRECISION``): halves every byte the output path moves,
+* ``bf16_f32acc+q8`` — the bf16 posture plus the 8-bit lossy snapshot
+  codec (``GS_SNAPSHOT_BITS=8``): the bytes that cross D2H and hit
+  disk are the uint8 payload, a 4x cut vs the f32 floor.
+
+One summary row per posture lands in the shared ``artifacts.py`` JSONL
+schema (``ab = "precision"``; ``metric`` carries the posture, so the
+regression sentinel keys every posture separately and committed
+results double as its history — ``regression_gate.py``).
+
+Usage::
+
+    python benchmarks/precision_bench.py [--L 256] [--steps 3]
+        [--plotgap 1] [--rounds 3] [--out ...jsonl]
+        [--min-speedup 1.1]
+
+``--min-speedup`` gates the run (exit 1) when the fully-armed posture
+(bf16 + q8) fails to beat the f32 floor's median driver wall by the
+given factor — the measured end-to-end win this lever exists for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Output-dominated: plotgap=1 writes every step; no checkpoints and no
+# VTK mirror so the A/B isolates the .bp output path the codec
+# compresses (the .vti mirror writes decoded values at full width by
+# design — docs/PRECISION.md).
+CONFIG = """\
+L = {L}
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = {plotgap}
+steps = {steps}
+noise = 0.1
+output = "gs.bp"
+checkpoint = false
+mesh_type = "none"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "Plain"
+verbose = false
+"""
+
+#: The measured postures: the exact floor, the codec alone (the
+#: headline lossy-output lever — ``--min-speedup`` gates on it), the
+#: bf16 storage posture, and both armed. The bf16 rows are
+#: informational on CPU: the posture's halo/HBM win is a TPU story
+#: (XLA:CPU emulates bf16 with converts), mirroring the
+#: HALO_DEPTH_EFFICIENCY standing note in ROADMAP.md. The codec's
+#: error bound is documented in docs/PRECISION.md:
+#: (max-min)/(2^bits-1)/2 per field per step.
+MODES = (
+    ("f32", {}),
+    ("f32+q8", {"GS_SNAPSHOT_BITS": "8"}),
+    ("bf16_f32acc", {"GS_COMPUTE_PRECISION": "bf16_f32acc"}),
+    ("bf16_f32acc+q8", {"GS_COMPUTE_PRECISION": "bf16_f32acc",
+                        "GS_SNAPSHOT_BITS": "8"}),
+)
+
+
+def run_once(args, mode_env: dict) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        cfg = Path(td) / "config.toml"
+        cfg.write_text(CONFIG.format(
+            L=args.L, steps=args.steps, plotgap=args.plotgap,
+        ))
+        stats_path = Path(td) / "stats.json"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["GS_TPU_STATS"] = str(stats_path)
+        env.pop("GS_COMPUTE_PRECISION", None)
+        env.pop("GS_SNAPSHOT_BITS", None)
+        env.update(mode_env)
+        t0 = time.perf_counter()
+        res = subprocess.run(
+            [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+            cwd=td, env=env, capture_output=True, text=True,
+        )
+        wall = time.perf_counter() - t0
+        if res.returncode != 0:
+            raise RuntimeError(res.stderr)
+        stats = json.loads(stats_path.read_text())
+        store_bytes = sum(
+            p.stat().st_size
+            for p in (Path(td) / "gs.bp").rglob("*") if p.is_file()
+        )
+    return {
+        "process_wall_s": round(wall, 3),
+        "driver_wall_s": stats["wall_s"],
+        "us_per_step": stats["wall_s"] / args.steps * 1e6,
+        "compute_s": stats["phases_s"].get("compute"),
+        "output_s": stats["phases_s"].get("output"),
+        "store_bytes": store_bytes,
+        "compute_precision": stats["config"].get("compute_precision"),
+        "snapshot_codec": stats["config"].get("snapshot_codec"),
+    }
+
+
+def run_ab(args, out: str) -> dict:
+    """Run every posture ``args.rounds`` times, append one artifact
+    row per posture, and return the median driver walls by mode."""
+    walls = {}
+    store_bytes = {}
+    for mode, env in MODES:
+        runs = [run_once(args, env) for _ in range(args.rounds)]
+        med = statistics.median(r["driver_wall_s"] for r in runs)
+        walls[mode] = med
+        store_bytes[mode] = runs[0]["store_bytes"]
+        row = {
+            "ab": "precision",
+            "t": artifacts.utc_stamp(),
+            "platform": "cpu",
+            "model": "grayscott",
+            "kernel": "xla",
+            "L": args.L,
+            "mesh": [1, 1, 1],
+            "devices": 1,
+            # The POSTURE is the row's precision identity (the config
+            # key already carries a `precision` field repo-wide).
+            "precision": mode,
+            # `metric` is a regression_gate KEY FIELD: each posture is
+            # its own config key, so the sentinel never compares the
+            # compressed path against the exact floor.
+            "metric": f"precision_{mode}",
+            "mode": mode,
+            "steps": args.steps,
+            "plotgap": args.plotgap,
+            "rounds": args.rounds,
+            "median_wall_s": round(med, 3),
+            "median_us_per_step": round(
+                statistics.median(r["us_per_step"] for r in runs), 1
+            ),
+            "rounds_us_per_step": [
+                round(r["us_per_step"], 1) for r in runs
+            ],
+            "store_bytes": runs[0]["store_bytes"],
+        }
+        if mode != "f32" and walls.get("f32"):
+            row["speedup_vs_f32"] = round(walls["f32"] / med, 4)
+            if store_bytes.get("f32"):
+                row["store_bytes_vs_f32"] = round(
+                    row["store_bytes"] / store_bytes["f32"], 4
+                )
+        artifacts.append_row(out, row)
+        print(json.dumps(row))
+    return walls
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--L", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--plotgap", type=int, default=1)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="append artifact rows here (default: the "
+                    "committed results naming convention)")
+    ap.add_argument("--min-speedup", type=float, default=None,
+                    help="fail (exit 1) when the lossy-output posture "
+                    "(f32+q8) does not beat the f32 floor's median "
+                    "wall by this factor")
+    args = ap.parse_args(argv)
+
+    out = args.out or artifacts.default_out("precision", "cpu")
+    walls = run_ab(args, out)
+
+    lossy = "f32+q8"
+    if args.min_speedup is not None and walls.get("f32"):
+        speedup = walls["f32"] / walls[lossy]
+        if speedup < args.min_speedup:
+            print(
+                f"precision_bench: FAIL — {lossy} speedup "
+                f"{speedup:.2f}x below the {args.min_speedup:.2f}x "
+                "bound",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"precision_bench: {lossy} {speedup:.2f}x vs the f32 "
+              f"floor (bound {args.min_speedup:.2f}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -305,6 +305,15 @@ def main() -> int:
                     help="with --calibrate: also run the batched-vs-"
                     "sequential ensemble A/B (ensemble_bench.py) with "
                     "this many members at each winner config")
+    ap.add_argument("--precision-ab", action="store_true",
+                    help="with --calibrate: also run the mixed-"
+                    "precision + compressed-output A/B "
+                    "(precision_bench.py, docs/PRECISION.md) on the "
+                    "output-dominated config; rows land in the same "
+                    "artifact and are gated by the sentinel")
+    ap.add_argument("--precision-L", type=int, default=256,
+                    help="grid side for --precision-ab (>=256 is the "
+                    "output-dominated acceptance config)")
     ap.add_argument("--apply", action="store_true",
                     help="with --calibrate: rewrite the icimodel "
                     "literals from the measured ratios")
@@ -363,6 +372,18 @@ def main() -> int:
                     campaign_steps=max(args.steps * 10, 200), out=out,
                     backend=backend, cpu=args.cpu,
                 )
+    if args.calibrate and args.precision_ab:
+        # Mixed-precision + codec A/B (docs/PRECISION.md): driver-level
+        # walls on the output-dominated config, one row per posture —
+        # the sentinel below gates them against committed history.
+        import argparse as _ap
+
+        import precision_bench
+
+        pargs = _ap.Namespace(
+            L=args.precision_L, steps=3, plotgap=1, rounds=args.rounds,
+        )
+        precision_bench.run_ab(pargs, out)
     print(f"# appended to {out}", file=sys.stderr)
     if args.calibrate:
         calibrate(out, args.apply)

@@ -152,6 +152,34 @@ class Settings:
     #: wall time, and compile-cache hit/miss per compiled step runner.
     #: GS_XSTATS env wins; armed implicitly with the compile cache.
     xstats: str = ""
+    #: Mixed-precision compute posture (extension; docs/PRECISION.md):
+    #: "" / "f32" (default) keeps today's compute in the resolved
+    #: precision dtype — bitwise-identical to every pre-posture
+    #: trajectory; "bf16_f32acc" holds fields (and therefore halo
+    #: slabs, HBM traffic, and stores) in bfloat16 while the Laplacian
+    #: + reaction + Euler update accumulate in float32 (requires
+    #: precision = "Float32"); "equality" is the operator escape hatch:
+    #: pinned f32 compute AND a loud refusal of any lossy snapshot
+    #: codec — the whole run is asserted byte-identical to a
+    #: pre-posture build. GS_COMPUTE_PRECISION env wins.
+    compute_precision: str = ""
+    #: Lossy snapshot codec for plotgap output (extension;
+    #: docs/PRECISION.md): "" = off (exact stores, today's behavior);
+    #: an integer bit width ("8") or per-field widths ("u:8,v:12")
+    #: quantize each output field to that many bits (uint payloads at
+    #: most 16 bits) INSIDE the fused snapshot-copy jit, cutting
+    #: D2H + disk volume ~itemsize*8/bits with a documented
+    #: max-abs-error bound of (max-min)/(2^bits - 1)/2 per field per
+    #: step. Checkpoints stay exact regardless (see
+    #: ``snapshot_bits_ckpt``). GS_SNAPSHOT_BITS env wins.
+    snapshot_bits: str = ""
+    #: Opt-in to apply the lossy codec to CHECKPOINT stores too
+    #: (extension; docs/PRECISION.md): default off — checkpoints stay
+    #: exact-precision so a resumed run is byte-identical — and a
+    #: truthy value extends ``snapshot_bits`` to checkpoint saves
+    #: (restores then dequantize; resume is no longer bitwise).
+    #: GS_SNAPSHOT_BITS_CKPT env wins.
+    snapshot_bits_ckpt: bool = False
     #: Registered model to integrate (extension; docs/MODELS.md): the
     #: ``[model]`` TOML table's ``name`` key (or a plain ``model =
     #: "heat"`` string). Gray-Scott is the default and keeps the
@@ -488,6 +516,46 @@ def resolve_compile_cache(settings: Settings) -> Any:
             "compile",
         )
     return None
+
+
+#: Valid mixed-precision compute postures (docs/PRECISION.md).
+COMPUTE_PRECISIONS = ("f32", "bf16_f32acc", "equality")
+
+
+def resolve_compute_precision(settings: Settings) -> str:
+    """Normalized mixed-precision compute posture: ``"f32"``,
+    ``"bf16_f32acc"``, or ``"equality"``. ``GS_COMPUTE_PRECISION`` env
+    wins over the ``compute_precision`` TOML key, mirroring the other
+    knobs; unset resolves to ``"f32"`` (today's compute, bitwise).
+
+    ``bf16_f32acc`` requires ``precision = "Float32"``: the posture's
+    contract is "f32 run, bf16 storage, f32 accumulation" — for a
+    Float64 run the posture would silently quarter the mantissa, and
+    for a BFloat16 run it is a no-op better spelled as the precision.
+    ``equality`` additionally refuses a lossy snapshot codec
+    (:func:`~..io.codec.resolve_snapshot_codec` enforces it): equality
+    means every trajectory AND store byte matches a pre-posture build.
+    """
+    import os
+
+    raw = os.environ.get("GS_COMPUTE_PRECISION")
+    if raw is None:
+        raw = getattr(settings, "compute_precision", "") or ""
+    v = raw.strip().lower() or "f32"
+    v = {"float32": "f32", "fp32": "f32"}.get(v, v)
+    if v not in COMPUTE_PRECISIONS:
+        raise SettingsError(
+            f"compute_precision / GS_COMPUTE_PRECISION must be one of "
+            f"{'|'.join(COMPUTE_PRECISIONS)}, got {raw!r}"
+        )
+    if v == "bf16_f32acc" and settings.precision != "Float32":
+        raise SettingsError(
+            f"compute_precision = 'bf16_f32acc' requires precision = "
+            f"'Float32' (got {settings.precision!r}): the posture is "
+            "bf16 storage with f32 accumulation of an f32 run — use "
+            "precision = 'BFloat16' for end-to-end bf16"
+        )
+    return v
 
 
 def resolve_precision(settings: Settings) -> Any:

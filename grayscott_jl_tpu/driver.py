@@ -358,6 +358,29 @@ def _run_once_inner(
                 f"{restart_step}"
             )
 
+    # Lossy snapshot codec (docs/PRECISION.md): resolved at Simulation
+    # construction (misconfigurations fail there); ensembles keep exact
+    # output — per-member quantization ranges are a member-axis
+    # reduction the fused probe family does not carry yet, and a codec
+    # that silently changed meaning per member would be worse than
+    # refusing. Loud, not silent.
+    codec = sim.snapshot_codec
+    if ens is not None and codec.enabled:
+        log.warn(
+            "snapshot_bits ignored for ensemble runs (member stores "
+            "stay exact); lossy output is a solo-run codec"
+        )
+        from .io.codec import CodecConfig
+
+        codec = CodecConfig({}, {})
+    #: field-index -> bits spec for snapshot_async's fused encoder.
+    enc_spec = {
+        i: codec.output[n.lower()]
+        for i, n in enumerate(sim.model.field_names)
+        if n.lower() in codec.output
+    }
+    ckpt_lossy = bool(codec.ckpt)
+
     if ens is not None:
         from .ensemble.io import EnsembleCheckpointWriter, EnsembleStream
 
@@ -368,9 +391,12 @@ def _run_once_inner(
 
         stream_cls, ckpt_cls = SimStream, CheckpointWriter
 
+    stream_kw = {"codec": codec.output or None} if ens is None else {}
+    ckpt_kw = {"codec": codec.ckpt or None} if ens is None else {}
     stream = stream_cls(
         settings, sim.domain, sim.dtype, writer_id=proc, nwriters=nprocs,
         resume_step=restart_step if settings.restart else None,
+        **stream_kw,
     )
     ckpt = (
         ckpt_cls(
@@ -380,6 +406,7 @@ def _run_once_inner(
             # record the writing run's layout so a future restore can
             # plan an old->new reshard.
             layout=sim.layout(),
+            **ckpt_kw,
         )
         if settings.checkpoint
         else None
@@ -408,6 +435,12 @@ def _run_once_inner(
         "kernel_language": sim.kernel_language,
         "kernel_selection": selection,
         "precision": settings.precision,
+        # Mixed-precision + codec postures (docs/PRECISION.md): what
+        # the run actually materialized — the tuner may have adopted
+        # bf16 under an authorizing posture, and every artifact reader
+        # must be able to tell.
+        "compute_precision": sim.compute_precision,
+        "snapshot_codec": codec.describe(),
         "n_devices": sim.domain.n_blocks,
         "n_processes": nprocs,
         "comm_overlap": sim.comm_overlap,
@@ -472,6 +505,7 @@ def _run_once_inner(
         obs_numerics.NumericsRecorder(
             sim.model.field_names, metrics=metrics, events=evs,
             gate=DriftGate.from_env(settings), log=log, labels=mlabels,
+            journal=journal,
         )
         if num_mode != "off" else None
     )
@@ -565,7 +599,13 @@ def _run_once_inner(
         if ckpt is not None:
             if not ckpt_written:
                 _mark("checkpoint", at_step)
-                snap = sim.snapshot_async()
+                # A ckpt-lossy store's variables are uint — every save
+                # (grace checkpoints included) must go through the
+                # codec; the default exact store takes exact copies.
+                snap = sim.snapshot_async(
+                    encode=enc_spec if ckpt_lossy else None,
+                    exact=not ckpt_lossy,
+                )
                 pipe.submit(at_step, snap, [("checkpoint", ckpt.save)])
                 stats.count("checkpoints")
                 log.info(
@@ -646,6 +686,16 @@ def _run_once_inner(
                         planned_step=fault.step,
                     )
                     sim.poison_nan()
+                fault = plan.take("drift", step)
+                if fault is not None:
+                    # Finite-but-wrong excursion (docs/PRECISION.md):
+                    # the health guard stays green, the numerics drift
+                    # gate (GS_DRIFT_POLICY) must catch it.
+                    journal.record(
+                        event="injected", kind="drift", step=step,
+                        planned_step=fault.step,
+                    )
+                    sim.poison_drift()
                 fault = plan.take("preempt", step)
                 if fault is not None:
                     # Fires BEFORE this boundary's writes: the
@@ -714,12 +764,26 @@ def _run_once_inner(
                         planned_step=fault.step,
                     )
                     bitflip = True
+                # Codec routing (docs/PRECISION.md): coded targets get
+                # the fused device-side quantization; the exact copies
+                # are captured only when some target needs them — a
+                # lossy-output-only boundary moves ONLY the compressed
+                # payload over D2H (the volume win).
+                want_enc = bool(enc_spec) and (
+                    at_plot or (at_ckpt and ckpt_lossy)
+                )
+                want_exact = (
+                    (at_ckpt and not ckpt_lossy)
+                    or (at_plot and not enc_spec)
+                )
                 with stats.phase("device_to_host", step=step):
                     snap = sim.snapshot_async(
                         health=guard.enabled,
                         numerics=num_mode == "boundary",
-                        checksum=snapshot_checksum,
+                        checksum=snapshot_checksum and want_exact,
                         bitflip=bitflip,
+                        encode=enc_spec if want_enc else None,
+                        exact=want_exact,
                     )
                     if pipe.synchronous:
                         # Depth 0 reproduces the reference's flow
@@ -757,8 +821,23 @@ def _run_once_inner(
                         raise
                     if event is not None:
                         journal.record(**event)
+                gate_first = (
+                    num_mode == "boundary"
+                    and num_recorder.gate is not None
+                    and getattr(num_recorder.gate, "raising", False)
+                )
+                if gate_first:
+                    # A raising drift policy (abort/rollback,
+                    # docs/PRECISION.md) mirrors the health guard: the
+                    # DriftError must unwind BEFORE the drifted
+                    # boundary is submitted, so the poisoned step
+                    # never reaches the stores and the supervisor
+                    # resumes from the last HEALTHY checkpoint.
+                    num_recorder.observe(
+                        step, snap.numerics_report(), boundary=True
+                    )
                 pipe.submit(step, snap, targets)
-                if num_mode == "boundary":
+                if num_mode == "boundary" and not gate_first:
                     # After submit — the resolution blocks only on the
                     # probe's scalars, never delays the write pipeline.
                     num_recorder.observe(

@@ -234,10 +234,12 @@ class EnsembleSimulation(Simulation):
 
     def _make_params(self):
         """Member-stacked Params pytree of the run's model: every leaf
-        is ``(N,)``, fed to the vmapped step body with ``in_axes=0``."""
+        is ``(N,)``, fed to the vmapped step body with ``in_axes=0``.
+        Params live at the COMPUTE dtype, like the solo path
+        (docs/PRECISION.md — f32 under the ``bf16_f32acc`` posture)."""
         return self.model.params_cls(*(
             jnp.asarray([mem.value(f) for mem in self.ens.members],
-                        self.dtype)
+                        self.compute_dtype)
             for f in self.model.params_cls._fields
         ))
 

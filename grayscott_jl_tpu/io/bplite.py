@@ -726,6 +726,16 @@ class BpReader:
 
     # -- data --------------------------------------------------------------
 
+    def _codec_info(self) -> Dict[str, dict]:
+        """The store's snapshot-codec registry (docs/PRECISION.md):
+        ``{var_name: {"bits": int, "dtype": str}}``, empty for exact
+        stores. Parsed from the ``snapshot_codec`` attribute on every
+        call — the attribute is tiny, and a live-coupled reader may see
+        it appear after construction."""
+        from .codec import decode_attr
+
+        return decode_attr(self.attributes())
+
     def get(
         self,
         name: str,
@@ -739,9 +749,16 @@ class BpReader:
         ``set_selection``). Assembles the box from the step's blocks.
         A CRC-mismatching block surfaces as a
         :class:`~..resilience.integrity.CorruptionError` naming the
-        variable and step entry alongside the file/offset/CRC pair."""
+        variable and step entry alongside the file/offset/CRC pair.
+
+        Variables written through the lossy snapshot codec
+        (docs/PRECISION.md — the ``snapshot_codec`` attribute names
+        them) decode transparently: the uint payload is CRC-verified
+        exactly like an exact block, then dequantized against the
+        step's ``<NAME>__qlo``/``__qhi`` range scalars, and the
+        original-dtype float array is returned."""
         try:
-            return self._get(name, step=step, start=start, count=count)
+            out = self._get(name, step=step, start=start, count=count)
         except Exception as e:
             from ..resilience.integrity import CorruptionError
 
@@ -752,6 +769,15 @@ class BpReader:
                     step=step if step is not None else self._consumed,
                 ) from e
             raise
+        info = self._codec_info().get(name)
+        if info is not None:
+            from .codec import dequantize, qhi_var, qlo_var
+
+            idx = step if step is not None else self._consumed
+            lo = float(self._get(qlo_var(name), step=idx))
+            hi = float(self._get(qhi_var(name), step=idx))
+            return dequantize(out, lo, hi, info["bits"], info["dtype"])
+        return out
 
     def _get(
         self,

@@ -44,6 +44,7 @@ class CheckpointWriter:
         nwriters: int = 1,
         resume_step: Optional[int] = None,
         layout=None,
+        codec=None,
     ):
         """``layout`` (a :class:`~..reshard.plan.LayoutMeta`, or None)
         is the writing run's decomposition record; written as store
@@ -60,7 +61,14 @@ class CheckpointWriter:
         that went missing between launches self-heals as a fresh store
         holding the post-resume history. ``GS_CKPT_VERIFY=full``
         additionally read-back-verifies every saved step against the
-        recorded CRCs before the boundary is declared written."""
+        recorded CRCs before the boundary is declared written.
+
+        ``codec`` (``{field_name: bits}``, docs/PRECISION.md) is the
+        EXPLICIT opt-in lossy checkpoint posture
+        (``snapshot_bits_ckpt``): coded field variables are defined at
+        their uint payload dtype with the per-step range scalars, and
+        restores dequantize — resume is then value-close, not bitwise.
+        Default None keeps checkpoints exact-precision."""
         from ..resilience import integrity
 
         L = settings.L
@@ -69,6 +77,7 @@ class CheckpointWriter:
         #: (Gray-Scott keeps ``u``/``v``) — the restore path
         #: (``Simulation.restore_from_reader``) reads the same names.
         self.field_names = model.field_names
+        self.codec = dict(codec or {})
         self._verify = integrity.resolve_verify(settings) == "full"
         #: Replica store paths, primary first.
         self.paths = integrity.replica_paths(
@@ -111,6 +120,15 @@ class CheckpointWriter:
                 w.define_attribute("precision", settings.precision)
                 w.define_attribute("model", model.name)
                 w.define_attribute("fields", list(self.field_names))
+                if self.codec:
+                    from .codec import CODEC_ATTR, codec_attr_value
+
+                    w.define_attribute(
+                        CODEC_ATTR,
+                        codec_attr_value(
+                            self.codec, self.field_names, dtype
+                        ),
+                    )
                 if layout is not None and fresh:
                     from ..reshard.plan import layout_attrs
 
@@ -124,8 +142,21 @@ class CheckpointWriter:
                     ).items():
                         w.define_attribute(name, value)
             w.define_variable("step", np.int32)
+            from .codec import payload_dtype, qhi_var, qlo_var
+
             for name in self.field_names:
-                w.define_variable(name, np.dtype(dtype).name, (L, L, L))
+                bits = self.codec.get(name.lower())
+                if bits is None:
+                    w.define_variable(
+                        name, np.dtype(dtype).name, (L, L, L)
+                    )
+                else:
+                    w.define_variable(
+                        name, np.dtype(payload_dtype(bits)).name,
+                        (L, L, L),
+                    )
+                    w.define_variable(qlo_var(name), np.float32)
+                    w.define_variable(qhi_var(name), np.float32)
             self.writers.append(w)
 
     @property
@@ -140,16 +171,27 @@ class CheckpointWriter:
         (``Simulation.local_blocks``). ``checksums`` (optional
         ``{field: device checksum}``) is the boundary's in-graph
         device-side record, stored in the integrity sidecar."""
-        blocks = list(blocks)
+        from .codec import EncodedField, qhi_var, qlo_var
+
+        enc = getattr(blocks, "encoded", None) if self.codec else None
+        blocks = list(enc if enc is not None else blocks)
         for w in self.writers:
             w.begin_step()
             w.put("step", np.int32(step))
             if checksums is not None and hasattr(
                     w, "record_device_checksums"):
                 w.record_device_checksums(step, checksums)
+            ranges_done = set()
             for offsets, sizes, *fblocks in blocks:
                 for name, fb in zip(self.field_names, fblocks):
-                    w.put(name, fb, start=offsets, count=sizes)
+                    if isinstance(fb, EncodedField):
+                        w.put(name, fb.q, start=offsets, count=sizes)
+                        if name not in ranges_done:
+                            w.put(qlo_var(name), np.float32(fb.lo))
+                            w.put(qhi_var(name), np.float32(fb.hi))
+                            ranges_done.add(name)
+                    else:
+                        w.put(name, fb, start=offsets, count=sizes)
             w.end_step()
         if self._verify:
             # Write-side read-back verify (GS_CKPT_VERIFY=full): the

@@ -1,0 +1,333 @@
+"""Lossy snapshot codec: device-side uint quantization for output.
+
+High-frequency output (``plotgap`` every few steps) is the
+bandwidth-bound regime where a run's wall clock is D2H + serialization
++ disk, not compute (``benchmarks/async_io_bench.py``; the portable-
+stencil roofline analysis, arxiv 2309.04671, makes the regime precise).
+This module cuts that volume at the *source*: each configured output
+field is quantized to ``bits`` uniform levels INSIDE the fused
+snapshot-copy jit (``Simulation.snapshot_async``), so the bytes that
+cross the device boundary, ride the async writer, and land on disk are
+the ``uint8``/``uint16`` payload — a 4x (f32 -> u8) to 2x (bf16 -> u8)
+reduction before the store sees a single byte.
+
+Scheme — per-field, per-step uniform uint quantization::
+
+    lo = min(f),  hi = max(f)                    (f32 reductions)
+    q  = round((f - lo) * (2^bits - 1) / (hi - lo))   as uintN
+    f' = lo + q * (hi - lo) / (2^bits - 1)            (decode)
+
+**Error bound** (documented, test-asserted per dtype): the decode error
+of any cell is at most half a quantization level,
+
+    |f' - f| <= (hi - lo) / (2^bits - 1) / 2   (+ one storage-dtype ulp)
+
+where ``hi - lo`` is that field's value range *at that step*. The
+bound is exact for float64 payloads up to the f32 arithmetic of the
+encoder (the reductions and scale run in f32 — negligible next to any
+bits <= 16 level width).
+
+Store schema (docs/PRECISION.md): a coded variable is DEFINED at its
+uint payload dtype, two per-step scalar variables ``<NAME>__qlo`` /
+``<NAME>__qhi`` (f32) carry the step's range, and one store attribute
+``snapshot_codec`` (a JSON object ``{name: {"bits": b, "dtype": d}}``)
+names the coded variables and their original dtypes. ``BpReader``
+decodes transparently — ``get`` of a coded variable returns the
+dequantized float array — and the integrity layer is untouched:
+per-block CRCs are computed over the *compressed* payload bytes at
+write time and verified before decode, so a torn or flipped compressed
+block is refused exactly like an exact one.
+
+Scope: **plotgap output only by default** — checkpoints stay
+exact-precision so a resumed run is byte-identical to an uninterrupted
+one; ``snapshot_bits_ckpt`` / ``GS_SNAPSHOT_BITS_CKPT`` opts
+checkpoints in explicitly (restores then dequantize; resume is no
+longer bitwise). The ``compute_precision = "equality"`` escape hatch
+refuses any codec loudly.
+
+Host-side pieces are numpy + stdlib; only :func:`device_quantize`
+touches ``jax.numpy``, lazily, when traced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "CODEC_ATTR",
+    "CodecConfig",
+    "EncodedField",
+    "decode_attr",
+    "dequantize",
+    "device_quantize",
+    "error_bound",
+    "parse_bits_spec",
+    "payload_dtype",
+    "qhi_var",
+    "qlo_var",
+    "resolve_snapshot_codec",
+]
+
+#: Store attribute naming the coded variables: JSON object
+#: ``{var_name: {"bits": int, "dtype": numpy-dtype-name}}``.
+CODEC_ATTR = "snapshot_codec"
+
+#: Valid quantization widths: uint payloads of at most 16 bits (wider
+#: would stop compressing f32 at all); below 2 bits a field collapses
+#: to its endpoints.
+MIN_BITS, MAX_BITS = 2, 16
+
+
+def qlo_var(name: str) -> str:
+    """Per-step range-minimum scalar variable for coded ``name``."""
+    return f"{name}__qlo"
+
+
+def qhi_var(name: str) -> str:
+    return f"{name}__qhi"
+
+
+def payload_dtype(bits: int):
+    """The uint payload dtype for a bit width."""
+    return np.uint8 if bits <= 8 else np.uint16
+
+
+def error_bound(lo: float, hi: float, bits: int, dtype=None) -> float:
+    """The documented max-abs decode error: half a quantization level,
+    plus the encoder/decoder's f32 arithmetic rounding at the range
+    magnitude, plus one ulp of the storage dtype (the decode's final
+    cast). The half-level term dominates for every bits <= 16."""
+    mag = max(abs(lo), abs(hi), 1e-30)
+    half_level = (hi - lo) / (2 ** bits - 1) / 2.0
+    # The scale/round/dequantize arithmetic runs in f32 regardless of
+    # the payload's original dtype (device_quantize/dequantize).
+    bound = half_level + float(np.finfo(np.float32).eps) * mag * 4
+    if dtype is None:
+        return bound
+    dt = np.dtype(dtype)
+    try:
+        eps = float(np.finfo(dt).eps)
+    except (TypeError, ValueError):
+        # Extension float dtypes (bfloat16 registers as kind 'V') are
+        # invisible to numpy's finfo; ml_dtypes' own finfo knows them.
+        try:
+            import ml_dtypes
+
+            eps = float(ml_dtypes.finfo(dt).eps)
+        except (ImportError, TypeError, ValueError):
+            eps = 0.0  # pragma: no cover — non-float payloads
+    return bound + eps * mag
+
+
+def parse_bits_spec(raw: str, field_names: Sequence[str]) -> Dict[str, int]:
+    """``"8"`` (every field) or ``"u:8,v:12"`` (per field; ``=`` also
+    accepted) -> ``{field_name: bits}``. Unknown fields and
+    out-of-range widths raise a loud ValueError naming the model's
+    fields — a typo must never silently write exact output."""
+    raw = (raw or "").strip()
+    if not raw:
+        return {}
+    names = [n.lower() for n in field_names]
+    out: Dict[str, int] = {}
+
+    def _bits(tok: str) -> int:
+        try:
+            b = int(tok)
+        except ValueError as e:
+            raise ValueError(
+                f"snapshot_bits entry {tok!r} is not an integer"
+            ) from e
+        if not MIN_BITS <= b <= MAX_BITS:
+            raise ValueError(
+                f"snapshot_bits must be in [{MIN_BITS}, {MAX_BITS}], "
+                f"got {b}"
+            )
+        return b
+
+    if ":" not in raw and "=" not in raw:
+        b = _bits(raw)
+        return {n: b for n in names}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        sep = ":" if ":" in entry else "="
+        fname, _, tok = entry.partition(sep)
+        fname = fname.strip().lower()
+        if fname not in names:
+            raise ValueError(
+                f"snapshot_bits names unknown field {fname!r} "
+                f"(model fields: {', '.join(names)})"
+            )
+        out[fname] = _bits(tok.strip())
+    return out
+
+
+class CodecConfig:
+    """Resolved codec posture for one run: ``output`` / ``ckpt`` map
+    field names to bit widths (empty = exact)."""
+
+    def __init__(self, output: Dict[str, int], ckpt: Dict[str, int]):
+        self.output = dict(output)
+        self.ckpt = dict(ckpt)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.output or self.ckpt)
+
+    def describe(self) -> Optional[dict]:
+        """The RunStats / provenance record — None when fully exact."""
+        if not self.enabled:
+            return None
+        return {
+            "output": dict(self.output),
+            "checkpoint": dict(self.ckpt) if self.ckpt else None,
+        }
+
+    def posture(self) -> str:
+        """Canonical string for cache keys (schema v6): ``"off"`` or a
+        sorted ``u:8,v:8[+ckpt]`` spelling — two runs with different
+        codec postures must never share a tuned winner."""
+        if not self.output and not self.ckpt:
+            return "off"
+        spec = ",".join(
+            f"{n}:{b}" for n, b in sorted(self.output.items())
+        )
+        return spec + ("+ckpt" if self.ckpt else "")
+
+
+def resolve_snapshot_codec(settings, field_names) -> CodecConfig:
+    """``GS_SNAPSHOT_BITS`` env > ``snapshot_bits`` TOML key (and
+    ``GS_SNAPSHOT_BITS_CKPT`` > ``snapshot_bits_ckpt`` for the
+    checkpoint opt-in) -> :class:`CodecConfig`. The
+    ``compute_precision = "equality"`` posture refuses any lossy codec
+    loudly — equality means byte-identical stores, full stop."""
+    raw = os.environ.get("GS_SNAPSHOT_BITS")
+    if raw is None:
+        raw = getattr(settings, "snapshot_bits", "") or ""
+    output = parse_bits_spec(raw, field_names)
+    raw_ck = os.environ.get("GS_SNAPSHOT_BITS_CKPT")
+    if raw_ck is None:
+        ckpt_on = bool(getattr(settings, "snapshot_bits_ckpt", False))
+    else:
+        ckpt_on = raw_ck.strip().lower() in ("1", "true", "yes", "on")
+    ckpt = dict(output) if ckpt_on and output else {}
+    if output:
+        from ..config.settings import resolve_compute_precision
+
+        if resolve_compute_precision(settings) == "equality":
+            from ..models.base import SettingsError
+
+            raise SettingsError(
+                "compute_precision = 'equality' refuses the lossy "
+                f"snapshot codec (snapshot_bits={raw!r}): equality "
+                "asserts byte-identical trajectories AND stores — "
+                "drop one of the two settings"
+            )
+    return CodecConfig(output, ckpt)
+
+
+def codec_attr_value(codec: Dict[str, int], var_names, dtype) -> str:
+    """The ``snapshot_codec`` attribute payload for a store whose
+    variables are ``var_names`` (store spelling, e.g. upper-cased) over
+    fields stored at ``dtype``. ``codec`` is keyed by lower-cased field
+    name."""
+    doc = {}
+    for vn in var_names:
+        bits = codec.get(vn.lower())
+        if bits is not None:
+            doc[vn] = {"bits": int(bits),
+                       "dtype": np.dtype(dtype).name}
+    return json.dumps(doc, sort_keys=True)
+
+
+def decode_attr(attrs: dict) -> Dict[str, dict]:
+    """Parse a store's ``snapshot_codec`` attribute into
+    ``{var_name: {"bits": int, "dtype": str}}``; missing or torn
+    attributes degrade to no-codec (exact reads) — the attribute is
+    load-bearing only for stores that actually wrote coded payloads,
+    and those always committed it at definition time."""
+    raw = attrs.get(CODEC_ATTR)
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+        return {
+            str(k): {"bits": int(v["bits"]), "dtype": str(v["dtype"])}
+            for k, v in doc.items()
+        }
+    except (ValueError, TypeError, KeyError):
+        return {}
+
+
+def device_quantize(field, bits: int):
+    """The traced encoder: ``(q, lo, hi)`` with ``q`` the uint payload
+    (same sharding as ``field`` — an elementwise map plus two global
+    reductions) and ``lo``/``hi`` f32 scalars. A constant field
+    (``hi == lo``) encodes to all-zeros and decodes to ``lo`` exactly.
+    Fused into the snapshot-copy jit so the exact f32/bf16 field never
+    crosses the device boundary for coded output."""
+    import jax.numpy as jnp
+
+    g = field.astype(jnp.float32)
+    lo = g.min()
+    hi = g.max()
+    levels = jnp.float32(2 ** bits - 1)
+    span = hi - lo
+    scale = levels / jnp.where(span > 0, span, jnp.float32(1.0))
+    q = jnp.clip(jnp.round((g - lo) * scale), 0, levels)
+    return q.astype(payload_dtype(bits)), lo, hi
+
+
+def dequantize(q, lo: float, hi: float, bits: int, dtype) -> np.ndarray:
+    """Host-side decode of a uint payload back to ``dtype`` — the
+    reader half of :func:`device_quantize`, error-bounded by
+    :func:`error_bound`."""
+    level = (np.float32(hi) - np.float32(lo)) / np.float32(2 ** bits - 1)
+    out = np.float32(lo) + np.asarray(q).astype(np.float32) * level
+    return out.astype(np.dtype(dtype))
+
+
+class EncodedField:
+    """One field's quantized block riding the output pipeline: the
+    uint payload plus the step's (lo, hi) range and the original
+    dtype. Store writers put ``.q`` (so CRCs cover the compressed
+    payload) and record the range scalars; :meth:`decode` serves
+    consumers that need values (VTK assembly, tests)."""
+
+    __slots__ = ("q", "lo", "hi", "bits", "dtype")
+
+    def __init__(self, q: np.ndarray, lo: float, hi: float, bits: int,
+                 dtype):
+        self.q = q
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.bits = int(bits)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def decode(self) -> np.ndarray:
+        return dequantize(self.q, self.lo, self.hi, self.bits,
+                          self.dtype)
+
+    def error_bound(self) -> float:
+        return error_bound(self.lo, self.hi, self.bits, self.dtype)
+
+
+class BoundaryBlocks(list):
+    """The list the async writer hands to write targets, grown an
+    ``encoded`` attribute: the exact blocks ride in the list body
+    (empty when the boundary captured no exact copies), and
+    ``encoded`` holds the codec form (entries mixing
+    :class:`EncodedField` for coded fields and plain arrays for
+    uncoded ones), or None when no codec ran. Plain lists keep working
+    everywhere — consumers use ``getattr(blocks, "encoded", None)``."""
+
+    encoded = None

@@ -80,7 +80,15 @@ class SimStream:
         writer_id: int = 0,
         nwriters: int = 1,
         resume_step: Optional[int] = None,
+        codec=None,
     ):
+        """``codec`` (docs/PRECISION.md, ``{field_name: bits}`` lower-
+        cased, or None) arms the lossy snapshot codec for this store:
+        coded variables are DEFINED at their uint payload dtype, the
+        per-step ``<NAME>__qlo``/``__qhi`` range scalars are declared
+        beside them, and the ``snapshot_codec`` attribute names the
+        coded variables so readers decode transparently
+        (``io/bplite.BpReader``)."""
         self.settings = settings
         self.domain = domain
         self.io_name = io_name
@@ -90,6 +98,7 @@ class SimStream:
         #: Store variable names: the model's field names uppercased
         #: (Gray-Scott keeps the reference's ``U``/``V`` spelling).
         self.var_names = tuple(n.upper() for n in model.field_names)
+        self.codec = dict(codec or {})
 
         # On restart, append — a resumed run must not truncate the output
         # steps written before the checkpoint it resumed from — but DO
@@ -120,17 +129,38 @@ class SimStream:
             self.writer.define_attribute("noise", settings.noise)
             self.writer.define_attribute("model", model.name)
             self.writer.define_attribute("fields", list(self.var_names))
+            if self.codec:
+                from .codec import CODEC_ATTR, codec_attr_value
+
+                self.writer.define_attribute(
+                    CODEC_ATTR,
+                    codec_attr_value(self.codec, self.var_names, dtype),
+                )
             # Visualization schemas (IO.jl:123-163)
             for name, value in fides_vtk_schemas(
                 L, self.var_names
             ).items():
                 self.writer.define_attribute(name, value)
 
+        from .codec import payload_dtype, qhi_var, qlo_var
+
         self.writer.define_variable("step", np.int32)
         for name in self.var_names:
-            self.writer.define_variable(
-                name, np.dtype(dtype).name, (L, L, L)
-            )
+            bits = self.codec.get(name.lower())
+            if bits is None:
+                self.writer.define_variable(
+                    name, np.dtype(dtype).name, (L, L, L)
+                )
+            else:
+                # Coded variable: the uint payload IS the store format
+                # — CRCs, durability, and rollback all operate on the
+                # compressed bytes; the range scalars complete the
+                # decode (docs/PRECISION.md).
+                self.writer.define_variable(
+                    name, np.dtype(payload_dtype(bits)).name, (L, L, L)
+                )
+                self.writer.define_variable(qlo_var(name), np.float32)
+                self.writer.define_variable(qhi_var(name), np.float32)
 
         self._vtk = None
         self._pvti = None
@@ -164,29 +194,58 @@ class SimStream:
         device-side field checksums in the store's integrity sidecar
         (real-ADIOS2 stores have no sidecar and skip the record).
         """
+        from .codec import EncodedField, qhi_var, qlo_var
+
         w = self.writer
+        # Codec routing (docs/PRECISION.md): a coded store consumes the
+        # snapshot's encoded form (``BoundaryBlocks.encoded``); exact
+        # stores take the list body, exactly as before. Plain lists
+        # (tests, analysis tools) have no ``encoded`` and write exact.
+        enc = getattr(blocks, "encoded", None) if self.codec else None
+        blocks = list(enc if enc is not None else blocks)
         w.begin_step()
         w.put("step", np.int32(step))
         if checksums is not None and hasattr(
                 w, "record_device_checksums"):
             w.record_device_checksums(step, checksums)
-        blocks = list(blocks)
+        ranges_done = set()
         for offsets, sizes, *fblocks in blocks:
             for name, fb in zip(self.var_names, fblocks):
-                w.put(name, fb, start=offsets, count=sizes)
+                if isinstance(fb, EncodedField):
+                    w.put(name, fb.q, start=offsets, count=sizes)
+                    if name not in ranges_done:
+                        # The (lo, hi) range is a global reduction —
+                        # one pair per step per field, identical
+                        # across shards and writers.
+                        w.put(qlo_var(name), np.float32(fb.lo))
+                        w.put(qhi_var(name), np.float32(fb.hi))
+                        ranges_done.add(name)
+                else:
+                    w.put(name, fb, start=offsets, count=sizes)
         w.end_step()
+        if self._pvti is not None or self._vtk is not None:
+            # Visualization consumes VALUES: coded blocks decode here
+            # (the documented max-abs-error bound applies — the .vti
+            # shows what the store serves).
+            vis_blocks = [
+                (offsets, sizes) + tuple(
+                    fb.decode() if isinstance(fb, EncodedField) else fb
+                    for fb in fblocks
+                )
+                for offsets, sizes, *fblocks in blocks
+            ]
         if self._pvti is not None:
-            self._pvti.write(step, blocks)
+            self._pvti.write(step, vis_blocks)
         if self._vtk is not None:
             L = self.settings.L
-            if len(blocks) == 1 and blocks[0][1] == (L, L, L):
-                arrays = blocks[0][2:]
+            if len(vis_blocks) == 1 and vis_blocks[0][1] == (L, L, L):
+                arrays = vis_blocks[0][2:]
             else:
                 arrays = tuple(
-                    np.empty((L, L, L), blocks[0][2].dtype)
+                    np.empty((L, L, L), vis_blocks[0][2].dtype)
                     for _ in self.var_names
                 )
-                for offsets, sizes, *fblocks in blocks:
+                for offsets, sizes, *fblocks in vis_blocks:
                     sl = tuple(
                         slice(o, o + s) for o, s in zip(offsets, sizes)
                     )

@@ -234,12 +234,19 @@ class NumericsRecorder:
     enabled = True
 
     def __init__(self, names, *, metrics=None, events=None, gate=None,
-                 log=None, labels=None, window: Optional[int] = None):
+                 log=None, labels=None, window: Optional[int] = None,
+                 journal=None):
         self.names = tuple(names)
         self.metrics = metrics
         self.events = events
         self.gate = gate
         self.log = log
+        #: FaultJournal (``resilience/supervisor.py``): abort/rollback
+        #: trips are journaled (the journal mirrors to the stream, so
+        #: the record lands in both) before the DriftError unwinds —
+        #: the same pre-raise journaling discipline the health guard
+        #: follows in the driver.
+        self.journal = journal
         self.labels = dict(labels or {})
         self.window = resolve_window() if window is None else int(window)
         self.probes = 0
@@ -318,8 +325,19 @@ class NumericsRecorder:
                         + f" (|drift| > {event.get('limit')}, "
                         f"policy={event.get('policy')})"
                     )
-                if self.events is not None:
+                raising = getattr(self.gate, "raising", False)
+                if raising and self.journal is not None:
+                    # The journal mirrors onto the stream — exactly one
+                    # drift record either way.
+                    self.journal.record(event="drift", step=step,
+                                        **event)
+                elif self.events is not None:
                     self.events.emit("drift", step=step, **event)
+                # abort/rollback unwind AFTER the trip is recorded:
+                # the DriftError reuses the HealthGuard recovery
+                # machinery via the supervisor's health classification
+                # (docs/PRECISION.md).
+                self.gate.enforce(step, event)
 
     # ----------------------------------------------------------- export
 

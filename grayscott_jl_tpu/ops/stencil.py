@@ -69,6 +69,7 @@ def reaction_update(
     noise_term,
     params,
     model,
+    compute_dtype=None,
 ) -> Tuple[jnp.ndarray, ...]:
     """One explicit-Euler step of ``model`` on ghost-padded fields.
 
@@ -84,11 +85,29 @@ def reaction_update(
     a 0.0 scalar on the noiseless path); which derivative receives it is
     the model's choice inside ``reaction``.
 
+    ``compute_dtype`` (docs/PRECISION.md, the ``bf16_f32acc`` posture)
+    widens the accumulation: the ghost-padded fields are upcast ONCE,
+    Laplacian + reaction + Euler update all run at the wide dtype, and
+    only the final result rounds back to the storage dtype — one
+    rounding per step, exactly like a hardware MXU bf16xbf16->f32
+    pipeline. ``None`` (and a matching dtype) leave the historical
+    dataflow untouched, bit for bit.
+
     Returns interior-shaped updated fields, in declaration order.
     """
+    fields_pad = tuple(fields_pad)
+    store_dtype = fields_pad[0].dtype
+    if compute_dtype is not None and compute_dtype != store_dtype:
+        fields_pad = tuple(f.astype(compute_dtype) for f in fields_pad)
+        noise_term = jnp.asarray(noise_term).astype(compute_dtype)
+    else:
+        compute_dtype = None  # fast path: no casts traced at all
     fields = tuple(f[1:-1, 1:-1, 1:-1] for f in fields_pad)
     laps = tuple(laplacian(f) for f in fields_pad)
     derivs = model.reaction(fields, laps, noise_term, params)
-    return tuple(
+    out = tuple(
         f + d * params.dt for f, d in zip(fields, derivs)
     )
+    if compute_dtype is not None:
+        out = tuple(f.astype(store_dtype) for f in out)
+    return out

@@ -80,6 +80,29 @@ OVERLAP_EFFICIENCY = 0.85
 HALO_DEPTH_EFFICIENCY = 0.9
 
 
+#: Single-chip compute-cost ratio of the ``bf16_f32acc`` posture
+#: (docs/PRECISION.md) vs the f32 baseline the ``MEASURED_US`` anchors
+#: were captured at: the stencil is memory-bandwidth-bound (envelope
+#: probe, BASELINE.md), and bf16 fields halve the HBM bytes per step
+#: while the f32 accumulation keeps the VPU/MXU work roughly flat — so
+#: the analytic guess sits between the 0.5 bandwidth bound and 1.0
+#: flops-flat, leaning conservative. An ANALYTIC literal until the
+#: precision A/B (``benchmarks/precision_bench.py``) measures it on
+#: real hardware — the same calibration discipline as
+#: OVERLAP_EFFICIENCY / HALO_DEPTH_EFFICIENCY.
+BF16_COMPUTE_RATIO = 0.75
+
+
+def precision_compute_ratio(compute_precision: str) -> float:
+    """Anchor-cost multiplier for a compute-precision posture: 1.0 for
+    f32/equality (the anchors' own posture), :data:`BF16_COMPUTE_RATIO`
+    for ``bf16_f32acc``. The HALO side of the posture needs no factor
+    here — callers price it through ``itemsize`` (2 for bf16 fields),
+    which is what halves every ``halo_bytes_*`` figure."""
+    return (BF16_COMPUTE_RATIO
+            if compute_precision == "bf16_f32acc" else 1.0)
+
+
 def sstep_amortization(halo_depth: int, efficiency: float = None) -> float:
     """Fraction of the per-chain-round exchange hop latency that
     REMAINS under s-step exchange at depth ``halo_depth`` — 1.0 at
@@ -723,10 +746,15 @@ def projected_step_us(
     overlap="auto",
     local=None,
     halo_depth: int = 1,
+    compute_precision: str = "f32",
 ) -> Optional[float]:
     """Model-projected µs/step for ONE concrete (language, mesh, depth)
     config — the scalar the measured autotuner (``tune/candidates``)
-    ranks its shortlist by. Routes to the same projection the Auto
+    ranks its shortlist by. ``compute_precision`` (docs/PRECISION.md)
+    prices the ``bf16_f32acc`` posture: the single-chip anchor scales
+    by :data:`BF16_COMPUTE_RATIO` and the caller passes the bf16
+    ``itemsize`` (2), which halves the projected halo bytes — the two
+    halves of why the posture wins on a bandwidth-bound mesh. Routes to the same projection the Auto
     dispatch uses for that shape (cubic :func:`project` for the XLA
     language, :func:`project_1d`/:func:`project_chain` for the Pallas
     chains, the single-chip anchors for one device) and converts
@@ -738,10 +766,11 @@ def projected_step_us(
     rank last, they are not excluded."""
     n, m, p = dims
     ndev = n * m * p
+    ratio = precision_compute_ratio(compute_precision)
     if local is None:
         local = tuple(-(-L // d) for d in dims)
     if lang == "xla":
-        base = anchor_us("XLA", L) / ndev
+        base = anchor_us("XLA", L) / ndev * ratio
         if ndev == 1:
             return base
         side = max(2, round((local[0] * local[1] * local[2]) ** (1 / 3)))
@@ -751,7 +780,7 @@ def projected_step_us(
         return base / row["projected_weak_scaling_eff"]
     if max(1, int(halo_depth)) > 1:
         return None  # the Pallas chains have no s-step schedule
-    base_full = anchor_us("Pallas", L)
+    base_full = anchor_us("Pallas", L) * ratio
     r = FUSE_COST_RATIO.get(fuse)
     if ndev == 1:
         return None if r is None else base_full * r

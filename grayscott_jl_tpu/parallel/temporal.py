@@ -83,6 +83,7 @@ def pin_out_of_domain(arr, bv, origin, row):
 def window_chain(
     fields_w, params, model, *, depth, step, origin, row, use_noise,
     unit_noise, boundaries: Sequence[float], final_pin: bool = True,
+    compute_dtype=None,
 ):
     """``depth`` XLA steps on ghost-inclusive field windows, shrinking
     one cell per side per stage; returns the (shape - 2*depth) cores.
@@ -102,7 +103,14 @@ def window_chain(
     ``final_pin=False`` skips the last stage's pin masks — legal only
     when the caller knows every output cell is in-domain (a divisible-L
     block-shaped result), where the pin is a provably-all-true mask.
-    Mid-stage pins always run: the shrinking ring reads them back."""
+    Mid-stage pins always run: the shrinking ring reads them back.
+
+    ``compute_dtype`` widens each stage's accumulation (the
+    ``bf16_f32acc`` posture, docs/PRECISION.md): fields stay in the
+    storage dtype between stages — so the exchanged frame and the
+    per-stage rounding match the stepwise path exactly — and each
+    stage upcasts, accumulates, and rounds back inside
+    ``stencil.reaction_update``."""
     from ..ops import stencil
 
     fields_w = tuple(fields_w)
@@ -114,7 +122,9 @@ def window_chain(
             nzf = params.noise * unit_noise(step + s, o, shape)
         else:
             nzf = jnp.asarray(0.0, fields_w[0].dtype)
-        fields_w = stencil.reaction_update(fields_w, nzf, params, model)
+        fields_w = stencil.reaction_update(
+            fields_w, nzf, params, model, compute_dtype=compute_dtype
+        )
         if s + 1 < depth or final_pin:
             fields_w = tuple(
                 pin_out_of_domain(f, bv, o, row)
@@ -126,7 +136,7 @@ def window_chain(
 def stitch_bands_from_frame(
     fields_i, fields_w, params, model, *, depth, step, offs, row,
     axis_sizes, use_noise, unit_noise, boundaries: Sequence[float],
-    dims_to_stitch: Sequence[int] = (0, 1, 2),
+    dims_to_stitch: Sequence[int] = (0, 1, 2), compute_dtype=None,
 ):
     """Overwrite the ``depth``-thick boundary bands of block-shaped
     results with :func:`window_chain` recomputes from the exchanged
@@ -165,7 +175,7 @@ def stitch_bands_from_frame(
                 depth=k, step=step,
                 origin=base.at[dim].add(w0), row=row,
                 use_noise=use_noise, unit_noise=unit_noise,
-                boundaries=boundaries,
+                boundaries=boundaries, compute_dtype=compute_dtype,
             )
             pos = [0, 0, 0]
             pos[dim] = d0

@@ -102,7 +102,7 @@ __all__ = [
 
 FAULT_KINDS = (
     "io_error", "nan", "preempt", "kernel", "hang", "bitflip",
-    "ckpt_corrupt",
+    "ckpt_corrupt", "drift",
 )
 
 #: Distinct process exit codes, chosen from the sysexits "temporary

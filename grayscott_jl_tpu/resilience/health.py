@@ -43,6 +43,7 @@ from typing import NamedTuple, Optional
 __all__ = [
     "DRIFT_POLICIES",
     "POLICIES",
+    "DriftError",
     "DriftGate",
     "EnsembleHealthReport",
     "HealthError",
@@ -54,23 +55,38 @@ __all__ = [
 
 POLICIES = ("abort", "rollback", "warn", "off")
 
-DRIFT_POLICIES = ("warn", "off")
+DRIFT_POLICIES = ("warn", "abort", "rollback", "off")
 
 
 class DriftGate:
     """Policy gate over the windowed numerics-drift signal
-    (``obs/numerics.py``): the precision-policy seam ROADMAP item 1's
-    mixed-precision work plugs into ("health probes gate precision
-    drift" needs a baseline to drift *from* — the numerics recorder —
-    and a place to act on it — this gate).
+    (``obs/numerics.py``) — the health gate for precision drift
+    (ROADMAP item 1, docs/PRECISION.md): the ``bf16_f32acc`` posture
+    changes the rounding of every accumulation, and this gate is where
+    a run whose statistics walk away from the f32 reference window
+    stops being a silent wrong answer.
 
-    Today's policies (``GS_DRIFT_POLICY``): ``warn`` (default — trips
-    are logged, land as ``drift`` events on the unified stream, and
-    count in the RunStats ``numerics`` section) and ``off``. The
-    future bf16 path adds an action that demotes/escalates precision
-    here; the call shape (per-statistic relative drifts at a boundary
-    step) is already what that decision needs. ``GS_DRIFT_LIMIT``
-    (default 0.5) is the relative-change trip threshold.
+    Policies (``GS_DRIFT_POLICY``), reusing the HealthGuard action
+    vocabulary one-for-one:
+
+    ``warn`` (default)
+        Trips are logged, land as ``drift`` events on the unified
+        stream (carrying the acting policy), and count in the RunStats
+        ``numerics`` section.
+    ``abort``
+        Raise :class:`DriftError` at the probe — the run stops loudly
+        before more drifted steps reach the stores (the supervisor
+        does NOT restart an abort, exactly like a health abort).
+    ``rollback``
+        Raise :class:`DriftError` classified for the supervisor
+        (``resilience/supervisor.py`` maps it through the same
+        ``health`` taxonomy slot): under ``GS_SUPERVISE`` the run
+        resumes from the latest durable checkpoint.
+    ``off``
+        No gating (the drift gauges still export).
+
+    ``GS_DRIFT_LIMIT`` (default 0.5) is the relative-change trip
+    threshold.
     """
 
     def __init__(self, policy: str = "warn", limit: float = 0.5):
@@ -96,11 +112,19 @@ class DriftGate:
             ) from e
         return cls(policy, limit)
 
+    @property
+    def raising(self) -> bool:
+        """Does a trip unwind the run (abort/rollback) rather than
+        merely record?"""
+        return self.policy in ("abort", "rollback")
+
     def check(self, step: int, drifts: dict) -> Optional[dict]:
         """Judge one probe's per-statistic drifts (``"field.stat" ->
         relative change``). Returns an event-able dict when any
         statistic exceeds the limit under an active policy, else
-        None."""
+        None. The caller (``obs/numerics.NumericsRecorder``) records
+        the event and then calls :meth:`enforce` so the trip is on the
+        stream BEFORE an abort/rollback unwinds."""
         if self.policy == "off":
             return None
         tripped = {
@@ -113,6 +137,13 @@ class DriftGate:
             "limit": self.limit,
             "tripped": tripped,
         }
+
+    def enforce(self, step: int, event: dict) -> None:
+        """Act on a tripped check: raise :class:`DriftError` under
+        abort/rollback (the HealthGuard action reuse), no-op under
+        warn."""
+        if event is not None and self.raising:
+            raise DriftError(step, event, self.policy)
 
 
 class HealthReport:
@@ -289,6 +320,31 @@ class HealthError(RuntimeError):
         )
         self.step = step
         self.report = report
+        self.policy = policy
+
+
+class DriftError(HealthError):
+    """The numerics-drift gate tripped under an abort/rollback policy.
+
+    Subclasses :class:`HealthError` so the supervisor's existing
+    classification applies unchanged
+    (``resilience/supervisor.classify_failure``): ``rollback`` maps to
+    the recoverable ``health`` taxonomy slot (resume from the latest
+    durable checkpoint), ``abort`` stays unclassified and the run dies
+    loudly — the precision-drift gate literally reuses the HealthGuard
+    recovery machinery (docs/PRECISION.md)."""
+
+    def __init__(self, step: int, event: dict, policy: str):
+        tripped = event.get("tripped", {})
+        RuntimeError.__init__(
+            self,
+            f"numerics drift gate tripped at step {step}: "
+            + ", ".join(f"{k}={v:+.3f}" for k, v in tripped.items())
+            + f" (|drift| > {event.get('limit')}); policy={policy}"
+        )
+        self.step = step
+        self.report = None
+        self.event = dict(event)
         self.policy = policy
 
 

@@ -280,11 +280,17 @@ class FieldSnapshot:
 
     def __init__(self, parts, step: int, health=None,
                  field_names=("u", "v"), numerics=None,
-                 checksums=None):
+                 checksums=None, enc_parts=None, enc_meta=None):
         #: Simulation step the snapshot was taken at.
         self.step = step
         self._parts = parts  # [(offsets, true_sizes, *field_devs), ...]
         self._blocks = None
+        #: Lossy-codec parts (docs/PRECISION.md): same per-shard shape
+        #: as ``_parts`` but coded fields carry their uint payloads —
+        #: the bytes in flight are the compressed ones. ``enc_meta``
+        #: maps field index -> (bits, lo_dev, hi_dev, dtype_str).
+        self._enc_parts = enc_parts
+        self._enc_meta = enc_meta or {}
         #: Model field names, for the health report's attribution.
         self.field_names = tuple(field_names)
         #: Device scalars of the fused health probe
@@ -384,22 +390,52 @@ class FieldSnapshot:
         Snapshots taken with ``checksum=True`` verify the landed bytes
         against the fused device-side checksum first
         (:class:`~.resilience.integrity.CorruptionError` on mismatch —
-        classified ``corruption`` by the supervisor)."""
+        classified ``corruption`` by the supervisor).
+
+        Returns a :class:`~.io.codec.BoundaryBlocks` list: the exact
+        blocks in the list body (empty when this boundary skipped the
+        exact copy — a lossy-output-only boundary), with the codec
+        form, when captured, on its ``encoded`` attribute (coded
+        fields as :class:`~.io.codec.EncodedField`, uncoded ones as
+        plain arrays). Plain-list consumers are unaffected."""
         if self._blocks is None:
-            host_parts = [
-                (offsets, true) + tuple(np.asarray(d) for d in devs)
-                for offsets, true, *devs in self._parts
-            ]
-            if self._checksums is not None:
-                self._verify_checksums(host_parts)
-            out = []
-            for offsets, true, *hosts in host_parts:
-                sl = tuple(slice(0, t) for t in true)
-                out.append(
-                    (offsets, true) + tuple(h[sl] for h in hosts)
-                )
+            from .io.codec import BoundaryBlocks, EncodedField
+
+            exact = []
+            if self._parts is not None:
+                host_parts = [
+                    (offsets, true) + tuple(np.asarray(d) for d in devs)
+                    for offsets, true, *devs in self._parts
+                ]
+                if self._checksums is not None:
+                    self._verify_checksums(host_parts)
+                for offsets, true, *hosts in host_parts:
+                    sl = tuple(slice(0, t) for t in true)
+                    exact.append(
+                        (offsets, true) + tuple(h[sl] for h in hosts)
+                    )
+            out = BoundaryBlocks(exact)
+            if self._enc_parts is not None:
+                enc_blocks = []
+                for offsets, true, *devs in self._enc_parts:
+                    sl = tuple(slice(0, t) for t in true)
+                    entries = []
+                    for i, d in enumerate(devs):
+                        h = np.asarray(d)[sl]
+                        meta = self._enc_meta.get(i)
+                        if meta is None:
+                            entries.append(h)
+                        else:
+                            bits, lo, hi, dt = meta
+                            entries.append(EncodedField(
+                                h, float(np.asarray(lo)),
+                                float(np.asarray(hi)), bits, dt,
+                            ))
+                    enc_blocks.append((offsets, true) + tuple(entries))
+                out.encoded = enc_blocks
             self._blocks = out
             self._parts = None  # release the device buffers
+            self._enc_parts = None
         return self._blocks
 
 
@@ -441,6 +477,38 @@ class Simulation:
                 f"must run the XLA path (use 'Plain'/'XLA' or 'Auto')"
             )
         self.dtype = config.resolve_precision(settings)
+        self._base_dtype = self.dtype
+        #: Mixed-precision compute posture (docs/PRECISION.md,
+        #: GS_COMPUTE_PRECISION / compute_precision key): "f32"
+        #: (default — today's compute, bitwise), "bf16_f32acc" (fields,
+        #: halo slabs, and stores held in bfloat16; Laplacian +
+        #: reaction + Euler update accumulated in float32), or
+        #: "equality" (pinned f32 AND a loud refusal of any lossy
+        #: snapshot codec — the operator escape hatch asserting byte
+        #: identity with a pre-posture build). Under an authorizing
+        #: posture the measured autotuner may adopt the per-config
+        #: winner across the precision axis below.
+        self.compute_precision = config.resolve_compute_precision(
+            settings
+        )
+        #: Accumulation dtype for the XLA reaction/Laplacian paths —
+        #: equals the storage dtype except under ``bf16_f32acc``, where
+        #: storage drops to bf16 and accumulation stays f32 (the Pallas
+        #: kernel's own ``_compute_dtype`` applies the same rule
+        #: in-kernel for bf16 fields).
+        self.compute_dtype = self.dtype
+        if self.compute_precision == "bf16_f32acc":
+            self.dtype = jnp.bfloat16
+            self.compute_dtype = jnp.float32
+        #: Lossy snapshot codec posture (docs/PRECISION.md,
+        #: GS_SNAPSHOT_BITS / snapshot_bits key): resolved here so a
+        #: misconfiguration (unknown field, equality + codec) fails at
+        #: construction and the posture joins the tuning-cache key.
+        from .io.codec import resolve_snapshot_codec
+
+        self.snapshot_codec = resolve_snapshot_codec(
+            settings, self.model.field_names
+        )
 
         # Persistent compilation cache (GS_COMPILE_CACHE / compile_cache
         # key; default on under supervision) — must be armed before the
@@ -628,6 +696,15 @@ class Simulation:
                 # (or another process count) must never be applied on
                 # mesh B.
                 procs=jax.process_count(),
+                # Precision + codec postures join the key (schema v6,
+                # docs/PRECISION.md): a bf16-measured winner must never
+                # be adopted by an f32 run (different HBM/halo bytes,
+                # different schedule), and the bf16_f32acc posture arms
+                # the precision CANDIDATE AXIS — the tuner measures
+                # both precisions and the winner below may adopt
+                # either, per config.
+                compute_precision=self.compute_precision,
+                snapshot_codec=self.snapshot_codec.posture(),
                 **self._tune_extras(),
             )
             self.kernel_selection["autotune"] = decision.provenance
@@ -643,6 +720,23 @@ class Simulation:
                 if (decision.halo_depth is not None
                         and not self._halo_depth_pinned):
                     self.halo_depth = max(1, int(decision.halo_depth))
+                if (decision.compute_precision is not None
+                        and self.compute_precision == "bf16_f32acc"
+                        and decision.compute_precision
+                        in config.COMPUTE_PRECISIONS):
+                    # Per-config precision adoption (docs/PRECISION.md):
+                    # only an authorizing bf16_f32acc posture searches
+                    # the precision axis, and the measured winner may
+                    # keep bf16 or fall back to f32 for THIS config.
+                    # Params and fields are built after this block, so
+                    # the adopted dtype is what the run materializes.
+                    self.compute_precision = decision.compute_precision
+                    if self.compute_precision == "bf16_f32acc":
+                        self.dtype = jnp.bfloat16
+                        self.compute_dtype = jnp.float32
+                    else:
+                        self.dtype = self._base_dtype
+                        self.compute_dtype = self._base_dtype
                 if decision.bx is not None and not env_str(
                         "GS_BX", ""):
                     # GS_BX is read at kernel-trace time; an env pin is
@@ -666,6 +760,17 @@ class Simulation:
                 )
         else:
             self.kernel_selection = None
+        if isinstance(self.kernel_selection, dict):
+            # Adopted-precision provenance (docs/PRECISION.md): every
+            # stats/bench consumer of kernel_selection sees which
+            # posture the run actually materialized next to the
+            # kernel/fuse decision it rode in on.
+            self.kernel_selection["compute_precision"] = (
+                self.compute_precision
+            )
+            self.kernel_selection["snapshot_codec"] = (
+                self.snapshot_codec.posture()
+            )
         if self.kernel_language == "pallas" and self.halo_depth > 1:
             # The Pallas in-kernel chains have no s-step schedule (the
             # fused chain IS their exchange amortization, and its depth
@@ -792,8 +897,11 @@ class Simulation:
 
     def _make_params(self):
         """Typed params pytree, routed through the model declaration
-        (``[model]`` table > legacy flat keys > declared defaults)."""
-        return self.model.make_params(self.settings, self.dtype)
+        (``[model]`` table > legacy flat keys > declared defaults).
+        Params live at the COMPUTE dtype: identical to the storage
+        dtype except under ``bf16_f32acc``, where the f32 params feed
+        the f32 accumulation directly (docs/PRECISION.md)."""
+        return self.model.make_params(self.settings, self.compute_dtype)
 
     def _resolve_use_noise(self) -> bool:
         return self.settings.noise != 0.0
@@ -906,6 +1014,10 @@ class Simulation:
         L = self.settings.L
         boundaries = model.boundaries
         dtype = fields[0].dtype
+        # bf16_f32acc accumulation dtype (docs/PRECISION.md): None-like
+        # (equal to the storage dtype) on every other posture, so the
+        # default paths trace the historical graph bit for bit.
+        cdt = self.compute_dtype
         key_i32 = lax.bitcast_convert_type(base_key, jnp.int32)
 
         if sharded:
@@ -1219,7 +1331,8 @@ class Simulation:
             else:
                 nz = jnp.asarray(0.0, dtype)
             return pin_block(
-                stencil.reaction_update(fields_pad, nz, params, model)
+                stencil.reaction_update(fields_pad, nz, params, model,
+                                        compute_dtype=cdt)
             )
 
         # Split-phase gate for the XLA window mode: only band windows
@@ -1280,14 +1393,14 @@ class Simulation:
                     frozen, params, model, depth=depth, step=step,
                     origin=offs - depth, row=L, use_noise=use_noise,
                     unit_noise=unit_noise, boundaries=boundaries,
-                    final_pin=padded,
+                    final_pin=padded, compute_dtype=cdt,
                 )
                 fields_w = pending.finish()
                 return temporal.stitch_bands_from_frame(
                     fields_i, fields_w, params, model, depth=depth,
                     step=step, offs=offs, row=L, axis_sizes=dims,
                     use_noise=use_noise, unit_noise=unit_noise,
-                    boundaries=boundaries,
+                    boundaries=boundaries, compute_dtype=cdt,
                 )
             fields_w = halo.halo_pad_wide(
                 fields_c, boundaries, AXIS_NAMES, dims, depth
@@ -1301,7 +1414,7 @@ class Simulation:
                 fields_w, params, model, depth=depth, step=step,
                 origin=offs - depth, row=L, use_noise=use_noise,
                 unit_noise=unit_noise, boundaries=boundaries,
-                final_pin=padded,
+                final_pin=padded, compute_dtype=cdt,
             )
 
         return run_chain_rounds(chain, fuse, fields)
@@ -1419,7 +1532,8 @@ class Simulation:
 
     def snapshot_async(
         self, *, health: bool = False, numerics: bool = False,
-        checksum: bool = False, bitflip=None,
+        checksum: bool = False, bitflip=None, encode=None,
+        exact: bool = True,
     ) -> FieldSnapshot:
         """Capture the current (u, v) for overlapped output: returns a
         :class:`FieldSnapshot` with non-blocking D2H transfers already
@@ -1451,8 +1565,29 @@ class Simulation:
         device-side COPY after the probes ran — silent write-path
         corruption, field/member-addressable, live trajectory
         untouched.
+
+        ``encode`` (docs/PRECISION.md — the lossy snapshot codec) maps
+        field indices to quantization bit widths: coded fields are
+        additionally quantized to uint payloads INSIDE the same jitted
+        program (``io/codec.device_quantize`` — the exact field is
+        read from HBM once for copy, probes, and encode together) and
+        only the compressed bytes ride the D2H for them.
+        ``exact=False`` skips the exact copies entirely (a lossy-
+        output-only boundary — the D2H volume win); at least one of
+        ``exact``/``encode`` must be requested.
         """
-        key = (health, numerics, checksum)
+        from .io import codec as io_codec
+
+        enc_items = (
+            tuple(sorted((int(i), int(b)) for i, b in encode.items()))
+            if encode else None
+        )
+        if not exact and enc_items is None:
+            raise ValueError(
+                "snapshot_async(exact=False) needs an encode spec — "
+                "a boundary with neither captures nothing"
+            )
+        key = (health, numerics, checksum, enc_items, exact)
         fn = self._snapshot_fns.get(key)
         if fn is None:
             # +0 forces a real output buffer (no donation, so XLA never
@@ -1460,37 +1595,73 @@ class Simulation:
             device_probe = self._probe_fn() if health else None
             num_probe = self._numerics_probe_fn() if numerics else None
             ck_probe = self._checksum_probe_fn() if checksum else None
+            spec = dict(enc_items) if enc_items else None
 
             def copy(*fields):
-                out = [tuple(
-                    f + jnp.zeros((), f.dtype) for f in fields
-                )]
+                res = {}
+                copies = (
+                    tuple(f + jnp.zeros((), f.dtype) for f in fields)
+                    if exact else None
+                )
+                if copies is not None:
+                    res["copies"] = copies
+                if spec is not None:
+                    entries, lohi = [], []
+                    for i, f in enumerate(fields):
+                        bits = spec.get(i)
+                        if bits is None:
+                            # Uncoded fields ride the codec set as
+                            # exact copies (one buffer, shared with
+                            # the exact set when both are captured).
+                            entries.append(
+                                copies[i] if copies is not None
+                                else f + jnp.zeros((), f.dtype)
+                            )
+                        else:
+                            q, lo, hi = io_codec.device_quantize(
+                                f, bits
+                            )
+                            entries.append(q)
+                            lohi.append((lo, hi))
+                    res["enc"] = tuple(entries)
+                    res["enc_lohi"] = tuple(lohi)
                 if device_probe is not None:
-                    out.append(device_probe(*fields))
+                    res["health"] = device_probe(*fields)
                 if num_probe is not None:
-                    out.append(num_probe(*fields))
+                    res["numerics"] = num_probe(*fields)
                 if ck_probe is not None:
-                    out.append(ck_probe(*fields))
-                return tuple(out) if len(out) > 1 else out[0]
+                    res["checksums"] = ck_probe(*fields)
+                return res
 
             fn = self._snapshot_fns[key] = jax.jit(copy)
         res = fn(*self.fields)
-        if health or numerics or checksum:
-            copies, *extras = res
-            probe = extras.pop(0) if health else None
-            nums = extras.pop(0) if numerics else None
-            cksums = extras.pop(0) if checksum else None
-        else:
-            copies, probe, nums, cksums = res, None, None, None
+        copies = res.get("copies")
+        probe = res.get("health")
+        nums = res.get("numerics")
+        cksums = res.get("checksums")
+        enc = res.get("enc")
         if bitflip is not None:
-            copies = self._apply_snapshot_bitflip(copies, bitflip)
-        parts = self._shard_parts(*copies)
-        for part in parts:
-            for dev in part[2:]:
-                dev.copy_to_host_async()
+            if copies is not None:
+                copies = self._apply_snapshot_bitflip(copies, bitflip)
+            else:
+                enc = self._apply_snapshot_bitflip(enc, bitflip)
+        parts = self._shard_parts(*copies) if copies is not None else None
+        enc_parts, enc_meta = None, None
+        if enc is not None:
+            enc_parts = self._shard_parts(*enc)
+            enc_meta = {}
+            for (i, bits), (lo, hi) in zip(enc_items, res["enc_lohi"]):
+                enc_meta[i] = (
+                    bits, lo, hi, str(np.dtype(self.dtype)),
+                )
+        for plist in (parts, enc_parts):
+            for part in plist or ():
+                for dev in part[2:]:
+                    dev.copy_to_host_async()
         return self.snapshot_cls(
             parts, self.step, health=probe, numerics=nums,
             checksums=cksums, field_names=self.model.field_names,
+            enc_parts=enc_parts, enc_meta=enc_meta,
         )
 
     def _checksum_probe_fn(self):
@@ -1547,6 +1718,31 @@ class Simulation:
         )
         self.fields = (
             self.fields[:i] + (poisoned,) + self.fields[i + 1:]
+        )
+
+    def poison_drift(self, field="u", factor: float = 8.0) -> None:
+        """Chaos/testing hook (``resilience/faults.py`` kind
+        ``drift``): scale a small corner box of ``field`` by
+        ``factor`` — a large but FINITE excursion, the numerical
+        signature of a mixed-precision accumulation going wrong
+        without blowing up. The corner sits outside the reaction seed
+        (the activator field is zero there for every registered
+        model's init), so the excursion decays diffusively instead of
+        feeding the reaction: the health guard stays green
+        (everything finite), while the field's max statistic jumps by
+        ~``factor`` and the numerics drift signal
+        (``obs/numerics.py``) must trip the
+        :class:`~.resilience.health.DriftGate` per
+        ``GS_DRIFT_POLICY``. A scatter on the live buffers; sharding
+        is preserved."""
+        i = self._field_index(field)
+        arr = self.fields[i]
+        box = tuple(slice(0, 2) for _ in range(arr.ndim))
+        scaled = arr.at[box].multiply(
+            jnp.asarray(factor, arr.dtype)
+        )
+        self.fields = (
+            self.fields[:i] + (scaled,) + self.fields[i + 1:]
         )
 
     def local_blocks(self):

@@ -83,6 +83,10 @@ class TuneDecision:
     #: s-step exchange depth the winner measured fastest (None: leave
     #: the resolved halo_depth alone; docs/TEMPORAL.md).
     halo_depth: Optional[int] = None
+    #: Compute-precision posture the winner measured fastest (None:
+    #: leave the run's resolved posture alone; only an authorizing
+    #: bf16_f32acc posture ever receives a value — docs/PRECISION.md).
+    compute_precision: Optional[str] = None
 
 
 def _emit_event(prov: dict, kernel: str) -> None:
@@ -133,6 +137,7 @@ def _winner_decision(mode: str, winner: dict, prov: dict) -> TuneDecision:
         # resolved value alone (they are structurally invisible anyway
         # — the schema bump orphaned them).
         halo_depth=int(sk) if sk is not None else None,
+        compute_precision=winner.get("compute_precision"),
     )
 
 
@@ -163,6 +168,8 @@ def autotune(
     pallas_allowed: bool = True,
     halo_depth: int = 0,
     procs: int = 1,
+    compute_precision: str = "f32",
+    snapshot_codec: str = "off",
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -185,7 +192,9 @@ def autotune(
     mode = resolve_mode(settings)
     gate = {"model": model, "n_fields": n_fields,
             "pallas_allowed": bool(pallas_allowed),
-            "halo_depth_pin": int(halo_depth)}
+            "halo_depth_pin": int(halo_depth),
+            "compute_precision": compute_precision,
+            "snapshot_codec": snapshot_codec}
     if mode == "off":
         return _analytic_decision(mode, analytic_kernel, gate)
 
@@ -197,7 +206,8 @@ def autotune(
         dtype=dtype, noise=noise, jax_version=jax.__version__,
         ensemble=ensemble, model=model, n_fields=n_fields,
         halo_depth=halo_depth, member_shards=member_shards,
-        procs=procs,
+        procs=procs, compute_precision=compute_precision,
+        snapshot_codec=snapshot_codec,
     )
     rec = cache.load(key)
     if rec is not None:
@@ -239,6 +249,7 @@ def autotune(
         bx_variants=2 if mode == "full" else 0,
         ensemble=ensemble, member_shards=member_shards,
         pallas_allowed=pallas_allowed, halo_depth=halo_depth,
+        compute_precision=compute_precision,
     )
     steps = env_int("GS_AUTOTUNE_STEPS", 20)
     rounds = env_int("GS_AUTOTUNE_ROUNDS",

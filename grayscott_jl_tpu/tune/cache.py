@@ -47,8 +47,16 @@ from ..config.env import env_str
 #: decision, so one config legitimately runs on different placements
 #: across resumes, and a winner tuned on placement A must never be
 #: applied on placement B; stale v4 entries are structurally invisible
-#: and degrade to the warned analytic pick like any other miss.
-SCHEMA_VERSION = 5
+#: and degrade to the warned analytic pick like any other miss. v6:
+#: the key grew the ``compute_precision`` posture and the
+#: ``snapshot_codec`` posture (docs/PRECISION.md): a bf16_f32acc-
+#: measured winner moves half the halo/HBM bytes of an f32 run and
+#: must never be adopted by one (the bf16 posture also arms the
+#: precision candidate axis, so its measured space is wider), and a
+#: lossy-output run's boundary program differs from an exact run's;
+#: stale v5 entries are structurally invisible and degrade to the
+#: warned analytic pick like any other miss.
+SCHEMA_VERSION = 6
 
 
 def cache_dir() -> str:
@@ -76,6 +84,8 @@ def cache_key(
     halo_depth: int = 0,
     member_shards: int = 1,
     procs: int = 1,
+    compute_precision: str = "f32",
+    snapshot_codec: str = "off",
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
@@ -91,7 +101,10 @@ def cache_key(
     (schema v5) complete the ADOPTED placement: with elastic
     resharding (docs/RESHARD.md) the same config can resume on a
     different member split or process count, and measurements never
-    transfer across placements."""
+    transfer across placements. ``compute_precision``/
+    ``snapshot_codec`` (schema v6, docs/PRECISION.md) are the
+    mixed-precision and lossy-output postures: a bf16-measured winner
+    can never be adopted by an f32 run."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -107,6 +120,8 @@ def cache_key(
         "halo_depth": int(halo_depth),
         "member_shards": int(member_shards),
         "procs": int(procs),
+        "compute_precision": str(compute_precision),
+        "snapshot_codec": str(snapshot_codec),
     }
 
 

@@ -45,10 +45,17 @@ class Candidate:
     #: batch-size-per-device x block-shape trade-off.
     member_shards: Optional[int] = None
     mesh: Optional[tuple] = None
+    #: Mixed-precision compute posture this candidate runs at
+    #: (docs/PRECISION.md): "f32" or "bf16_f32acc". The axis is only
+    #: enumerated under an authorizing ``bf16_f32acc`` run posture —
+    #: the winner decides, per config, whether bf16 actually pays.
+    compute_precision: str = "f32"
 
     def label(self) -> str:
         parts = [self.kernel, f"fuse={self.fuse}",
                  "overlap" if self.comm_overlap else "fused"]
+        if self.compute_precision != "f32":
+            parts.insert(1, "bf16")
         if self.halo_depth != 1:
             parts.append(f"sk={self.halo_depth}")
         if self.bx is not None:
@@ -133,6 +140,7 @@ def generate(
     member_shards: int = 1,
     pallas_allowed: bool = True,
     halo_depth: int = 0,
+    compute_precision: str = "f32",
 ) -> List[Candidate]:
     """The ranked measurement shortlist for one run config.
 
@@ -153,6 +161,13 @@ def generate(
     Pallas candidates always carry k=1 — no s-step schedule exists
     there (docs/TEMPORAL.md "Interactions").
 
+    ``compute_precision`` is the run's posture (docs/PRECISION.md):
+    ``bf16_f32acc`` arms the precision AXIS — every (kernel, depth,
+    overlap, k) point is enumerated at BOTH precisions, the bf16
+    variants priced with halved halo bytes (itemsize 2) and the
+    :data:`~..parallel.icimodel.BF16_COMPUTE_RATIO` anchor discount —
+    while ``f32``/``equality`` runs never see a bf16 candidate.
+
     Ensemble runs (``ensemble > 1``, ``member_shards`` the configured
     member-axis split) additionally search the batch-size x block-shape
     trade-off: every alternative split m' of the same device pool
@@ -168,21 +183,43 @@ def generate(
     if sharded and overlap_toggle:
         overlaps.append(not comm_overlap)
 
-    langs = {"xla": _xla_depths(local, dims, fuse_cap)}
-    if platform == "tpu" and pallas_allowed:
-        # pallas_allowed is the model gate: the hand-fused kernel
-        # implements Gray-Scott only (Model.pallas_capable), so the
-        # tuner must never time — or cache a winner for — a Pallas
-        # schedule another model cannot run.
-        depths = _pallas_depths(local, itemsize, dims, fuse_cap)
-        if depths:
-            langs["pallas"] = depths
+    # Precision axis (docs/PRECISION.md): only an authorizing
+    # bf16_f32acc posture widens the search — the posture's own
+    # precision is the analytic default, and the f32 variant rides
+    # along so the measurement decides per config. f32/equality
+    # postures never see a bf16 candidate (and a bf16-measured winner
+    # is unreachable anyway — the posture is in the cache key).
+    analytic_cp = (
+        "bf16_f32acc" if compute_precision == "bf16_f32acc" else "f32"
+    )
+    precisions = (
+        ["bf16_f32acc", "f32"] if compute_precision == "bf16_f32acc"
+        else ["f32"]
+    )
 
-    def score(kernel, fuse, ov, sk=1):
+    def _isz(cp: str) -> int:
+        return 2 if cp == "bf16_f32acc" else itemsize
+
+    def _langs(cp: str) -> dict:
+        out = {"xla": _xla_depths(local, dims, fuse_cap)}
+        if platform == "tpu" and pallas_allowed:
+            # pallas_allowed is the model gate: the hand-fused kernel
+            # implements Gray-Scott only (Model.pallas_capable), so
+            # the tuner must never time — or cache a winner for — a
+            # Pallas schedule another model cannot run. Feasibility is
+            # re-gated per precision: bf16 halves the slab bytes and
+            # can admit deeper chains.
+            depths = _pallas_depths(local, _isz(cp), dims, fuse_cap)
+            if depths:
+                out["pallas"] = depths
+        return out
+
+    def score(kernel, fuse, ov, sk=1, cp="f32"):
         us = icimodel.projected_step_us(
-            kernel, dims, L, fuse, itemsize=itemsize, links=links,
+            kernel, dims, L, fuse, itemsize=_isz(cp), links=links,
             link_gbps=link_gbps, local=local,
             overlap="auto" if ov else 0.0, halo_depth=sk,
+            compute_precision=cp,
         )
         if us is not None and ensemble > 1:
             # Rank ensembles by the batch each device group carries so
@@ -204,20 +241,25 @@ def generate(
 
     ens_tag = member_shards if ensemble > 1 else None
     out = []
-    for kernel, depths in langs.items():
-        for fuse in depths:
-            for ov in overlaps if sharded else [False]:
-                for sk in sstep_depths(kernel, fuse):
-                    out.append(Candidate(
-                        kernel=kernel, fuse=fuse, comm_overlap=ov,
-                        halo_depth=sk,
-                        projected_step_us=score(kernel, fuse, ov, sk),
-                        analytic=(kernel == analytic_kernel
-                                  and fuse == analytic_fuse
-                                  and ov == comm_overlap
-                                  and sk == analytic_sk),
-                        member_shards=ens_tag,
-                    ))
+    for cp in precisions:
+        for kernel, depths in _langs(cp).items():
+            for fuse in depths:
+                for ov in overlaps if sharded else [False]:
+                    for sk in sstep_depths(kernel, fuse):
+                        out.append(Candidate(
+                            kernel=kernel, fuse=fuse, comm_overlap=ov,
+                            halo_depth=sk,
+                            projected_step_us=score(
+                                kernel, fuse, ov, sk, cp
+                            ),
+                            analytic=(kernel == analytic_kernel
+                                      and fuse == analytic_fuse
+                                      and ov == comm_overlap
+                                      and sk == analytic_sk
+                                      and cp == analytic_cp),
+                            member_shards=ens_tag,
+                            compute_precision=cp,
+                        ))
 
     if ensemble > 1:
         # Batch-size x block-shape trade-off: alternative member-axis
@@ -239,10 +281,12 @@ def generate(
             alt_sharded = total // m_alt > 1
             for fuse in _xla_depths(alt_local, alt_dims, fuse_cap):
                 proj = icimodel.projected_step_us(
-                    "xla", alt_dims, L, fuse, itemsize=itemsize,
+                    "xla", alt_dims, L, fuse,
+                    itemsize=_isz(analytic_cp),
                     links=links, link_gbps=link_gbps, local=alt_local,
                     overlap="auto" if (comm_overlap and alt_sharded)
                     else 0.0,
+                    compute_precision=analytic_cp,
                 )
                 out.append(Candidate(
                     kernel="xla", fuse=fuse,
@@ -253,6 +297,7 @@ def generate(
                     ),
                     member_shards=m_alt,
                     mesh=tuple(alt_dims),
+                    compute_precision=analytic_cp,
                 ))
     if not any(c.analytic for c in out):
         # The analytic pick fell outside the enumerable space (e.g. a
@@ -265,9 +310,11 @@ def generate(
             projected_step_us=score(
                 analytic_kernel, analytic_fuse,
                 comm_overlap if sharded else False,
-                analytic_sk if analytic_kernel == "xla" else 1),
+                analytic_sk if analytic_kernel == "xla" else 1,
+                analytic_cp),
             analytic=True,
             member_shards=ens_tag,
+            compute_precision=analytic_cp,
         ))
 
     big = float("inf")

@@ -71,6 +71,12 @@ def pinned_settings(settings, candidate: Candidate):
         else "Plain",
         comm_overlap="on" if candidate.comm_overlap else "off",
         halo_depth=max(1, int(getattr(candidate, "halo_depth", 1))),
+        # The candidate's precision posture (docs/PRECISION.md): the
+        # probe sim materializes the candidate's storage dtype so a
+        # bf16 measurement times bf16 halo/HBM bytes for real.
+        compute_precision=getattr(
+            candidate, "compute_precision", "f32"
+        ) or "f32",
         # Tuning is a construction-time concern; the pinned probe sims
         # must not arm supervision, restart, or checkpoint machinery.
         supervise=False, restart=False, checkpoint=False,
@@ -155,11 +161,15 @@ def measure_candidates(
         pins = {"GS_FUSE": cand.fuse, "GS_BX": cand.bx,
                 "GS_TPU_MESH_DIMS": ",".join(str(d) for d in pin_mesh),
                 # The Settings pins below would lose to stray
-                # GS_COMM_OVERLAP/GS_HALO_DEPTH in the environment.
+                # GS_COMM_OVERLAP/GS_HALO_DEPTH/GS_COMPUTE_PRECISION
+                # in the environment.
                 "GS_COMM_OVERLAP": "on" if cand.comm_overlap else "off",
                 "GS_HALO_DEPTH": max(
                     1, int(getattr(cand, "halo_depth", 1))
                 ),
+                "GS_COMPUTE_PRECISION": getattr(
+                    cand, "compute_precision", "f32"
+                ) or "f32",
                 # A probe sim must never consult or write the tuning
                 # cache itself.
                 "GS_AUTOTUNE": "off"}

@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# Chaos smoke test, seven scenarios (1-3 against one uninterrupted
+# Chaos smoke test, eight scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
 # elastic — resume on a DIFFERENT mesh / member count than the kill,
 # 6 serve — a worker killed mid-batch under the service front door,
 # 7 integrity — silent checkpoint corruption survived by replica
-# failover):
+# failover, 8 precision — lossy output resumed from an exact
+# checkpoint):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -38,6 +39,13 @@
 #      byte-identical to an uninterrupted service run; the merged
 #      event stream (job_* lifecycle kinds included) validates via
 #      gs_report.py --check;
+#   8. lossy output + exact checkpoints (docs/PRECISION.md): a
+#      supervised run with the 8-bit snapshot codec armed
+#      (GS_SNAPSHOT_BITS=8 — uint8 payloads in gs.bp) is preempted
+#      mid-run and auto-resumes from its EXACT-precision checkpoint ->
+#      the compressed output store and the .vti mirror are
+#      byte-identical to an uninterrupted lossy run, proving the
+#      codec's determinism and that checkpoints stayed exact;
 #   7. data integrity (docs/RESILIENCE.md "Data integrity"): a
 #      ckpt_corrupt fault flips a payload byte in the PRIMARY
 #      checkpoint replica's freshly-durable entry mid-run, a later
@@ -595,7 +603,48 @@ PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
   exit 1
 }
 
-echo "chaos_smoke: PASS — all seven scenarios recovered byte-identical" \
+echo "chaos_smoke: [8/8] precision — lossy output + preempt -> exact-checkpoint resume..."
+# Same seeded preemption as scenario 1, now with the 8-bit snapshot
+# codec armed on BOTH runs: the reference is the uninterrupted lossy
+# run, and byte-identity of the uint8 store proves the quantized
+# output is deterministic across a restart from the EXACT checkpoint.
+mkdir -p "$WORK/lossyref" "$WORK/lossy"
+for d in lossyref lossy; do write_config "$WORK/$d"; done
+run "$WORK/lossyref" \
+  GS_SNAPSHOT_BITS=8 \
+  > "$WORK/lossyref.log" 2>&1
+run "$WORK/lossy" \
+  GS_SUPERVISE=1 \
+  GS_MAX_RESTARTS=5 \
+  GS_RESTART_BACKOFF_S=0.05 \
+  GS_SNAPSHOT_BITS=8 \
+  GS_EVENTS="$WORK/lossy/events.jsonl" \
+  GS_FAULTS="step=${PREEMPT}:kind=preempt" \
+  > "$WORK/lossy.log" 2>&1
+# The output store really is compressed (uint8 payloads)...
+grep -aq '"uint8"' "$WORK/lossy/gs.bp/md.json" || {
+  echo "chaos_smoke: FAIL — lossy store carries no uint8 payloads" >&2
+  exit 1
+}
+# ...and the checkpoint really is exact (float32 variables, no codec).
+python3 - "$WORK/lossy/ckpt.bp/md.json" <<'EOF'
+import json, sys
+md = json.load(open(sys.argv[1]))
+assert md["variables"]["u"]["dtype"] == "float32", md["variables"]["u"]
+assert "snapshot_codec" not in md.get("attributes", {}), "ckpt got the codec"
+EOF
+for store in gs.bp gs.vtk; do
+  if ! diff -r "$WORK/lossyref/$store" "$WORK/lossy/$store" > /dev/null; then
+    echo "chaos_smoke: FAIL — lossy $store differs after the preempt resume" >&2
+    exit 1
+  fi
+done
+grep -aq '"fault": "preempt"' "$WORK/lossy/events.jsonl" || {
+  echo "chaos_smoke: FAIL — injected preempt missing from the lossy event stream" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all eight scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

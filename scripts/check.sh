@@ -37,8 +37,11 @@ if [[ "${1:-}" != "--fast" ]]; then
     # .github/workflows/check.yml): the fail-silent contracts —
     # bitflip detection, ckpt_corrupt failover, sole-replica refusal —
     # hold on every push (docs/RESILIENCE.md "Data integrity").
+    # test_precision_run rides along too: the codec's byte-identity
+    # and drift-gate recovery contracts (docs/PRECISION.md).
     JAX_PLATFORMS=cpu python -m pytest tests/unit \
-        tests/functional/test_integrity_run.py -q -m 'not slow' \
+        tests/functional/test_integrity_run.py \
+        tests/functional/test_precision_run.py -q -m 'not slow' \
         -p no:cacheprovider
 fi
 echo "check.sh: OK"

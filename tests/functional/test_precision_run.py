@@ -1,0 +1,220 @@
+"""Functional: mixed-precision posture + lossy snapshot codec end to
+end through the real CLI (docs/PRECISION.md).
+
+The contracts: lossy output decodes within the documented bound while
+checkpoints stay EXACT (byte-identical to an exact run's); a
+supervised lossy run preempted mid-flight resumes from its exact
+checkpoint and finishes with stores byte-identical to an uninterrupted
+lossy run (``scripts/chaos_smoke.sh`` scenario 8 is the seeded
+knob-twister of the same scenario); the drift gate's rollback policy
+recovers a supervised run through the HealthGuard machinery; and the
+bf16 posture rides the whole driver with its posture in RunStats.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import run_cli, write_config
+from test_supervisor import STEPS, _supervised
+
+from grayscott_jl_tpu.io import codec as io_codec
+from grayscott_jl_tpu.io.bplite import BpReader
+
+
+@pytest.fixture(scope="module")
+def exact_run(tmp_path_factory):
+    """The exact (codec-off) reference run."""
+    d = tmp_path_factory.mktemp("exact")
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg)
+    assert res.returncode == 0, res.stderr + res.stdout
+    return d
+
+
+@pytest.fixture(scope="module")
+def lossy_run(tmp_path_factory):
+    """The uninterrupted lossy reference (GS_SNAPSHOT_BITS=8)."""
+    d = tmp_path_factory.mktemp("lossy")
+    cfg = write_config(
+        d, noise=0.1, steps=STEPS, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    res = run_cli(d, cfg, extra_env={"GS_SNAPSHOT_BITS": "8"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    return d
+
+
+def test_lossy_store_schema_and_error_bound(exact_run, lossy_run):
+    """The coded store holds uint8 payloads + range scalars + the
+    codec attribute; every decoded step is within the documented
+    max-abs-error bound of the exact run's step; the checkpoint store
+    is byte-identical to the exact run's (checkpoints stay exact, and
+    the trajectory is untouched by the codec)."""
+    r = BpReader(str(lossy_run / "gs.bp"))
+    ex = BpReader(str(exact_run / "gs.bp"))
+    assert r.num_steps() == ex.num_steps() > 0
+    info = r.available_variables()
+    assert info["U"].dtype == np.uint8
+    codec = io_codec.decode_attr(r.attributes())
+    assert codec["U"]["bits"] == 8
+    for step in range(r.num_steps()):
+        for name in ("U", "V"):
+            dec = r.get(name, step=step)
+            exact = ex.get(name, step=step)
+            lo = float(r._get(io_codec.qlo_var(name), step=step))
+            hi = float(r._get(io_codec.qhi_var(name), step=step))
+            bound = io_codec.error_bound(lo, hi, 8, "float32")
+            assert np.max(np.abs(dec - exact)) <= bound * (1 + 1e-6)
+    r.close()
+    ex.close()
+    # checkpoints stayed exact: byte-identical store trees
+    _assert_trees_byte_identical(
+        exact_run / "ckpt.bp", lossy_run / "ckpt.bp"
+    )
+
+
+def test_lossy_preempt_resumes_byte_identical(tmp_path, lossy_run):
+    """The chaos acceptance for the codec: a supervised lossy run
+    preempted mid-flight auto-resumes from its EXACT checkpoint and
+    every store — the compressed .bp included — is byte-identical to
+    the uninterrupted lossy run's."""
+    d, res, stats_path = _supervised(
+        tmp_path, "lossy_chaos", "step=45:kind=preempt",
+        extra_env={"GS_SNAPSHOT_BITS": "8"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(lossy_run / store, d / store)
+    stats = json.loads(stats_path.read_text())
+    assert stats["config"]["snapshot_codec"]["output"] == {
+        "u": 8, "v": 8}
+    assert stats["config"]["snapshot_codec"]["checkpoint"] is None
+    recoveries = [e for e in stats["faults"]
+                  if e["event"] == "recovery"]
+    assert [e["kind"] for e in recoveries] == ["preemption"]
+
+
+def test_drift_rollback_recovers_byte_identical(
+    tmp_path, tmp_path_factory
+):
+    """The ROADMAP-required precision health gate: an injected
+    finite-but-wrong excursion (kind=drift) under
+    GS_DRIFT_POLICY=rollback trips the DriftGate BEFORE the drifted
+    boundary reaches the stores; the supervisor classifies it through
+    the health taxonomy, restarts, and the run finishes byte-identical
+    to an uninterrupted run with the same observability armed."""
+    ref = tmp_path_factory.mktemp("drift_ref")
+    # 30 steps: long enough for probes at 10/20/30 and a recovery,
+    # short enough that no NATURAL statistic transition (v.min lifting
+    # off zero as the pattern diffuses everywhere, a +1.0 drift by
+    # construction) crosses the gate — the injected excursion must be
+    # the only trip.
+    cfg = write_config(
+        ref, noise=0.1, steps=30, output="gs.bp",
+        checkpoint="true", checkpoint_freq=20,
+    )
+    env_obs = {"GS_NUMERICS": "boundary"}
+    res = run_cli(ref, cfg, extra_env=env_obs)
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    # Limit 0.7: above the natural early-transient drift of u.min
+    # (~0.5 at these boundaries) and below the injected x8 corner
+    # excursion (drift = 7/8 on u.max).
+    d, res, stats_path = _supervised(
+        tmp_path, "drift", "step=15:kind=drift",
+        extra_env={**env_obs, "GS_DRIFT_POLICY": "rollback",
+                   "GS_DRIFT_LIMIT": "0.7"},
+        steps=30,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    for store in ("gs.bp", "gs.vtk", "ckpt.bp"):
+        _assert_trees_byte_identical(ref / store, d / store)
+    stats = json.loads(stats_path.read_text())
+    events = stats["faults"]
+    assert {"injected"} <= {e["event"] for e in events}
+    drift = [e for e in events if e["event"] == "drift"]
+    assert drift and drift[0]["policy"] == "rollback"
+    assert drift[0]["tripped"]  # the statistic(s) that tripped
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    assert [e["kind"] for e in recoveries] == ["health"]
+
+
+def test_drift_abort_fails_loudly(tmp_path):
+    """abort means abort: the DriftError is not classified and the
+    supervised run gives up instead of looping."""
+    d, res, stats_path = _supervised(
+        tmp_path, "drift_abort", "step=15:kind=drift",
+        extra_env={"GS_NUMERICS": "boundary",
+                   "GS_DRIFT_POLICY": "abort",
+                   "GS_DRIFT_LIMIT": "0.7"},
+        steps=30,
+    )
+    assert res.returncode != 0
+    assert "drift" in (res.stderr + res.stdout).lower()
+
+
+def test_drift_warn_continues_bf16_posture(tmp_path):
+    """warn records the trip (event carries the acting policy) and the
+    run completes without a restart — exercised AT the bf16_f32acc
+    posture, the configuration the gate exists to guard: the posture's
+    run trips the DriftGate on injected drift."""
+    d, res, stats_path = _supervised(
+        tmp_path, "drift_warn", "step=15:kind=drift",
+        extra_env={"GS_NUMERICS": "boundary",
+                   "GS_DRIFT_POLICY": "warn",
+                   "GS_DRIFT_LIMIT": "0.7",
+                   "GS_COMPUTE_PRECISION": "bf16_f32acc",
+                   "GS_EVENTS": "events.jsonl"},
+        steps=30,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    stats = json.loads(stats_path.read_text())
+    assert not [e for e in stats["faults"]
+                if e["event"] == "recovery"]
+    drift = [
+        json.loads(ln) for ln in
+        (d / "events.jsonl").read_text().splitlines()
+        if '"drift"' in ln and json.loads(ln).get("kind") == "drift"
+    ]
+    assert drift and drift[0]["attrs"]["policy"] == "warn"
+
+
+def test_bf16_posture_through_cli(tmp_path):
+    """The bf16_f32acc posture end to end: bf16 store payloads, f32
+    config precision, posture recorded in RunStats, run green."""
+    d = tmp_path / "bf16"
+    d.mkdir()
+    cfg = write_config(
+        d, noise=0.1, steps=20, output="gs.bp",
+        checkpoint="true", checkpoint_freq=10,
+    )
+    stats = d / "stats.json"
+    res = run_cli(d, cfg, extra_env={
+        "GS_COMPUTE_PRECISION": "bf16_f32acc",
+        "GS_TPU_STATS": str(stats),
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    doc = json.loads(stats.read_text())
+    assert doc["config"]["compute_precision"] == "bf16_f32acc"
+    assert doc["config"]["precision"] == "Float32"
+    r = BpReader(str(d / "gs.bp"))
+    assert r.available_variables()["U"].dtype == np.dtype("bfloat16")
+    u = r.get("U", step=0)
+    assert np.isfinite(u.astype(np.float32)).all()
+    r.close()
+    # resume works at the posture (exact bf16 checkpoint round-trip)
+    cfg2 = write_config(
+        d, noise=0.1, steps=20, output="gs.bp",
+        checkpoint="true", checkpoint_freq=10,
+        restart="true",
+    )
+    res2 = run_cli(d, cfg2, extra_env={
+        "GS_COMPUTE_PRECISION": "bf16_f32acc",
+    })
+    assert res2.returncode == 0, res2.stderr + res2.stdout

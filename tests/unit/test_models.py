@@ -381,8 +381,9 @@ def test_tune_cache_key_separates_models():
     br = cache.cache_key(**base, model="brusselator", n_fields=2)
     ht = cache.cache_key(**base, model="heat", n_fields=1)
     # v3 grew model/n_fields; v4 grew halo_depth (s-step exchange
-    # pin); v5 grew member_shards/procs (the adopted placement).
-    assert gs["schema"] == cache.SCHEMA_VERSION == 5
+    # pin); v5 grew member_shards/procs (the adopted placement); v6
+    # grew compute_precision/snapshot_codec (docs/PRECISION.md).
+    assert gs["schema"] == cache.SCHEMA_VERSION == 6
     assert gs["model"] == "grayscott" and gs["n_fields"] == 2
     digests = {cache.key_digest(k) for k in (gs, br, ht)}
     assert len(digests) == 3  # a Brusselator run can never adopt a

@@ -191,8 +191,18 @@ def test_drift_gate_policies(monkeypatch):
     ev = gate.check(2, {"u.mean": 0.7, "u.l2": -0.6, "v.max": 0.2})
     assert ev["tripped"] == {"u.mean": 0.7, "u.l2": -0.6}
     assert DriftGate("off", 0.5).check(2, {"u.mean": 0.9}) is None
+    # abort/rollback are real policies now (docs/PRECISION.md): the
+    # gate raises DriftError — classified through the health taxonomy.
+    from grayscott_jl_tpu.resilience.health import DriftError
+
+    g_abort = DriftGate("abort", 0.5)
+    ev_a = g_abort.check(3, {"u.mean": 0.9})
+    with pytest.raises(DriftError):
+        g_abort.enforce(3, ev_a)
+    assert not DriftGate("warn", 0.5).raising
+    assert DriftGate("rollback", 0.5).raising
     with pytest.raises(ValueError):
-        DriftGate("abort", 0.5)  # future policies arrive explicitly
+        DriftGate("demote", 0.5)  # unknown policies stay loud
     with pytest.raises(ValueError):
         DriftGate("warn", 0.0)
     monkeypatch.setenv("GS_DRIFT_POLICY", "off")

@@ -1,0 +1,496 @@
+"""Mixed-precision compute posture + lossy snapshot codec
+(docs/PRECISION.md).
+
+Contracts pinned here:
+
+* posture resolution — env wins, bf16 requires Float32, ``equality``
+  refuses the lossy codec loudly;
+* the default/``equality`` paths are BITWISE identical to the
+  pre-posture trajectory for all four registered models;
+* ``bf16_f32acc`` holds fields/stores in bf16 with f32 params and
+  accumulation, stays finite, tracks the f32 trajectory, and is
+  bitwise-reproducible across shardings;
+* quantize -> dequantize round-trips within the DOCUMENTED max-abs
+  error bound, per dtype and bit width;
+* coded stores: uint payloads + range scalars + codec attribute,
+  transparent reader decode, CRC-verified compressed blocks (torn /
+  flipped bytes are never served);
+* tune cache schema v6 key separation + stale-v5 degrade;
+* the precision candidate axis and its icimodel pricing;
+* DriftGate abort/rollback reuse of the HealthGuard taxonomy.
+"""
+
+import dataclasses as dc
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from grayscott_jl_tpu.config.settings import (
+    Settings,
+    SettingsError,
+    resolve_compute_precision,
+)
+from grayscott_jl_tpu.io import codec as io_codec
+from grayscott_jl_tpu.io.bplite import BpReader
+from grayscott_jl_tpu.simulation import Simulation
+
+PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+
+def _settings(**kw):
+    base = dict(L=16, noise=0.1, precision="Float32", backend="CPU",
+                kernel_language="Plain", **PARAMS)
+    base.update(kw)
+    return Settings(**base)
+
+
+# ------------------------------------------------------------ resolvers
+
+
+def test_resolve_compute_precision_defaults_and_env(monkeypatch):
+    assert resolve_compute_precision(_settings()) == "f32"
+    assert resolve_compute_precision(
+        _settings(compute_precision="bf16_f32acc")
+    ) == "bf16_f32acc"
+    monkeypatch.setenv("GS_COMPUTE_PRECISION", "equality")
+    # env wins over the TOML key, mirroring every other knob
+    assert resolve_compute_precision(
+        _settings(compute_precision="bf16_f32acc")
+    ) == "equality"
+    monkeypatch.setenv("GS_COMPUTE_PRECISION", "fp16")
+    with pytest.raises(SettingsError):
+        resolve_compute_precision(_settings())
+
+
+def test_bf16_posture_requires_float32():
+    with pytest.raises(SettingsError):
+        resolve_compute_precision(
+            _settings(precision="Float64",
+                      compute_precision="bf16_f32acc")
+        )
+    with pytest.raises(SettingsError):
+        resolve_compute_precision(
+            _settings(precision="BFloat16",
+                      compute_precision="bf16_f32acc")
+        )
+
+
+def test_equality_refuses_lossy_codec():
+    s = _settings(compute_precision="equality", snapshot_bits="8")
+    with pytest.raises(SettingsError):
+        io_codec.resolve_snapshot_codec(s, ("u", "v"))
+    with pytest.raises(SettingsError):
+        Simulation(s, n_devices=1)
+
+
+def test_parse_bits_spec():
+    assert io_codec.parse_bits_spec("", ("u", "v")) == {}
+    assert io_codec.parse_bits_spec("8", ("u", "v")) == {
+        "u": 8, "v": 8}
+    assert io_codec.parse_bits_spec("u:8,v:12", ("u", "v")) == {
+        "u": 8, "v": 12}
+    assert io_codec.parse_bits_spec("V=6", ("u", "v")) == {"v": 6}
+    with pytest.raises(ValueError):
+        io_codec.parse_bits_spec("w:8", ("u", "v"))  # unknown field
+    with pytest.raises(ValueError):
+        io_codec.parse_bits_spec("1", ("u", "v"))  # below MIN_BITS
+    with pytest.raises(ValueError):
+        io_codec.parse_bits_spec("24", ("u", "v"))  # above MAX_BITS
+
+
+def test_snapshot_bits_ckpt_opt_in(monkeypatch):
+    s = _settings(snapshot_bits="8")
+    cfg = io_codec.resolve_snapshot_codec(s, ("u", "v"))
+    assert cfg.output == {"u": 8, "v": 8} and cfg.ckpt == {}
+    assert cfg.posture() == "u:8,v:8"
+    monkeypatch.setenv("GS_SNAPSHOT_BITS_CKPT", "1")
+    cfg2 = io_codec.resolve_snapshot_codec(s, ("u", "v"))
+    assert cfg2.ckpt == cfg2.output
+    assert cfg2.posture().endswith("+ckpt")
+    assert io_codec.resolve_snapshot_codec(
+        _settings(), ("u", "v")
+    ).posture() == "off"
+
+
+# -------------------------------------------------- trajectory identity
+
+
+@pytest.mark.parametrize(
+    "model", ["grayscott", "brusselator", "fhn", "heat"]
+)
+def test_equality_and_default_bitwise_per_model(model):
+    """The acceptance contract: compute_precision unset and 'equality'
+    produce BITWISE identical trajectories (and both are the pre-PR
+    program — the default path traces no cast at all)."""
+    kw = dict(model=model)
+    if model != "grayscott":
+        kw["dt"] = 0.05
+    a = Simulation(_settings(**kw), n_devices=1)
+    b = Simulation(
+        _settings(compute_precision="equality", **kw), n_devices=1
+    )
+    a.iterate(6)
+    b.iterate(6)
+    for fa, fb in zip(a.get_fields(), b.get_fields()):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_bf16_posture_storage_compute_split():
+    sim = Simulation(
+        _settings(compute_precision="bf16_f32acc"), n_devices=1
+    )
+    assert sim.dtype == jnp.bfloat16
+    assert sim.compute_dtype == jnp.float32
+    assert sim.params.F.dtype == jnp.float32  # f32 accumulation side
+    assert sim.fields[0].dtype == jnp.bfloat16  # bf16 storage side
+    ref = Simulation(_settings(), n_devices=1)
+    sim.iterate(10)
+    ref.iterate(10)
+    for fb, f32 in zip(sim.get_fields(), ref.get_fields()):
+        b = np.asarray(fb).astype(np.float32)
+        assert np.isfinite(b).all()
+        assert np.max(np.abs(b - np.asarray(f32))) < 0.1
+
+
+def test_bf16_posture_sharded_bitwise_vs_single():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    s = _settings(compute_precision="bf16_f32acc")
+    one = Simulation(s, n_devices=1)
+    eight = Simulation(s, n_devices=8)
+    one.iterate(10)
+    eight.iterate(10)
+    for a, b in zip(one.get_fields(), eight.get_fields()):
+        np.testing.assert_array_equal(
+            np.asarray(a).astype(np.float32),
+            np.asarray(b).astype(np.float32),
+        )
+
+
+# ----------------------------------------------------- codec round-trip
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16"])
+@pytest.mark.parametrize("bits", [4, 8, 12, 16])
+def test_quantize_roundtrip_error_bound(dtype, bits):
+    """The DOCUMENTED bound: |decode - exact| <= (hi-lo)/(2^bits-1)/2
+    (+ one storage-dtype ulp), for every payload dtype and width."""
+    rng = np.random.default_rng(bits)
+    base = rng.uniform(-1.3, 2.7, size=(9, 8, 7)).astype(np.float32)
+    field = jnp.asarray(base, jnp.dtype(dtype))
+    q, lo, hi = io_codec.device_quantize(field, bits)
+    assert q.dtype == io_codec.payload_dtype(bits)
+    dec = io_codec.dequantize(
+        np.asarray(q), float(lo), float(hi), bits, dtype
+    )
+    bound = io_codec.error_bound(float(lo), float(hi), bits, dtype)
+    err = np.max(np.abs(
+        dec.astype(np.float64)
+        - np.asarray(field).astype(np.float64)
+    ))
+    assert err <= bound * (1 + 1e-6), (err, bound)
+
+
+def test_quantize_constant_field_is_exact():
+    field = jnp.full((4, 4, 4), 0.25, jnp.float32)
+    q, lo, hi = io_codec.device_quantize(field, 8)
+    dec = io_codec.dequantize(
+        np.asarray(q), float(lo), float(hi), 8, "float32"
+    )
+    np.testing.assert_array_equal(dec, np.asarray(field))
+
+
+def test_snapshot_encode_shapes_and_exact_flag():
+    sim = Simulation(_settings(), n_devices=1)
+    sim.iterate(2)
+    snap = sim.snapshot_async(encode={0: 8, 1: 12}, exact=False)
+    blocks = snap.blocks()
+    assert list(blocks) == []  # no exact copies captured
+    enc = blocks.encoded
+    assert len(enc) == 1
+    offsets, sizes, eu, ev = enc[0]
+    assert isinstance(eu, io_codec.EncodedField)
+    assert eu.q.dtype == np.uint8 and ev.q.dtype == np.uint16
+    # decode within bound of the live fields
+    u = np.asarray(sim.fields[0])
+    assert np.max(np.abs(eu.decode() - u)) <= eu.error_bound() * (
+        1 + 1e-6
+    )
+    both = sim.snapshot_async(encode={0: 8}, exact=True).blocks()
+    assert len(both) == 1 and both.encoded is not None
+    with pytest.raises(ValueError):
+        sim.snapshot_async(exact=False)
+
+
+# ------------------------------------------------------- coded stores
+
+
+def _coded_store(tmp_path, bits="8", steps=3):
+    """A small coded output store written through the REAL pipeline
+    (SimStream + snapshot_async), returning (store_path, exact_fields
+    per step)."""
+    from grayscott_jl_tpu.io.stream import SimStream
+
+    s = _settings(
+        output=str(tmp_path / "gs.bp"), mesh_type="none",
+        snapshot_bits=bits,
+    )
+    sim = Simulation(s, n_devices=1)
+    codec = io_codec.resolve_snapshot_codec(s, sim.model.field_names)
+    stream = SimStream(
+        s, sim.domain, sim.dtype, codec=codec.output,
+    )
+    spec = {i: codec.output[n.lower()]
+            for i, n in enumerate(sim.model.field_names)}
+    exact = []
+    for step in range(steps):
+        sim.iterate(1)
+        snap = sim.snapshot_async(encode=spec, exact=False)
+        stream.write_step(sim.step, snap.blocks())
+        exact.append(tuple(np.asarray(f) for f in sim.fields))
+    stream.close()
+    return s.output, exact
+
+
+def test_coded_store_roundtrip_within_bound(tmp_path):
+    path, exact = _coded_store(tmp_path)
+    r = BpReader(path)
+    assert r.num_steps() == 3
+    info = r.available_variables()
+    assert info["U"].dtype == np.uint8
+    assert info["U__qlo"].dtype == np.float32
+    attr = json.loads(r.attributes()[io_codec.CODEC_ATTR])
+    assert attr["U"] == {"bits": 8, "dtype": "float32"}
+    for step, (u, v) in enumerate(exact):
+        for name, ex in (("U", u), ("V", v)):
+            dec = r.get(name, step=step)
+            assert dec.dtype == np.float32  # transparent decode
+            lo = float(r._get(io_codec.qlo_var(name), step=step))
+            hi = float(r._get(io_codec.qhi_var(name), step=step))
+            bound = io_codec.error_bound(lo, hi, 8, "float32")
+            assert np.max(np.abs(dec - ex)) <= bound * (1 + 1e-6)
+    # subselection decodes too (the pdfcalc path)
+    sel = r.get("U", step=0, start=(2, 3, 4), count=(5, 6, 7))
+    np.testing.assert_array_equal(
+        sel, r.get("U", step=0)[2:7, 3:9, 4:11]
+    )
+    r.close()
+
+
+def test_compressed_payload_bitflip_never_served(tmp_path):
+    """Torn-write/bitflip fuzz on COMPRESSED blocks: a flipped payload
+    byte in a coded store raises CorruptionError under verify-on-read
+    — the reader never serves a silently-different decode."""
+    from grayscott_jl_tpu.resilience.integrity import CorruptionError
+
+    path, _ = _coded_store(tmp_path)
+    data = os.path.join(path, "data.0")
+    payload = open(data, "rb").read()
+    baseline = {
+        (name, step): BpReader(path).get(name, step=step)
+        for name in ("U", "V") for step in range(3)
+    }
+    md = json.load(open(os.path.join(path, "md.json")))
+    # flip one byte inside every field block of every step
+    for step_blocks in md["steps"]:
+        for name in ("U", "V"):
+            b = step_blocks[name][0]
+            off = int(b["offset"]) + 7
+            corrupted = bytearray(payload)
+            corrupted[off] ^= 0x40
+            with open(data, "wb") as f:
+                f.write(bytes(corrupted))
+            r = BpReader(path)
+            served_wrong = False
+            for (n2, s2), ref in baseline.items():
+                try:
+                    got = r.get(n2, step=s2)
+                except CorruptionError:
+                    continue  # refused: correct
+                if not np.array_equal(got, ref):
+                    served_wrong = True
+            assert not served_wrong, (name, step)
+            r.close()
+    with open(data, "wb") as f:
+        f.write(payload)
+
+
+def test_compressed_store_torn_tail_hides_step(tmp_path):
+    """Truncating the payload at every byte of the LAST coded record
+    hides that step (durability cap) — never an exception, never a
+    partial decode."""
+    path, _ = _coded_store(tmp_path)
+    data = os.path.join(path, "data.0")
+    payload = open(data, "rb").read()
+    md = json.load(open(os.path.join(path, "md.json")))
+    last = md["steps"][-1]
+    tail_start = min(
+        int(b["offset"]) for blocks in last.values() for b in blocks
+    )
+    for cut in range(tail_start, len(payload), 257):
+        with open(data, "wb") as f:
+            f.write(payload[:cut])
+        r = BpReader(path)
+        assert r.num_steps() == 2  # the torn step is invisible
+        r.get("U", step=1)  # durable steps still decode
+        r.close()
+    with open(data, "wb") as f:
+        f.write(payload)
+
+
+# ------------------------------------------------------- tune cache v6
+
+
+def test_cache_v6_key_separates_postures(tmp_path):
+    from grayscott_jl_tpu.tune import cache
+
+    base = dict(device_kind="cpu", platform="cpu", dims=(2, 2, 2),
+                L=32, dtype="float32", noise=0.1, jax_version="j")
+    k0 = cache.cache_key(**base)
+    assert k0["schema"] == cache.SCHEMA_VERSION == 6
+    assert k0["compute_precision"] == "f32"
+    assert k0["snapshot_codec"] == "off"
+    variants = [
+        cache.cache_key(**base, compute_precision="bf16_f32acc"),
+        cache.cache_key(**base, snapshot_codec="u:8,v:8"),
+        cache.cache_key(**base, compute_precision="bf16_f32acc",
+                        snapshot_codec="u:8,v:8+ckpt"),
+    ]
+    digests = {cache.key_digest(k) for k in [k0] + variants}
+    assert len(digests) == 4  # a bf16-measured winner can never be
+    #                           adopted by an f32 run (and vice versa)
+
+
+def test_stale_v5_record_is_a_warned_miss(tmp_path, capsys):
+    from grayscott_jl_tpu.tune import cache
+
+    key = cache.cache_key(
+        device_kind="cpu", platform="cpu", dims=(1, 1, 1), L=16,
+        dtype="float32", noise=0.0, jax_version="j",
+    )
+    # forge a v5-shaped record (no posture fields) at the v6 path
+    v5_key = {k: v for k, v in key.items()
+              if k not in ("compute_precision", "snapshot_codec")}
+    v5_key["schema"] = 5
+    path = cache.entry_path(key, str(tmp_path))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"schema": 5, "key": v5_key,
+                   "winner": {"kernel": "xla", "fuse": 2,
+                              "comm_overlap": False}}, f)
+    assert cache.load(key, str(tmp_path)) is None
+    assert "stale or malformed" in capsys.readouterr().err
+
+
+# ------------------------------------------- candidate axis + pricing
+
+
+def test_precision_candidate_axis():
+    from grayscott_jl_tpu.tune import candidates
+
+    kw = dict(
+        dims=(2, 2, 2), L=32, platform="cpu", itemsize=4, fuse_cap=2,
+        analytic_kernel="xla", analytic_fuse=2, comm_overlap=True,
+        overlap_toggle=False, top_n=64,
+    )
+    f32 = candidates.generate(**kw, compute_precision="f32")
+    assert all(c.compute_precision == "f32" for c in f32)
+    eq = candidates.generate(**kw, compute_precision="equality")
+    assert all(c.compute_precision == "f32" for c in eq)
+    bf = candidates.generate(**kw, compute_precision="bf16_f32acc")
+    kinds = {c.compute_precision for c in bf}
+    assert kinds == {"f32", "bf16_f32acc"}
+    # the analytic default under the posture IS the posture
+    analytic = [c for c in bf if c.analytic]
+    assert analytic and analytic[0].compute_precision == "bf16_f32acc"
+    assert "bf16" in analytic[0].label()
+    # round-trip through the cache record form
+    again = candidates.from_dict(analytic[0].as_dict())
+    assert again.compute_precision == "bf16_f32acc"
+
+
+def test_icimodel_prices_bf16_halo_bytes_halved():
+    from grayscott_jl_tpu.parallel import icimodel
+
+    row32 = icimodel.project(16, 2, 1000.0, itemsize=4)
+    row16 = icimodel.project(16, 2, 1000.0, itemsize=2)
+    assert row16["halo_bytes_per_step"] * 2 == \
+        row32["halo_bytes_per_step"]
+    us32 = icimodel.projected_step_us(
+        "xla", (2, 2, 2), 32, 2, itemsize=4, overlap=0.0,
+    )
+    us16 = icimodel.projected_step_us(
+        "xla", (2, 2, 2), 32, 2, itemsize=2, overlap=0.0,
+        compute_precision="bf16_f32acc",
+    )
+    # cheaper anchor (BF16_COMPUTE_RATIO) + halved bytes => faster
+    assert us16 < us32
+    assert icimodel.precision_compute_ratio("bf16_f32acc") == \
+        icimodel.BF16_COMPUTE_RATIO < 1.0
+    assert icimodel.precision_compute_ratio("f32") == 1.0
+
+
+def test_pinned_settings_carry_candidate_precision():
+    from grayscott_jl_tpu.tune import measure
+    from grayscott_jl_tpu.tune.candidates import Candidate
+
+    cand = Candidate(kernel="xla", fuse=2, comm_overlap=False,
+                     compute_precision="bf16_f32acc")
+    pinned = measure.pinned_settings(
+        _settings(compute_precision="bf16_f32acc"), cand
+    )
+    assert pinned.compute_precision == "bf16_f32acc"
+    cand32 = Candidate(kernel="xla", fuse=2, comm_overlap=False)
+    assert measure.pinned_settings(
+        _settings(), cand32
+    ).compute_precision == "f32"
+
+
+# --------------------------------------------------- drift gate reuse
+
+
+def test_drift_error_classification():
+    from grayscott_jl_tpu.resilience.health import DriftError, HealthError
+    from grayscott_jl_tpu.resilience.supervisor import classify_failure
+
+    ev = {"tripped": {"u.l2": 0.9}, "limit": 0.5}
+    rollback = DriftError(40, dict(ev, policy="rollback"), "rollback")
+    assert isinstance(rollback, HealthError)
+    assert classify_failure(rollback) == "health"
+    assert classify_failure(
+        DriftError(40, dict(ev, policy="abort"), "abort")
+    ) is None  # abort means abort — no restart loop
+
+
+def test_poison_drift_is_finite_but_drifting():
+    sim = Simulation(_settings(), n_devices=1)
+    sim.iterate(2)
+    before = np.asarray(sim.fields[0])
+    sim.poison_drift("u", factor=64.0)
+    after = np.asarray(sim.fields[0])
+    assert np.isfinite(after).all()  # health guard stays green
+    np.testing.assert_allclose(
+        after[:2, :2, :2], before[:2, :2, :2] * 64.0, rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        after[2:, 2:, 2:], before[2:, 2:, 2:]
+    )
+    # the max statistic drifts hard; the trajectory survives (the
+    # corner is outside the reaction seed — v is zero there)
+    sim.iterate(10)
+    assert np.isfinite(np.asarray(sim.fields[0])).all()
+
+
+def test_drift_fault_kind_registered():
+    from grayscott_jl_tpu.resilience.faults import FAULT_KINDS, FaultPlan
+
+    assert "drift" in FAULT_KINDS
+    plan = FaultPlan.parse("step=10:kind=drift")
+    assert plan.pending("drift")
