@@ -202,18 +202,6 @@ def _measure_subprocess(platform: str, kernel: str):
     return None, reason, timed_out
 
 
-def model_kernel(kernel: str, model: str) -> str:
-    """The kernel a model can actually measure: the hand-fused Pallas
-    kernel implements Gray-Scott only (Model.pallas_capable), so other
-    models remap to the XLA path at DISPATCH — the result row then
-    truthfully says kernel=Plain instead of silently falling back."""
-    if model != "grayscott" and kernel == "Pallas":
-        print(f"bench: model {model!r} is not Pallas-capable; "
-              "measuring the XLA kernel", file=sys.stderr)
-        return "Plain"
-    return kernel
-
-
 def cpu_kernel(kernel: str) -> str:
     """The kernel to measure on a CPU fallback: off-TPU the Pallas path
     is the TPU-semantics interpreter — a correctness tool ~1000x off
@@ -600,6 +588,8 @@ if __name__ == "__main__":
         worker(sys.argv[2], sys.argv[3],
                sys.argv[4] if len(sys.argv) > 4 else MODEL)
     else:
-        KERNEL = model_kernel(KERNEL, MODEL)
+        # Every registered model measures the requested kernel as-is:
+        # the generator (ops/kernelgen) builds the fused Pallas kernel
+        # from the model declaration, so there is no per-model remap.
         main()
     sys.exit(0)

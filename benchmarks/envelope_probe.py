@@ -66,8 +66,11 @@ def main() -> int:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from grayscott_jl_tpu.models.grayscott import Params
+    from grayscott_jl_tpu.models.grayscott import MODEL, Params
+    from grayscott_jl_tpu.ops import kernelgen
     from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    gs_spec = kernelgen.get_spec(MODEL)
 
     L, bx, fuse = args.l, args.bx, args.fuse
     nblocks = L // bx
@@ -433,7 +436,8 @@ def main() -> int:
             uu, vv = uv
             seeds = jnp.asarray([1, 2, 0], jnp.int32).at[2].set(i * fuse)
             return ps.fused_step(
-                uu, vv, params, seeds, use_noise=use_noise, fuse=fuse,
+                (uu, vv), params, seeds, spec=gs_spec,
+                use_noise=use_noise, fuse=fuse,
             )
 
         return lax.fori_loop(0, n_passes, body, (u, v))

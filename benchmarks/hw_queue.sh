@@ -17,13 +17,13 @@ cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
 STAMP=$(date +%F_%H%M)
 
-# Stages 1-3 do not self-bound, so they get an outer timeout with the
+# Stages 1-4 do not self-bound, so they get an outer timeout with the
 # sanctioned SIGTERM-grace-SIGKILL contract (-k after 30s, matching
-# tunnel_watch.sh). Stage 4 (bench.py) bounds every backend touch
+# tunnel_watch.sh). Stage 5 (bench.py) bounds every backend touch
 # itself and always exits 0 — an OUTER kill there would be the exact
 # mid-run client death the wedge postmortem forbids, so it runs bare.
 
-echo "== 1/5 hardware test suite (xy-chain Mosaic lowering FIRST) =="
+echo "== 1/6 hardware test suite (xy-chain Mosaic lowering FIRST) =="
 # The xy-chain Mosaic lowering test settles compile-or-not for the
 # kernel every (n, m, 1) pod mesh launches — on a minutes-long grant
 # window that answer must land before anything else can time out the
@@ -40,7 +40,7 @@ GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
     2>&1 \
     | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
 
-echo "== 2/5 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
+echo "== 2/6 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
 # k=6 re-measured alongside (the deep-chain lever, BASELINE r4 queue);
 # k=8 is excluded — it fails Mosaic compile (BASELINE.md Mosaic gates).
 timeout -k 30 1800 python benchmarks/ab_probe.py \
@@ -54,19 +54,31 @@ timeout -k 30 1800 python benchmarks/ab_probe.py \
         >/dev/null \
     && echo "model updated + sweep re-run (remember: commit the diff)"
 
-echo "== 3/5 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
+echo "== 3/6 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=5 --case fuse=5,midbf16=1 \
     --case fuse=4 --case fuse=4,midbf16=1 \
     --rounds 6 --out "benchmarks/results/ab_r5_midbf16_${STAMP}.jsonl"
 
-echo "== 4/5 headline sample (self-bounding bench, no outer kill) =="
+echo "== 4/6 per-model Pallas vs XLA A/B (generated kernels, all models) =="
+# First hardware numbers for the generator era (docs/KERNELGEN.md):
+# every registered model times its generated Pallas kernel against the
+# XLA path round-robin, rows land in the artifacts.py schema, and the
+# regression gate judges them against the committed per-(model,kernel)
+# history — first runs just seed that history (gate skips, exit 0).
+timeout -k 30 1800 python benchmarks/model_ab.py \
+    --rounds 6 --out "benchmarks/results/model_ab_tpu_${STAMP}.jsonl" \
+    && python benchmarks/regression_gate.py \
+        --fresh "benchmarks/results/model_ab_tpu_${STAMP}.jsonl" \
+    && echo "per-model A/B gated clean (commit the artifact)"
+
+echo "== 5/6 headline sample (self-bounding bench, no outer kill) =="
 GS_BENCH_TPU_HORIZON=0 python bench.py \
     >"benchmarks/results/bench_r5_sample_${STAMP}.json" \
     2>"benchmarks/results/bench_r5_sample_${STAMP}.err"
 tail -c 400 "benchmarks/results/bench_r5_sample_${STAMP}.json"; echo
 
-echo "== 5/5 launching the long-horizon headline hunter =="
+echo "== 6/6 launching the long-horizon headline hunter =="
 if ! hunter_running hw_queue; then
     launch_hunter
     echo "hunter launched"

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Per-model Pallas vs XLA A/B rows on one chip.
+
+Every registered model gets its fused Pallas kernel from the generator
+(``ops/kernelgen``), so the question "does the generated kernel beat
+the XLA path for THIS model" is now answerable for all of them — this
+harness measures it. For each model it times the generated Pallas
+kernel against the Plain/XLA kernel ROUND-ROBIN in one process (the
+``ab_probe.py`` clock-state discipline: the tunnel chip's clock
+throttles on a minutes timescale, so paired configs must be visited
+within seconds of each other), and appends one artifact row per
+(model, kernel) in the shared ``artifacts.py`` schema.
+
+    python benchmarks/model_ab.py --out benchmarks/results/...jsonl
+
+Rows carry ``"ab": "model_kernel"`` plus the schedule-determining
+fields ``model`` / ``kernel`` / ``L``, so ``regression_gate.py``
+groups committed history per (model, kernel) pair and flags a fresh
+median that regressed beyond the history's noise — the hw_queue stage
+pipes the fresh artifact straight into the gate. Pallas rows also
+record the generated-kernel provenance (``generated`` +
+``generator_version``, docs/KERNELGEN.md) so the history can tell
+generator eras apart.
+
+A model whose reaction the generator refuses (``kernelgen.
+generation_gate_reason``) gets a LOUD skip row (``skipped`` + the
+reason, no timing fields — the gate ignores it) instead of a silent
+Plain remap: the refusal is part of the measurement record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="per-model Pallas vs XLA A/B rows (one chip)"
+    )
+    ap.add_argument("--l", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--models", nargs="*", default=None,
+                    help="registered model names (default: all)")
+    ap.add_argument("--out", default=None,
+                    help="JSONL artifact path (default: the "
+                    "artifacts.py naming convention)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="CPU fallback: interpret-mode Pallas is a "
+                    "correctness tool ~1000x off, so use a small --l")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from grayscott_jl_tpu.models import available_models, get_model
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.obs.metrics import quantile
+    from grayscott_jl_tpu.ops import kernelgen
+    from grayscott_jl_tpu.simulation import Simulation
+
+    platform = jax.devices()[0].platform
+    backend = {"tpu": "TPU", "cpu": "CPU", "gpu": "CUDA"}[platform]
+    out_path = args.out or artifacts.default_out("model_ab", platform)
+    names = args.models or available_models()
+
+    def sync(sim) -> float:
+        # Dependent scalar readback: block_until_ready is unreliable
+        # through the axon tunnel (utils/benchmark.time_sim_rounds).
+        return float(jnp.sum(sim.u[:1, :1, :4]))
+
+    jobs = []  # (row-stub, sim) pairs, warmed, round-robin timed below
+    for name in names:
+        model = get_model(name)
+        gate = kernelgen.generation_gate_reason(model)
+        for kernel in ("Pallas", "Plain"):
+            stub = {
+                "ab": "model_kernel", "t": artifacts.utc_stamp(),
+                "model": name, "kernel": kernel, "L": args.l,
+                "steps": args.steps, "platform": platform,
+            }
+            if kernel == "Pallas":
+                if gate is not None:
+                    # Feasibility refusal: record it, never remap.
+                    stub.update(skipped=True, reason=gate)
+                    artifacts.append_row(out_path, stub)
+                    print(f"model_ab: SKIP {name}/Pallas — {gate}",
+                          file=sys.stderr, flush=True)
+                    continue
+                stub.update(
+                    generated=True,
+                    generator_version=kernelgen.GENERATOR_VERSION,
+                )
+            settings = Settings(
+                L=args.l, Du=0.2, Dv=0.1, F=0.02, k=0.048,
+                noise=0.1, precision="Float32",
+                dt=1.0 if name == "grayscott" else 0.05,
+                backend=backend, kernel_language=kernel,
+            )
+            settings.model = name
+            sim = Simulation(settings, n_devices=1)
+            t0 = time.perf_counter()
+            sim.iterate(args.steps)
+            sync(sim)
+            print(f"model_ab: warmed {name}/{kernel} in "
+                  f"{time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            jobs.append((stub, sim))
+
+    rounds = [[] for _ in jobs]
+    for _ in range(args.rounds):
+        for i, (_stub, sim) in enumerate(jobs):
+            t0 = time.perf_counter()
+            sim.iterate(args.steps)
+            sync(sim)
+            rounds[i].append(
+                (time.perf_counter() - t0) / args.steps * 1e6
+            )
+
+    for (stub, _sim), rs in zip(jobs, rounds):
+        row = {
+            **stub,
+            "rounds_us_per_step": [round(x, 1) for x in rs],
+            "best_us_per_step": round(min(rs), 1),
+            "median_us_per_step": round(statistics.median(rs), 1),
+            "p50_us_per_step": round(quantile(rs, 50), 1),
+            "p95_us_per_step": round(quantile(rs, 95), 1),
+            "p99_us_per_step": round(quantile(rs, 99), 1),
+            "best_cell_updates_per_s": round(
+                args.l ** 3 / (min(rs) * 1e-6), 1
+            ),
+        }
+        artifacts.append_row(out_path, row)
+        print(json.dumps(row), flush=True)
+
+    print(f"\n| model | kernel | best µs/step | median | p99 |",
+          file=sys.stderr)
+    print("|---|---|---|---|---|", file=sys.stderr)
+    for (stub, _sim), rs in zip(jobs, rounds):
+        print(
+            f"| {stub['model']} | {stub['kernel']} | {min(rs):.1f} | "
+            f"{statistics.median(rs):.1f} | {quantile(rs, 99):.1f} |",
+            file=sys.stderr,
+        )
+    print(f"model_ab: rows appended to {out_path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,10 +4,11 @@ Three checks, all structural replacements for what used to be grep:
 
 * **model isolation** — ``ops/`` and ``parallel/`` are model-generic
   execution machinery: they must not import concrete ``models/*``
-  modules (``models.base``, the declaration protocol, is allowed).
-  One sanctioned exception, mirrored from the models-as-data test:
-  ``ops/pallas_stencil.py`` may import ``models.grayscott`` — it IS
-  the Gray-Scott model's hand-fused form — but never redefine it.
+  modules, nor the bare ``models`` package whose import registers
+  them (``models.base``, the declaration protocol, is allowed). No
+  exceptions: since the kernel generator (``ops/kernelgen``) builds
+  the fused Pallas kernel from any model's declaration, the former
+  ``pallas_stencil`` -> ``models.grayscott`` sanction is gone.
 * **JAX-free at import** — the modules the docs promise are importable
   without JAX (``obs/*``, ``models/*``, ``config/*``, ``lint/*``,
   ``reshard/plan``, ``parallel/domain``) must keep every import-time
@@ -34,13 +35,6 @@ PASS_ID = "layering"
 
 #: Layered subpackages that must stay model-generic.
 SHARED_SUBPACKAGES = ("grayscott_jl_tpu.ops", "grayscott_jl_tpu.parallel")
-
-#: (importing module, imported module) pairs sanctioned by the
-#: models-as-data contract (see ``tests/unit/test_models.py``).
-SANCTIONED_MODEL_IMPORTS = {
-    ("grayscott_jl_tpu.ops.pallas_stencil",
-     "grayscott_jl_tpu.models.grayscott"),
-}
 
 #: Modules promised importable without JAX (docs/ANALYSIS.md).
 JAXFREE_PREFIXES = (
@@ -136,11 +130,14 @@ def _check_model_isolation(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     for node, names in _all_imports(sf):
         for name in names:
-            if not name.startswith("grayscott_jl_tpu.models."):
+            # The bare package import is as concrete as a module
+            # import: ``import grayscott_jl_tpu.models`` registers
+            # every built-in model as a side effect.
+            if name != "grayscott_jl_tpu.models" and not name.startswith(
+                "grayscott_jl_tpu.models."
+            ):
                 continue
             if name == "grayscott_jl_tpu.models.base":
-                continue
-            if (sf.module, name) in SANCTIONED_MODEL_IMPORTS:
                 continue
             findings.append(Finding(
                 PASS_ID, sf.rel, node.lineno,
@@ -185,7 +182,6 @@ def _check_jaxfree(sf: SourceFile) -> List[Finding]:
 def _check_literals(sf: SourceFile) -> List[Finding]:
     findings: List[Finding] = []
     in_parallel = sf.module.startswith("grayscott_jl_tpu.parallel.")
-    sanctioned = sf.module == "grayscott_jl_tpu.ops.pallas_stencil"
     for i, line in enumerate(sf.lines, start=1):
         if _BANNED_TOKENS.search(line):
             findings.append(Finding(
@@ -207,8 +203,7 @@ def _check_literals(sf: SourceFile) -> List[Finding]:
                 "parallel/ must receive boundaries via the model "
                 "declaration, not name them",
             ))
-        elif (not in_parallel and not sanctioned
-              and _UNQUALIFIED_BOUNDARY.search(line)):
+        elif not in_parallel and _UNQUALIFIED_BOUNDARY.search(line):
             findings.append(Finding(
                 PASS_ID, sf.rel, i,
                 "boundary constants must come from the model "

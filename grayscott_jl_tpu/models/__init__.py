@@ -5,7 +5,7 @@ declaration, pure reaction function, init function) — see
 ``models/base.py`` for the protocol and ``docs/MODELS.md`` for how to
 add one. Importing this package registers the built-in models:
 
-* ``grayscott``   — the flagship (reference parity, Pallas-capable)
+* ``grayscott``   — the flagship (reference parity)
 * ``brusselator`` — trimolecular autocatalysis
 * ``fhn``         — FitzHugh–Nagumo excitable media
 * ``heat``        — plain one-field diffusion

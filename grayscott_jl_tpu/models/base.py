@@ -21,10 +21,14 @@ overlap, temporal blocking, autotune, resilience, ensembles, I/O —
 consumes only this declaration and is shared by every model with zero
 per-model parallelism code (the separation argued by the stencil-DSL
 shared-compilation-stack line of work; PAPERS.md). Gray-Scott
-(``models/grayscott.py``) is the flagship registered instance; the
-hand-fused Pallas TPU kernel is currently implemented for it alone,
-which the :attr:`Model.pallas_capable` flag gates explicitly (other
-models take the XLA path, recorded in ``kernel_selection`` provenance).
+(``models/grayscott.py``) is the flagship registered instance. The
+fused Pallas TPU kernel is GENERATED from the declaration too
+(``ops/kernelgen`` trace-inlines the pure reaction into the slab
+pipeline): eligibility is a feasibility property of the reaction's
+jaxpr — elementwise ops only — checked by
+``kernelgen.generation_gate_reason`` and recorded as the
+``kernel_gate`` provenance in ``kernel_selection``, not a per-model
+capability flag.
 
 Adding a model is ~40 lines: declare fields/params/reaction/init, call
 :func:`register`. See ``docs/MODELS.md`` for the walkthrough.
@@ -75,7 +79,6 @@ class Model:
         param_decls: Mapping[str, Optional[float]],
         reaction: Callable,
         init: Callable,
-        pallas_capable: bool = False,
         params_cls: Optional[type] = None,
         legacy_keys: Optional[Mapping[str, str]] = None,
         description: str = "",
@@ -100,7 +103,6 @@ class Model:
         self.param_defaults: Dict[str, Optional[float]] = dict(param_decls)
         self.reaction = reaction
         self.init = init
-        self.pallas_capable = bool(pallas_capable)
         self.legacy_keys = dict(legacy_keys or {})
         self.description = description
         #: The typed Params pytree class: model params in declaration
@@ -195,7 +197,6 @@ class Model:
             "fields": list(self.field_names),
             "boundaries": list(self.boundaries),
             "params": list(self.param_names),
-            "pallas_capable": self.pallas_capable,
         }
 
 

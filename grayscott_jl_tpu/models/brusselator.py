@@ -6,8 +6,8 @@
 A registered :class:`~.base.Model`: the declaration below is ALL the
 Brusselator-specific code in the framework — halo exchange, split-phase
 overlap, temporal blocking, autotune, resilience, ensembles, and I/O
-come from the shared stack unchanged (XLA kernel path; the Pallas
-kernel is Gray-Scott-gated).
+come from the shared stack unchanged, and the fused Pallas TPU kernel
+is generated from the reaction below (``ops/kernelgen``).
 
 Boundary/background state is the homogeneous steady state of the
 default parameters, ``(u, v) = (A, B/A) = (1, 3)``: the frozen ghost

@@ -4,8 +4,9 @@
     w_t = Dw * lap(w) + eps * (v + a - b*w)
 
 A registered :class:`~.base.Model`: the declaration below is ALL the
-FitzHugh–Nagumo-specific code in the framework (XLA kernel path; the
-Pallas kernel is Gray-Scott-gated). The activator ``v`` is seeded
+FitzHugh–Nagumo-specific code in the framework — including the fused
+Pallas TPU kernel, which ``ops/kernelgen`` generates by trace-inlining
+the reaction below. The activator ``v`` is seeded
 super-threshold in the center cube over a quiescent background, so a
 single excitation wave propagates outward — the classic excitable-media
 scenario.

@@ -13,14 +13,16 @@ boundary constants, parameter declaration, reaction, and init below are
 *declaration*, consumed by the shared execution machinery
 (``ops/stencil.py`` n-field update, ``parallel/`` halo exchange and
 temporal blocking, ``simulation.py``) exactly like every other
-registered model's. Two things are Gray-Scott-privileged:
-
-* the hand-fused Pallas TPU kernel (``ops/pallas_stencil.py``)
-  implements this reaction only (``pallas_capable=True``; other models
-  take the XLA path, gated explicitly in ``kernel_selection``);
-* the reference-parity flat TOML keys (``F``/``k``/``Du``/``Dv``)
-  remain valid param spellings via ``legacy_keys`` — reference configs
-  run unmodified, while the ``[model]`` table works too.
+registered model's. The fused Pallas TPU kernel is generated from this
+declaration like any other model's (``ops/kernelgen`` trace-inlines the
+reaction into ``ops/pallas_stencil``'s slab pipeline) — Gray-Scott is
+the generator's flagship instance, whose generated kernel is asserted
+bitwise-identical to the hand-written kernel it replaced
+(``tests/golden/pallas_hand_kernel.npz``). One thing remains
+Gray-Scott-privileged: the reference-parity flat TOML keys
+(``F``/``k``/``Du``/``Dv``) stay valid param spellings via
+``legacy_keys`` — reference configs run unmodified, while the
+``[model]`` table works too.
 
 Design differences from the reference (idiomatic JAX):
 
@@ -177,7 +179,6 @@ MODEL = base.register(base.Model(
     param_decls={"Du": 0.05, "Dv": 0.1, "F": 0.04, "k": 0.0},
     reaction=reaction,
     init=init_fields,
-    pallas_capable=True,
     params_cls=Params,
     legacy_keys={"Du": "Du", "Dv": "Dv", "F": "F", "k": "k"},
     description="Gray-Scott cubic autocatalysis (reference parity)",

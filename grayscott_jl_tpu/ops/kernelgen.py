@@ -1,0 +1,209 @@
+"""Mosaic kernel generator: fused Pallas kernels from Model declarations.
+
+Any registered model (``models/base.Model``) whose pure ``reaction``
+traces to elementwise JAX — purity is machine-checked by gslint's
+``purity`` pass, elementwise-ness is checked here — gets the fused
+stencil+reaction+noise Pallas TPU kernel (``ops/pallas_stencil``): the
+n-field VMEM-resident slab pipeline, the in-kernel temporal chain,
+per-field frozen-ghost boundary constants, and f32 accumulation under
+the bf16 posture. There is no source codegen: the model's ``reaction``
+is *trace-inlined* into the kernel body — calling it on the in-kernel
+window values emits its arithmetic directly into the Mosaic program,
+the same mechanism by which the XLA path (``stencil.reaction_update``)
+stays model-generic. The kernel-from-declaration approach follows the
+stencil-DSL lowering literature (arxiv 2309.04671, 2404.02218): the
+declaration carries exactly the four things the generator needs —
+field count, boundary constants, parameter declarations, and the pure
+update form.
+
+Feasibility is a *property of the reaction's jaxpr*, not of the model's
+name: :func:`generation_gate_reason` traces the reaction once over
+dummy block-shaped operands and refuses (with a reason string that
+rides into ``kernel_selection`` provenance as the ``kernel_gate``
+record) when the trace fails, the output arity/shape is wrong, or the
+jaxpr contains a non-elementwise primitive (a reduction, a gather, a
+convolution — anything whose value at a cell depends on other cells
+would silently change meaning inside the slab pipeline, where the
+reaction only ever sees a local window). Everything else — VMEM slab
+fit, Mosaic lane alignment, f64 — stays a *shape* gate in
+``pallas_stencil`` / ``icimodel``, orthogonal to the model.
+
+:class:`KernelSpec` is the generator's contract with the kernel: a
+frozen, identity-hashed view of the declaration that rides through
+``jax.jit`` as a static argument. Specs are memoized per model object
+(:func:`get_spec`) so repeated dispatches reuse the jit cache.
+
+Equality fine print (docs/KERNELGEN.md): the generated kernel inlines
+the reaction with the SAME operand association as the XLA path — noise
+is passed pre-scaled into ``reaction`` exactly like
+``stencil.reaction_update`` does — so for Gray-Scott the generated
+program is operation-for-operation the hand-written kernel it replaced,
+and the trajectory is bitwise-identical (asserted against
+``tests/golden/pallas_hand_kernel.npz``, captured from the last
+hand-written build).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+#: Version of the generated-kernel contract. Bump when the generated
+#: program changes in any observable way (operation order, noise
+#: association, mid-stage rounding): the tune cache keys on it (schema
+#: v7 ``kernel_generator``) so winners measured against one generator's
+#: kernels are never adopted by another's, and ``kernel_selection``
+#: provenance records it so artifacts can tell generated-kernel eras
+#: apart.
+GENERATOR_VERSION = 1
+
+#: Primitives the generator accepts in a reaction jaxpr: elementwise
+#: arithmetic (plus the broadcasts/casts jnp scalar-mixing inserts).
+#: Anything outside this set couples cells and cannot be inlined into
+#: the slab pipeline, where the reaction sees one local window at a
+#: time. Conservative by design — extend it only with ops that are
+#: provably per-cell.
+_ELEMENTWISE_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "rem", "neg", "sign", "abs",
+    "max", "min", "pow", "integer_pow", "sqrt", "rsqrt", "cbrt",
+    "exp", "exp2", "expm1", "log", "log1p", "logistic", "tanh",
+    "sin", "cos", "tan", "sinh", "cosh", "erf", "erfc", "square",
+    "floor", "ceil", "round", "clamp", "is_finite", "nextafter",
+    "eq", "ne", "ge", "gt", "le", "lt", "and", "or", "not", "xor",
+    "select_n", "convert_element_type", "broadcast_in_dim", "copy",
+    "stop_gradient", "reshape", "squeeze", "expand_dims",
+})
+
+#: Call-like primitives whose inner jaxpr is walked recursively.
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_jvp_call_jaxpr", "remat", "remat2",
+    "checkpoint",
+})
+
+
+class KernelGenError(ValueError):
+    """A model declaration the generator cannot lower; ``str(exc)`` is
+    the feasibility reason recorded in ``kernel_gate`` provenance."""
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class KernelSpec:
+    """Static view of a Model declaration for the generated kernel.
+
+    ``eq=False`` keeps dataclass identity hashing: a spec is a valid
+    ``jax.jit`` static argument, and :func:`get_spec` memoization makes
+    repeated dispatches hit the jit cache. ``model`` is the declaration
+    object itself (duck-typed — ops/ imports no model module); the
+    XLA fallbacks hand it to ``stencil.reaction_update`` unchanged.
+    """
+
+    name: str
+    n_fields: int
+    field_names: Tuple[str, ...]
+    boundaries: Tuple[float, ...]
+    param_fields: Tuple[str, ...]
+    params_cls: type
+    reaction: Callable
+    model: object
+    version: int = GENERATOR_VERSION
+
+
+def _walk_jaxpr(jaxpr, bad):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _CALL_PRIMS:
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    _walk_jaxpr(inner, bad)
+        elif name not in _ELEMENTWISE_PRIMS:
+            bad.add(name)
+
+
+def generation_gate_reason(model) -> Optional[str]:
+    """Why the generator cannot lower ``model``'s reaction into the
+    fused kernel, or ``None`` when it can.
+
+    ONE statement of the model-side Pallas gate, shared by explicit
+    ``kernel_language = "Pallas"`` validation, the Auto branch, and the
+    autotuner's shortlist (``pallas_allowed``) — all three must agree,
+    and the reason string is what lands in ``kernel_gate`` provenance.
+    Purely abstract: traces over shaped dummies, never touches a
+    device buffer.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shape = (4, 4, 4)
+    n = len(model.field_names)
+    dummies = tuple(
+        jax.ShapeDtypeStruct(shape, jnp.float32) for _ in range(n)
+    )
+    noise = jax.ShapeDtypeStruct(shape, jnp.float32)
+    params = model.params_cls(*(
+        jax.ShapeDtypeStruct((), jnp.float32)
+        for _ in model.params_cls._fields
+    ))
+    try:
+        jaxpr, shapes = jax.make_jaxpr(model.reaction, return_shape=True)(
+            dummies, dummies, noise, params
+        )
+    except Exception as e:  # noqa: BLE001 — the reason IS the product
+        return f"reaction failed to trace: {type(e).__name__}: {e}"
+    if not isinstance(shapes, (tuple, list)) or len(shapes) != n:
+        got = len(shapes) if isinstance(shapes, (tuple, list)) else 1
+        return (
+            f"reaction returned {got} derivative(s) for {n} field(s)"
+        )
+    for fname, s in zip(model.field_names, shapes):
+        if tuple(s.shape) != shape:
+            return (
+                f"derivative for field {fname!r} has shape "
+                f"{tuple(s.shape)}, expected the field shape {shape}"
+            )
+    bad = set()
+    _walk_jaxpr(jaxpr.jaxpr, bad)
+    if bad:
+        return (
+            "reaction uses non-elementwise primitive(s) "
+            f"{sorted(bad)}; the slab pipeline only sees a local "
+            "window, so cross-cell ops cannot be inlined"
+        )
+    return None
+
+
+def build_spec(model) -> KernelSpec:
+    """Spec for ``model``, or :class:`KernelGenError` naming the reason
+    when generation is infeasible (callers wanting a non-raising check
+    use :func:`generation_gate_reason` directly)."""
+    reason = generation_gate_reason(model)
+    if reason is not None:
+        raise KernelGenError(
+            f"cannot generate a Pallas kernel for model "
+            f"{model.name!r}: {reason}"
+        )
+    return KernelSpec(
+        name=model.name,
+        n_fields=len(model.field_names),
+        field_names=tuple(model.field_names),
+        boundaries=tuple(float(b) for b in model.boundaries),
+        param_fields=tuple(model.params_cls._fields),
+        params_cls=model.params_cls,
+        reaction=model.reaction,
+        model=model,
+    )
+
+
+#: Memoized specs keyed on the model object — identity matters: the
+#: spec is a jit static argument, so handing the SAME object back on
+#: every dispatch is what makes the jit cache hit.
+_SPECS: dict = {}
+
+
+def get_spec(model) -> KernelSpec:
+    key = (model.name, id(model))
+    spec = _SPECS.get(key)
+    if spec is None:
+        spec = _SPECS[key] = build_spec(model)
+    return spec

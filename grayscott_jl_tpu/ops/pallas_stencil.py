@@ -1,15 +1,25 @@
-"""Hand-fused Pallas TPU kernel for the Gray-Scott update.
+"""Generated fused Pallas TPU kernel for registered reaction models.
 
 ``kernel_language = "Pallas"`` — the TPU-native re-design of the
 reference's hand-written GPU kernels (``ext/CUDAExt.jl:127-187``,
 ``Simulation_KA.jl:160-236``). Where those launch a 2D (k,j) thread grid
 with a serial i loop per thread, this kernel is a single program that
 walks the outermost (x) axis in ``BX``-plane slabs with a manually
-double-buffered HBM->VMEM DMA pipeline, computing both fields' diffusion +
-reaction + noise in one fused VMEM-resident pass per slab.
+double-buffered HBM->VMEM DMA pipeline, computing every field's
+diffusion + reaction + noise in one fused VMEM-resident pass per slab.
+
+The kernel is **generated, not hand-written per model**: the slab
+pipeline below is model-independent, and the model's pure ``reaction``
+is trace-inlined into the stage compute from the declaration's
+:class:`~..ops.kernelgen.KernelSpec` (field count, frozen-ghost
+boundary constants, parameter declarations) — see ``ops/kernelgen.py``
+and docs/KERNELGEN.md. Gray-Scott is the flagship instance: the
+generated program is operation-for-operation the hand kernel it
+replaced, bitwise-checked in tests/golden/pallas_hand_kernel.npz.
 
 The stencil is memory-bound (~30 flops vs 16 bytes minimum traffic per
-cell per step), so the kernel is designed around HBM traffic:
+cell per step for two f32 fields), so the kernel is designed around HBM
+traffic:
 
 * operands are the **interior-shaped** ``(L, L, L)`` fields — no
   materialized ghost pad (a blocked-``pallas_call`` or XLA version spends
@@ -21,8 +31,8 @@ cell per step), so the kernel is designed around HBM traffic:
   three-plane-operand trick;
 * y/z neighbors are in-VMEM shifts (``pltpu.roll``) with the wrapped
   boundary row/column repaired by a masked select — ghost cells never
-  exist in memory. On the global edge the mask substitutes the frozen
-  boundary value (u=1, v=0 — the reference's ``MPI.PROC_NULL`` ghost
+  exist in memory. On the global edge the mask substitutes the model's
+  frozen boundary value (the reference's ``MPI.PROC_NULL`` ghost
   semantics, ``Simulation_CPU.jl:23-24``); on an interior shard edge it
   substitutes the neighbor face delivered by the ``ppermute`` halo
   exchange (``parallel/halo.exchange_faces``);
@@ -34,8 +44,8 @@ cell per step), so the kernel is designed around HBM traffic:
   below the 1-read-1-write "roofline" of any single-step schedule.
   Multi-block slabs fuse too (any BX >= k, the production shape at
   L=128+). With faces, fusion crosses the shard boundary in the
-  1D-x-sharded **x-chain** mode (4-tuple of fuse-wide x faces; r3);
-  only the 12-face 3D-sharded mode requires fuse=1 (y/z halos break
+  1D-x-sharded **x-chain** mode (two fuse-wide x faces per field; r3);
+  only the full-faces 3D-sharded mode requires fuse=1 (y/z halos break
   Mosaic lane alignment).
   Measured on the v5e, the slab DMA pipeline has a hard per-pass
   envelope (~2 ms at L=256 f32) that is flat in compute content, so
@@ -71,11 +81,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import stencil
 from ..config.env import env_raw, env_str
-# The Pallas kernel IS the Gray-Scott model's hand-fused form: its
-# reaction math and boundary constants come from the model declaration
-# (models/grayscott.py); other registered models take the XLA path
-# (gated in simulation.py's kernel selection).
-from ..models import grayscott as _gs_model
 from .noise import _u32, block_bits, plane_seed, uniform_pm1_block
 
 # Name compat across jax releases: CompilerParams/InterpretParams are
@@ -193,10 +198,12 @@ def _mid_store_dtype(dtype, mid_bf16: bool):
 
 
 def _slab_fits(bx: int, nx: int, ny: int, nz: int, itemsize: int,
-               fuse: int, mid_itemsize: int, budget: int) -> bool:
+               fuse: int, mid_itemsize: int, budget: int,
+               n_fields: int = 2) -> bool:
     """ONE statement of the slab-depth VMEM feasibility gate, shared by
     the dispatch pick (:func:`pick_block_planes`) and the autotuner's
-    candidate enumeration (:func:`feasible_block_planes`)."""
+    candidate enumeration (:func:`feasible_block_planes`). Scratch
+    scales linearly in the model's field count (``n_fields``)."""
     if nx % bx:
         return False
     if bx < nx and bx < fuse:
@@ -207,16 +214,16 @@ def _slab_fits(bx: int, nx: int, ny: int, nz: int, itemsize: int,
     # A whole-block slab (nblocks == 1) only ever touches buffer
     # slot 0 — no double buffering to charge for.
     nio = 1 if bx == nx else 2
-    in_bytes = 2 * nio * (bx + 2 * fuse) * ny * nz * itemsize
+    in_bytes = n_fields * nio * (bx + 2 * fuse) * ny * nz * itemsize
     nbuf, mid_planes = _mid_layout(bx, fuse)
-    mid_bytes = 2 * nbuf * mid_planes * ny * nz * mid_itemsize
-    out_bytes = 2 * nio * bx * ny * nz * itemsize
+    mid_bytes = n_fields * nbuf * mid_planes * ny * nz * mid_itemsize
+    out_bytes = n_fields * nio * bx * ny * nz * itemsize
     return in_bytes + mid_bytes + out_bytes <= budget
 
 
 def feasible_block_planes(
     nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1,
-    mid_itemsize: int = None,
+    mid_itemsize: int = None, n_fields: int = 2,
 ) -> list:
     """EVERY slab depth BX the VMEM gate admits for this shape, largest
     first — the ``bx`` axis of the measured autotuner's candidate space
@@ -229,19 +236,20 @@ def feasible_block_planes(
         mid_itemsize = max(itemsize, 4)
     out = [bx for bx in range(nx, 0, -1)
            if _slab_fits(bx, nx, ny, nz, itemsize, fuse, mid_itemsize,
-                         budget)]
+                         budget, n_fields)]
     return out
 
 
 def pick_block_planes(
     nx: int, ny: int, nz: int, itemsize: int, fuse: int = 1,
-    mid_itemsize: int = None,
+    mid_itemsize: int = None, n_fields: int = 2,
 ) -> int:
-    """Largest slab depth BX (dividing nx) whose double-buffered u/v
-    in/mid/out scratch fits the VMEM budget; 0 if even BX=1 does not
-    fit. ``fuse`` is the temporal-blocking depth (input halo width);
-    ``mid_itemsize`` the mid-buffer element size (defaults to the
-    conservative f32 floor; bf16-mid configs pass 2).
+    """Largest slab depth BX (dividing nx) whose double-buffered
+    per-field in/mid/out scratch fits the VMEM budget; 0 if even BX=1
+    does not fit. ``fuse`` is the temporal-blocking depth (input halo
+    width); ``mid_itemsize`` the mid-buffer element size (defaults to
+    the conservative f32 floor; bf16-mid configs pass 2); ``n_fields``
+    the model's field count.
     ``GS_BX`` forces a specific depth (benchmark sweeps) when it divides
     ``nx`` and fits; otherwise it is ignored with a warning."""
     budget = _vmem_budget()
@@ -250,7 +258,7 @@ def pick_block_planes(
 
     def fits(bx: int) -> bool:
         return _slab_fits(bx, nx, ny, nz, itemsize, fuse, mid_itemsize,
-                          budget)
+                          budget, n_fields)
 
     override = env_str("GS_BX", "")
     if override:
@@ -294,7 +302,10 @@ def mosaic_gate_reason(local, itemsize: int):
     dispatch (``parallel/icimodel.py``) — the model must never promise
     a schedule the kernel would silently decline. The y-sublane gate is
     not here: chain operands arrive y-extended and sublane-rounded, and
-    a 128-aligned cubic block satisfies it by construction."""
+    a 128-aligned cubic block satisfies it by construction. Model-side
+    feasibility (can the reaction be inlined at all?) is
+    ``kernelgen.generation_gate_reason`` — orthogonal to this shape
+    gate."""
     nz = local[2]
     if itemsize == 8:
         return "float64 runs the Pallas kernel's XLA fallback on TPU"
@@ -305,7 +316,8 @@ def mosaic_gate_reason(local, itemsize: int):
 
 
 def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
-                      fuse: int, mid_itemsize: int = None) -> int:
+                      fuse: int, mid_itemsize: int = None,
+                      n_fields: int = 2) -> int:
     """Deepest chain depth <= ``fuse`` whose slab scratch fits the VMEM
     budget (:func:`pick_block_planes` > 0); 0 if not even ``fuse=1``
     fits. Dispatch-time guard for the in-kernel chain modes: the
@@ -314,14 +326,16 @@ def max_feasible_fuse(nx: int, ny: int, nz: int, itemsize: int,
     shape 64x512x512 f32 fits fuse=3 at bx=4 but not fuse=5)."""
     for k in range(fuse, 0, -1):
         if pick_block_planes(nx, ny, nz, itemsize, k,
-                             mid_itemsize=mid_itemsize) > 0:
+                             mid_itemsize=mid_itemsize,
+                             n_fields=n_fields) > 0:
             return k
     return 0
 
 
 def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
                            fuse: int, sublane: int = 8,
-                           mid_itemsize: int = None) -> int:
+                           mid_itemsize: int = None,
+                           n_fields: int = 2) -> int:
     """:func:`max_feasible_fuse` for the xy-chain mode, where the
     operand arrives y-extended: depth k widens every plane to
     ``ny + 2k`` rows rounded up to the sublane tile, so feasibility
@@ -330,7 +344,8 @@ def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
         ny_ext = ny + 2 * k
         ny_ext += (-ny_ext) % sublane
         if pick_block_planes(nx, ny_ext, nz, itemsize, k,
-                             mid_itemsize=mid_itemsize) > 0:
+                             mid_itemsize=mid_itemsize,
+                             n_fields=n_fields) > 0:
             return k
     return 0
 
@@ -372,76 +387,83 @@ def _shifted(block, axis, shift, edge_value, masks):
     return jnp.where(masks[(axis, shift)], edge_value, rolled)
 
 
-def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
-                 fuse, mid_bf16=False):
-    """Build the fused single-program kernel body; see module docstring.
+def _make_kernel(spec, nblocks, bx, nx, ny, nz, dtype, use_noise,
+                 with_faces, fuse, mid_bf16=False):
+    """Build the fused single-program kernel body for ``spec``'s model;
+    see module docstring. The pipeline is model-independent; the stage
+    compute TRACE-INLINES ``spec.reaction`` over the window interiors
+    (ops/kernelgen.py), with per-field boundary constants from
+    ``spec.boundaries``.
 
     Two faces modes: ``with_faces`` with ``fuse == 1`` takes the full
-    12-face tuple of a 3D-sharded block; ``with_faces`` with
-    ``fuse >= 2`` is the 1D-x-sharded temporal chain — ONLY the four
-    x faces, each ``fuse`` planes wide, feeding the in-kernel k-stage
-    chain (y/z stay global frozen boundaries), with mid-stage
-    out-of-domain pinning keyed on GLOBAL x coordinates so interior
-    shards recompute the neighbor ring instead of freezing it.
+    6-per-field face tuple of a 3D-sharded block; ``with_faces`` with
+    ``fuse >= 2`` is the 1D-x-sharded temporal chain — ONLY the
+    2-per-field x faces, each ``fuse`` planes wide, feeding the
+    in-kernel k-stage chain (y/z stay global frozen boundaries), with
+    mid-stage out-of-domain pinning keyed on GLOBAL x coordinates so
+    interior shards recompute the neighbor ring instead of freezing it.
 
-    Ref order (mid scratch present only when ``fuse >= 2``):
-      params(SMEM f32[6]; f64 for f64 fields — never bf16, Mosaic SMEM
-      support for bf16 scalars is shaky),
+    Ref order, for an n-field model (mid scratch present only when
+    ``fuse >= 2``):
+      params(SMEM f32[n_params]; f64 for f64 fields — never bf16,
+      Mosaic SMEM support for bf16 scalars is shaky),
       seeds(SMEM i32[7] = key lo, key hi, step, x/y/z global offset,
       global row length L — the position-keyed noise coordinates),
-      u, v (ANY/HBM, (nx, ny, nz)),
-      [u_xlo, u_xhi, v_xlo, v_xhi (ANY, (fuse, ny, nz)),
-       fuse==1 only: u_ylo, u_yhi, v_ylo, v_yhi (VMEM, (nx, 1, nz)),
-                     u_zlo, u_zhi, v_zlo, v_zhi (VMEM, (nx, ny, 1))],
-      u_out, v_out (ANY/HBM),
-      scratch: in_u, in_v (VMEM (2, bx+2*fuse, ny, nz)),
-               [mid_u, mid_v (VMEM (nbuf, bx+2(fuse-1), ny, nz))],
-               out_u, out_v (VMEM (2, bx, ny, nz)),
-               in_sems (DMA (2, 2)), out_sems (DMA (2, 2)),
-               [face_sems (DMA (2, 2, 2))]
+      f_0 .. f_{n-1} (ANY/HBM, (nx, ny, nz)),
+      [f_0_xlo, f_0_xhi, .., f_{n-1}_xhi (ANY, (fuse, ny, nz)),
+       fuse==1 only: per-field y faces (VMEM, (nx, 1, nz)),
+                     per-field z faces (VMEM, (nx, ny, 1))],
+      f_0_out .. f_{n-1}_out (ANY/HBM),
+      scratch: in_0 .. in_{n-1} (VMEM (2, bx+2*fuse, ny, nz)),
+               [mid_0 .. mid_{n-1} (VMEM (nbuf, bx+2(fuse-1), ny, nz))],
+               out_0 .. out_{n-1} (VMEM (2, bx, ny, nz)),
+               in_sems (DMA (2, n)), out_sems (DMA (2, n)),
+               [face_sems (DMA (2, n, 2))]
     """
     halo = fuse
     win_n = bx + 2 * halo
     x_chain = with_faces and fuse >= 2
+    n_f = spec.n_fields
 
-    def kernel(params, seeds, u, v, *rest):
-        if with_faces and not x_chain:
-            (u_xlo, u_xhi, v_xlo, v_xhi,
-             u_ylo, u_yhi, v_ylo, v_yhi,
-             u_zlo, u_zhi, v_zlo, v_zhi,
-             u_out, v_out,
-             in_u, in_v, out_u, out_v,
-             in_sems, out_sems, face_sems) = rest
-            x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
-        elif x_chain:
-            (u_xlo, u_xhi, v_xlo, v_xhi,
-             u_out, v_out,
-             in_u, in_v, mid_u, mid_v, out_u, out_v,
-             in_sems, out_sems, face_sems) = rest
-            x_faces = ((u_xlo, u_xhi), (v_xlo, v_xhi))
-        elif fuse >= 2:
-            (u_out, v_out,
-             in_u, in_v, mid_u, mid_v, out_u, out_v,
-             in_sems, out_sems) = rest
-            x_faces = None
-        else:
-            (u_out, v_out,
-             in_u, in_v, out_u, out_v,
-             in_sems, out_sems) = rest
-            x_faces = None
+    def kernel(params, seeds, *rest):
+        rest = list(rest)
+
+        def take(k):
+            out = rest[:k]
+            del rest[:k]
+            return out
+
+        field_refs = take(n_f)
+        x_faces = y_faces = z_faces = None
+        if with_faces:
+            xf = take(2 * n_f)
+            x_faces = [(xf[2 * i], xf[2 * i + 1]) for i in range(n_f)]
+            if not x_chain:
+                yf = take(2 * n_f)
+                zf = take(2 * n_f)
+                y_faces = [(yf[2 * i], yf[2 * i + 1]) for i in range(n_f)]
+                z_faces = [(zf[2 * i], zf[2 * i + 1]) for i in range(n_f)]
+        field_outs = take(n_f)
+        ins = take(n_f)
+        mids = take(n_f) if fuse >= 2 else None
+        out_scr = take(n_f)
+        in_sems, out_sems = take(2)
+        face_sems = rest[0] if with_faces else None
 
         # cdt == dtype except bf16, which computes in f32 (_compute_dtype).
         cdt = _compute_dtype(dtype)
-        u_bv = jnp.asarray(_gs_model.U_BOUNDARY, cdt)
-        v_bv = jnp.asarray(_gs_model.V_BOUNDARY, cdt)
-        fields = ((u, in_u, 0, u_bv), (v, in_v, 1, v_bv))
-        # Params land in SMEM at >= f32 (see ref order above); cast the
-        # six scalars to the compute dtype at the point of use.
-        Du, Dv, F, K, dt, noise = (
-            params[j].astype(cdt) for j in range(6)
+        bvs = tuple(jnp.asarray(b, cdt) for b in spec.boundaries)
+        # Params land in SMEM at >= f32 (see ref order above); rebuild
+        # the model's params namedtuple with every scalar cast to the
+        # compute dtype, so the inlined reaction sees exactly the
+        # argument types the XLA path feeds it.
+        p_c = spec.params_cls(
+            *(params[j].astype(cdt)
+              for j in range(len(spec.param_fields)))
         )
+        dt = p_c.dt
+        noise = p_c.noise
         inv_six = jnp.asarray(1.0 / 6.0, cdt)
-        one = jnp.asarray(1.0, cdt)
 
         def slab_io(slot, b, start):
             """Start (or wait for) all input DMAs of slab ``b``.
@@ -460,7 +482,8 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 d = make()
                 (d.start if start else d.wait)()
 
-            for field_ref, scr, tag, bv in fields:
+            for tag in range(n_f):
+                field_ref, scr, bv = field_refs[tag], ins[tag], bvs[tag]
                 sem = in_sems.at[slot, tag]
                 if nblocks == 1:
                     go(lambda: pltpu.make_async_copy(
@@ -512,10 +535,10 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                             for p in planes:
                                 scr[slot, p] = jnp.full((ny, nz), bv, dtype)
 
-        def out_dma(ref, scr, slot, b, tag):
+        def out_dma(slot, b, tag):
             return pltpu.make_async_copy(
-                scr.at[slot],
-                ref.at[pl.ds(b * bx, bx)],
+                out_scr[tag].at[slot],
+                field_outs[tag].at[pl.ds(b * bx, bx)],
                 out_sems.at[slot, tag],
             )
 
@@ -537,22 +560,6 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                 + _shifted(c, 2, -1, zhi, masks)
             ) * inv_six - c
 
-        def euler_terms(u_win, v_win, u_edges, v_edges):
-            """Rate terms (u_c, du, v_c, dv) of the window interior —
-            noise joins ``du`` *before* the dt multiply, per-plane in the
-            caller, in exactly the XLA kernel's operation order
-            (``stencil.reaction_update``) so the two kernel languages
-            agree to float roundoff even with noise on."""
-            n = u_win.shape[0] - 2
-            u_c = u_win[1:n + 1]
-            v_c = v_win[1:n + 1]
-            lap_u = lap(u_win, u_c, u_edges)
-            lap_v = lap(v_win, v_c, v_edges)
-            uvv = u_c * v_c * v_c
-            du = Du * lap_u - uvv + F * (one - u_c)
-            dv = Dv * lap_v + uvv - (F + K) * v_c
-            return u_c, du, v_c, dv
-
         def noise_block(step_idx, g0, w, iota_w=None):
             """Pre-scaled noise for ``w`` consecutive local x-planes
             starting at ``g0`` — one 3D evaluation of the identical
@@ -571,25 +578,46 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             bits = block_bits(seed, iy, iz, seeds[6])
             return noise * _kernel_pm1(bits, cdt)
 
-        const_edges_u = (u_bv,) * 4
-        const_edges_v = (v_bv,) * 4
+        const_edges = tuple((bv,) * 4 for bv in bvs)
+
+        def react(wins, edges, step_idx, g0, w, iota_w=None):
+            """One stage of every field: slice the window interiors,
+            form the Laplacians, and trace-inline ``spec.reaction``
+            over them. Noise is passed pre-scaled INTO the reaction,
+            exactly like the XLA path (``stencil.reaction_update``), so
+            the two kernel languages agree to float roundoff even with
+            noise on — and, for Gray-Scott, the inlined program is
+            operation-for-operation the old hand-written kernel."""
+            m = wins[0].shape[0] - 2
+            centers = tuple(w_[1:m + 1] for w_ in wins)
+            laps = tuple(
+                lap(w_, c, e) for w_, c, e in zip(wins, centers, edges)
+            )
+            if use_noise:
+                noise_term = noise_block(step_idx, g0, w, iota_w)
+            else:
+                noise_term = jnp.asarray(0.0, cdt)
+            derivs = spec.reaction(centers, laps, noise_term, p_c)
+            return centers, derivs
 
         def compute1(slot, b):
-            u_win = in_u[slot].astype(cdt)
-            v_win = in_v[slot].astype(cdt)
+            wins = tuple(ins[i][slot].astype(cdt) for i in range(n_f))
             if with_faces:
-                rows = lambda f: f[pl.ds(b * bx, bx)].astype(cdt)  # noqa: E731
-                u_edges = (rows(u_ylo), rows(u_yhi),
-                           rows(u_zlo), rows(u_zhi))
-                v_edges = (rows(v_ylo), rows(v_yhi),
-                           rows(v_zlo), rows(v_zhi))
+                def rows(f):
+                    return f[pl.ds(b * bx, bx)].astype(cdt)
+
+                edges = tuple(
+                    (rows(y_faces[i][0]), rows(y_faces[i][1]),
+                     rows(z_faces[i][0]), rows(z_faces[i][1]))
+                    for i in range(n_f)
+                )
             else:
-                u_edges, v_edges = const_edges_u, const_edges_v
-            u_c, du, v_c, dv = euler_terms(u_win, v_win, u_edges, v_edges)
-            if use_noise:
-                du = du + noise_block(seeds[2], b * bx, bx)
-            out_u[slot] = (u_c + du * dt).astype(dtype)
-            out_v[slot] = (v_c + dv * dt).astype(dtype)
+                edges = const_edges
+            centers, derivs = react(wins, edges, seeds[2], b * bx, bx)
+            for i in range(n_f):
+                out_scr[i][slot] = (
+                    centers[i] + derivs[i] * dt
+                ).astype(dtype)
 
         def compute_k(slot, b):
             """``fuse``-stage temporal blocking: stage s advances step
@@ -625,32 +653,36 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             for s in range(k):
                 w_out = bx + 2 * (k - 1 - s)
                 if s == 0:
-                    u_win = in_u[slot].astype(cdt)
-                    v_win = in_v[slot].astype(cdt)
+                    wins = tuple(
+                        ins[i][slot].astype(cdt) for i in range(n_f)
+                    )
                 else:
                     # Mid buffers hold _mid_store_dtype values (bf16 for
                     # bf16 fields / GS_MID_BF16); widen to the compute
                     # dtype BEFORE any roll (no 16-bit rotate path).
                     buf = (s - 1) % 2 if k > 2 else 0
-                    u_win = mid_u[buf, pl.ds(0, w_out + 2)].astype(cdt)
-                    v_win = mid_v[buf, pl.ds(0, w_out + 2)].astype(cdt)
-                u_c, du, v_c, dv = euler_terms(
-                    u_win, v_win, const_edges_u, const_edges_v
-                )
+                    wins = tuple(
+                        mids[i][buf, pl.ds(0, w_out + 2)].astype(cdt)
+                        for i in range(n_f)
+                    )
                 step_s = seeds[2] + s
                 if s == k - 1:
-                    if use_noise:
-                        du = du + noise_block(step_s, b * bx, bx)
-                    out_u[slot] = (u_c + du * dt).astype(dtype)
-                    out_v[slot] = (v_c + dv * dt).astype(dtype)
+                    centers, derivs = react(
+                        wins, const_edges, step_s, b * bx, bx
+                    )
+                    for i in range(n_f):
+                        out_scr[i][slot] = (
+                            centers[i] + derivs[i] * dt
+                        ).astype(dtype)
                 else:
-                    buf = s % 2 if k > 2 else 0
                     g0 = b * bx - (k - 1 - s)
                     iota_w = lax.broadcasted_iota(
                         jnp.int32, (w_out, 1, 1), 0
                     )
-                    if use_noise:
-                        du = du + noise_block(step_s, g0, w_out, iota_w)
+                    centers, derivs = react(
+                        wins, const_edges, step_s, g0, w_out, iota_w
+                    )
+                    buf = s % 2 if k > 2 else 0
                     # Ring planes outside the domain stay at the frozen
                     # boundary value. In the x-chain (1D-sharded) mode
                     # "domain" is the GLOBAL grid: interior shards own
@@ -681,12 +713,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
                         def _store(x):
                             return x.astype(ms)
 
-                    mid_u[buf, pl.ds(0, w_out)] = _store(
-                        jnp.where(valid, u_c + du * dt, u_bv)
-                    )
-                    mid_v[buf, pl.ds(0, w_out)] = _store(
-                        jnp.where(valid, v_c + dv * dt, v_bv)
-                    )
+                    for i in range(n_f):
+                        mids[i][buf, pl.ds(0, w_out)] = _store(
+                            jnp.where(
+                                valid, centers[i] + derivs[i] * dt, bvs[i]
+                            )
+                        )
 
         compute = compute_k if fuse >= 2 else compute1
 
@@ -709,12 +741,12 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
 
             @pl.when(b >= 2)
             def _():
-                out_dma(u_out, out_u, slot, b - 2, 0).wait()
-                out_dma(v_out, out_v, slot, b - 2, 1).wait()
+                for tag in range(n_f):
+                    out_dma(slot, b - 2, tag).wait()
 
             compute(slot, b)
-            out_dma(u_out, out_u, slot, b, 0).start()
-            out_dma(v_out, out_v, slot, b, 1).start()
+            for tag in range(n_f):
+                out_dma(slot, b, tag).start()
             return 0
 
         lax.fori_loop(0, nblocks, body, 0)
@@ -723,21 +755,22 @@ def _make_kernel(nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
             if tail_b >= 0:
                 slot = tail_b % nio
                 b = jnp.int32(tail_b)
-                out_dma(u_out, out_u, slot, b, 0).wait()
-                out_dma(v_out, out_v, slot, b, 1).wait()
+                for tag in range(n_f):
+                    out_dma(slot, b, tag).wait()
 
     return kernel
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bx", "use_noise", "interpret", "fuse",
+    static_argnames=("spec", "bx", "use_noise", "interpret", "fuse",
                      "detect_races", "mid_bf16"),
 )
-def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
+def _fused_call(fields, params_vec, seeds, faces, *, spec, bx, use_noise,
                 interpret, fuse, detect_races=False, mid_bf16=False):
-    nx, ny, nz = u.shape
-    dtype = u.dtype
+    n_f = spec.n_fields
+    nx, ny, nz = fields[0].shape
+    dtype = fields[0].dtype
     nblocks = nx // bx
     with_faces = faces is not None
 
@@ -745,15 +778,15 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
     vmem_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
 
-    in_specs = [smem_spec, smem_spec, any_spec, any_spec]
-    operands = [params_vec, seeds, u, v]
+    in_specs = [smem_spec, smem_spec] + [any_spec] * n_f
+    operands = [params_vec, seeds, *fields]
     if with_faces:
-        # x faces ride DMA from HBM (ANY); y/z faces (12-face mode
-        # only) are small -> VMEM. The 4-face tuple is the x-chain
+        # x faces ride DMA from HBM (ANY); y/z faces (full-faces mode
+        # only) are small -> VMEM. The 2-per-field tuple is the x-chain
         # mode: fuse-wide x slabs, no y/z faces.
-        in_specs += [any_spec] * 4
-        if len(faces) == 12:
-            in_specs += [vmem_spec] * 8
+        in_specs += [any_spec] * (2 * n_f)
+        if len(faces) == 6 * n_f:
+            in_specs += [vmem_spec] * (4 * n_f)
         operands += list(faces)
 
     # Single-slab runs (nblocks == 1) only ever use buffer slot 0;
@@ -761,35 +794,35 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
     # (pick_block_planes budgets the same way).
     nio = 1 if nblocks == 1 else 2
     scratch_shapes = [
-        pltpu.VMEM((nio, bx + 2 * fuse, ny, nz), dtype),
-        pltpu.VMEM((nio, bx + 2 * fuse, ny, nz), dtype),
+        pltpu.VMEM((nio, bx + 2 * fuse, ny, nz), dtype)
+        for _ in range(n_f)
     ]
     if fuse >= 2:
         nbuf, mid_planes = _mid_layout(bx, fuse)
         mid_dtype = _mid_store_dtype(dtype, mid_bf16)
         scratch_shapes += [
-            pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
-            pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype),
+            pltpu.VMEM((nbuf, mid_planes, ny, nz), mid_dtype)
+            for _ in range(n_f)
         ]
     scratch_shapes += [
-        pltpu.VMEM((nio, bx, ny, nz), dtype),
-        pltpu.VMEM((nio, bx, ny, nz), dtype),
-        pltpu.SemaphoreType.DMA((nio, 2)),
-        pltpu.SemaphoreType.DMA((nio, 2)),
+        pltpu.VMEM((nio, bx, ny, nz), dtype) for _ in range(n_f)
+    ]
+    scratch_shapes += [
+        pltpu.SemaphoreType.DMA((nio, n_f)),
+        pltpu.SemaphoreType.DMA((nio, n_f)),
     ]
     if with_faces:
-        scratch_shapes.append(pltpu.SemaphoreType.DMA((nio, 2, 2)))
+        scratch_shapes.append(pltpu.SemaphoreType.DMA((nio, n_f, 2)))
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _make_kernel(
-            nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces, fuse,
-            mid_bf16,
+            spec, nblocks, bx, nx, ny, nz, dtype, use_noise, with_faces,
+            fuse, mid_bf16,
         ),
         in_specs=in_specs,
-        out_specs=[any_spec, any_spec],
+        out_specs=[any_spec] * n_f,
         out_shape=[
-            jax.ShapeDtypeStruct((nx, ny, nz), dtype),
-            jax.ShapeDtypeStruct((nx, ny, nz), dtype),
+            jax.ShapeDtypeStruct((nx, ny, nz), dtype) for _ in range(n_f)
         ],
         scratch_shapes=scratch_shapes,
         # Mosaic's default scoped-VMEM cap is well below the slab budget;
@@ -804,30 +837,38 @@ def _fused_call(u, v, params_vec, seeds, faces, *, bx, use_noise,
         # jit cache (it is part of the cache key).
         interpret=_interpret_arg(detect_races) if interpret else False,
     )(*operands)
+    return tuple(out)
 
 
-def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
+def fused_step(fields, params, seeds, faces=None, *, spec, use_noise=True,
                allow_interpret=True, fuse=1, detect_races=False,
                offsets=None, row=None):
-    """``fuse`` fused Gray-Scott steps on interior-shaped fields.
+    """``fuse`` fused steps of ``spec``'s model on interior-shaped
+    fields (an n-tuple of (nx, ny, nz) arrays in declaration order).
 
-    ``seeds`` is an int32[3] vector (PRNG key data lo/hi, absolute step
-    index) keying the in-kernel noise stream; ``offsets`` (optional,
-    int32[3]) is the block's global origin and ``row`` the global grid
-    side L — together they make the noise position-keyed across shard
-    layouts (defaults: zero origin, row = local nz — the single-block
-    case). ``faces`` takes one of two forms:
+    ``spec`` is the model's generated-kernel spec
+    (``kernelgen.get_spec(model)``) — a static argument carrying the
+    reaction to inline, the per-field boundary constants, and the
+    parameter layout. ``seeds`` is an int32[3] vector (PRNG key data
+    lo/hi, absolute step index) keying the in-kernel noise stream;
+    ``offsets`` (optional, int32[3]) is the block's global origin and
+    ``row`` the global grid side L — together they make the noise
+    position-keyed across shard layouts (defaults: zero origin, row =
+    local nz — the single-block case). ``faces`` takes one of two
+    forms (n = field count):
 
-    * 12-tuple (fuse=1 only) — resolved halo faces of a 3D-sharded
-      block, in the order ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi,
-      v_ylo, v_yhi, u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped
+    * 6n-tuple (fuse=1 only) — resolved halo faces of a 3D-sharded
+      block, axis-major then field-major then lo/hi, e.g. for two
+      fields u, v: ``(u_xlo, u_xhi, v_xlo, v_xhi, u_ylo, u_yhi, v_ylo,
+      v_yhi, u_zlo, u_zhi, v_zlo, v_zhi)`` with x faces shaped
       (1, ny, nz), y faces (nx, 1, nz), z faces (nx, ny, 1);
-    * 4-tuple ``(u_xlo, u_xhi, v_xlo, v_xhi)`` with fuse >= 2, each
-      shaped (fuse, ny, nz) — the x-sharded **x-chain** mode: the
-      fuse-wide x slabs feed the in-kernel temporal chain across the
-      shard boundary (z stays a global frozen boundary, and mid-stage
-      ring pinning uses GLOBAL x *and y* coordinates so interior shards
-      recompute the neighbor ring bitwise instead of freezing it).
+    * 2n-tuple ``(f0_xlo, f0_xhi, f1_xlo, f1_xhi, ...)`` with
+      fuse >= 2, each shaped (fuse, ny, nz) — the x-sharded **x-chain**
+      mode: the fuse-wide x slabs feed the in-kernel temporal chain
+      across the shard boundary (z stays a global frozen boundary, and
+      mid-stage ring pinning uses GLOBAL x *and y* coordinates so
+      interior shards recompute the neighbor ring bitwise instead of
+      freezing it).
       The **xy-chain** is the same mode with a y-extended operand
       (``parallel/temporal.xy_chain``): rows cover global
       ``[offsets[1], offsets[1] + ny)`` including a fuse-deep exchanged
@@ -839,7 +880,7 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
       is what makes this extension Mosaic-cheap, unlike the 128-lane z.
 
     ``fuse=k`` temporal blocking advances k steps per HBM pass
-    (single- or multi-block; with faces only in the 4-tuple x-chain
+    (single- or multi-block; with faces only in the 2n-tuple x-chain
     form). ``detect_races`` (interpret
     mode only) runs the TPU interpreter's DMA/compute race detector; it
     is a static jit argument, so toggling it recompiles rather than
@@ -850,25 +891,40 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     the same values on hardware and under the interpreter, and the same
     stream as the XLA kernel.
 
-    Returns (u', v'). Falls back to the XLA kernel when Mosaic cannot
-    serve the dtype (f64 on TPU), the shape would overflow VMEM, or —
-    off TPU with ``allow_interpret=False`` — when the caller is inside
-    ``shard_map``: the interpret-mode TPU model keeps *global* semaphore
-    state, and concurrent per-shard interpreter instances deadlock each
-    other (reproduced at nblocks >= 2 on an 8-device CPU mesh). The
-    sharded kernel path is instead covered by the single-device
-    with-faces interpret test plus the TPU hardware tests.
+    Returns the updated field tuple. Falls back to the XLA kernel when
+    Mosaic cannot serve the dtype (f64 on TPU), the shape would
+    overflow VMEM, or — off TPU with ``allow_interpret=False`` — when
+    the caller is inside ``shard_map``: the interpret-mode TPU model
+    keeps *global* semaphore state, and concurrent per-shard
+    interpreter instances deadlock each other (reproduced at
+    nblocks >= 2 on an 8-device CPU mesh). The sharded kernel path is
+    instead covered by the single-device with-faces interpret test plus
+    the TPU hardware tests.
     """
-    x_chain = faces is not None and len(faces) == 4
+    fields = tuple(fields)
+    n_f = spec.n_fields
+    if len(fields) != n_f:
+        raise ValueError(
+            f"model {spec.name!r} declares {n_f} field(s); "
+            f"got {len(fields)}"
+        )
+    x_chain = faces is not None and len(faces) == 2 * n_f
+    if faces is not None and not x_chain and len(faces) != 6 * n_f:
+        raise ValueError(
+            f"faces for the {n_f}-field model {spec.name!r} must be the "
+            f"{2 * n_f}-tuple x-chain form or the {6 * n_f}-tuple 3D "
+            f"form; got {len(faces)}"
+        )
     if fuse > 1 and faces is not None and not x_chain:
         raise ValueError(
-            "temporal blocking with faces requires the 4-tuple x-chain "
-            "mode (1D-sharded); the 12-face 3D mode is fuse=1 only"
+            "temporal blocking with faces requires the x-chain mode "
+            "(1D-sharded, two fuse-wide x faces per field); the "
+            "full-faces 3D mode is fuse=1 only"
         )
     if x_chain and fuse < 2:
         raise ValueError("the x-chain faces mode requires fuse >= 2")
-    nx, ny, nz = u.shape
-    dtype = u.dtype
+    nx, ny, nz = fields[0].shape
+    dtype = fields[0].dtype
     on_tpu = jax.default_backend() == "tpu"
     seeds = jnp.asarray(seeds, jnp.int32)
     if offsets is None:
@@ -893,27 +949,29 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
     )
     mid_item = jnp.dtype(_mid_store_dtype(dtype, mid_bf16)).itemsize
     bx = pick_block_planes(nx, ny, nz, dtype.itemsize, fuse,
-                           mid_itemsize=mid_item)
+                           mid_itemsize=mid_item, n_fields=n_f)
     if bx == 0 and fuse > 1 and not x_chain:
         # The requested depth overflows VMEM for this shape, but a
         # shallower chain may still fit — step down rather than losing
         # the Pallas kernel entirely (large grids are exactly where the
         # kernel matters most).
         shallower = max_feasible_fuse(nx, ny, nz, dtype.itemsize,
-                                      fuse - 1, mid_itemsize=mid_item)
+                                      fuse - 1, mid_itemsize=mid_item,
+                                      n_fields=n_f)
         if shallower:
             done = 0
             while done < fuse:
                 k = min(shallower, fuse - done)
-                u, v = fused_step(
-                    u, v, params,
+                fields = fused_step(
+                    fields, params,
                     seeds.at[2].add(done) if done else seeds, faces,
-                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    spec=spec, use_noise=use_noise,
+                    allow_interpret=allow_interpret,
                     fuse=k, detect_races=detect_races,
                     offsets=offsets, row=row,
                 )
                 done += k
-            return u, v
+            return fields
     # Mosaic tiles VMEM as (sublane, 128-lane) over the trailing two dims
     # and rejects the kernel's sliced scratch views unless the lane dim is
     # a whole number of tiles (measured on v5e: L=64 f32 fails "Slice
@@ -948,34 +1006,35 @@ def fused_step(u, v, params, seeds, faces=None, *, use_noise=True,
                     "max_feasible_fuse"
                 )
             return _xla_xchain_fallback(
-                u, v, params, seeds, faces, fuse=fuse,
+                fields, params, seeds, faces, spec=spec, fuse=fuse,
                 use_noise=use_noise, offsets=offsets, row=row,
             )
         for s in range(fuse):
-            u, v = _xla_fallback(
-                u, v, params, seeds.at[2].add(s) if s else seeds, faces,
-                use_noise=use_noise, offsets=offsets, row=row,
+            fields = _xla_fallback(
+                fields, params, seeds.at[2].add(s) if s else seeds,
+                faces, spec=spec, use_noise=use_noise, offsets=offsets,
+                row=row,
             )
-        return u, v
+        return fields
 
     # SMEM scalars stay >= f32 (bf16 scalars in SMEM are a shaky Mosaic
     # combination); the kernel casts them to the field dtype at use.
     smem_dtype = jnp.promote_types(dtype, jnp.float32)
     params_vec = jnp.stack(
-        [params.Du, params.Dv, params.F, params.k, params.dt, params.noise]
+        [getattr(params, f_) for f_ in spec.param_fields]
     ).astype(smem_dtype)
     seeds7 = jnp.concatenate([seeds, offsets, row[None]])
     return _fused_call(
-        u, v, params_vec, seeds7,
+        fields, params_vec, seeds7,
         tuple(faces) if faces is not None else None,
-        bx=bx, use_noise=use_noise, interpret=not on_tpu,
+        spec=spec, bx=bx, use_noise=use_noise, interpret=not on_tpu,
         fuse=fuse, detect_races=detect_races and not on_tpu,
         mid_bf16=mid_bf16,
     )
 
 
-def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
-                         offsets, row):
+def _xla_xchain_fallback(fields, params, seeds, faces, *, spec, fuse,
+                         use_noise, offsets, row):
     """XLA form of the in-kernel x-chain (1D-sharded temporal blocking):
     ``fuse`` stages on an x-extended window seeded by the fuse-wide x
     faces, with z frozen at the global boundary and out-of-global-domain
@@ -986,13 +1045,16 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
     whose block spans the full L in y). Bitwise-equal to the Mosaic
     chain for f32/f64 (same op order, same position-keyed noise) —
     the CPU-mesh / f64 / lane-misaligned path of the same design."""
-    u_xlo, u_xhi, v_xlo, v_xhi = faces
-    nx, ny, nz = u.shape
+    n_f = spec.n_fields
+    nx, ny, nz = fields[0].shape
+    dtype = fields[0].dtype
     k = fuse
-    u_bv = jnp.asarray(_gs_model.U_BOUNDARY, u.dtype)
-    v_bv = jnp.asarray(_gs_model.V_BOUNDARY, v.dtype)
-    u_w = jnp.concatenate([u_xlo, u, u_xhi], axis=0)
-    v_w = jnp.concatenate([v_xlo, v, v_xhi], axis=0)
+    bvs = tuple(jnp.asarray(b, dtype) for b in spec.boundaries)
+    wins = [
+        jnp.concatenate([faces[2 * i], fields[i], faces[2 * i + 1]],
+                        axis=0)
+        for i in range(n_f)
+    ]
     gy = offsets[1] + jnp.arange(ny)
     valid_y = ((gy >= 0) & (gy < row))[None, :, None]
     gz = offsets[2] + jnp.arange(nz)
@@ -1012,15 +1074,15 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
             )
             unit = uniform_pm1_block(
                 seeds[:2], seeds[2] + s, offs_w, (w_out, ny, nz), row,
-                u.dtype,
+                dtype,
             )
             nz_field = params.noise * unit
         else:
-            nz_field = jnp.asarray(0.0, u.dtype)
-        u_w, v_w = stencil.reaction_update(
-            (pad_yz(u_w, u_bv), pad_yz(v_w, v_bv)), nz_field, params,
-            _gs_model.MODEL,
-        )
+            nz_field = jnp.asarray(0.0, dtype)
+        wins = list(stencil.reaction_update(
+            tuple(pad_yz(w, bv) for w, bv in zip(wins, bvs)), nz_field,
+            params, spec.model,
+        ))
         if s == k - 1:
             # Mirror the kernel: the final stage writes its output
             # unpinned (out-of-domain y pad rows hold computed ring
@@ -1030,37 +1092,43 @@ def _xla_xchain_fallback(u, v, params, seeds, faces, *, fuse, use_noise,
             break
         gx = offsets[0] - m_out + jnp.arange(w_out)
         valid = ((gx >= 0) & (gx < row))[:, None, None] & valid_yz
-        u_w = jnp.where(valid, u_w, u_bv)
-        v_w = jnp.where(valid, v_w, v_bv)
-    return u_w, v_w
+        wins = [jnp.where(valid, w, bv) for w, bv in zip(wins, bvs)]
+    return tuple(wins)
 
 
-def _xla_fallback(u, v, params, seeds, faces, *, use_noise, offsets=None,
-                  row=None):
+def _xla_fallback(fields, params, seeds, faces, *, spec, use_noise,
+                  offsets=None, row=None):
     """XLA-path step with the same call contract as ``fused_step``,
     drawing from the same position-keyed noise stream."""
+    n_f = spec.n_fields
     if faces is None:
-        u_pad = stencil.pad_with_boundary(u, _gs_model.U_BOUNDARY)
-        v_pad = stencil.pad_with_boundary(v, _gs_model.V_BOUNDARY)
+        pads = tuple(
+            stencil.pad_with_boundary(f, bv)
+            for f, bv in zip(fields, spec.boundaries)
+        )
     else:
-        u_pad = _pad_from_faces(u, faces[0], faces[1], faces[4], faces[5],
-                                faces[8], faces[9])
-        v_pad = _pad_from_faces(v, faces[2], faces[3], faces[6], faces[7],
-                                faces[10], faces[11])
+        pads = tuple(
+            _pad_from_faces(
+                fields[i], faces[2 * i], faces[2 * i + 1],
+                faces[2 * n_f + 2 * i], faces[2 * n_f + 2 * i + 1],
+                faces[4 * n_f + 2 * i], faces[4 * n_f + 2 * i + 1],
+            )
+            for i in range(n_f)
+        )
+    shape = fields[0].shape
+    dtype = fields[0].dtype
     if use_noise:
         seeds = jnp.asarray(seeds, jnp.int32)
         if offsets is None:
             offsets = jnp.zeros((3,), jnp.int32)
         unit = uniform_pm1_block(
-            seeds[:2], seeds[2], offsets, u.shape,
-            u.shape[2] if row is None else row, u.dtype,
+            seeds[:2], seeds[2], offsets, shape,
+            shape[2] if row is None else row, dtype,
         )
         nz_field = params.noise * unit
     else:
-        nz_field = jnp.asarray(0.0, u.dtype)
-    return stencil.reaction_update(
-        (u_pad, v_pad), nz_field, params, _gs_model.MODEL
-    )
+        nz_field = jnp.asarray(0.0, dtype)
+    return stencil.reaction_update(pads, nz_field, params, spec.model)
 
 
 def _pad_from_faces(x, xlo, xhi, ylo, yhi, zlo, zhi):

@@ -154,6 +154,7 @@ def project(
     hop_us: float = 1.0,
     overlap: float = 0.0,
     halo_depth: int = 1,
+    n_fields: int = 2,
 ) -> dict:
     """Weak-scaling efficiency projection for one cubic-local config.
 
@@ -179,7 +180,8 @@ def project(
     sk = max(1, int(halo_depth))
     s_steps = fuse * sk  # steps per exchange round
     wide = local + 2 * s_steps  # corner-propagated exchange slab
-    face_bytes = wide * wide * s_steps * itemsize * 2  # per face/round
+    # Every exchanged face carries all of the model's fields.
+    face_bytes = wide * wide * s_steps * itemsize * n_fields
     total_bytes = 6 * face_bytes
     # The exchange completes at the MAX-loaded link, not at aggregate
     # bandwidth: with 6 links each face rides its own (1 face/link);
@@ -236,16 +238,20 @@ def pin_big_vmem() -> None:
     ps._VMEM_BUDGET = ps._VMEM_BUDGETS[True]
 
 
-def _feasible_chain_depth(local, itemsize, kmax, sublane=8, ypad=True):
+def _feasible_chain_depth(local, itemsize, kmax, sublane=8, ypad=True,
+                          n_fields=2):
     """Deepest chain depth the real Mosaic VMEM feasibility check
     admits for this local shape (``pallas_stencil.max_feasible_fuse*``);
     ``ypad`` selects the xy-chain form (y-extended operand) vs the 1D
-    x-chain."""
+    x-chain; ``n_fields`` scales the per-slab VMEM bytes (every field
+    rides the same slab pipeline)."""
     from ..ops import pallas_stencil as ps
 
     if ypad:
-        return ps.max_feasible_fuse_ypad(*local, itemsize, kmax, sublane)
-    return ps.max_feasible_fuse(*local, itemsize, kmax)
+        return ps.max_feasible_fuse_ypad(*local, itemsize, kmax, sublane,
+                                         n_fields=n_fields)
+    return ps.max_feasible_fuse(*local, itemsize, kmax,
+                                n_fields=n_fields)
 
 
 def band_cells_per_round(local, k):
@@ -274,6 +280,7 @@ def project_chain(
     hop_us: float = 1.0,
     overlap: float = 0.0,
     xla_us_per_cell: float = None,
+    n_fields: int = 2,
 ) -> dict:
     """Weak-scaling projection for the round-4 cross-shard fused chain
     (``parallel/temporal.xy_chain``) on an (n, m, p) mesh.
@@ -331,11 +338,11 @@ def project_chain(
         zx, zy = nz + 2 * k, ny + 2 * k
         face_bytes = max(
             zy * zx, (nx + 2 * k) * zx, (nx + 2 * k) * zy
-        ) * itemsize * 2
+        ) * itemsize * n_fields
         n_faces = 6
     else:
         band_us = 0.0
-        face_bytes = max(ny_ext * nz, nx * nz) * itemsize * 2
+        face_bytes = max(ny_ext * nz, nx * nz) * itemsize * n_fields
         n_faces = (2 if n > 1 else 0) + (2 if m > 1 else 0)
     # k-wide slabs every k steps -> per-step bytes are k-independent;
     # completion at the MAX-loaded link: with fewer links than faces
@@ -394,7 +401,7 @@ def _mesh_candidates(n_devices: int, L: int):
 
 
 def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
-                     kmin=2, kmax=8, **kw):
+                     kmin=2, kmax=8, n_fields=2, **kw):
     """Best feasible chain row for ONE mesh: routes (n,1,1) to the 1D
     x-chain model and everything else to the xy-chain model, applying
     the SAME feasibility gates the kernel dispatch applies (Mosaic's
@@ -415,23 +422,26 @@ def best_chain_depth(dims, L, base_us_full, *, local=None, itemsize=4,
     sublane = 16 if itemsize == 2 else 8
     if m == 1 and p == 1:
         cap = _feasible_chain_depth(
-            local, itemsize, max(kmin, local[0]), ypad=False
+            local, itemsize, max(kmin, local[0]), ypad=False,
+            n_fields=n_fields,
         )
         ks = [k for k in FUSE_COST_RATIO if kmin <= k <= min(cap, kmax)]
         # The projection must describe the SAME block shape the gates
         # above were applied to — pass ``local`` through instead of
         # letting the model recompute it with floor division.
         rows = [project_1d(n, L, k, base_us_full, local=local,
-                           itemsize=itemsize, **kw)
+                           itemsize=itemsize, n_fields=n_fields, **kw)
                 for k in ks]
     else:
         cap = min(kmax, local[0], local[1])
         if p > 1:
             cap = min(cap, local[2] // 2)
-        cap = _feasible_chain_depth(local, itemsize, cap, sublane)
+        cap = _feasible_chain_depth(local, itemsize, cap, sublane,
+                                    n_fields=n_fields)
         ks = [k for k in FUSE_COST_RATIO if kmin <= k <= cap]
         rows = [project_chain(dims, L, k, base_us_full, local=local,
-                              itemsize=itemsize, sublane=sublane, **kw)
+                              itemsize=itemsize, sublane=sublane,
+                              n_fields=n_fields, **kw)
                 for k in ks]
     if not rows:
         return None
@@ -468,6 +478,7 @@ def project_1d(
     hop_us: float = 1.0,
     overlap: float = 0.0,
     halo_depth: int = 1,
+    n_fields: int = 2,
 ) -> dict:
     """Weak-scaling projection for the 1D x-sharded in-kernel fused
     chain (``GS_TPU_MESH_DIMS=n,1,1``): each shard owns an
@@ -499,7 +510,8 @@ def project_1d(
     # k-independent; with >= 2 usable links each face rides its own x
     # link, else they serialize on the shared one.
     faces_per_link = -(-2 // links)
-    ser_us = faces_per_link * ny * nz * itemsize * 2 / (link_gbps * 1e3)
+    ser_us = (faces_per_link * ny * nz * itemsize * n_fields
+              / (link_gbps * 1e3))
     lat_us = 2 * hop_us / fuse * sstep_amortization(sk)
     raw_us = ser_us + lat_us
     ov = _resolve_overlap(overlap, us_base * r * recompute, raw_us)
@@ -514,7 +526,7 @@ def project_1d(
         "fuse_cost_ratio_interpolated": fuse in (2, 3),
         "compute_us_per_step": round(us_base, 1),
         "ring_recompute_ratio": round(recompute, 4),
-        "halo_bytes_per_step": round(2 * ny * nz * itemsize * 2),
+        "halo_bytes_per_step": round(2 * ny * nz * itemsize * n_fields),
         "exchanges_per_step": round(1.0 / s_steps, 4),
         "comm_us_per_step_exposed": round(comm_us, 2),
         "comm_us_per_step_hidden": round(raw_us - comm_us, 2),
@@ -576,6 +588,7 @@ def select_kernel(
     overlap="auto",
     hop_us: float = 1.0,
     sweep_mesh: bool = False,
+    n_fields: int = 2,
 ):
     """Resolve ``kernel_language = "Auto"`` for a concrete run config.
 
@@ -648,7 +661,8 @@ def select_kernel(
             info["reason"] = f"single chip: {gate}"
             return "xla", info
         feasible = _feasible_chain_depth(
-            (L, L, L), itemsize, max(fuse, 1), ypad=False
+            (L, L, L), itemsize, max(fuse, 1), ypad=False,
+            n_fields=n_fields,
         )
         if feasible >= 1:
             info["reason"] = (
@@ -669,7 +683,7 @@ def select_kernel(
     # underestimates z-sharded Pallas chain comm on 2D-torus fabrics
     # (v5e/v6e: 6 faces on 4 links).
     kw = dict(links=links, link_gbps=link_gbps, hop_us=hop_us,
-              overlap=overlap)
+              overlap=overlap, n_fields=n_fields)
 
     # XLA language on the actual mesh: locals may be non-cubic; use the
     # cubic-equivalent side (the model's project() is cubic) — face
@@ -747,6 +761,7 @@ def projected_step_us(
     local=None,
     halo_depth: int = 1,
     compute_precision: str = "f32",
+    n_fields: int = 2,
 ) -> Optional[float]:
     """Model-projected µs/step for ONE concrete (language, mesh, depth)
     config — the scalar the measured autotuner (``tune/candidates``)
@@ -776,7 +791,8 @@ def projected_step_us(
         side = max(2, round((local[0] * local[1] * local[2]) ** (1 / 3)))
         row = project(side, max(1, fuse), base, itemsize=itemsize,
                       links=links, link_gbps=link_gbps, hop_us=hop_us,
-                      overlap=overlap, halo_depth=halo_depth)
+                      overlap=overlap, halo_depth=halo_depth,
+                      n_fields=n_fields)
         return base / row["projected_weak_scaling_eff"]
     if max(1, int(halo_depth)) > 1:
         return None  # the Pallas chains have no s-step schedule
@@ -787,7 +803,8 @@ def projected_step_us(
     if fuse < 2 or r is None:
         return None
     kw = dict(local=local, itemsize=itemsize, links=links,
-              link_gbps=link_gbps, hop_us=hop_us, overlap=overlap)
+              link_gbps=link_gbps, hop_us=hop_us, overlap=overlap,
+              n_fields=n_fields)
     try:
         if m == 1 and p == 1:
             row = project_1d(n, L, fuse, base_full, **kw)
@@ -841,7 +858,8 @@ def comm_report(sim) -> dict:
     local = tuple(-(-L // d) for d in dims)
     lang = "Pallas" if sim.kernel_language == "pallas" else "XLA"
     kw = dict(itemsize=itemsize, links=links, link_gbps=link_gbps,
-              overlap=ov_arg)
+              overlap=ov_arg,
+              n_fields=int(getattr(sim.model, "n_fields", 2)))
     row = None
     if lang == "Pallas" and fuse >= 2:
         k = min(fuse, max(FUSE_COST_RATIO))
@@ -919,6 +937,7 @@ def projected_step_us_for(sim) -> Optional[float]:
             overlap="auto" if getattr(sim, "comm_overlap", False)
             else 0.0,
             halo_depth=getattr(sim, "halo_depth", 1),
+            n_fields=int(getattr(sim.model, "n_fields", 2)),
         )
     except Exception:  # noqa: BLE001 — a gauge must never kill a run
         return None

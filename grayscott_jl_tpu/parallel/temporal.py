@@ -200,7 +200,7 @@ def xy_overlap_feasible(local, dims, depth) -> bool:
 
 
 def xy_chain(
-    u, v, params, model, *, depth, step, offs, chain_kernel: Callable,
+    fields, params, model, *, depth, step, offs, chain_kernel: Callable,
     use_noise, unit_noise, row, axis_names, axis_sizes,
     boundaries: Sequence[float], sublane: int = 8,
     overlap: bool = False, band_kernel: Callable = None,
@@ -208,11 +208,15 @@ def xy_chain(
     """``depth`` fused steps on an (n, m, p) sharded block: in-kernel
     chain across x and y shard boundaries, XLA band correction on
     sharded z sides. See the module docstring for the design.
+    Model-generic: ``fields`` is the model's field tuple in declaration
+    order, and every faces tuple is field-major (lo, hi) pairs — the
+    generated kernel's x-chain operand order
+    (``ops/pallas_stencil.fused_step``).
 
-    ``chain_kernel(u_p, v_p, faces4, step, offs_p)`` runs the fused
+    ``chain_kernel(fields_p, faces, step, offs_p)`` runs the fused
     kernel (or its bitwise XLA fallback) at ``fuse=depth`` on the
-    y-extended operand; ``unit_noise(step_idx, origin, shape)`` draws
-    from the shared position-keyed stream. Must be called inside
+    y-extended operand tuple; ``unit_noise(step_idx, origin, shape)``
+    draws from the shared position-keyed stream. Must be called inside
     ``shard_map``.
 
     ``overlap=True`` is the split-phase form (docs/OVERLAP.md): the
@@ -232,48 +236,54 @@ def xy_chain(
     interior to hide behind); shallower blocks silently take the fused
     round, which is bitwise identical anyway.
     """
-    nx, ny, nz = u.shape
+    fields = tuple(fields)
+    bvs = tuple(boundaries)
+    nx, ny, nz = fields[0].shape
+    dtype = fields[0].dtype
     dims = axis_sizes
     k = depth
-    u_bv, v_bv = boundaries
     z_sharded = dims[2] > 1
-    if overlap and not xy_overlap_feasible(u.shape, dims, k):
+    if overlap and not xy_overlap_feasible(fields[0].shape, dims, k):
         overlap = False  # no comm-independent interior: fused round
     if overlap and band_kernel is None:
         raise ValueError("xy_chain overlap=True requires band_kernel")
 
-    # ((body_u, body_v), faces4, offsets, out_row_slice, position) jobs
-    # for the split-phase x/y band recompute, built beside the exchange.
+    # (body_fields, faces, offsets, out_row_slice, position) jobs for
+    # the split-phase x/y band recompute, built beside the exchange.
     band_jobs = []
 
     def const_faces(shape_nyz):
         return tuple(
-            jnp.full((k,) + shape_nyz, bv, u.dtype)
-            for bv in (u_bv, u_bv, v_bv, v_bv)
+            jnp.full((k,) + shape_nyz, bv, dtype)
+            for bv in bvs for _ in (0, 1)
         )
+
+    def interleave(los, his):
+        """Field-major (lo, hi) faces tuple from per-field slabs."""
+        return tuple(x for pair in zip(los, his) for x in pair)
 
     if z_sharded:
         # One corner-propagated k-deep frame serves the kernel operand,
         # its x faces, AND the band windows (6 ppermutes total).
-        u_w, v_w = halo.halo_pad_wide(
-            (u, v), boundaries, axis_names, dims, k
+        fields_w = halo.halo_pad_wide(
+            fields, bvs, axis_names, dims, k
         )
         if overlap:
             # Split phase: the kernel sees frozen constants everywhere,
             # so the frame has NO consumer on the kernel's dataflow
             # path; bands for every sharded axis are stitched after.
-            u_p = jnp.pad(u, ((0, 0), (k, k), (0, 0)),
-                          constant_values=u_bv)
-            v_p = jnp.pad(v, ((0, 0), (k, k), (0, 0)),
-                          constant_values=v_bv)
+            fields_p = tuple(
+                jnp.pad(f, ((0, 0), (k, k), (0, 0)), constant_values=bv)
+                for f, bv in zip(fields, bvs)
+            )
             faces = const_faces((ny + 2 * k, nz))
             m_y = ny + 2 * k
 
             def fr(x0, x1, ys):
-                """Frame windows of (u, v) at frame x range [x0, x1)
-                and y range ``ys``, z clipped to the owned planes."""
-                return (u_w[x0:x1, ys, k:k + nz],
-                        v_w[x0:x1, ys, k:k + nz])
+                """Frame windows of the fields at frame x range
+                [x0, x1) and y range ``ys``, z clipped to the owned
+                planes."""
+                return tuple(w[x0:x1, ys, k:k + nz] for w in fields_w)
 
             if dims[1] > 1:
                 # y bands: body rows are the frame's [arrived y slab |
@@ -285,11 +295,10 @@ def xy_chain(
                     (slice(0, 3 * k), -k, 0),
                     (slice(m_y - 3 * k, m_y), ny - 2 * k, ny - k),
                 ):
-                    xlo_u, xlo_v = fr(0, k, ys)
-                    xhi_u, xhi_v = fr(k + nx, nx + 2 * k, ys)
                     band_jobs.append((
                         fr(k, k + nx, ys),
-                        (xlo_u, xhi_u, xlo_v, xhi_v),
+                        interleave(fr(0, k, ys),
+                                   fr(k + nx, nx + 2 * k, ys)),
                         jnp.stack([offs[0], offs[1] + o_y, offs[2]]),
                         slice(k, 2 * k), (0, d_y, 0),
                     ))
@@ -304,39 +313,38 @@ def xy_chain(
                     (slice(nx, k + nx), slice(nx - k, nx),
                      slice(k + nx, nx + 2 * k), nx - k, nx - k),
                 ):
-                    flo_u, flo_v = fr(fl.start, fl.stop, ally)
-                    fhi_u, fhi_v = fr(fh.start, fh.stop, ally)
                     band_jobs.append((
                         fr(xs.start, xs.stop, ally),
-                        (flo_u, fhi_u, flo_v, fhi_v),
+                        interleave(fr(fl.start, fl.stop, ally),
+                                   fr(fh.start, fh.stop, ally)),
                         jnp.stack([offs[0] + o_x, offs[1] - k,
                                    offs[2]]),
                         slice(k, k + ny), (d_x, 0, 0),
                     ))
         else:
-            u_p = u_w[k:k + nx, :, k:k + nz]
-            v_p = v_w[k:k + nx, :, k:k + nz]
-            faces = (
-                u_w[0:k, :, k:k + nz], u_w[k + nx:, :, k:k + nz],
-                v_w[0:k, :, k:k + nz], v_w[k + nx:, :, k:k + nz],
+            fields_p = tuple(w[k:k + nx, :, k:k + nz] for w in fields_w)
+            faces = interleave(
+                tuple(w[0:k, :, k:k + nz] for w in fields_w),
+                tuple(w[k + nx:, :, k:k + nz] for w in fields_w),
             )
     else:
         # Lean 4-ppermute build: k-wide y slabs first, then x slabs of
         # the y-padded fields so the x faces carry y corner data.
-        (u_ylo, u_yhi), (v_ylo, v_yhi) = halo.exchange_slabs(
-            [u, v], boundaries, 1, axis_names[1], dims[1], k
+        y_pairs = halo.exchange_slabs(
+            list(fields), bvs, 1, axis_names[1], dims[1], k
         )
-        u_pr = jnp.concatenate([u_ylo, u, u_yhi], axis=1)
-        v_pr = jnp.concatenate([v_ylo, v, v_yhi], axis=1)
-        pairs = halo.exchange_slabs(
-            [u_pr, v_pr], boundaries, 0, axis_names[0], dims[0], k
+        fields_pr = tuple(
+            jnp.concatenate([lo, f, hi], axis=1)
+            for f, (lo, hi) in zip(fields, y_pairs)
         )
-        (xp_ulo, xp_uhi), (xp_vlo, xp_vhi) = pairs
+        x_pairs = halo.exchange_slabs(
+            list(fields_pr), bvs, 0, axis_names[0], dims[0], k
+        )
         if overlap:
-            u_p = jnp.pad(u, ((0, 0), (k, k), (0, 0)),
-                          constant_values=u_bv)
-            v_p = jnp.pad(v, ((0, 0), (k, k), (0, 0)),
-                          constant_values=v_bv)
+            fields_p = tuple(
+                jnp.pad(f, ((0, 0), (k, k), (0, 0)), constant_values=bv)
+                for f, bv in zip(fields, bvs)
+            )
             faces = const_faces((ny + 2 * k, nz))
             m_y = ny + 2 * k
             if dims[1] > 1:
@@ -350,9 +358,11 @@ def xy_chain(
                     (slice(m_y - 3 * k, m_y), ny - 2 * k, ny - k),
                 ):
                     band_jobs.append((
-                        (u_pr[:, ys, :], v_pr[:, ys, :]),
-                        (xp_ulo[:, ys, :], xp_uhi[:, ys, :],
-                         xp_vlo[:, ys, :], xp_vhi[:, ys, :]),
+                        tuple(f[:, ys, :] for f in fields_pr),
+                        interleave(
+                            tuple(lo[:, ys, :] for lo, _ in x_pairs),
+                            tuple(hi[:, ys, :] for _, hi in x_pairs),
+                        ),
                         jnp.stack([offs[0], offs[1] + o_y, offs[2]]),
                         slice(k, 2 * k), (0, d_y, 0),
                     ))
@@ -360,12 +370,17 @@ def xy_chain(
                 # x bands: a k-plane body whose x faces are the arrived
                 # slab and the adjacent owned planes (both y-padded).
                 for body, faces_b, o_x, d_x in (
-                    ((u_pr[:k], v_pr[:k]),
-                     (xp_ulo, u_pr[k:2 * k], xp_vlo, v_pr[k:2 * k]),
+                    (tuple(f[:k] for f in fields_pr),
+                     interleave(
+                         tuple(lo for lo, _ in x_pairs),
+                         tuple(f[k:2 * k] for f in fields_pr),
+                     ),
                      0, 0),
-                    ((u_pr[nx - k:], v_pr[nx - k:]),
-                     (u_pr[nx - 2 * k:nx - k], xp_uhi,
-                      v_pr[nx - 2 * k:nx - k], xp_vhi),
+                    (tuple(f[nx - k:] for f in fields_pr),
+                     interleave(
+                         tuple(f[nx - 2 * k:nx - k] for f in fields_pr),
+                         tuple(hi for _, hi in x_pairs),
+                     ),
                      nx - k, nx - k),
                 ):
                     band_jobs.append((
@@ -375,8 +390,11 @@ def xy_chain(
                         slice(k, k + ny), (d_x, 0, 0),
                     ))
         else:
-            u_p, v_p = u_pr, v_pr
-            faces = (pairs[0][0], pairs[0][1], pairs[1][0], pairs[1][1])
+            fields_p = fields_pr
+            faces = interleave(
+                tuple(lo for lo, _ in x_pairs),
+                tuple(hi for _, hi in x_pairs),
+            )
 
     # Round the y extent up to the sublane tile with boundary-constant
     # filler rows at the high end — Mosaic needs sublane-aligned planes,
@@ -389,22 +407,26 @@ def xy_chain(
                 a, ((0, 0), (0, extra), (0, 0)), constant_values=bv
             )
 
-        u_p, v_p = pad_y(u_p, u_bv), pad_y(v_p, v_bv)
-        faces = (pad_y(faces[0], u_bv), pad_y(faces[1], u_bv),
-                 pad_y(faces[2], v_bv), pad_y(faces[3], v_bv))
+        fields_p = tuple(
+            pad_y(f, bv) for f, bv in zip(fields_p, bvs)
+        )
+        faces = tuple(
+            pad_y(fc, bvs[i // 2]) for i, fc in enumerate(faces)
+        )
 
     offs_p = jnp.stack([offs[0], offs[1] - k, offs[2]])
-    u_o, v_o = chain_kernel(u_p, v_p, faces, step, offs_p)
-    u_o = u_o[:, k:k + ny, :]
-    v_o = v_o[:, k:k + ny, :]
+    out = chain_kernel(fields_p, faces, step, offs_p)
+    out = tuple(f[:, k:k + ny, :] for f in out)
 
     # Split-phase x/y bands first (they reproduce the fused kernel's
     # values, including each other's corners), then the z bands, which
     # overwrite the z shell in BOTH modes with identical values.
     for body, faces_b, offs_b, out_rows, pos in band_jobs:
-        bu, bv_ = band_kernel(body[0], body[1], faces_b, step, offs_b)
-        u_o = lax.dynamic_update_slice(u_o, bu[:, out_rows, :], pos)
-        v_o = lax.dynamic_update_slice(v_o, bv_[:, out_rows, :], pos)
+        band = band_kernel(body, faces_b, step, offs_b)
+        out = tuple(
+            lax.dynamic_update_slice(o, b[:, out_rows, :], pos)
+            for o, b in zip(out, band)
+        )
 
     if z_sharded:
         # The kernel ran with frozen z edges: its outermost k z-cells
@@ -412,10 +434,10 @@ def xy_chain(
         # on global z edges). Recompute both k-wide bands from the
         # frame — bitwise the same values, so overwriting
         # unconditionally is correct on edge shards too.
-        u_o, v_o = stitch_bands_from_frame(
-            (u_o, v_o), (u_w, v_w), params, model, depth=k,
+        out = stitch_bands_from_frame(
+            out, fields_w, params, model, depth=k,
             step=step, offs=offs, row=row, axis_sizes=dims,
             use_noise=use_noise, unit_noise=unit_noise,
-            boundaries=boundaries, dims_to_stitch=(2,),
+            boundaries=bvs, dims_to_stitch=(2,),
         )
-    return u_o, v_o
+    return out

@@ -20,10 +20,12 @@ pure reaction, init) selected by the ``[model]`` TOML table; Gray-Scott
 is the default and flagship. ``self.fields`` is the model's field tuple
 in declaration order (``self.u``/``self.v`` alias fields 0/1 for the
 two-field models). Everything below the model boundary — halo exchange,
-split-phase overlap, temporal blocking, autotune, snapshots — is
-model-generic; the one exception is the hand-fused Pallas kernel, which
-implements Gray-Scott only and is gated per model
-(``Model.pallas_capable``, recorded in ``kernel_selection``).
+split-phase overlap, temporal blocking, autotune, snapshots, and the
+fused Pallas kernel itself — is model-generic: ``ops/kernelgen``
+trace-inlines the model's pure reaction into the slab pipeline, and
+Pallas eligibility is a feasibility check on the reaction's jaxpr
+(``kernelgen.generation_gate_reason``, recorded as the ``kernel_gate``
+provenance in ``kernel_selection``), not a model-name gate.
 
 Distribution: with >1 device of the selected platform, fields are sharded
 ``P('x','y','z')`` over a 3D ``jax.sharding.Mesh`` (the ``MPI.Cart_create``
@@ -470,11 +472,22 @@ class Simulation:
         # construction, not at first iterate (the reference defers all
         # dispatch errors to runtime fallbacks, public.jl:31-32, 77-78).
         validate_kernel_language(self.kernel_language)
-        if self.kernel_language == "pallas" and not self.model.pallas_capable:
+        from .ops import kernelgen
+
+        #: Why the kernel generator cannot lower this model's reaction
+        #: into the fused Pallas kernel, or None when it can
+        #: (docs/KERNELGEN.md). ONE statement of the model-side gate:
+        #: explicit-Pallas validation, the Auto branch, and the
+        #: autotuner shortlist below all consult this same reason.
+        self._kernel_gate_reason = kernelgen.generation_gate_reason(
+            self.model
+        )
+        if (self.kernel_language == "pallas"
+                and self._kernel_gate_reason is not None):
             raise ValueError(
-                f"kernel_language = 'Pallas' is implemented for the "
-                f"Gray-Scott reaction only; model {self.model.name!r} "
-                f"must run the XLA path (use 'Plain'/'XLA' or 'Auto')"
+                f"kernel_language = 'Pallas' cannot be generated for "
+                f"model {self.model.name!r}: {self._kernel_gate_reason} "
+                f"(use 'Plain'/'XLA' or 'Auto')"
             )
         self.dtype = config.resolve_precision(settings)
         self._base_dtype = self.dtype
@@ -595,22 +608,24 @@ class Simulation:
             except Exception:
                 kind = ""
             mesh_forced = bool(env_str("GS_TPU_MESH_DIMS", ""))
-            if not self.model.pallas_capable:
-                # Pallas gate (docs/MODELS.md): the hand-fused kernel
-                # implements the Gray-Scott reaction only, so Auto
-                # resolves straight to XLA for every other model — an
-                # EXPLICIT decision recorded in the provenance, and the
+            if self._kernel_gate_reason is not None:
+                # Generator feasibility gate (docs/KERNELGEN.md): the
+                # fused kernel is generated from the model's reaction,
+                # so Auto resolves to XLA only when generation is
+                # infeasible — an EXPLICIT decision recorded in the
+                # provenance with the generator's reason, and the
                 # autotuner below searches XLA candidates only.
                 self.kernel_language = "xla"
                 self.kernel_selection = {
                     "reason": (
-                        f"model '{self.model.name}' is not "
-                        "Pallas-capable (Gray-Scott-only kernel); "
-                        "XLA path"
+                        f"no Pallas kernel can be generated for model "
+                        f"'{self.model.name}' "
+                        f"({self._kernel_gate_reason}); XLA path"
                     ),
-                    "pallas_gate": {
+                    "kernel_gate": {
                         "model": self.model.name,
-                        "pallas_capable": False,
+                        "generated": False,
+                        "reason": self._kernel_gate_reason,
                     },
                 }
             else:
@@ -620,6 +635,7 @@ class Simulation:
                         device_kind=kind,
                         itemsize=np.dtype(self.dtype).itemsize,
                         fuse=default_fuse(),
+                        n_fields=self.model.n_fields,
                         sweep_mesh=self.sharded and not mesh_forced,
                         # Auto's pick must reflect the comm this run
                         # will actually expose: the calibrated overlap
@@ -684,7 +700,14 @@ class Simulation:
                 # kernels can actually run.
                 model=self.model.name,
                 n_fields=self.model.n_fields,
-                pallas_allowed=self.model.pallas_capable,
+                pallas_allowed=(self._kernel_gate_reason is None),
+                # Generator-contract version (schema v7): winners are
+                # measured against THIS generator's kernels; 0 when no
+                # Pallas kernel can be generated (XLA-only shortlist).
+                kernel_generator=(
+                    kernelgen.GENERATOR_VERSION
+                    if self._kernel_gate_reason is None else 0
+                ),
                 # A pinned s-step depth joins the tuning-cache key and
                 # is respected, not searched; "auto" (0) lets the
                 # tuner widen the shortlist across k.
@@ -771,6 +794,15 @@ class Simulation:
             self.kernel_selection["snapshot_codec"] = (
                 self.snapshot_codec.posture()
             )
+            if self.kernel_language == "pallas":
+                # Generated-kernel provenance (docs/KERNELGEN.md): a
+                # resolved Pallas pick is a generator product, and
+                # artifacts must be able to tell generator eras apart
+                # (gs_report --check validates these attrs).
+                self.kernel_selection["generated"] = True
+                self.kernel_selection["generator_version"] = (
+                    kernelgen.GENERATOR_VERSION
+                )
         if self.kernel_language == "pallas" and self.halo_depth > 1:
             # The Pallas in-kernel chains have no s-step schedule (the
             # fused chain IS their exchange amortization, and its depth
@@ -1070,12 +1102,14 @@ class Simulation:
             return fields
 
         if self.kernel_language == "pallas":
-            # The hand-fused kernel is the Gray-Scott model's own
-            # (models/grayscott.py declares pallas_capable); the gate in
-            # __init__ guarantees a two-field (u, v) state here.
-            from .ops import pallas_stencil
+            # The fused kernel is GENERATED from the model declaration
+            # (ops/kernelgen): the gate in __init__ guarantees the
+            # model's reaction trace-inlines, and the spec is the jit
+            # static argument every launch below shares.
+            from .ops import kernelgen, pallas_stencil
 
-            u, v = fields
+            spec = kernelgen.get_spec(model)
+            n_f = spec.n_fields
 
             def step_seeds(step_idx):
                 return jnp.stack(
@@ -1090,10 +1124,11 @@ class Simulation:
             # fallback is the same elementwise program, bitwise.
             allow_interpret = not sharded and not self.is_ensemble
 
-            def kernel_step(u, v, step_idx, faces):
+            def kernel_step(fields_k, step_idx, faces):
                 return pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step_idx), faces,
-                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    fields_k, params, step_seeds(step_idx), faces,
+                    spec=spec, use_noise=use_noise,
+                    allow_interpret=allow_interpret,
                     fuse=1, offsets=offs, row=L,
                 )
 
@@ -1126,6 +1161,7 @@ class Simulation:
                     mid_itemsize=pallas_stencil.mid_itemsize_for(
                         self.dtype
                     ),
+                    n_fields=n_f,
                 )
                 if feasible < fuse:
                     capped = max(feasible, 1)
@@ -1137,16 +1173,17 @@ class Simulation:
                     fuse = capped
 
                 def chain(fields_c, step, depth):
-                    u, v = fields_c
                     if depth == 1:
-                        faces12 = halo.exchange_faces(
-                            (u, v), boundaries, AXIS_NAMES, dims
+                        faces_full = halo.exchange_faces(
+                            fields_c, boundaries, AXIS_NAMES, dims
                         )
-                        return pin_block(kernel_step(u, v, step, faces12))
+                        return pin_block(
+                            kernel_step(fields_c, step, faces_full)
+                        )
                     pairs = halo.exchange_x_slabs(
-                        (u, v), boundaries, AXIS_NAMES[0], dims[0], depth
+                        fields_c, boundaries, AXIS_NAMES[0], dims[0], depth
                     )
-                    if overlap_on and u.shape[0] >= 2 * depth:
+                    if overlap_on and fields_c[0].shape[0] >= 2 * depth:
                         # Split-phase round (docs/OVERLAP.md): the same
                         # 2-ppermute slab exchange is issued first, but
                         # the kernel chains on frozen-constant x faces
@@ -1164,54 +1201,59 @@ class Simulation:
                         # round below.
                         self.overlap_applied = True
                         k = depth
-                        nx = u.shape[0]
-                        faces4 = tuple(
+                        nx = fields_c[0].shape[0]
+                        faces_z = tuple(
                             f for fs in halo.frozen_slabs(
-                                (u, v), boundaries, 0, k
+                                fields_c, boundaries, 0, k
                             ) for f in fs
                         )
-                        u_i, v_i = pallas_stencil.fused_step(
-                            u, v, params, step_seeds(step), faces4,
-                            use_noise=use_noise,
+                        interior = list(pallas_stencil.fused_step(
+                            fields_c, params, step_seeds(step), faces_z,
+                            spec=spec, use_noise=use_noise,
                             allow_interpret=allow_interpret,
                             fuse=k, offsets=offs, row=L,
-                        )
-                        (u_lo, u_hi), (v_lo, v_hi) = pairs
+                        ))
+                        # Band faces stay field-major (lo, hi): the low
+                        # band reads the arrived lo slab and the owned
+                        # planes above it, the high band mirrors that.
                         jobs = (
-                            ((u[:k], v[:k]),
-                             (u_lo, u[k:2 * k], v_lo, v[k:2 * k]),
+                            (tuple(f[:k] for f in fields_c),
+                             tuple(x for f, (lo, _hi) in zip(fields_c,
+                                                             pairs)
+                                   for x in (lo, f[k:2 * k])),
                              0),
-                            ((u[nx - k:], v[nx - k:]),
-                             (u[nx - 2 * k:nx - k], u_hi,
-                              v[nx - 2 * k:nx - k], v_hi),
+                            (tuple(f[nx - k:] for f in fields_c),
+                             tuple(x for f, (_lo, hi) in zip(fields_c,
+                                                             pairs)
+                                   for x in (f[nx - 2 * k:nx - k], hi)),
                              nx - k),
                         )
-                        for (b_u, b_v), faces_b, d_x in jobs:
-                            bu, bv_ = pallas_stencil._xla_xchain_fallback(
-                                b_u, b_v, params, step_seeds(step),
-                                faces_b, fuse=k, use_noise=use_noise,
+                        for body_f, faces_b, d_x in jobs:
+                            band = pallas_stencil._xla_xchain_fallback(
+                                body_f, params, step_seeds(step),
+                                faces_b, spec=spec, fuse=k,
+                                use_noise=use_noise,
                                 offsets=jnp.stack([
                                     offs[0] + d_x, offs[1], offs[2],
                                 ]),
                                 row=L,
                             )
-                            u_i = lax.dynamic_update_slice(
-                                u_i, bu, (d_x, 0, 0)
-                            )
-                            v_i = lax.dynamic_update_slice(
-                                v_i, bv_, (d_x, 0, 0)
-                            )
-                        return pin_block((u_i, v_i))
-                    faces4 = (pairs[0][0], pairs[0][1],
-                              pairs[1][0], pairs[1][1])
+                            interior = [
+                                lax.dynamic_update_slice(
+                                    fi, bi, (d_x, 0, 0)
+                                )
+                                for fi, bi in zip(interior, band)
+                            ]
+                        return pin_block(tuple(interior))
+                    faces_x = tuple(f for pr in pairs for f in pr)
                     return pin_block(pallas_stencil.fused_step(
-                        u, v, params, step_seeds(step), faces4,
-                        use_noise=use_noise,
+                        fields_c, params, step_seeds(step), faces_x,
+                        spec=spec, use_noise=use_noise,
                         allow_interpret=allow_interpret,
                         fuse=depth, offsets=offs, row=L,
                     ))
 
-                return run_chain_rounds(chain, fuse, (u, v))
+                return run_chain_rounds(chain, fuse, fields)
 
             if sharded:
                 # xy-chain (+ z-band correction when z is sharded): the
@@ -1236,6 +1278,7 @@ class Simulation:
                     mid_itemsize=pallas_stencil.mid_itemsize_for(
                         self.dtype
                     ),
+                    n_fields=n_f,
                 )
                 if feasible < fuse:
                     pallas_stencil._warn_once(
@@ -1246,28 +1289,29 @@ class Simulation:
                     fuse = max(feasible, 1)
 
                 def chain(fields_c, step, depth):
-                    u, v = fields_c
                     if depth == 1:
-                        faces12 = halo.exchange_faces(
-                            (u, v), boundaries, AXIS_NAMES, dims
+                        faces_full = halo.exchange_faces(
+                            fields_c, boundaries, AXIS_NAMES, dims
                         )
-                        return pin_block(kernel_step(u, v, step, faces12))
+                        return pin_block(
+                            kernel_step(fields_c, step, faces_full)
+                        )
 
-                    def chain_kernel(u_p, v_p, faces4, stp, offs_p):
+                    def chain_kernel(fields_p, faces_p, stp, offs_p):
                         return pallas_stencil.fused_step(
-                            u_p, v_p, params, step_seeds(stp), faces4,
-                            use_noise=use_noise,
+                            fields_p, params, step_seeds(stp), faces_p,
+                            spec=spec, use_noise=use_noise,
                             allow_interpret=allow_interpret,
                             fuse=depth, offsets=offs_p, row=L,
                         )
 
-                    def band_kernel(u_b, v_b, faces_b, stp, offs_b):
+                    def band_kernel(fields_b, faces_b, stp, offs_b):
                         # The x-chain XLA reference — the SAME program
                         # structure as the fused kernel's own fallback,
                         # which keeps recomputed bands bitwise equal.
                         return pallas_stencil._xla_xchain_fallback(
-                            u_b, v_b, params, step_seeds(stp), faces_b,
-                            fuse=depth, use_noise=use_noise,
+                            fields_b, params, step_seeds(stp), faces_b,
+                            spec=spec, fuse=depth, use_noise=use_noise,
                             offsets=offs_b, row=L,
                         )
 
@@ -1277,7 +1321,7 @@ class Simulation:
                     if ov:
                         self.overlap_applied = True
                     return pin_block(temporal.xy_chain(
-                        u, v, params, model, depth=depth, step=step,
+                        fields_c, params, model, depth=depth, step=step,
                         offs=offs, chain_kernel=chain_kernel,
                         use_noise=use_noise, unit_noise=unit_noise,
                         row=L, axis_names=AXIS_NAMES, axis_sizes=dims,
@@ -1285,7 +1329,7 @@ class Simulation:
                         overlap=ov, band_kernel=band_kernel,
                     ))
 
-                return run_chain_rounds(chain, fuse, (u, v))
+                return run_chain_rounds(chain, fuse, fields)
 
             # Single block: in-kernel temporal blocking (``fuse`` steps
             # per HBM pass — the slab pipeline is DMA-envelope-bound on
@@ -1295,22 +1339,23 @@ class Simulation:
             fuse = min(self._fuse_base(), max(nsteps, 1))
 
             def body(i, carry):
-                u, v = carry
                 return pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step0 + fuse * i), None,
-                    use_noise=use_noise, allow_interpret=allow_interpret,
+                    carry, params, step_seeds(step0 + fuse * i), None,
+                    spec=spec, use_noise=use_noise,
+                    allow_interpret=allow_interpret,
                     fuse=fuse, offsets=offs, row=L,
                 )
 
-            pairs, rem = divmod(nsteps, fuse)
-            u, v = lax.fori_loop(0, pairs, body, (u, v))
+            rounds, rem = divmod(nsteps, fuse)
+            fields = lax.fori_loop(0, rounds, body, fields)
             if rem:
-                u, v = pallas_stencil.fused_step(
-                    u, v, params, step_seeds(step0 + fuse * pairs), None,
-                    use_noise=use_noise, allow_interpret=allow_interpret,
+                fields = pallas_stencil.fused_step(
+                    fields, params, step_seeds(step0 + fuse * rounds),
+                    None, spec=spec, use_noise=use_noise,
+                    allow_interpret=allow_interpret,
                     fuse=rem, offsets=offs, row=L,
                 )
-            return (u, v)
+            return tuple(fields)
 
         # ---- XLA kernel path ----
 

@@ -170,6 +170,7 @@ def autotune(
     procs: int = 1,
     compute_precision: str = "f32",
     snapshot_codec: str = "off",
+    kernel_generator: int = 0,
 ) -> TuneDecision:
     """Resolve the measured schedule for one run config.
 
@@ -192,6 +193,7 @@ def autotune(
     mode = resolve_mode(settings)
     gate = {"model": model, "n_fields": n_fields,
             "pallas_allowed": bool(pallas_allowed),
+            "kernel_generator": int(kernel_generator),
             "halo_depth_pin": int(halo_depth),
             "compute_precision": compute_precision,
             "snapshot_codec": snapshot_codec}
@@ -208,6 +210,7 @@ def autotune(
         halo_depth=halo_depth, member_shards=member_shards,
         procs=procs, compute_precision=compute_precision,
         snapshot_codec=snapshot_codec,
+        kernel_generator=kernel_generator,
     )
     rec = cache.load(key)
     if rec is not None:
@@ -249,7 +252,7 @@ def autotune(
         bx_variants=2 if mode == "full" else 0,
         ensemble=ensemble, member_shards=member_shards,
         pallas_allowed=pallas_allowed, halo_depth=halo_depth,
-        compute_precision=compute_precision,
+        compute_precision=compute_precision, n_fields=n_fields,
     )
     steps = env_int("GS_AUTOTUNE_STEPS", 20)
     rounds = env_int("GS_AUTOTUNE_ROUNDS",

@@ -55,8 +55,16 @@ from ..config.env import env_str
 #: precision candidate axis, so its measured space is wider), and a
 #: lossy-output run's boundary program differs from an exact run's;
 #: stale v5 entries are structurally invisible and degrade to the
-#: warned analytic pick like any other miss.
-SCHEMA_VERSION = 6
+#: warned analytic pick like any other miss. v7: the key grew
+#: ``kernel_generator`` — the version of the kernel-generator contract
+#: (``ops/kernelgen.GENERATOR_VERSION``) whose generated Pallas
+#: kernels the shortlist measured: a generator bump may change the
+#: generated program (operation order, noise association, mid-stage
+#: rounding), so winners measured against one generator's kernels must
+#: never be adopted by another's; stale v6 entries are structurally
+#: invisible and degrade to the warned analytic pick like any other
+#: miss.
+SCHEMA_VERSION = 7
 
 
 def cache_dir() -> str:
@@ -86,6 +94,7 @@ def cache_key(
     procs: int = 1,
     compute_precision: str = "f32",
     snapshot_codec: str = "off",
+    kernel_generator: int = 0,
 ) -> dict:
     """The canonical tuning key. Every field participates in the
     digest; adding a field is a schema bump (old digests stop
@@ -104,7 +113,10 @@ def cache_key(
     transfer across placements. ``compute_precision``/
     ``snapshot_codec`` (schema v6, docs/PRECISION.md) are the
     mixed-precision and lossy-output postures: a bf16-measured winner
-    can never be adopted by an f32 run."""
+    can never be adopted by an f32 run. ``kernel_generator`` (schema
+    v7, docs/KERNELGEN.md) is the generator-contract version whose
+    generated Pallas kernels were measured (0 = Pallas infeasible for
+    this model, XLA-only shortlist)."""
     return {
         "schema": SCHEMA_VERSION,
         "device_kind": str(device_kind or ""),
@@ -122,6 +134,7 @@ def cache_key(
         "procs": int(procs),
         "compute_precision": str(compute_precision),
         "snapshot_codec": str(snapshot_codec),
+        "kernel_generator": int(kernel_generator),
     }
 
 

@@ -81,7 +81,8 @@ def from_dict(d: dict) -> Candidate:
     return Candidate(**out)
 
 
-def _pallas_depths(local, itemsize: int, dims, kmax: int) -> List[int]:
+def _pallas_depths(local, itemsize: int, dims, kmax: int,
+                   n_fields: int = 2) -> List[int]:
     """Chain depths the Mosaic gates admit for this block on this mesh
     — mirrors the caps the runner itself applies (``simulation.py``
     x-chain / xy-chain dispatch), restricted to depths the cost model
@@ -94,11 +95,13 @@ def _pallas_depths(local, itemsize: int, dims, kmax: int) -> List[int]:
     sharded = n * m * p > 1
     if not sharded:
         cap = ps.max_feasible_fuse(*local, itemsize,
-                                   max(icimodel.FUSE_COST_RATIO))
+                                   max(icimodel.FUSE_COST_RATIO),
+                                   n_fields=n_fields)
         lo = 1
     elif m == 1 and p == 1:
         cap = min(kmax, local[0])
-        cap = ps.max_feasible_fuse(*local, itemsize, max(cap, 1))
+        cap = ps.max_feasible_fuse(*local, itemsize, max(cap, 1),
+                                   n_fields=n_fields)
         lo = 2
     else:
         cap = min(kmax, local[0], local[1])
@@ -106,7 +109,7 @@ def _pallas_depths(local, itemsize: int, dims, kmax: int) -> List[int]:
             cap = min(cap, local[2] // 2)
         sublane = 16 if itemsize == 2 else 8
         cap = ps.max_feasible_fuse_ypad(*local, itemsize, max(cap, 1),
-                                        sublane)
+                                        sublane, n_fields=n_fields)
         lo = 2
     return [k for k in sorted(icimodel.FUSE_COST_RATIO)
             if lo <= k <= cap]
@@ -141,6 +144,7 @@ def generate(
     pallas_allowed: bool = True,
     halo_depth: int = 0,
     compute_precision: str = "f32",
+    n_fields: int = 2,
 ) -> List[Candidate]:
     """The ranked measurement shortlist for one run config.
 
@@ -203,13 +207,16 @@ def generate(
     def _langs(cp: str) -> dict:
         out = {"xla": _xla_depths(local, dims, fuse_cap)}
         if platform == "tpu" and pallas_allowed:
-            # pallas_allowed is the model gate: the hand-fused kernel
-            # implements Gray-Scott only (Model.pallas_capable), so
-            # the tuner must never time — or cache a winner for — a
-            # Pallas schedule another model cannot run. Feasibility is
+            # pallas_allowed is the generator-feasibility gate
+            # (``kernelgen.generation_gate_reason``): the fused kernel
+            # is generated from the model's reaction, and the tuner
+            # must never time — or cache a winner for — a Pallas
+            # schedule the generator refuses to build. Feasibility is
             # re-gated per precision: bf16 halves the slab bytes and
-            # can admit deeper chains.
-            depths = _pallas_depths(local, _isz(cp), dims, fuse_cap)
+            # can admit deeper chains; ``n_fields`` scales the slab
+            # bytes the VMEM gates price.
+            depths = _pallas_depths(local, _isz(cp), dims, fuse_cap,
+                                    n_fields=n_fields)
             if depths:
                 out["pallas"] = depths
         return out
@@ -219,7 +226,7 @@ def generate(
             kernel, dims, L, fuse, itemsize=_isz(cp), links=links,
             link_gbps=link_gbps, local=local,
             overlap="auto" if ov else 0.0, halo_depth=sk,
-            compute_precision=cp,
+            compute_precision=cp, n_fields=n_fields,
         )
         if us is not None and ensemble > 1:
             # Rank ensembles by the batch each device group carries so
@@ -333,8 +340,10 @@ def generate(
                 mid_itemsize=ps.mid_itemsize_for("float32"
                                                  if itemsize == 4
                                                  else "bfloat16"),
+                n_fields=n_fields,
             )
-            auto = ps.pick_block_planes(*local, itemsize, c.fuse)
+            auto = ps.pick_block_planes(*local, itemsize, c.fuse,
+                                        n_fields=n_fields)
             for bx in [b for b in opts if b != auto][:bx_variants]:
                 extra.append(dataclasses.replace(
                     c, bx=bx, analytic=False))

@@ -236,6 +236,14 @@ def bench_one(
         # artifacts keep a uniform schema with sharded runs.
         "comm": icimodel.comm_report(sim),
     }
+    if sim.kernel_language == "pallas":
+        # Generated-kernel provenance (docs/KERNELGEN.md): every Pallas
+        # measurement row names the generator contract that built its
+        # kernel, so A/B artifacts can tell generator eras apart.
+        from ..ops import kernelgen
+
+        out["generated"] = True
+        out["generator_version"] = kernelgen.GENERATOR_VERSION
     if sim.kernel_selection is not None:
         # Auto-dispatch runs (GS_BENCH_KERNEL=Auto) carry the tuner
         # provenance (RunStats `kernel_selection.autotune` mirror):

@@ -39,6 +39,10 @@ if [[ "${1:-}" != "--fast" ]]; then
     # hold on every push (docs/RESILIENCE.md "Data integrity").
     # test_precision_run rides along too: the codec's byte-identity
     # and drift-gate recovery contracts (docs/PRECISION.md).
+    # tests/unit includes test_kernelgen.py — the interpret-mode
+    # generated-kernel equality contracts (GS bitwise vs the hand
+    # kernel's golden, every model vs its XLA trajectory at the
+    # documented tolerance; docs/KERNELGEN.md) hold on every push.
     JAX_PLATFORMS=cpu python -m pytest tests/unit \
         tests/functional/test_integrity_run.py \
         tests/functional/test_precision_run.py -q -m 'not slow' \

@@ -187,6 +187,29 @@ def check(trace_path, events_path, stats_path,
         except (OSError, json.JSONDecodeError) as e:
             problems.append(f"stats {stats_path}: unreadable ({e})")
         else:
+            cfg = (stats.get("config")
+                   if isinstance(stats, dict) else None)
+            if isinstance(cfg, dict):
+                sel = cfg.get("kernel_selection")
+                if (cfg.get("kernel_language") == "pallas"
+                        and isinstance(sel, dict)):
+                    # Generated-kernel provenance (docs/KERNELGEN.md):
+                    # a resolved Pallas pick is a generator product,
+                    # and the artifact must say which generator
+                    # contract built it — hand-written-era records
+                    # carry neither attr and predate this check.
+                    if sel.get("generated") is not True:
+                        problems.append(
+                            f"stats {stats_path}: kernel_selection of "
+                            f"a Pallas run must record generated=true"
+                        )
+                    if not isinstance(sel.get("generator_version"),
+                                      int):
+                        problems.append(
+                            f"stats {stats_path}: kernel_selection of "
+                            f"a Pallas run must record an integer "
+                            f"generator_version"
+                        )
             comm = stats.get("comm") if isinstance(stats, dict) else None
             if isinstance(comm, dict):
                 # The s-step visibility fields (docs/TEMPORAL.md) are

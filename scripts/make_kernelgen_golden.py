@@ -1,0 +1,170 @@
+"""Regenerate the kernel-generator goldens (docs/KERNELGEN.md).
+
+Two committed artifacts back the generator's correctness contract in
+tier-1 (``tests/unit/test_kernelgen.py``):
+
+* ``tests/golden/pallas_hand_kernel.npz`` — exact field bytes of the
+  HAND-WRITTEN Gray-Scott Pallas kernel over seven refactor-sensitive
+  configs (single-block fuse=1/3, full 12-face mode, x-chain, xy-chain
+  operand, GS_MID_BF16 mids, bf16 storage posture). The committed file
+  was captured at the last pre-generator commit with the old kernel;
+  the generated kernel must replay it BITWISE. Re-running this script
+  regenerates it **through the generated kernel** — only do that when
+  the kernel program is intentionally changed (and say so in the PR),
+  because it re-anchors the identity gate to the current code.
+* ``tests/golden/model_trajectories.npz`` — 10-step XLA (``Plain``)
+  trajectories for every non-flagship model at L=16, the reference the
+  generated kernels must match at the documented tolerance
+  (docs/KERNELGEN.md "Equality fine print").
+
+Run from the repo root::
+
+    JAX_PLATFORMS=cpu python scripts/make_kernelgen_golden.py
+"""
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from grayscott_jl_tpu.config.settings import Settings  # noqa: E402
+from grayscott_jl_tpu.models import get_model, grayscott  # noqa: E402
+from grayscott_jl_tpu.ops import kernelgen, pallas_stencil  # noqa: E402
+from grayscott_jl_tpu.simulation import Simulation  # noqa: E402
+
+OUT = ROOT / "tests" / "golden"
+
+GS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+
+def _params(noise, dtype=jnp.float32):
+    s = Settings(L=16, noise=noise, precision="Float32", backend="CPU",
+                 kernel_language="Pallas", **GS)
+    return grayscott.Params.from_settings(s, dtype)
+
+
+def _rand(shape, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random(shape), jnp.float32)
+
+
+def capture_kernel_configs() -> None:
+    """The seven bitwise-gate configs, through the generated kernel."""
+    spec = kernelgen.get_spec(grayscott.MODEL)
+    step = pallas_stencil.fused_step
+    arrays = {}
+
+    # 1. single-block, fuse=1, noise on, seeded GS init (flagship)
+    u, v = grayscott.init_fields(16, jnp.float32)
+    seeds = jnp.asarray([123, 456, 7], jnp.int32)
+    for i in range(4):
+        u, v = step((u, v), _params(0.1), seeds.at[2].add(i),
+                    spec=spec, use_noise=True)
+    arrays["single_f1_u"], arrays["single_f1_v"] = (np.asarray(u),
+                                                    np.asarray(v))
+
+    # 2. single-block temporal chain fuse=3, random fields
+    u, v = _rand((16, 16, 16), 1), _rand((16, 16, 16), 2)
+    u3, v3 = step((u, v), _params(0.25),
+                  jnp.asarray([9, 17, 5], jnp.int32),
+                  spec=spec, use_noise=True, fuse=3)
+    arrays["single_f3_u"], arrays["single_f3_v"] = (np.asarray(u3),
+                                                    np.asarray(v3))
+
+    # 3. full-faces mode (6n-tuple: axis-major, field-major lo/hi)
+    L = 16
+    u, v = _rand((L, L, L), 3), _rand((L, L, L), 4)
+    shapes = [(1, L, L)] * 4 + [(L, 1, L)] * 4 + [(L, L, 1)] * 4
+    faces = tuple(_rand(s, 10 + i) for i, s in enumerate(shapes))
+    uf, vf = step((u, v), _params(0.1),
+                  jnp.asarray([3, 1, 9], jnp.int32), faces,
+                  spec=spec, use_noise=True)
+    arrays["faces12_u"], arrays["faces12_v"] = (np.asarray(uf),
+                                                np.asarray(vf))
+
+    # 4. x-chain mode (2n-tuple fuse-wide x faces), k=2, interior shard
+    nx, ny, nz, k = 16, 8, 128, 2
+    u, v = _rand((nx, ny, nz), 5), _rand((nx, ny, nz), 6)
+    xfaces = tuple(_rand((k, ny, nz), 30 + i) for i in range(4))
+    ux, vx = step((u, v), _params(0.2),
+                  jnp.asarray([3, 5, 11], jnp.int32), xfaces,
+                  spec=spec, use_noise=True, fuse=k,
+                  offsets=jnp.asarray([16, 0, 0], jnp.int32),
+                  row=jnp.int32(64))
+    arrays["xchain_u"], arrays["xchain_v"] = (np.asarray(ux),
+                                              np.asarray(vx))
+
+    # 5. xy-chain operand (y-extended block, global-y pinning), k=2
+    nx, nz, k = 16, 128, 2
+    ny = 8 + 2 * k + 4  # + filler to sublane 16
+    u, v = _rand((nx, ny, nz), 7), _rand((nx, ny, nz), 8)
+    yfaces = tuple(_rand((k, ny, nz), 40 + i) for i in range(4))
+    uy, vy = step((u, v), _params(0.2),
+                  jnp.asarray([3, 5, 11], jnp.int32), yfaces,
+                  spec=spec, use_noise=True, fuse=k,
+                  offsets=jnp.asarray([16, 8 - k, 0], jnp.int32),
+                  row=jnp.int32(64))
+    arrays["xychain_u"], arrays["xychain_v"] = (np.asarray(uy),
+                                                np.asarray(vy))
+
+    # 6. bf16 mid-stage buffers (GS_MID_BF16=1), fuse=3
+    os.environ["GS_MID_BF16"] = "1"
+    try:
+        u, v = _rand((16, 16, 16), 1), _rand((16, 16, 16), 2)
+        ub, vb = step((u, v), _params(0.1),
+                      jnp.asarray([1, 2, 3], jnp.int32),
+                      spec=spec, use_noise=True, fuse=3)
+    finally:
+        os.environ.pop("GS_MID_BF16")
+    arrays["midbf16_u"], arrays["midbf16_v"] = (np.asarray(ub),
+                                                np.asarray(vb))
+
+    # 7. bf16 storage posture (bf16 fields, f32 accumulation), fuse=2
+    ub16 = _rand((16, 16, 16), 1).astype(jnp.bfloat16)
+    vb16 = _rand((16, 16, 16), 2).astype(jnp.bfloat16)
+    u2, v2 = step((ub16, vb16), _params(0.1, jnp.bfloat16),
+                  jnp.asarray([4, 5, 6], jnp.int32),
+                  spec=spec, use_noise=True, fuse=2)
+    arrays["bf16_f2_u"] = np.asarray(u2.astype(jnp.float32))
+    arrays["bf16_f2_v"] = np.asarray(v2.astype(jnp.float32))
+
+    np.savez(OUT / "pallas_hand_kernel.npz", **arrays)
+    for name, a in sorted(arrays.items()):
+        print(f"{name}: shape={a.shape} sum={float(a.sum()):.6f}")
+    print(f"wrote {OUT / 'pallas_hand_kernel.npz'}")
+
+
+def capture_model_trajectories() -> None:
+    """10-step XLA reference trajectories for the non-flagship models
+    (Gray-Scott's XLA reference lives in grayscott_trajectories.npz,
+    scripts/make_golden.py)."""
+    arrays = {}
+    for model in ("brusselator", "fhn", "heat"):
+        s = Settings(L=16, noise=0.1, dt=0.05, precision="Float32",
+                     backend="CPU", kernel_language="Plain")
+        s.model = model
+        sim = Simulation(s, n_devices=1, seed=7)
+        sim.iterate(10)
+        for name, f in zip(get_model(model).field_names,
+                           sim.get_fields()):
+            arrays[f"{model}_{name}"] = np.asarray(f)
+            print(f"{model}.{name}: sum={float(np.asarray(f).sum()):.6f}")
+    np.savez(OUT / "model_trajectories.npz", **arrays)
+    print(f"wrote {OUT / 'model_trajectories.npz'}")
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    capture_kernel_configs()
+    capture_model_trajectories()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ import jax
 from test_end_to_end import run_cli, write_config
 
 from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.ops import kernelgen
 from grayscott_jl_tpu.tune import cache as tune_cache
 
 REPO = Path(__file__).resolve().parents[2]
@@ -104,6 +105,9 @@ def test_supervised_restart_records_pick_identically(tmp_path):
     key = tune_cache.cache_key(
         device_kind=kind, platform="cpu", dims=(2, 2, 2), L=32,
         dtype="float32", noise=0.1, jax_version=jax.__version__,
+        # the CLI's resolved key carries the generator contract
+        # (schema v7); the fixture must match it to be a hit
+        kernel_generator=kernelgen.GENERATOR_VERSION,
     )
     tune_cache.store(key, {
         "winner": {"kernel": "xla", "fuse": 2, "comm_overlap": True,

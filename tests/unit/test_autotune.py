@@ -25,6 +25,7 @@ import pytest
 import jax
 
 from grayscott_jl_tpu.config.settings import Settings, resolve_autotune
+from grayscott_jl_tpu.ops import kernelgen
 from grayscott_jl_tpu.parallel import icimodel
 from grayscott_jl_tpu.simulation import Simulation
 from grayscott_jl_tpu.tune import autotuner, cache, candidates, measure
@@ -390,6 +391,9 @@ def test_cache_fixture_hit_applies_winner_and_is_restart_stable(
     key = cache.cache_key(
         device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
         dtype="float32", noise=s.noise, jax_version=jax.__version__,
+        # a Simulation-resolved key carries the generator contract the
+        # run's Pallas kernels would come from (schema v7)
+        kernel_generator=kernelgen.GENERATOR_VERSION,
     )
     # the analytic config on this mesh: xla, depth 2 (CPU default),
     # split-phase on (sharded default)
@@ -424,6 +428,9 @@ def test_cache_hit_overrides_toward_measured_winner(monkeypatch):
     key = cache.cache_key(
         device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
         dtype="float32", noise=s.noise, jax_version=jax.__version__,
+        # a Simulation-resolved key carries the generator contract the
+        # run's Pallas kernels would come from (schema v7)
+        kernel_generator=kernelgen.GENERATOR_VERSION,
     )
     cache.store(key, {"winner": _winner(fuse=1, comm_overlap=False)})
     monkeypatch.setenv("GS_AUTOTUNE", "cached")
@@ -443,6 +450,9 @@ def test_operator_pins_beat_the_cache(monkeypatch):
     key = cache.cache_key(
         device_kind=kind, platform="cpu", dims=(2, 2, 2), L=s.L,
         dtype="float32", noise=s.noise, jax_version=jax.__version__,
+        # a Simulation-resolved key carries the generator contract the
+        # run's Pallas kernels would come from (schema v7)
+        kernel_generator=kernelgen.GENERATOR_VERSION,
     )
     cache.store(key, {"winner": _winner(fuse=1, comm_overlap=False)})
     monkeypatch.setenv("GS_AUTOTUNE", "cached")

@@ -365,7 +365,7 @@ def test_cache_key_v4_carries_halo_depth(tmp_path):
         dtype="float32", noise=0.1, jax_version=jax.__version__,
         halo_depth=2,
     )
-    assert key["schema"] == cache.SCHEMA_VERSION == 6
+    assert key["schema"] == cache.SCHEMA_VERSION == 7
     assert key["halo_depth"] == 2
     auto = cache.cache_key(
         device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=16,

@@ -1,0 +1,293 @@
+"""The Mosaic kernel generator (ops/kernelgen.py, docs/KERNELGEN.md).
+
+Contracts asserted here:
+
+* **Hand-kernel identity** — the generated Gray-Scott kernel replays
+  the hand-written kernel it replaced BITWISE over seven
+  refactor-sensitive configs (``tests/golden/pallas_hand_kernel.npz``,
+  captured from the last hand-written build;
+  ``scripts/make_kernelgen_golden.py`` re-anchors it).
+* **Per-model equality** — every non-flagship model's generated kernel
+  (interpret mode on CPU) matches its committed XLA trajectory at the
+  tolerance documented in docs/KERNELGEN.md "Equality fine print"
+  (Gray-Scott's Pallas-vs-XLA coverage lives in test_pallas.py).
+* **Feasibility gate** — ``generation_gate_reason`` passes every
+  built-in model and refuses non-inlinable reactions LOUDLY at every
+  level: explicit Pallas errors at construction, Auto degrades to XLA
+  with ``kernel_selection.kernel_gate`` provenance, and the autotuner
+  shortlist prunes Pallas candidates.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.models import base as model_base
+from grayscott_jl_tpu.models import get_model, grayscott
+from grayscott_jl_tpu.ops import kernelgen, pallas_stencil
+from grayscott_jl_tpu.simulation import Simulation
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+GOLDEN = REPO / "tests" / "golden"
+
+GS_PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+ALL_MODELS = ("grayscott", "brusselator", "fhn", "heat")
+
+SPEC = kernelgen.get_spec(grayscott.MODEL)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _params(noise, dtype=jnp.float32):
+    s = Settings(L=16, noise=noise, precision="Float32", backend="CPU",
+                 kernel_language="Pallas", **GS_PARAMS)
+    return grayscott.Params.from_settings(s, dtype)
+
+
+def _rand(shape, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random(shape), jnp.float32)
+
+
+def _model_settings(model, lang, L=16, noise=0.1):
+    s = Settings(L=L, noise=noise, dt=0.05, precision="Float32",
+                 backend="CPU", kernel_language=lang)
+    s.model = model
+    return s
+
+
+# -------------------------------------------- hand-kernel bitwise gate
+
+def _hand_kernel_config(name, monkeypatch):
+    """Recompute one golden config through the generated kernel. The
+    configs (and every literal in them) mirror
+    ``scripts/make_kernelgen_golden.py`` exactly — a drifted literal
+    here compares the wrong program against the golden."""
+    step = pallas_stencil.fused_step
+    if name == "single_f1":
+        u, v = grayscott.init_fields(16, jnp.float32)
+        seeds = jnp.asarray([123, 456, 7], jnp.int32)
+        for i in range(4):
+            u, v = step((u, v), _params(0.1), seeds.at[2].add(i),
+                        spec=SPEC, use_noise=True)
+        return u, v
+    if name == "single_f3":
+        u, v = _rand((16, 16, 16), 1), _rand((16, 16, 16), 2)
+        return step((u, v), _params(0.25),
+                    jnp.asarray([9, 17, 5], jnp.int32),
+                    spec=SPEC, use_noise=True, fuse=3)
+    if name == "faces12":
+        L = 16
+        u, v = _rand((L, L, L), 3), _rand((L, L, L), 4)
+        shapes = [(1, L, L)] * 4 + [(L, 1, L)] * 4 + [(L, L, 1)] * 4
+        faces = tuple(_rand(s, 10 + i) for i, s in enumerate(shapes))
+        return step((u, v), _params(0.1),
+                    jnp.asarray([3, 1, 9], jnp.int32), faces,
+                    spec=SPEC, use_noise=True)
+    if name == "xchain":
+        nx, ny, nz, k = 16, 8, 128, 2
+        u, v = _rand((nx, ny, nz), 5), _rand((nx, ny, nz), 6)
+        xfaces = tuple(_rand((k, ny, nz), 30 + i) for i in range(4))
+        return step((u, v), _params(0.2),
+                    jnp.asarray([3, 5, 11], jnp.int32), xfaces,
+                    spec=SPEC, use_noise=True, fuse=k,
+                    offsets=jnp.asarray([16, 0, 0], jnp.int32),
+                    row=jnp.int32(64))
+    if name == "xychain":
+        nx, nz, k = 16, 128, 2
+        ny = 8 + 2 * k + 4  # + filler to sublane 16
+        u, v = _rand((nx, ny, nz), 7), _rand((nx, ny, nz), 8)
+        yfaces = tuple(_rand((k, ny, nz), 40 + i) for i in range(4))
+        return step((u, v), _params(0.2),
+                    jnp.asarray([3, 5, 11], jnp.int32), yfaces,
+                    spec=SPEC, use_noise=True, fuse=k,
+                    offsets=jnp.asarray([16, 8 - k, 0], jnp.int32),
+                    row=jnp.int32(64))
+    if name == "midbf16":
+        monkeypatch.setenv("GS_MID_BF16", "1")
+        u, v = _rand((16, 16, 16), 1), _rand((16, 16, 16), 2)
+        out = step((u, v), _params(0.1),
+                   jnp.asarray([1, 2, 3], jnp.int32),
+                   spec=SPEC, use_noise=True, fuse=3)
+        monkeypatch.undo()
+        return out
+    assert name == "bf16_f2"
+    u16 = _rand((16, 16, 16), 1).astype(jnp.bfloat16)
+    v16 = _rand((16, 16, 16), 2).astype(jnp.bfloat16)
+    u2, v2 = step((u16, v16), _params(0.1, jnp.bfloat16),
+                  jnp.asarray([4, 5, 6], jnp.int32),
+                  spec=SPEC, use_noise=True, fuse=2)
+    return u2.astype(jnp.float32), v2.astype(jnp.float32)
+
+
+@pytest.mark.parametrize("name", [
+    "single_f1", "single_f3", "faces12", "xchain", "xychain",
+    "midbf16", "bf16_f2",
+])
+def test_generated_kernel_replays_hand_kernel_bitwise(name, monkeypatch):
+    golden = np.load(GOLDEN / "pallas_hand_kernel.npz")
+    u, v = _hand_kernel_config(name, monkeypatch)
+    np.testing.assert_array_equal(
+        np.asarray(u), golden[f"{name}_u"],
+        err_msg=f"{name}: generated kernel drifted from the hand "
+                "kernel (u)",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(v), golden[f"{name}_v"],
+        err_msg=f"{name}: generated kernel drifted from the hand "
+                "kernel (v)",
+    )
+
+
+# ---------------------------------------- per-model generated kernels
+
+@pytest.mark.parametrize("model", ["brusselator", "fhn", "heat"])
+def test_generated_kernel_matches_xla_trajectory(model):
+    """Every non-flagship model runs the GENERATED Pallas kernel
+    (interpret mode) and lands on its committed XLA trajectory at the
+    documented tolerance — wide enough for interpret-vs-XLA stencil
+    association, tight enough that a wrong boundary constant, noise
+    association, or mis-inlined op fails loudly."""
+    golden = np.load(GOLDEN / "model_trajectories.npz")
+    sim = Simulation(_model_settings(model, "Pallas"), n_devices=1,
+                     seed=7)
+    assert sim.kernel_language == "pallas"
+    sim.iterate(10)
+    for fname, f in zip(sim.model.field_names, sim.get_fields()):
+        got = np.asarray(f)
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(
+            got, golden[f"{model}_{fname}"], rtol=0, atol=1e-5,
+            err_msg=f"{model}.{fname} drifted from the XLA golden",
+        )
+
+
+@requires8
+def test_generated_kernel_composes_with_sharding():
+    """Pallas language + (2,2,2) mesh for a non-flagship model: the
+    sharded step must match the single-device generated kernel (on CPU
+    the sharded path takes the generated kernel's XLA fallback — the
+    same composition Gray-Scott's test_pallas_sharded pins)."""
+    one = Simulation(_model_settings("brusselator", "Pallas"),
+                     n_devices=1, seed=3)
+    eight = Simulation(_model_settings("brusselator", "Pallas"),
+                       n_devices=8, seed=3)
+    one.iterate(10)
+    eight.iterate(10)
+    for a, b in zip(one.get_fields(), eight.get_fields()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_spec_is_memoized_per_model():
+    """KernelSpec is identity-hashed (a jit static argument): repeated
+    dispatches must reuse ONE spec per model object or every call
+    retraces."""
+    assert kernelgen.get_spec(grayscott.MODEL) is SPEC
+    heat = get_model("heat")
+    assert kernelgen.get_spec(heat) is kernelgen.get_spec(heat)
+
+
+# ------------------------------------------------- feasibility refusals
+
+def test_every_builtin_model_is_generator_feasible():
+    for name in ALL_MODELS:
+        assert kernelgen.generation_gate_reason(get_model(name)) is None
+
+
+@pytest.fixture
+def infeasible_model():
+    """A registered model whose reaction needs a cross-cell reduction —
+    the generator must refuse it (the slab pipeline only hands the
+    reaction a local window)."""
+
+    def reaction(fields, laps, noise, params):
+        (t,) = fields
+        (lap,) = laps
+        mean = jnp.sum(t) / t.size  # cross-cell: cannot be inlined
+        return (params.D * lap + (mean - t) * params.relax + noise,)
+
+    def init(L, dtype, *, offsets=(0, 0, 0), sizes=None):
+        return model_base.seeded_box_init(
+            L, dtype, backgrounds=(0.0,), seed_values=(1.0,),
+            half_width=4, offsets=offsets, sizes=sizes,
+        )
+
+    m = model_base.register(model_base.Model(
+        name="meanfield_fixture", field_names=("t",), boundaries=(0.0,),
+        param_decls={"D": 0.1, "relax": 0.01}, reaction=reaction,
+        init=init,
+    ))
+    try:
+        yield m
+    finally:
+        model_base._REGISTRY.pop("meanfield_fixture", None)
+
+
+def test_gate_names_the_non_elementwise_primitive(infeasible_model):
+    reason = kernelgen.generation_gate_reason(infeasible_model)
+    assert reason is not None
+    assert "non-elementwise" in reason
+    assert "reduce_sum" in reason
+
+
+def test_gate_rejects_wrong_arity_and_shape():
+    def two_for_one(fields, laps, noise, params):
+        (t,) = fields
+        (lap,) = laps
+        return (params.D * lap, t)
+
+    bad = model_base.Model(
+        name="badarity_fixture", field_names=("t",), boundaries=(0.0,),
+        param_decls={"D": 0.1}, reaction=two_for_one,
+        init=get_model("heat").init,
+    )
+    reason = kernelgen.generation_gate_reason(bad)
+    assert reason is not None and "2 derivative(s)" in reason
+
+    def wrong_shape(fields, laps, noise, params):
+        (t,) = fields
+        return (jnp.stack([t, t]),)
+
+    bad2 = model_base.Model(
+        name="badshape_fixture", field_names=("t",), boundaries=(0.0,),
+        param_decls={"D": 0.1}, reaction=wrong_shape,
+        init=get_model("heat").init,
+    )
+    reason2 = kernelgen.generation_gate_reason(bad2)
+    assert reason2 is not None and "shape" in reason2
+
+
+def test_explicit_pallas_refuses_infeasible_model(infeasible_model):
+    with pytest.raises(ValueError, match="cannot be generated"):
+        Simulation(_model_settings("meanfield_fixture", "Pallas"),
+                   n_devices=1)
+
+
+def test_auto_records_kernel_gate_provenance(infeasible_model,
+                                             monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE", "off")
+    sim = Simulation(_model_settings("meanfield_fixture", "Auto"),
+                     n_devices=1)
+    assert sim.kernel_language == "xla"
+    gate = sim.kernel_selection["kernel_gate"]
+    assert gate["model"] == "meanfield_fixture"
+    assert gate["generated"] is False
+    assert "non-elementwise" in gate["reason"]
+    # The refused model still RUNS — the XLA path serves it.
+    sim.iterate(2)
+    assert np.isfinite(np.asarray(sim.get_fields()[0])).all()
+
+
+def test_build_spec_raises_with_the_gate_reason(infeasible_model):
+    with pytest.raises(kernelgen.KernelGenError,
+                       match="non-elementwise"):
+        kernelgen.build_spec(infeasible_model)

@@ -76,12 +76,15 @@ def test_registry_round_trip():
         assert len(m.field_names) == len(m.boundaries) == m.n_fields
         d = m.describe()
         assert d["name"] == name and d["fields"] == list(m.field_names)
-    # the flagship is the only Pallas-capable model
-    assert models.get_model("grayscott").pallas_capable
-    assert not any(
-        models.get_model(n).pallas_capable
-        for n in ("brusselator", "fhn", "heat")
-    )
+    # No per-model Pallas flag exists: the fused kernel is GENERATED
+    # from the declaration, and every built-in reaction is
+    # generator-feasible (docs/KERNELGEN.md; refusal paths are pinned
+    # in test_kernelgen.py).
+    from grayscott_jl_tpu.ops import kernelgen
+
+    for name in ALL_MODELS:
+        assert kernelgen.generation_gate_reason(
+            models.get_model(name)) is None
 
 
 def test_unknown_model_lists_registry():
@@ -340,22 +343,34 @@ def test_checkpoint_restart_roundtrip_per_model(tmp_path):
 
 # ------------------------------------------------------------ Pallas gate
 
-def test_explicit_pallas_refused_for_non_capable_model():
-    with pytest.raises(ValueError, match="Gray-Scott"):
-        Simulation(
-            _settings("heat", kernel_language="Pallas"), n_devices=1
+def test_explicit_pallas_constructs_for_every_model():
+    """The per-model name gate is gone: explicit Pallas constructs (and
+    steps, interpret mode) for every registered model — the generator
+    builds each kernel from the declaration (docs/KERNELGEN.md;
+    refusal paths for infeasible reactions live in test_kernelgen.py)."""
+    for model in ALL_MODELS:
+        sim = Simulation(
+            _settings(model, kernel_language="Pallas"), n_devices=1
+        )
+        assert sim.kernel_language == "pallas"
+        sim.iterate(1)
+        assert all(
+            np.isfinite(np.asarray(f)).all() for f in sim.get_fields()
         )
 
 
-def test_auto_gates_pallas_with_provenance(monkeypatch):
+def test_auto_allows_pallas_for_feasible_models(monkeypatch):
+    """Auto for a feasible non-flagship model resolves by PLATFORM (XLA
+    on CPU — interpret-mode Pallas is a correctness tool, not a
+    schedule), with no kernel_gate refusal in the provenance and the
+    tuner's Pallas axis left open."""
     monkeypatch.setenv("GS_AUTOTUNE", "off")
     sim = Simulation(
         _settings("brusselator", kernel_language="Auto"), n_devices=1
     )
     assert sim.kernel_language == "xla"
-    gate = sim.kernel_selection["pallas_gate"]
-    assert gate == {"model": "brusselator", "pallas_capable": False}
-    assert sim.kernel_selection["autotune"]["pallas_allowed"] is False
+    assert "kernel_gate" not in sim.kernel_selection
+    assert sim.kernel_selection["autotune"]["pallas_allowed"] is True
 
 
 def test_candidates_respect_pallas_gate():
@@ -382,8 +397,9 @@ def test_tune_cache_key_separates_models():
     ht = cache.cache_key(**base, model="heat", n_fields=1)
     # v3 grew model/n_fields; v4 grew halo_depth (s-step exchange
     # pin); v5 grew member_shards/procs (the adopted placement); v6
-    # grew compute_precision/snapshot_codec (docs/PRECISION.md).
-    assert gs["schema"] == cache.SCHEMA_VERSION == 6
+    # grew compute_precision/snapshot_codec (docs/PRECISION.md); v7
+    # grew kernel_generator (docs/KERNELGEN.md).
+    assert gs["schema"] == cache.SCHEMA_VERSION == 7
     assert gs["model"] == "grayscott" and gs["n_fields"] == 2
     digests = {cache.key_digest(k) for k in (gs, br, ht)}
     assert len(digests) == 3  # a Brusselator run can never adopt a

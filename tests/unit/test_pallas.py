@@ -17,10 +17,34 @@ import jax.numpy as jnp
 from grayscott_jl_tpu.config.settings import Settings
 from grayscott_jl_tpu.models import grayscott
 from grayscott_jl_tpu.models import grayscott as gs_model
-from grayscott_jl_tpu.ops import pallas_stencil, stencil
+from grayscott_jl_tpu.ops import kernelgen, pallas_stencil, stencil
 from grayscott_jl_tpu.simulation import Simulation
 
 PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+# This suite predates the kernel generator and exercises the kernel in
+# its historical two-field Gray-Scott shape; the wrappers adapt that
+# call shape to the generated-kernel tuple+spec API so every
+# refactor-sensitive config here keeps pinning the same program.
+SPEC = kernelgen.get_spec(grayscott.MODEL)
+
+
+def fused_step(u, v, params, seeds, faces=None, **kw):
+    return pallas_stencil.fused_step(
+        (u, v), params, seeds, faces, spec=SPEC, **kw
+    )
+
+
+def xla_fallback(u, v, params, seeds, faces, **kw):
+    return pallas_stencil._xla_fallback(
+        (u, v), params, seeds, faces, spec=SPEC, **kw
+    )
+
+
+def xchain_fallback(u, v, params, seeds, faces, **kw):
+    return pallas_stencil._xla_xchain_fallback(
+        (u, v), params, seeds, faces, spec=SPEC, **kw
+    )
 
 
 def _settings(lang, L=16, noise=0.0, **kw):
@@ -71,8 +95,8 @@ def test_pallas_noise_statistics_and_reproducibility():
     u, v = grayscott.init_fields(L, dtype)
     seeds = jnp.asarray([123, 456, 7], jnp.int32)
 
-    u1, v1 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
-    u0, v0 = pallas_stencil.fused_step(u, v, params0, seeds, use_noise=False)
+    u1, v1 = fused_step(u, v, params, seeds, use_noise=True)
+    u0, v0 = fused_step(u, v, params0, seeds, use_noise=False)
 
     # v never receives noise (Simulation_CPU.jl:101-112).
     np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), rtol=1e-6)
@@ -84,10 +108,10 @@ def test_pallas_noise_statistics_and_reproducibility():
     assert abs(unit.std() - 1 / np.sqrt(3)) < 0.01  # std of U(-1,1)
 
     # Same seeds -> identical draw; different step seed -> different draw.
-    u1b, _ = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    u1b, _ = fused_step(u, v, params, seeds, use_noise=True)
     np.testing.assert_array_equal(np.asarray(u1), np.asarray(u1b))
     seeds2 = seeds.at[2].set(8)
-    u2, _ = pallas_stencil.fused_step(u, v, params, seeds2, use_noise=True)
+    u2, _ = fused_step(u, v, params, seeds2, use_noise=True)
     assert not np.array_equal(np.asarray(u1), np.asarray(u2))
 
 
@@ -107,11 +131,11 @@ def test_temporal_blocking_with_noise_matches_two_single_steps():
     v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
     seeds = jnp.asarray([17, 29, 4], jnp.int32)
 
-    u2, v2 = pallas_stencil.fused_step(
+    u2, v2 = fused_step(
         u, v, params, seeds, use_noise=True, fuse=2
     )
-    ua, va = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
-    ub, vb = pallas_stencil.fused_step(
+    ua, va = fused_step(u, v, params, seeds, use_noise=True)
+    ub, vb = fused_step(
         ua, va, params, seeds.at[2].add(1), use_noise=True
     )
     np.testing.assert_allclose(
@@ -143,10 +167,10 @@ def test_noise_stream_is_position_keyed_not_layout_keyed():
     seeds = jnp.asarray([3, 1, 9], jnp.int32)
 
     def noise_delta(faces_arg):
-        un, _ = pallas_stencil.fused_step(
+        un, _ = fused_step(
             u, v, noisy, seeds, faces_arg, use_noise=True
         )
-        u0, _ = pallas_stencil.fused_step(
+        u0, _ = fused_step(
             u, v, quiet, seeds, faces_arg, use_noise=False
         )
         return np.asarray(un) - np.asarray(u0)
@@ -168,11 +192,11 @@ def test_temporal_blocking_matches_two_single_steps():
     v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
     seeds = jnp.asarray([5, 6, 0], jnp.int32)
 
-    u2, v2 = pallas_stencil.fused_step(
+    u2, v2 = fused_step(
         u, v, params, seeds, use_noise=False, fuse=2
     )
-    ua, va = pallas_stencil.fused_step(u, v, params, seeds, use_noise=False)
-    ub, vb = pallas_stencil.fused_step(
+    ua, va = fused_step(u, v, params, seeds, use_noise=False)
+    ub, vb = fused_step(
         ua, va, params, seeds.at[2].add(1), use_noise=False
     )
     np.testing.assert_allclose(
@@ -209,12 +233,12 @@ def test_deep_temporal_blocking_matches_single_steps(fuse, use_noise):
     v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
     seeds = jnp.asarray([9, 17, 5], jnp.int32)
 
-    uk, vk = pallas_stencil.fused_step(
+    uk, vk = fused_step(
         u, v, params, seeds, use_noise=use_noise, fuse=fuse
     )
     us, vs = u, v
     for s in range(fuse):
-        us, vs = pallas_stencil.fused_step(
+        us, vs = fused_step(
             us, vs, params, seeds.at[2].add(s), use_noise=use_noise,
         )
     np.testing.assert_allclose(
@@ -240,7 +264,7 @@ def test_fuse_steps_down_when_vmem_overflows():
     v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
     seeds = jnp.asarray([2, 4, 8], jnp.int32)
 
-    want_u, want_v = pallas_stencil.fused_step(
+    want_u, want_v = fused_step(
         u, v, params, seeds, use_noise=True, fuse=4
     )
 
@@ -254,7 +278,7 @@ def test_fuse_steps_down_when_vmem_overflows():
     try:
         assert pallas_stencil.pick_block_planes(L, L, L, item, 4) == 0
         assert pallas_stencil.pick_block_planes(L, L, L, item, 2) > 0
-        got_u, got_v = pallas_stencil.fused_step(
+        got_u, got_v = fused_step(
             u, v, params, seeds, use_noise=True, fuse=4
         )
     finally:
@@ -287,11 +311,11 @@ def test_bf16_mid_buffers_track_exact_chain(monkeypatch):
     v = jax.random.uniform(jax.random.fold_in(key, 1), (L, L, L), dtype)
     seeds = jnp.asarray([1, 2, 3], jnp.int32)
 
-    exact_u, exact_v = pallas_stencil.fused_step(
+    exact_u, exact_v = fused_step(
         u, v, params, seeds, use_noise=True, fuse=k
     )
     monkeypatch.setenv("GS_MID_BF16", "1")
-    approx_u, approx_v = pallas_stencil.fused_step(
+    approx_u, approx_v = fused_step(
         u, v, params, seeds, use_noise=True, fuse=k
     )
     monkeypatch.undo()
@@ -351,10 +375,10 @@ def test_pallas_faces_kernel_matches_padded_oracle():
     )
     seeds = jnp.asarray([1, 2, 3], jnp.int32)
 
-    got_u, got_v = pallas_stencil.fused_step(
+    got_u, got_v = fused_step(
         u, v, params, seeds, faces, use_noise=False
     )
-    want_u, want_v = pallas_stencil._xla_fallback(
+    want_u, want_v = xla_fallback(
         u, v, params, seeds, faces, use_noise=False
     )
     np.testing.assert_allclose(
@@ -446,12 +470,12 @@ def test_x_chain_kernel_matches_fallback(use_noise, monkeypatch):
     offs = jnp.asarray([16, 0, 0], jnp.int32)  # interior shard
     row = jnp.int32(64)
     monkeypatch.setenv("GS_BX", "16")  # restores any pre-existing value
-    a = pallas_stencil.fused_step(
+    a = fused_step(
         u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
         offsets=offs, row=row,
     )
     monkeypatch.undo()
-    b = pallas_stencil._xla_xchain_fallback(
+    b = xchain_fallback(
         u, v, params, seeds, faces, fuse=k, use_noise=use_noise,
         offsets=offs, row=row,
     )
@@ -483,11 +507,11 @@ def test_x_chain_with_boundary_faces_equals_no_faces_chain(monkeypatch):
     offs = jnp.zeros((3,), jnp.int32)
     row = jnp.int32(nx)
     monkeypatch.setenv("GS_BX", "16")
-    a = pallas_stencil.fused_step(
+    a = fused_step(
         u, v, params, seeds, faces, use_noise=True, fuse=k,
         offsets=offs, row=row,
     )
-    b = pallas_stencil.fused_step(
+    b = fused_step(
         u, v, params, seeds, use_noise=True, fuse=k,
         offsets=offs, row=row,
     )
@@ -514,12 +538,12 @@ def test_xy_chain_kernel_matches_fallback(use_noise, monkeypatch):
     offs = jnp.asarray([16, 8 - k, 0], jnp.int32)
     row = jnp.int32(64)
     monkeypatch.setenv("GS_BX", "16")  # multi-slab face-DMA branches
-    a = pallas_stencil.fused_step(
+    a = fused_step(
         u, v, params, seeds, faces, use_noise=use_noise, fuse=k,
         offsets=offs, row=row,
     )
     monkeypatch.undo()
-    b = pallas_stencil._xla_xchain_fallback(
+    b = xchain_fallback(
         u, v, params, seeds, faces, fuse=k, use_noise=use_noise,
         offsets=offs, row=row,
     )
@@ -545,11 +569,11 @@ def test_xy_chain_edge_block_pins_out_of_domain_rows(monkeypatch):
     # y origin -k: rows [0, k) are outside the global domain.
     offs = jnp.asarray([0, -k, 0], jnp.int32)
     row = jnp.int32(64)
-    a = pallas_stencil.fused_step(
+    a = fused_step(
         u, v, params, seeds, faces, use_noise=True, fuse=k,
         offsets=offs, row=row,
     )
-    b = pallas_stencil._xla_xchain_fallback(
+    b = xchain_fallback(
         u, v, params, seeds, faces, fuse=k, use_noise=True,
         offsets=offs, row=row,
     )
@@ -561,10 +585,10 @@ def test_xy_chain_edge_block_pins_out_of_domain_rows(monkeypatch):
 def test_x_chain_rejects_bad_faces():
     u, v, faces, params, seeds = _xchain_inputs(k=3)
     with pytest.raises(ValueError, match="fuse >= 2"):
-        pallas_stencil.fused_step(
+        fused_step(
             u, v, params, seeds, faces, fuse=1,
         )
     with pytest.raises(ValueError, match="x-chain faces"):
-        pallas_stencil.fused_step(
+        fused_step(
             u, v, params, seeds, tuple(f[:2] for f in faces), fuse=3,
         )

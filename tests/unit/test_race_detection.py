@@ -21,6 +21,13 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def _gs_spec():
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import kernelgen
+
+    return kernelgen.get_spec(grayscott.MODEL)
+
+
 def test_pipelined_kernel_has_no_dma_races():
     import jax.numpy as jnp
 
@@ -42,11 +49,13 @@ def test_pipelined_kernel_has_no_dma_races():
 
     # The detector raises/logs on a race; completing with finite values
     # and matching the XLA oracle means the slot protocol is sound.
+    spec = _gs_spec()
     u1, v1 = pallas_stencil.fused_step(
-        u, v, params, seeds, use_noise=False, detect_races=True
+        (u, v), params, seeds, spec=spec, use_noise=False,
+        detect_races=True,
     )
     want_u, want_v = pallas_stencil._xla_fallback(
-        u, v, params, seeds, None, use_noise=False
+        (u, v), params, seeds, None, spec=spec, use_noise=False
     )
     np.testing.assert_allclose(
         np.asarray(u1), np.asarray(want_u), rtol=1e-6, atol=5e-7
@@ -82,15 +91,16 @@ def _chain_race_case(nx, ny, nz, k, offs, row, seed, monkeypatch,
     offs = jnp.asarray(offs, jnp.int32)
     row = jnp.int32(row)
 
+    spec = _gs_spec()
     if bx is not None:
         monkeypatch.setenv("GS_BX", str(bx))
     u1, v1 = pallas_stencil.fused_step(
-        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        (u, v), params, seeds, faces, spec=spec, use_noise=True, fuse=k,
         offsets=offs, row=row, detect_races=True,
     )
     monkeypatch.undo()
     want_u, want_v = pallas_stencil._xla_xchain_fallback(
-        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        (u, v), params, seeds, faces, spec=spec, fuse=k, use_noise=True,
         offsets=offs, row=row,
     )
     np.testing.assert_allclose(
@@ -146,13 +156,16 @@ def test_single_buffer_whole_block_slab_has_no_dma_races():
     v = jax.random.uniform(jax.random.fold_in(key, 1), (nx, ny, nz), dtype)
     seeds = jnp.asarray([3, 1, 4], jnp.int32)
 
+    spec = _gs_spec()
     u1, v1 = pallas_stencil.fused_step(
-        u, v, params, seeds, use_noise=True, fuse=k, detect_races=True,
+        (u, v), params, seeds, spec=spec, use_noise=True, fuse=k,
+        detect_races=True,
     )
     us, vs = u, v
     for step in range(k):
         us, vs = pallas_stencil._xla_fallback(
-            us, vs, params, seeds.at[2].add(step), None, use_noise=True,
+            (us, vs), params, seeds.at[2].add(step), None, spec=spec,
+            use_noise=True,
         )
     np.testing.assert_allclose(
         np.asarray(u1), np.asarray(us), rtol=1e-4, atol=2e-6

@@ -17,6 +17,13 @@ requires_tpu = pytest.mark.skipif(
 )
 
 
+def _gs_spec():
+    from grayscott_jl_tpu.models import grayscott
+    from grayscott_jl_tpu.ops import kernelgen
+
+    return kernelgen.get_spec(grayscott.MODEL)
+
+
 @requires_tpu
 def test_in_kernel_noise_statistics():
     import jax.numpy as jnp
@@ -34,8 +41,11 @@ def test_in_kernel_noise_statistics():
     u, v = grayscott.init_fields(L, dtype)
     seeds = jnp.asarray([123, 456, 7], jnp.int32)
 
-    u1, v1 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
-    u0, v0 = pallas_stencil.fused_step(u, v, params, seeds, use_noise=False)
+    spec = _gs_spec()
+    u1, v1 = pallas_stencil.fused_step((u, v), params, seeds, spec=spec,
+                                       use_noise=True)
+    u0, v0 = pallas_stencil.fused_step((u, v), params, seeds, spec=spec,
+                                       use_noise=False)
 
     np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), rtol=1e-6)
     unit = (np.asarray(u1) - np.asarray(u0)) / (noise * float(params.dt))
@@ -49,7 +59,8 @@ def test_in_kernel_noise_statistics():
         assert not np.array_equal(unit[:bx], unit[bx:2 * bx])
 
     # Reproducibility: same seeds -> identical draw.
-    u1b, _ = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True)
+    u1b, _ = pallas_stencil.fused_step((u, v), params, seeds, spec=spec,
+                                       use_noise=True)
     np.testing.assert_array_equal(np.asarray(u1), np.asarray(u1b))
 
 
@@ -73,9 +84,11 @@ def test_mosaic_noise_matches_xla_stream():
     u, v = grayscott.init_fields(L, dtype)
     seeds = jnp.asarray([11, 22, 33], jnp.int32)
 
-    got_u, got_v = pallas_stencil.fused_step(u, v, params, seeds,
-                                             use_noise=True)
-    want_u, want_v = pallas_stencil._xla_fallback(u, v, params, seeds, None,
+    spec = _gs_spec()
+    got_u, got_v = pallas_stencil.fused_step((u, v), params, seeds,
+                                             spec=spec, use_noise=True)
+    want_u, want_v = pallas_stencil._xla_fallback((u, v), params, seeds,
+                                                  None, spec=spec,
                                                   use_noise=True)
     np.testing.assert_allclose(np.asarray(got_u), np.asarray(want_u),
                                rtol=1e-6, atol=5e-7)
@@ -104,12 +117,14 @@ def test_temporal_blocking_with_noise_on_hardware(fuse):
     u, v = grayscott.init_fields(L, dtype)
     seeds = jnp.asarray([5, 6, 0], jnp.int32)
 
-    uk, vk = pallas_stencil.fused_step(u, v, params, seeds, use_noise=True,
-                                       fuse=fuse)
+    spec = _gs_spec()
+    uk, vk = pallas_stencil.fused_step((u, v), params, seeds, spec=spec,
+                                       use_noise=True, fuse=fuse)
     us, vs = u, v
     for step in range(fuse):
         us, vs = pallas_stencil.fused_step(
-            us, vs, params, seeds.at[2].add(step), use_noise=True)
+            (us, vs), params, seeds.at[2].add(step), spec=spec,
+            use_noise=True)
     np.testing.assert_allclose(np.asarray(uk), np.asarray(us),
                                rtol=1e-6, atol=5e-7)
     np.testing.assert_allclose(np.asarray(vk), np.asarray(vs),
@@ -198,11 +213,12 @@ def test_faces_kernel_on_hardware(noise):
     assert pallas_stencil.pick_block_planes(L, L, L, 4, 1) > 0
     assert L % 128 == 0, "lane-misaligned L would route to XLA"
 
+    spec = _gs_spec()
     got_u, got_v = pallas_stencil.fused_step(
-        u, v, params, seeds, faces, use_noise=use_noise
+        (u, v), params, seeds, faces, spec=spec, use_noise=use_noise
     )
     want_u, want_v = pallas_stencil._xla_fallback(
-        u, v, params, seeds, faces, use_noise=use_noise
+        (u, v), params, seeds, faces, spec=spec, use_noise=use_noise
     )
     np.testing.assert_allclose(np.asarray(got_u), np.asarray(want_u),
                                rtol=1e-6, atol=5e-7)
@@ -331,12 +347,13 @@ def test_x_chain_kernel_on_hardware():
     offs = jnp.asarray([256, 0, 0], jnp.int32)  # interior shard
     row = jnp.int32(1024)
 
+    spec = _gs_spec()
     a = pallas_stencil.fused_step(
-        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        (u, v), params, seeds, faces, spec=spec, use_noise=True, fuse=k,
         offsets=offs, row=row,
     )
     b = pallas_stencil._xla_xchain_fallback(
-        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        (u, v), params, seeds, faces, spec=spec, fuse=k, use_noise=True,
         offsets=offs, row=row,
     )
     np.testing.assert_allclose(
@@ -356,11 +373,11 @@ def test_x_chain_kernel_on_hardware():
     )
     offs0 = jnp.zeros((3,), jnp.int32)
     c = pallas_stencil.fused_step(
-        u, v, params, seeds, bfaces, use_noise=True, fuse=k,
+        (u, v), params, seeds, bfaces, spec=spec, use_noise=True, fuse=k,
         offsets=offs0, row=jnp.int32(nx),
     )
     d = pallas_stencil.fused_step(
-        u, v, params, seeds, use_noise=True, fuse=k,
+        (u, v), params, seeds, spec=spec, use_noise=True, fuse=k,
         offsets=offs0, row=jnp.int32(nx),
     )
     np.testing.assert_array_equal(np.asarray(c[0]), np.asarray(d[0]))
@@ -402,12 +419,13 @@ def test_xy_chain_kernel_on_hardware():
     offs = jnp.asarray([128, 128 - k, 0], jnp.int32)
     row = jnp.int32(512)
 
+    spec = _gs_spec()
     a = pallas_stencil.fused_step(
-        u, v, params, seeds, faces, use_noise=True, fuse=k,
+        (u, v), params, seeds, faces, spec=spec, use_noise=True, fuse=k,
         offsets=offs, row=row,
     )
     b = pallas_stencil._xla_xchain_fallback(
-        u, v, params, seeds, faces, fuse=k, use_noise=True,
+        (u, v), params, seeds, faces, spec=spec, fuse=k, use_noise=True,
         offsets=offs, row=row,
     )
     # Compare the y interior (the rows temporal.xy_chain consumes);
