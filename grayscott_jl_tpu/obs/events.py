@@ -49,6 +49,7 @@ from .trace import _proc_index, rank_path
 __all__ = [
     "EventStream",
     "NULL_EVENTS",
+    "arm_events",
     "bound",
     "get_events",
     "parse_events",
@@ -180,7 +181,8 @@ class EventStream:
 
     def describe(self) -> dict:
         return {"enabled": True, "path": self.path,
-                "emitted": self.emitted, "broken": self.broken}
+                "emitted": self.emitted, "broken": self.broken,
+                "subscribers": len(self._subscribers)}
 
 
 def parse_events(path: str) -> List[dict]:
@@ -250,6 +252,19 @@ def get_events():
     if _stream is None:
         path = os.environ.get("GS_EVENTS", "").strip()
         _stream = EventStream(rank_path(path)) if path else NULL_EVENTS
+    return _stream
+
+
+def arm_events(path: str, proc: Optional[int] = None) -> EventStream:
+    """Point the process-wide stream at ``path`` explicitly, with an
+    explicit ``proc`` id. Serve-fleet members (``serve/cluster.py``)
+    are a multi-process run WITHOUT a JAX distributed launch — every
+    process would resolve ``_proc_index() == 0`` and clobber one file —
+    so each member arms its own ``.rank<N>`` file here and the readers'
+    existing ``rank_files`` merge tells one fleet-wide story."""
+    global _stream
+    os.environ["GS_EVENTS"] = path
+    _stream = EventStream(path, proc=proc)
     return _stream
 
 

@@ -80,6 +80,7 @@ __all__ = [
     "read_quarantine",
     "recoverable_restore_error",
     "replica_paths",
+    "replicate_store",
     "resolve_config",
     "resolve_replicas",
     "resolve_scrub",
@@ -88,6 +89,7 @@ __all__ = [
     "restore_with_failover",
     "scrub_store",
     "verify_last_step",
+    "verify_store",
 ]
 
 VERIFY_MODES = ("off", "read", "full")
@@ -584,6 +586,63 @@ def verify_last_step(path: str) -> None:
                 continue
     finally:
         r.close()
+
+
+def verify_store(path: str) -> dict:
+    """Full CRC audit of a finished store (the result-cache read gate,
+    ``serve/cache.py``): every durable step entry's recorded block CRCs
+    are recomputed against the payload bytes on disk, raising
+    :class:`CorruptionError` naming the first corrupt entry. Unlike
+    :func:`scrub_store` this never quarantines — the caller's contract
+    is "serve these bytes or refuse", not "repair the store" — and a
+    store with no committed metadata fails loudly rather than passing
+    vacuously (a cache must not vouch for a store it cannot read)."""
+    report = scrub_store(path, quarantine=False)
+    if report is None:
+        raise CorruptionError(
+            f"store {path} has no readable metadata — nothing to "
+            "verify, nothing to serve"
+        )
+    if report["corrupt"]:
+        raise CorruptionError(
+            f"store {path}: CRC mismatch in step entr"
+            f"{'ies' if len(report['corrupt']) > 1 else 'y'} "
+            f"{report['corrupt']} "
+            f"({report['steps_audited']} audited)"
+        )
+    return report
+
+
+def replicate_store(path: str, n: Optional[int] = None) -> List[str]:
+    """Mirror a finished store to its ``.r1`` .. ``.r<n-1>`` replica
+    paths (``GS_CKPT_REPLICAS`` when ``n`` is None) — the publish-time
+    durability half of the result cache: a cached artifact whose
+    primary later rots on disk fails over to a mirror instead of
+    degrading to a relaunch. Copies land atomically (tmp dir + rename)
+    so a concurrent reader never sees a half-copied mirror; existing
+    mirrors are left alone (first publish wins — the store is
+    content-addressed, every writer holds identical bytes). Returns
+    the mirror paths written."""
+    import shutil
+
+    if n is None:
+        n = resolve_replicas()
+    written = []
+    for mirror in replica_paths(path, n)[1:]:
+        if os.path.exists(mirror):
+            continue
+        tmp = f"{mirror}.copy.{os.getpid()}"
+        try:
+            shutil.copytree(path, tmp)
+            os.rename(tmp, mirror)
+        except FileExistsError:
+            shutil.rmtree(tmp, ignore_errors=True)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        else:
+            written.append(mirror)
+    return written
 
 
 def primary_checkpoint_path(settings) -> str:

@@ -54,9 +54,25 @@ __all__ = [
     "FileRendezvous",
     "KVRendezvous",
     "RendezvousTimeout",
+    "atomic_publish",
     "from_env",
     "resolve_timeout_s",
 ]
+
+
+def atomic_publish(path: str, payload: str) -> None:
+    """Atomically publish ``payload`` at ``path`` (tmp + fsync +
+    rename): readers see the old bytes or the new bytes, never a torn
+    write. This is the one file-KV write primitive — the restart
+    rendezvous publishes its votes through it, and the serve fleet's
+    shared scheduler state (``serve/cluster.py``) builds its whole KV
+    namespace on it plus ``os.rename`` for exclusive claims."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class RendezvousTimeout(RuntimeError):
@@ -235,13 +251,7 @@ class FileRendezvous(_Rendezvous):
         )
 
     def _publish(self, round_no: int, payload: str) -> None:
-        path = self._path(round_no, self.proc)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_publish(self._path(round_no, self.proc), payload)
 
     def _gather(self, round_no: int) -> List[str]:
         deadline = time.monotonic() + self.timeout_s

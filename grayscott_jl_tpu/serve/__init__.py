@@ -19,8 +19,18 @@ per-tenant quotas, size caps) and the worker's requeue path (a killed
 worker's in-flight members resume from their member-store quorum step,
 ``ensemble/io.restore_ensemble`` + ``reshard/plan``) are what make the
 process safe to leave running.
+
+Two fleet-scale layers ride on top (ROADMAP item 4): ``cluster`` moves
+the scheduler state into a shared filesystem KV namespace so N
+front-door replicas and M worker processes act as ONE service (any
+replica admits/routes/fails-over any job; a dead worker's lease
+expires into a fail-over), and ``cache`` exploits bitwise-deterministic
+runs to answer repeated JobSpecs from a content-addressed,
+CRC-verified store of finished trajectories — a cache hit is a store
+read, not a launch.
 """
 
+from .cache import ResultCache, job_digest  # noqa: F401
 from .protocol import JobSpec, pack_key, parse_job  # noqa: F401
 from .scheduler import (  # noqa: F401
     Job,

@@ -1,0 +1,285 @@
+"""Content-addressed result cache: a JobSpec digest IS its trajectory.
+
+The framework's strongest property is that runs are bitwise
+deterministic — a packed member's store is byte-identical to the solo
+run of the same spec (docs/SERVICE.md, "equality fine print"), across
+restarts, requeues, and pack factors. So a finished trajectory is
+fully determined by the physics-relevant spec fields, and a repeated
+request is a store READ, not a launch (ROADMAP item 4; the
+workflow-composition move of arxiv 2309.10292 applied to the service
+layer).
+
+:func:`canonical_spec` fixes the identity: model, resolved member
+parameters (defaults filled, canonically ordered, floats spelled as
+``float.hex()`` so ``0.06`` and ``0.060`` collide and no decimal
+formatting ambiguity separates equal values), seed, L, steps, the
+output/checkpoint cadence (they shape WHICH steps the store holds),
+precision + the resolved compute-precision posture, halo_depth, and
+the snapshot-codec posture (lossy bytes differ from exact bytes).
+Deliberately EXCLUDED: tenant and priority — they shape scheduling,
+not bytes, and the whole point is that different users hit the same
+entry.
+
+:class:`ResultCache` maps ``digest -> finished store`` through the
+shared filesystem:
+
+* **publish** (worker side, batch completion) records the entry with
+  :func:`~..resilience.rendezvous.atomic_publish` (last-writer-wins is
+  safe: every writer of a digest holds identical bytes) and mirrors
+  the store per ``GS_CKPT_REPLICAS``
+  (:func:`~..resilience.integrity.replicate_store`) for durability;
+* **lookup** (front-door side, admission) re-verifies the artifact's
+  PR 14 CRC sidecars (:func:`~..resilience.integrity.verify_store`)
+  before vouching for it, failing over to an on-disk mirror when the
+  primary rots, and degrading to a cache MISS — a fresh launch — when
+  every copy is corrupt. A bad byte is never served; at worst a hit
+  becomes a recompute.
+
+Stdlib-only and JAX-free to import, like the rest of ``serve/``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from types import SimpleNamespace
+from typing import Optional
+
+from ..config.env import env_flag, env_str
+from ..config.settings import resolve_compute_precision
+from ..io.codec import resolve_snapshot_codec
+from ..models import get_model
+from ..resilience.integrity import (
+    CorruptionError,
+    _existing_replicas,
+    replicate_store,
+    verify_store,
+)
+from . import protocol
+
+__all__ = [
+    "ResultCache",
+    "canonical_spec",
+    "job_digest",
+    "resolve_cache_dir",
+    "resolve_cache_enabled",
+    "resolve_cache_verify",
+]
+
+
+def resolve_cache_enabled() -> bool:
+    """``GS_SERVE_CACHE`` — serve the result cache (default on; the
+    determinism contract makes it safe by construction)."""
+    return env_flag("GS_SERVE_CACHE", True)
+
+
+def resolve_cache_dir(default: str = "") -> str:
+    """``GS_CACHE_DIR`` — the cache root; empty defers to the
+    scheduler's default (``<state_dir>/cache``, or the shared
+    ``<fleet_dir>/cache`` for fleet members)."""
+    return env_str("GS_CACHE_DIR", default)
+
+
+def resolve_cache_verify() -> bool:
+    """``GS_CACHE_VERIFY`` — CRC-verify cached artifacts at lookup
+    time (default on). Off trusts publish-time CRCs; the read gate is
+    what turns silent disk rot into a failover instead of a bad
+    payload, so leave it on outside benchmarks."""
+    return env_flag("GS_CACHE_VERIFY", True)
+
+
+def canonical_spec(spec: protocol.JobSpec) -> dict:
+    """The physics-identity document of one job — every field that
+    determines the finished store's bytes, spelled canonically.
+
+    Floats go through ``float.hex()``: exact, round-trippable, and
+    formatting-independent — ``1e-2`` and ``0.01`` collide, while any
+    value delta (even one ulp) separates. Parameters come
+    default-filled and canonically ordered from
+    :func:`~.protocol.resolved_params`, so ``{"f": 0.03}`` and
+    ``{"f": 0.03, "k": <default k>}`` are the same scenario here just
+    as they are on the device. Postures resolve through the SAME
+    resolvers the worker's launch uses (``resolve_compute_precision``,
+    ``resolve_snapshot_codec``), so the digest names the bytes this
+    environment would actually write.
+    """
+    model = get_model(spec.model)
+    stub = SimpleNamespace(compute_precision="", precision=spec.precision)
+    return {
+        "v": 1,
+        "model": spec.model,
+        "L": spec.L,
+        "steps": spec.steps,
+        "plotgap": spec.plotgap,
+        "checkpoint_freq": spec.checkpoint_freq,
+        "precision": spec.precision,
+        "halo_depth": spec.halo_depth,
+        "seed": spec.seed,
+        "params": [
+            [name, float(value).hex()]
+            for name, value in protocol.resolved_params(spec)
+        ],
+        "compute_precision": resolve_compute_precision(stub),
+        "snapshot_codec": resolve_snapshot_codec(
+            stub, model.field_names
+        ).posture(),
+    }
+
+
+def job_digest(spec: protocol.JobSpec) -> str:
+    """sha256 of the canonical spec document (sorted keys, no
+    whitespace) — the cache key."""
+    blob = json.dumps(
+        canonical_spec(spec), sort_keys=True, separators=(",", ":")
+    )
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class ResultCache:
+    """``digest -> finished member store`` over a (possibly shared)
+    directory tree.
+
+    Entries live at ``<root>/<digest[:2]>/<digest>.json`` — two-level
+    fan-out so a planet-scale cache directory never holds millions of
+    siblings — and are published atomically, so a concurrent reader
+    sees a complete entry or none. ``verifier`` is injectable for unit
+    tests (defaults to the PR 14 CRC audit
+    :func:`~..resilience.integrity.verify_store`).
+    """
+
+    def __init__(self, root: str, *, events=None, metrics=None,
+                 verify: bool = True, verifier=None):
+        self.root = root
+        if events is None:
+            from ..obs import events as obs_events
+
+            events = obs_events.get_events()
+        if metrics is None:
+            from ..obs import metrics as obs_metrics
+
+            metrics = obs_metrics.get_metrics()
+        self.events = events
+        self.metrics = metrics
+        self.verify = bool(verify)
+        self._verifier = verifier if verifier is not None else verify_store
+        os.makedirs(root, exist_ok=True)
+
+    # --------------------------------------------------------- entries
+
+    def entry_path(self, digest: str) -> str:
+        return os.path.join(self.root, digest[:2], f"{digest}.json")
+
+    def _read_entry(self, digest: str) -> Optional[dict]:
+        try:
+            with open(self.entry_path(digest), encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return entry if isinstance(entry, dict) else None
+
+    # --------------------------------------------------------- publish
+
+    def publish(self, spec: protocol.JobSpec, store: str, *,
+                job: str = "", digest: Optional[str] = None
+                ) -> Optional[dict]:
+        """Record ``digest -> store`` after a batch finishes (worker /
+        completing-scheduler side). Verifies the artifact BEFORE
+        vouching for it (a store that already fails its own CRCs must
+        not become a cache entry), then mirrors it per
+        ``GS_CKPT_REPLICAS`` and writes the entry atomically.
+        Idempotent and race-safe: every publisher of a digest holds
+        byte-identical stores, so last-writer-wins is a no-op. Returns
+        the entry, or None when the store is unpublishable (missing,
+        no committed metadata, or corrupt)."""
+        if digest is None:
+            digest = job_digest(spec)
+        if not store or not os.path.isdir(store):
+            return None
+        try:
+            report = self._verifier(store)
+        except CorruptionError:
+            return None
+        entry = {
+            "digest": digest,
+            "store": store,
+            "job": job,
+            "steps_audited": report["steps_audited"],
+            "published_t": round(time.time(), 6),
+        }
+        from ..resilience.rendezvous import atomic_publish
+
+        path = self.entry_path(digest)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        mirrors = replicate_store(store)
+        atomic_publish(path, json.dumps(entry, sort_keys=True))
+        self.metrics.counter("serve_cache_published").inc()
+        self.events.emit(
+            "cache_publish", digest=digest, job=job, store=store,
+            mirrors=len(mirrors),
+        )
+        return entry
+
+    # ---------------------------------------------------------- lookup
+
+    def lookup(self, digest: str) -> Optional[dict]:
+        """The verified entry for ``digest``, or None (a miss).
+
+        Health-ordered read gate: try the recorded primary store, then
+        every on-disk ``.r<k>`` mirror, returning the FIRST candidate
+        that passes the CRC audit (the entry's ``store`` field is
+        rewritten to the winning candidate). When every copy is
+        corrupt the entry is dropped — the next publish of this digest
+        rebuilds it from a fresh launch — and the lookup degrades to a
+        miss. Never returns an unverified store while ``verify`` is
+        on."""
+        entry = self._read_entry(digest)
+        if entry is None:
+            return None
+        store = entry.get("store")
+        if not store or not os.path.isdir(store):
+            self._drop(digest, reason="store_missing")
+            return None
+        if not self.verify:
+            return entry
+        candidates = [store] + _existing_replicas(store)
+        for candidate in candidates:
+            try:
+                self._verifier(candidate)
+            except CorruptionError:
+                continue
+            if candidate != store:
+                self.metrics.counter(
+                    "serve_cache_failover"
+                ).inc()
+            return {**entry, "store": candidate}
+        self._drop(digest, reason="all_replicas_corrupt")
+        return None
+
+    def _drop(self, digest: str, *, reason: str) -> None:
+        """Retire an entry that can no longer be served (primary and
+        every mirror corrupt or gone). Dropping is what converts "bad
+        cache" into "cache miss" — the caller launches fresh."""
+        try:
+            os.remove(self.entry_path(digest))
+        except OSError:
+            pass
+        self.metrics.counter(
+            "serve_cache_dropped", reason=reason
+        ).inc()
+
+    # -------------------------------------------------------- describe
+
+    def describe(self) -> dict:
+        entries = 0
+        if os.path.isdir(self.root):
+            for shard in os.listdir(self.root):
+                sub = os.path.join(self.root, shard)
+                if os.path.isdir(sub):
+                    entries += sum(
+                        1 for n in os.listdir(sub)
+                        if n.endswith(".json")
+                    )
+        return {"root": self.root, "entries": entries,
+                "verify": self.verify}

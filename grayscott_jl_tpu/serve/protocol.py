@@ -37,6 +37,7 @@ __all__ = [
     "batch_settings",
     "pack_key",
     "parse_job",
+    "resolved_params",
 ]
 
 #: Named priority levels -> numeric rank (higher runs first). Clients
@@ -247,6 +248,17 @@ def _member_values(spec: JobSpec, model) -> Tuple[Tuple[str, float], ...]:
     values["noise"] = float(spec.noise)
     fields = member_param_fields(model)
     return tuple((f, values[f]) for f in fields)
+
+
+def resolved_params(spec: JobSpec) -> Tuple[Tuple[str, float], ...]:
+    """The fully-resolved, canonically-ordered member parameters of one
+    job — model defaults filled, dt/noise included, field order fixed
+    by ``member_param_fields``. This is exactly the runtime data a
+    packed slot receives, which makes it the parameter half of the
+    result-cache identity (``serve/cache.py``): two specs with this
+    tuple equal (plus equal pack-shaping fields and seed) run the same
+    member and therefore produce bitwise-identical stores."""
+    return _member_values(spec, get_model(spec.model))
 
 
 def batch_settings(specs, *, n_slots: int, output: str,

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..config.env import env_flag, env_float, env_int, env_str
 from ..models.base import SettingsError
+from . import cache as cache_mod
 from . import protocol
 
 __all__ = [
@@ -76,6 +77,15 @@ class ServeConfig:
     supervise: bool = True
     max_requeues: int = 2
     chaos: str = ""
+    sse_queue: int = 256
+    cache: bool = True
+    cache_dir: str = ""
+    cache_verify: bool = True
+    fleet_dir: str = ""
+    replica: str = ""
+    fleet_rank: int = 0
+    lease_ttl_s: float = 10.0
+    heartbeat_s: float = 2.0
 
     def describe(self) -> dict:
         return dataclasses.asdict(self)
@@ -106,8 +116,25 @@ def resolve_serve_config(settings=None) -> ServeConfig:
         supervise=env_flag("GS_SERVE_SUPERVISE", True),
         max_requeues=env_int("GS_SERVE_MAX_REQUEUES", 2),
         chaos=env_str("GS_SERVE_CHAOS", ""),
+        sse_queue=env_int("GS_SERVE_SSE_QUEUE", 256),
+        cache=cache_mod.resolve_cache_enabled(),
+        cache_dir=cache_mod.resolve_cache_dir(),
+        cache_verify=cache_mod.resolve_cache_verify(),
+        fleet_dir=env_str("GS_SERVE_FLEET_DIR", ""),
+        replica=env_str("GS_SERVE_REPLICA", ""),
+        fleet_rank=env_int("GS_SERVE_FLEET_RANK", 0),
+        lease_ttl_s=env_float("GS_SERVE_LEASE_TTL_S", 10.0),
+        heartbeat_s=env_float("GS_SERVE_HEARTBEAT_S", 2.0),
     )
-    if cfg.workers < 1:
+    if cfg.fleet_dir:
+        # A fleet member may be a pure front door (workers=0): the
+        # compute capacity lives in the shared fleet, not the process.
+        if cfg.workers < 0:
+            raise ValueError(
+                f"GS_SERVE_WORKERS must be >= 0 in fleet mode, got "
+                f"{cfg.workers}"
+            )
+    elif cfg.workers < 1:
         raise ValueError(f"GS_SERVE_WORKERS must be >= 1, got {cfg.workers}")
     if cfg.pack_max < 1:
         raise ValueError(f"GS_SERVE_PACK_MAX must be >= 1, got {cfg.pack_max}")
@@ -122,6 +149,24 @@ def resolve_serve_config(settings=None) -> ServeConfig:
     if cfg.pack_window_s < 0:
         raise ValueError(
             f"GS_SERVE_PACK_WINDOW_S must be >= 0, got {cfg.pack_window_s}"
+        )
+    if cfg.sse_queue < 1:
+        raise ValueError(
+            f"GS_SERVE_SSE_QUEUE must be >= 1, got {cfg.sse_queue}"
+        )
+    if cfg.fleet_rank < 0:
+        raise ValueError(
+            f"GS_SERVE_FLEET_RANK must be >= 0, got {cfg.fleet_rank}"
+        )
+    if cfg.lease_ttl_s <= 0:
+        raise ValueError(
+            f"GS_SERVE_LEASE_TTL_S must be > 0, got {cfg.lease_ttl_s}"
+        )
+    if not 0 < cfg.heartbeat_s < cfg.lease_ttl_s:
+        raise ValueError(
+            f"GS_SERVE_HEARTBEAT_S must be in (0, lease_ttl_s="
+            f"{cfg.lease_ttl_s}), got {cfg.heartbeat_s} — a lease must "
+            "outlive at least one missed heartbeat"
         )
     return cfg
 
@@ -157,6 +202,8 @@ class Job:
     finished_t: Optional[float] = None
     store: Optional[str] = None
     checkpoint_store: Optional[str] = None
+    digest: Optional[str] = None
+    cache: Optional[str] = None
 
     def describe(self) -> dict:
         out = {
@@ -177,6 +224,8 @@ class Job:
             "first_step_t": self.first_step_t,
             "finished_t": self.finished_t,
             "store": self.store,
+            "digest": self.digest,
+            "cache": self.cache,
         }
         if self.first_step_t is not None:
             out["request_to_first_step_s"] = round(
@@ -245,6 +294,15 @@ class Scheduler:
         self._closed = False
         self._chaos_pending = cfg.chaos.strip()
         self._unsubscribe = None
+        self.cache: Optional[cache_mod.ResultCache] = None
+        if cfg.cache:
+            root = cfg.cache_dir or os.path.join(
+                cfg.fleet_dir or cfg.state_dir, "cache"
+            )
+            self.cache = cache_mod.ResultCache(
+                root, events=self.events, metrics=self.metrics,
+                verify=cfg.cache_verify,
+            )
 
     # ------------------------------------------------------------ events
 
@@ -298,6 +356,12 @@ class Scheduler:
         spec = protocol.parse_job(
             payload, max_l=self.cfg.max_l, max_steps=self.cfg.max_steps
         )
+        # Cache probe OUTSIDE the lock: the CRC audit of a cached
+        # artifact is I/O, and admission must not serialize behind it.
+        digest = cached = None
+        if self.cache is not None:
+            digest = cache_mod.job_digest(spec)
+            cached = self.cache.lookup(digest)
         with self._cond:
             self._seq += 1
             job = Job(
@@ -306,7 +370,37 @@ class Scheduler:
                 spec=spec,
                 seq=self._seq,
                 submitted_t=time.time(),
+                digest=digest,
             )
+            if cached is not None and not self._closed:
+                # The determinism dividend (ROADMAP item 4): this exact
+                # physics already ran somewhere in the fleet and its
+                # CRC-verified store is on disk — answer in
+                # O(store-read), consuming no queue slot, no tenant
+                # quota, and no worker launch.
+                now = time.time()
+                job.cache = "hit"
+                job.state = "complete"
+                job.store = cached["store"]
+                job.first_step_t = job.finished_t = now
+                self.jobs[job.id] = job
+                self.metrics.counter("serve_cache_hits").inc()
+                self.events.emit(
+                    "job_submitted", job=job.id, tenant=job.tenant,
+                    priority=spec.priority, model=spec.model, L=spec.L,
+                    steps=spec.steps, cache="hit",
+                )
+                self.events.emit(
+                    "cache_hit", digest=digest, job=job.id,
+                    tenant=job.tenant,
+                )
+                self.events.emit(
+                    "job_complete", job=job.id, tenant=job.tenant,
+                    status="complete", cache="hit",
+                    wall_s=round(now - job.submitted_t, 3),
+                )
+                self._cond.notify_all()
+                return job
             reason = self._admission_reason(job)
             if reason is not None:
                 job.state = "rejected"
@@ -333,6 +427,13 @@ class Scheduler:
                 priority=spec.priority, model=spec.model, L=spec.L,
                 steps=spec.steps,
             )
+            if self.cache is not None:
+                job.cache = "miss"
+                self.metrics.counter("serve_cache_misses").inc()
+                self.events.emit(
+                    "cache_miss", digest=digest, job=job.id,
+                    tenant=job.tenant,
+                )
             self._cond.notify_all()
             return job
 
@@ -534,6 +635,18 @@ class Scheduler:
                 "serve_batches_complete", ok=str(ok).lower()
             ).inc()
             self._cond.notify_all()
+        if ok and self.cache is not None:
+            # Publish OUTSIDE the lock: replication + the CRC audit are
+            # store I/O, and admission must not stall behind them. A
+            # job whose launch wrote no store (plotgap=0, no
+            # checkpoints) simply isn't cacheable — publish declines
+            # silently.
+            for job in batch.jobs:
+                if job.store:
+                    self.cache.publish(
+                        job.spec, job.store, job=job.id,
+                        digest=job.digest,
+                    )
 
     # ----------------------------------------------------------- status
 
@@ -548,6 +661,19 @@ class Scheduler:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+    def close(self) -> None:
+        """Full teardown: drain + detach the event subscription. The
+        fleet scheduler (``serve/cluster.py``) extends this with
+        membership retirement; the server calls ``close`` uniformly."""
+        self.drain()
+        self.detach_events()
+
+    def announce_endpoint(self, host: str, port: int) -> None:
+        """Fleet replicas record their bound HTTP endpoint in the
+        shared member doc (``ClusterScheduler``) so peers and
+        launchers can discover ephemeral ports; the single-process
+        scheduler has nobody to tell."""
 
     def idle(self) -> bool:
         """No queued work and no in-flight batches."""

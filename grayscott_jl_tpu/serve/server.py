@@ -125,12 +125,24 @@ class ServeService:
         self.cfg = cfg
         self.log = log or Logger(verbose=True)
         os.makedirs(cfg.state_dir, exist_ok=True)
-        self.events = _ensure_events(cfg.state_dir)
-        self.scheduler = Scheduler(cfg, events=self.events)
+        if cfg.fleet_dir:
+            # Fleet replica: per-member .rank<N> event file + the
+            # shared-KV scheduler — any replica can answer for any job.
+            from .cluster import ClusterScheduler, arm_fleet_events
+
+            self.events = arm_fleet_events(cfg)
+            self.scheduler = ClusterScheduler(
+                cfg, role="frontdoor", events=self.events, log=self.log,
+            )
+        else:
+            self.events = _ensure_events(cfg.state_dir)
+            self.scheduler = Scheduler(cfg, events=self.events)
         self.scheduler.attach_events()
         self.fleet = WorkerFleet(self.scheduler, cfg, log=self.log)
         handler = _make_handler(self)
         self.httpd = _Server((cfg.host, cfg.port), handler)
+        if cfg.fleet_dir:
+            self.scheduler.announce_endpoint(cfg.host, self.port)
         self._http_thread: Optional[threading.Thread] = None
 
     @property
@@ -157,7 +169,7 @@ class ServeService:
         then stop the HTTP loop."""
         self.scheduler.drain()
         self.fleet.stop(timeout)
-        self.scheduler.detach_events()
+        self.scheduler.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._http_thread is not None:
@@ -300,17 +312,32 @@ def _make_handler(service: ServeService):
             """Live progress: replay the job's current state, then
             stream its lifecycle + batch run events until terminal.
             Frames are ``event: <kind>`` + JSON data lines; output
-            boundaries additionally carry a coarse field slice."""
-            q: "queue.Queue" = queue.Queue(maxsize=256)
+            boundaries additionally carry a coarse field slice.
+
+            The per-subscriber queue is BOUNDED (GS_SERVE_SSE_QUEUE):
+            a slow client drops frames, it never grows an unbounded
+            buffer inside the serving process or blocks the emitting
+            run. The idle poll doubles as the disconnect detector —
+            the keepalive write to a dead socket raises, the handler
+            returns, and ``finally`` unsubscribes the fan-out — and,
+            in fleet mode, as the terminal detector: another process's
+            ``job_complete`` never flows through THIS process's stream,
+            so the refreshed job document is what ends the session."""
+            q: "queue.Queue" = queue.Queue(
+                maxsize=service.cfg.sse_queue
+            )
+            ref = {"job": job}
 
             def fan_out(record: dict) -> None:
                 # This job's own lifecycle records, plus its batch's
-                # run events (job.batch_id is read live — the job may
-                # still be queued when the client connects).
+                # run events (the job snapshot is refreshed on idle —
+                # the job may still be queued when the client
+                # connects).
+                j = ref["job"]
                 attrs = record.get("attrs") or {}
-                if attrs.get("job") == job.id or (
-                    job.batch_id is not None
-                    and attrs.get("batch") == job.batch_id
+                if attrs.get("job") == j.id or (
+                    j.batch_id is not None
+                    and attrs.get("batch") == j.batch_id
                 ):
                     try:
                         q.put_nowait(record)
@@ -331,8 +358,16 @@ def _make_handler(service: ServeService):
                     return
                 while True:
                     try:
-                        record = q.get(timeout=30.0)
+                        record = q.get(timeout=5.0)
                     except queue.Empty:
+                        latest = scheduler.jobs.get(job.id)
+                        if latest is not None:
+                            ref["job"] = latest
+                            if latest.state in terminal:
+                                self._sse_frame(
+                                    "done", latest.describe()
+                                )
+                                return
                         self.wfile.write(b": keepalive\n\n")
                         self.wfile.flush()
                         continue
@@ -354,7 +389,7 @@ def _make_handler(service: ServeService):
                     ):
                         self._sse_frame("done", job.describe())
                         return
-            except (BrokenPipeError, ConnectionResetError):
+            except OSError:
                 pass  # client went away — normal SSE teardown
             finally:
                 unsubscribe()
@@ -371,11 +406,29 @@ def _make_handler(service: ServeService):
 
 def main(argv=None) -> int:
     """CLI entry (``scripts/gs_serve.py``): resolve the GS_SERVE_*
-    knobs, start the service, serve until SIGINT/SIGTERM, drain."""
+    knobs, start the service, serve until SIGINT/SIGTERM, drain.
+
+    ``--role frontdoor`` (default) runs the HTTP front door —
+    standalone, or as a fleet replica when ``GS_SERVE_FLEET_DIR`` is
+    set. ``--role worker`` runs a headless fleet worker process
+    (``serve/cluster.worker_main``)."""
     import signal
 
     from .scheduler import resolve_serve_config
 
+    argv = list(argv or [])
+    role = "frontdoor"
+    if "--role" in argv:
+        i = argv.index("--role")
+        role = argv[i + 1] if i + 1 < len(argv) else ""
+    if role == "worker":
+        from .cluster import worker_main
+
+        return worker_main(argv)
+    if role != "frontdoor":
+        raise SystemExit(
+            f"gs-serve: unknown --role {role!r} (frontdoor|worker)"
+        )
     cfg = resolve_serve_config()
     service = ServeService(cfg)
     stop = threading.Event()

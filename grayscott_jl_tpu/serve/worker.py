@@ -162,12 +162,16 @@ class WorkerFleet:
                 settings.restart = False
         self.launches += 1
         t0 = time.time()
+        member = getattr(self.scheduler, "member_id", "") or "local"
         try:
             # Every event the launch emits from this thread (driver
             # lifecycle, journal mirrors) carries the batch id — the
             # scheduler's progress tracker and the SSE fan-out key on
-            # it (obs/events.bound).
-            with obs_events.bound(batch=batch.id):
+            # it (obs/events.bound) — plus the launching worker's
+            # fleet identity, so a merged multi-rank report can
+            # attribute every run event to the process that ran it.
+            with obs_events.bound(batch=batch.id,
+                                  worker=f"{member}.{worker_id}"):
                 if batch.supervise:
                     from ..resilience.supervisor import supervise
 

@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# Chaos smoke test, eight scenarios (1-3 against one uninterrupted
+# Chaos smoke test, nine scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
 # elastic — resume on a DIFFERENT mesh / member count than the kill,
 # 6 serve — a worker killed mid-batch under the service front door,
 # 7 integrity — silent checkpoint corruption survived by replica
 # failover, 8 precision — lossy output resumed from an exact
-# checkpoint):
+# checkpoint, 9 fleet — a front-door replica AND a leaseholding
+# worker process SIGKILLed mid-load under the distributed fleet):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -54,7 +55,18 @@
 #      (replica_failover on GS_EVENTS, validated by gs_report.py
 #      --check) -> final output stores byte-identical to an
 #      uninterrupted run, and the surviving mirror byte-identical to
-#      the uninterrupted primary.
+#      the uninterrupted primary;
+#   9. distributed fleet (docs/SERVICE.md "the distributed fleet"):
+#      two front-door replicas + two worker processes share one
+#      GS_SERVE_FLEET_DIR; one front door AND the worker holding a
+#      batch lease are SIGKILLed mid-load -> the surviving replica's
+#      reaper expires the lease, the surviving worker adopts the
+#      resume entry, and EVERY accepted job completes; re-requesting
+#      a completed JobSpec is served from the content-addressed result
+#      cache with cache="hit" provenance and a byte-identical store;
+#      the merged multi-rank event stream (worker_join/worker_lost/
+#      job_failover/cache_* kinds included) validates via
+#      gs_report.py --check.
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -644,7 +656,170 @@ grep -aq '"fault": "preempt"' "$WORK/lossy/events.jsonl" || {
   exit 1
 }
 
-echo "chaos_smoke: PASS — all eight scenarios recovered byte-identical" \
+echo "chaos_smoke: [9/9] fleet — front door + worker SIGKILLed mid-load, cache replay..."
+# Distributed-fleet edition (ISSUE 17): the kill is a real SIGKILL of
+# two of the four fleet PROCESSES — no in-process chaos hook — so the
+# recovery path is lease expiry -> reaper fail-over -> resume adoption
+# by the surviving worker, all through the shared fleet dir.
+mkdir -p "$WORK/fleet"
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS=cpu \
+  REPO_DIR="$REPO" \
+  FLEET_WORK="$WORK/fleet" \
+  python3 - <<'EOF'
+import filecmp, json, os, shutil, signal, subprocess, sys, time
+import urllib.request
+
+repo = os.environ["REPO_DIR"]
+work = os.environ["FLEET_WORK"]
+fleet_dir = os.path.join(work, "fleet")
+
+sys.path.insert(0, repo)
+from grayscott_jl_tpu.serve.cluster import FleetKV
+
+
+def member_env(rank, workers):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["GS_SERVE_FLEET_DIR"] = fleet_dir
+    env["GS_SERVE_FLEET_RANK"] = str(rank)
+    env["GS_SERVE_PORT"] = "0"
+    env["GS_SERVE_WORKERS"] = str(workers)
+    env["GS_SERVE_STATE_DIR"] = os.path.join(work, f"state{rank}")
+    env["GS_SERVE_LEASE_TTL_S"] = "3.0"
+    env["GS_SERVE_HEARTBEAT_S"] = "0.5"
+    env["GS_SERVE_PACK_MAX"] = "2"
+    env["GS_SERVE_PACK_WINDOW_S"] = "0.1"
+    env["GS_SERVE_SUPERVISE"] = "0"
+    env["GS_EVENTS"] = os.path.join(work, "events.jsonl")
+    env["GS_CKPT_REPLICAS"] = "2"
+    return env
+
+
+def post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode()
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+def spec(i):
+    return {
+        "tenant": "chaos", "model": "grayscott", "L": 16, "steps": 24,
+        "plotgap": 8, "checkpoint_freq": 8, "dt": 1.0, "noise": 0.1,
+        "seed": 200 + i,
+        "params": {"F": 0.03 + 0.002 * i, "k": 0.062,
+                   "Du": 0.2, "Dv": 0.1},
+    }
+
+
+procs = []
+for rank, role in ((0, "frontdoor"), (1, "frontdoor"),
+                   (2, "worker"), (3, "worker")):
+    args = [sys.executable, os.path.join(repo, "scripts", "gs_serve.py")]
+    if role == "worker":
+        args += ["--role", "worker"]
+    procs.append(subprocess.Popen(
+        args, env=member_env(rank, 1 if role == "worker" else 0),
+        cwd=work,
+        stdout=open(os.path.join(work, f"member{rank}.log"), "w"),
+        stderr=subprocess.STDOUT,
+    ))
+
+kv = FleetKV(fleet_dir)
+bases = {}
+deadline = time.time() + 120
+while time.time() < deadline and len(bases) < 2:
+    for mid in kv.keys("members"):
+        doc = kv.get(f"members/{mid}")
+        if doc and doc.get("role") == "frontdoor" and doc.get("port"):
+            bases[mid] = (f"http://{doc['host']}:{doc['port']}",
+                          doc["pid"])
+    time.sleep(0.2)
+assert len(bases) == 2, f"front doors never announced: {bases}"
+(base_a, pid_a), (base_b, pid_b) = sorted(bases.values())
+
+jobs = [post(base_a if i % 2 == 0 else base_b,
+             "/v1/jobs", spec(i))["job"] for i in range(4)]
+
+victim_pid = None
+deadline = time.time() + 120
+while time.time() < deadline and victim_pid is None:
+    for bid in kv.keys("leases"):
+        lease = kv.get(f"leases/{bid}")
+        mdoc = lease and kv.get(f"members/{lease['worker']}")
+        if mdoc:
+            victim_pid = mdoc["pid"]
+            break
+    time.sleep(0.05)
+assert victim_pid is not None, "no worker ever took a lease"
+os.kill(victim_pid, signal.SIGKILL)
+os.kill(pid_b, signal.SIGKILL)
+
+jobs += [post(base_a, "/v1/jobs", spec(i))["job"] for i in (4, 5)]
+
+deadline = time.time() + 420
+records = []
+while time.time() < deadline:
+    records = [get(base_a, f"/v1/jobs/{j}") for j in jobs]
+    if all(r["state"] in ("complete", "failed") for r in records):
+        break
+    time.sleep(0.3)
+states = [r["state"] for r in records]
+assert states == ["complete"] * 6, f"fleet job states: {states}"
+
+# Cached replay: the repeated JobSpec is terminal IN the submit
+# response, names the same store, and the bytes are identical.
+target = records[0]
+snapshot = os.path.join(work, "snapshot.bp")
+shutil.copytree(target["store"], snapshot)
+body = post(base_a, "/v1/jobs", spec(0))
+assert body["cache"] == "hit", body
+assert body["state"] == "complete", body
+assert body["store"] == target["store"], body
+cmp = filecmp.dircmp(snapshot, body["store"])
+assert not (cmp.left_only or cmp.right_only or cmp.diff_files), (
+    f"cached store drifted: {cmp.diff_files}"
+)
+assert all(
+    open(os.path.join(snapshot, f), "rb").read()
+    == open(os.path.join(body["store"], f), "rb").read()
+    for f in cmp.common_files
+), "cached replay not byte-identical"
+
+for p in procs:
+    if p.poll() is None:
+        p.send_signal(signal.SIGTERM)
+for p in procs:
+    try:
+        p.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+print(f"fleet chaos: killed front door {pid_b} + worker {victim_pid} "
+      f"mid-load; 6/6 jobs completed, cached replay byte-identical")
+EOF
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/fleet/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the fleet events" >&2
+  exit 1
+}
+grep -aq '"kind": "worker_lost"' "$WORK/fleet"/events.jsonl.rank* || {
+  echo "chaos_smoke: FAIL — no worker_lost on the merged fleet stream" >&2
+  exit 1
+}
+grep -aq '"kind": "cache_hit"' "$WORK/fleet"/events.jsonl.rank* || {
+  echo "chaos_smoke: FAIL — no cache_hit on the merged fleet stream" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all nine scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

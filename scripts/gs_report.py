@@ -94,6 +94,18 @@ EVENT_KIND_SCHEMA = {
     "job_requeued": ("job", "tenant", "batch", "fault"),
     "job_complete": ("job", "tenant", "status"),
     "job_rejected": ("job", "tenant", "reason"),
+    # distributed serve fleet + result cache (serve/cluster.py,
+    # serve/cache.py; docs/SERVICE.md "the distributed fleet"):
+    # membership joins/losses, a dead worker's batch failing over to
+    # the fleet, and the content-addressed cache's hit/miss/publish
+    # provenance (the digest names the physics; byte-identical replay
+    # is the contract).
+    "worker_join": ("worker", "role"),
+    "worker_lost": ("worker",),
+    "job_failover": ("job", "tenant", "batch", "worker"),
+    "cache_hit": ("digest", "job", "tenant"),
+    "cache_miss": ("digest", "job", "tenant"),
+    "cache_publish": ("digest", "job", "store"),
 }
 
 
@@ -456,6 +468,52 @@ def report_tenants(events) -> None:
                   f"{batch}{req} {wait} {total}")
 
 
+def report_fleet(events) -> None:
+    """The distributed-fleet story (docs/SERVICE.md): membership
+    joins/losses, batch fail-overs, and the result cache's
+    hit/miss/publish ledger distilled from the (rank-merged) stream —
+    the section an operator checks to answer "did the fleet lose a
+    member, and did any accepted job go with it?" (the correct answer
+    to the second half is always no)."""
+    def kind_of(e):
+        return e.get("kind") or e.get("event")
+
+    joins = [e for e in events if kind_of(e) == "worker_join"]
+    losses = [e for e in events if kind_of(e) == "worker_lost"]
+    failovers = [e for e in events if kind_of(e) == "job_failover"]
+    hits = [e for e in events if kind_of(e) == "cache_hit"]
+    misses = [e for e in events if kind_of(e) == "cache_miss"]
+    publishes = [e for e in events if kind_of(e) == "cache_publish"]
+    if not (joins or losses or failovers or hits or misses
+            or publishes):
+        return
+    print("== fleet ==")
+    roles: dict = {}
+    for e in joins:
+        role = (e.get("attrs") or {}).get("role", "?")
+        roles[role] = roles.get(role, 0) + 1
+    role_s = " ".join(f"{r}={n}" for r, n in sorted(roles.items()))
+    print(f"  members joined={len(joins)} ({role_s or '-'}) "
+          f"lost={len(losses)} job failovers={len(failovers)}")
+    for e in losses:
+        attrs = e.get("attrs") or {}
+        print(f"  lost {attrs.get('worker')}")
+    for e in failovers:
+        attrs = e.get("attrs") or {}
+        print(f"  failover {attrs.get('job')} "
+              f"(batch {attrs.get('batch')}) off dead worker "
+              f"{attrs.get('worker')}")
+    lookups = len(hits) + len(misses)
+    rate = f"{100 * len(hits) / lookups:.1f}%" if lookups else "-"
+    print(f"  cache: {len(hits)} hit / {len(misses)} miss "
+          f"({rate} hit rate), {len(publishes)} publish(es)")
+    for e in hits:
+        attrs = e.get("attrs") or {}
+        print(f"  hit {attrs.get('job')} <- "
+              f"{str(attrs.get('digest'))[:12]} "
+              f"(tenant {attrs.get('tenant')})")
+
+
 def report_integrity(events) -> None:
     """The data-integrity story (docs/RESILIENCE.md): detected
     corruptions, replica failovers, and scrub audits distilled from
@@ -602,6 +660,7 @@ def main() -> int:
     if events:
         report_attempts(events)
         report_tenants(events)
+        report_fleet(events)
         report_integrity(events)
         report_timeline(events, args.top)
     return 0

@@ -3,6 +3,14 @@
 
     GS_SERVE_PORT=8642 python scripts/gs_serve.py
 
+Fleet mode (docs/SERVICE.md, "the distributed fleet") — every member
+shares GS_SERVE_FLEET_DIR and gets a unique GS_SERVE_FLEET_RANK::
+
+    GS_SERVE_FLEET_DIR=/shared/fleet GS_SERVE_FLEET_RANK=0 \\
+        python scripts/gs_serve.py                     # front door
+    GS_SERVE_FLEET_DIR=/shared/fleet GS_SERVE_FLEET_RANK=2 \\
+        python scripts/gs_serve.py --role worker       # worker
+
 All configuration rides the ``GS_SERVE_*`` env knob family (resolved
 by ``grayscott_jl_tpu.serve.scheduler.resolve_serve_config``; table in
 docs/SERVICE.md and README). SIGTERM/SIGINT drain the service: no new

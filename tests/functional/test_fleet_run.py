@@ -1,0 +1,266 @@
+"""Functional: the distributed serve fleet end to end (ISSUE 17).
+
+The acceptance contracts:
+
+* **multi-process load**: two front-door replicas + two worker
+  processes joined only through ``GS_SERVE_FLEET_DIR``; jobs admitted
+  by EITHER front door run on the shared worker pool and any replica
+  answers status for any job;
+* **fail-over**: SIGKILL one front door AND the worker holding a lease
+  mid-load — every accepted job still completes (lease expiry ->
+  reaper -> resume adoption by the survivor);
+* **result cache**: re-requesting a completed JobSpec returns a
+  byte-identical payload from the cache with ``cache="hit"``
+  provenance and ZERO new launches; a deliberately corrupted cached
+  artifact is CRC-detected and served from its replica; when every
+  copy is corrupt the request degrades to a fresh launch — a bad byte
+  is never served;
+* the merged multi-rank event stream validates with
+  ``gs_report --check`` and renders the ``== fleet ==`` section.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from test_serve_run import _get, _post
+
+from grayscott_jl_tpu.resilience.integrity import corrupt_store_byte
+from grayscott_jl_tpu.serve.cluster import FleetKV
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _spec(i):
+    return {
+        "tenant": "alice" if i % 2 == 0 else "bob",
+        "model": "grayscott", "L": 16, "steps": 24,
+        "plotgap": 8, "checkpoint_freq": 8, "dt": 1.0, "noise": 0.1,
+        "seed": 100 + i,
+        "params": {"F": 0.03 + 0.001 * i, "k": 0.062,
+                   "Du": 0.2, "Dv": 0.1},
+    }
+
+
+def _member_env(tmp_path, rank, *, workers):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["GS_SERVE_FLEET_DIR"] = str(tmp_path / "fleet")
+    env["GS_SERVE_FLEET_RANK"] = str(rank)
+    env["GS_SERVE_PORT"] = "0"
+    env["GS_SERVE_WORKERS"] = str(workers)
+    env["GS_SERVE_STATE_DIR"] = str(tmp_path / f"state{rank}")
+    env["GS_SERVE_LEASE_TTL_S"] = "3.0"
+    env["GS_SERVE_HEARTBEAT_S"] = "0.5"
+    env["GS_SERVE_PACK_MAX"] = "2"
+    env["GS_SERVE_PACK_WINDOW_S"] = "0.1"
+    env["GS_SERVE_SUPERVISE"] = "0"
+    env["GS_EVENTS"] = str(tmp_path / "events.jsonl")
+    env["GS_CKPT_REPLICAS"] = "2"
+    return env
+
+
+def _spawn(tmp_path, rank, role):
+    args = [sys.executable, str(REPO / "scripts" / "gs_serve.py")]
+    if role == "worker":
+        args += ["--role", "worker"]
+    log = open(tmp_path / f"member{rank}.log", "w")
+    proc = subprocess.Popen(
+        args, env=_member_env(
+            tmp_path, rank, workers=1 if role == "worker" else 0,
+        ),
+        cwd=tmp_path, stdout=log, stderr=subprocess.STDOUT,
+    )
+    proc._gs_log = log  # closed with the process, test-only
+    return proc
+
+
+def _frontdoor_bases(kv, want, timeout=120):
+    """Discover the replicas' ephemeral ports from their member docs
+    (``announce_endpoint``) — the fleet's own service discovery."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        bases = {}
+        for mid in kv.keys("members"):
+            doc = kv.get(f"members/{mid}")
+            if (doc and doc.get("role") == "frontdoor"
+                    and doc.get("port")):
+                bases[mid] = (
+                    f"http://{doc['host']}:{doc['port']}", doc["pid"]
+                )
+        if len(bases) >= want:
+            return bases
+        time.sleep(0.2)
+    raise AssertionError(f"front doors never announced: {bases}")
+
+
+def _wait_terminal(base, jobs, timeout=420):
+    deadline = time.time() + timeout
+    records = []
+    while time.time() < deadline:
+        records = [_get(base, f"/v1/jobs/{j}")[1] for j in jobs]
+        if all(r["state"] in ("complete", "failed", "cancelled")
+               for r in records):
+            return records
+        time.sleep(0.3)
+    raise AssertionError(
+        f"fleet jobs never finished: "
+        f"{[(r['job'], r['state']) for r in records]}"
+    )
+
+
+def _store_hash(store):
+    h = hashlib.sha256()
+    for p in sorted(Path(store).rglob("*")):
+        if p.is_file():
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def _run_starts(tmp_path):
+    """Launches fleet-wide: run_start events across every rank file."""
+    n = 0
+    for p in Path(tmp_path).glob("events.jsonl.rank*"):
+        for line in p.read_text().splitlines():
+            try:
+                if json.loads(line).get("kind") == "run_start":
+                    n += 1
+            except json.JSONDecodeError:
+                pass  # torn tail of a SIGKILLed writer
+    return n
+
+
+def test_fleet_failover_and_result_cache(tmp_path):
+    """The whole acceptance story in one fleet: 2 front doors + 2
+    workers, a mid-load SIGKILL of one front door and the leaseholding
+    worker, then the cache-hit / corruption-failover ladder."""
+    procs = {}
+    kv = FleetKV(str(tmp_path / "fleet"))
+    try:
+        procs["fd0"] = _spawn(tmp_path, 0, "frontdoor")
+        procs["fd1"] = _spawn(tmp_path, 1, "frontdoor")
+        procs["wk2"] = _spawn(tmp_path, 2, "worker")
+        procs["wk3"] = _spawn(tmp_path, 3, "worker")
+        bases = _frontdoor_bases(kv, want=2)
+        (base_a, pid_a), (base_b, pid_b) = sorted(bases.values())
+
+        # Jobs admitted through BOTH front doors land in one queue.
+        jobs = []
+        for i in range(4):
+            base = base_a if i % 2 == 0 else base_b
+            jobs.append(_post(base, "/v1/jobs", _spec(i))[1]["job"])
+
+        # Wait for a worker to commit to a batch, then kill it AND
+        # the front door we will not use again — no single process
+        # may lose an accepted job.
+        deadline = time.time() + 120
+        victim_pid = None
+        while time.time() < deadline and victim_pid is None:
+            for bid in kv.keys("leases"):
+                lease = kv.get(f"leases/{bid}")
+                if lease is None:
+                    continue
+                mdoc = kv.get(f"members/{lease['worker']}")
+                if mdoc:
+                    victim_pid = mdoc["pid"]
+                    break
+            time.sleep(0.05)
+        assert victim_pid is not None, "no worker ever took a lease"
+        os.kill(victim_pid, signal.SIGKILL)
+        os.kill(pid_b, signal.SIGKILL)
+        surviving_base = base_a
+        for p in procs.values():
+            if p.pid in (victim_pid, pid_b):
+                p.wait(timeout=30)
+
+        # Admission continues on the surviving replica mid-failover.
+        for i in (4, 5):
+            jobs.append(
+                _post(surviving_base, "/v1/jobs", _spec(i))[1]["job"]
+            )
+
+        records = _wait_terminal(surviving_base, jobs)
+        assert [r["state"] for r in records] == ["complete"] * 6, (
+            records
+        )
+        assert all(r["store"] for r in records)
+
+        # ------------------------------------------------ cache ladder
+        target = records[0]
+        snapshot = _store_hash(target["store"])
+        launches_before = _run_starts(tmp_path)
+
+        # 1. Repeat spec -> cache hit: terminal in the submit
+        #    response, byte-identical store, zero new launches.
+        code, body = _post(surviving_base, "/v1/jobs", _spec(0))
+        assert code == 200
+        assert body["cache"] == "hit"
+        assert body["state"] == "complete"
+        assert body["store"] == target["store"]
+        assert _store_hash(body["store"]) == snapshot
+        assert _run_starts(tmp_path) == launches_before
+
+        # 2. Corrupt the cached primary -> CRC detected at lookup,
+        #    the .r1 mirror is served; still no launch.
+        assert corrupt_store_byte(target["store"]) is not None
+        mirror = f"{target['store']}.r1"
+        assert os.path.isdir(mirror)
+        code, body = _post(surviving_base, "/v1/jobs", _spec(0))
+        assert code == 200
+        assert body["cache"] == "hit"
+        assert body["store"] == mirror
+        assert _store_hash(mirror) == snapshot
+        assert _run_starts(tmp_path) == launches_before
+
+        # 3. Corrupt the mirror too -> every copy bad: the entry is
+        #    dropped and the request degrades to a fresh launch — the
+        #    corrupt bytes are never served.
+        assert corrupt_store_byte(mirror) is not None
+        code, body = _post(surviving_base, "/v1/jobs", _spec(0))
+        assert code == 200
+        assert body["cache"] == "miss"
+        fresh = _wait_terminal(surviving_base, [body["job"]])[0]
+        assert fresh["state"] == "complete"
+        assert fresh["store"] != target["store"]
+        assert _store_hash(fresh["store"]) == snapshot  # same physics
+        assert _run_starts(tmp_path) > launches_before
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=30)
+
+    # ------------------------------------------- merged stream report
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    events_base = str(tmp_path / "events.jsonl")
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--check", "--events", events_base],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gs_report.py"),
+         "--events", events_base],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "== fleet ==" in res.stdout
+    assert "worker_lost" in res.stdout or "lost" in res.stdout
+    assert "cache" in res.stdout

@@ -370,3 +370,52 @@ def test_serve_load_1k_clients(tmp_path, serve_env):
     assert m["completed"] == 1000
     assert m["p99_request_to_first_step_ms"] <= 300 * 1e3
     assert m["warm_hits"] == m["launches"] - 1
+
+
+def test_sse_disconnected_clients_are_reaped(tmp_path, serve_env):
+    """Satellite of ISSUE 17: a client that drops its SSE socket
+    mid-stream must not leak its fan-out subscriber. The idle
+    keepalive (or the next frame write) hits the dead socket, the
+    handler raises OSError, and the ``finally`` unsubscribes — the
+    stream's subscriber count returns to baseline under load."""
+    import http.client
+
+    svc, base = start_service(
+        tmp_path, "svc", pack_window_s=0.5, workers=1,
+    )
+    try:
+        assert svc.cfg.sse_queue >= 1  # bounded per-subscriber queue
+        baseline = svc.events.describe()["subscribers"]
+        job = _post(base, "/v1/jobs", SPECS[0])[1]["job"]
+        conns = []
+        for _ in range(5):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", svc.port, timeout=120,
+            )
+            conn.request("GET", f"/v1/jobs/{job}/events")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            conns.append((conn, resp))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if svc.events.describe()["subscribers"] == baseline + 5:
+                break
+            time.sleep(0.1)
+        assert svc.events.describe()["subscribers"] == baseline + 5
+        # Drop four clients abruptly — no clean HTTP teardown — while
+        # the job is still in flight; keep one honest client.
+        for conn, _ in conns[:4]:
+            conn.close()
+        # The batch runs to completion under the remaining client.
+        wait_terminal(base, [job])
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if svc.events.describe()["subscribers"] <= baseline:
+                break
+            time.sleep(0.25)
+        assert svc.events.describe()["subscribers"] <= baseline, (
+            "SSE fan-out leaked subscribers after client disconnect"
+        )
+        conns[4][0].close()
+    finally:
+        svc.close()

@@ -1,0 +1,314 @@
+"""Unit coverage for the content-addressed result cache (ISSUE 17).
+
+Cache-key canonicalization: parameter ordering, default filling, and
+float formatting must all hash stably — two spellings of the same
+physics collide on one digest, while ANY physics-relevant delta (a
+parameter ulp, the seed, L, steps, precision or posture) separates.
+Scheduling-only fields (tenant, priority) are deliberately excluded.
+
+ResultCache mechanics with an injectable verifier: publish/lookup
+round-trip, the never-serve-a-bad-byte read gate (corrupt primary ->
+mirror failover; every copy corrupt -> entry dropped, lookup degrades
+to a miss), and the scheduler's hit path completing a repeat JobSpec
+without consuming a queue slot.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from grayscott_jl_tpu.models import get_model
+from grayscott_jl_tpu.obs.events import NULL_EVENTS
+from grayscott_jl_tpu.resilience.integrity import CorruptionError
+from grayscott_jl_tpu.serve import protocol
+from grayscott_jl_tpu.serve.cache import (
+    ResultCache,
+    canonical_spec,
+    job_digest,
+)
+from grayscott_jl_tpu.serve.scheduler import Scheduler, ServeConfig
+
+SPEC = {
+    "tenant": "alice",
+    "model": "grayscott",
+    "L": 16,
+    "steps": 24,
+    "plotgap": 8,
+    "checkpoint_freq": 8,
+    "params": {"F": 0.03, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "dt": 1.0,
+    "noise": 0.1,
+    "seed": 11,
+}
+
+
+def parse(**kw):
+    return protocol.parse_job({**SPEC, **kw})
+
+
+# ------------------------------------------------- key canonicalization
+
+
+def test_digest_is_deterministic():
+    assert job_digest(parse()) == job_digest(parse())
+    assert len(job_digest(parse())) == 64  # sha256 hex
+
+
+def test_digest_param_order_invariant():
+    a = parse(params={"F": 0.03, "k": 0.062, "Du": 0.2, "Dv": 0.1})
+    b = parse(params={"Dv": 0.1, "Du": 0.2, "k": 0.062, "F": 0.03})
+    assert job_digest(a) == job_digest(b)
+
+
+def test_digest_default_filling():
+    """A sparse params dict and the same values spelled explicitly are
+    the same scenario — defaults are filled before hashing."""
+    model = get_model("grayscott")
+    defaults = dict(model.param_defaults)
+    sparse = parse(params={"F": 0.03})
+    explicit = parse(params={**defaults, "F": 0.03})
+    assert job_digest(sparse) == job_digest(explicit)
+
+
+def test_digest_float_formatting():
+    """Decimal spellings of one value collide; a one-ulp delta
+    separates (float.hex is exact)."""
+    a = parse(params={**SPEC["params"], "k": 0.062})
+    b = parse(params={**SPEC["params"], "k": 6.2e-2})
+    assert job_digest(a) == job_digest(b)
+    ulp = parse(
+        params={**SPEC["params"], "k": math.nextafter(0.062, 1.0)}
+    )
+    assert job_digest(ulp) != job_digest(a)
+
+
+@pytest.mark.parametrize("delta", [
+    {"seed": 12},
+    {"L": 32},
+    {"steps": 32},
+    {"plotgap": 4},
+    {"checkpoint_freq": 4},
+    {"precision": "Float64"},
+    {"halo_depth": 2},
+    {"dt": 0.5},
+    {"noise": 0.0},
+])
+def test_digest_separates_physics_deltas(delta):
+    assert job_digest(parse(**delta)) != job_digest(parse())
+
+
+def test_digest_separates_models():
+    other = parse(
+        model="brusselator",
+        params={"A": 4.5, "B": 7.5, "Du": 0.2, "Dv": 0.1},
+    )
+    assert job_digest(other) != job_digest(parse())
+
+
+def test_digest_excludes_scheduling_fields():
+    """Tenant and priority shape WHO runs WHEN, not the bytes — two
+    users asking for the same physics share one entry."""
+    a = parse(tenant="alice", priority="normal")
+    b = parse(tenant="bob", priority="high")
+    assert job_digest(a) == job_digest(b)
+
+
+def test_digest_tracks_compute_precision_posture(monkeypatch):
+    base = job_digest(parse())
+    monkeypatch.setenv("GS_COMPUTE_PRECISION", "bf16_f32acc")
+    assert job_digest(parse()) != base
+
+
+def test_digest_tracks_snapshot_codec_posture(monkeypatch):
+    base = job_digest(parse())
+    monkeypatch.setenv("GS_SNAPSHOT_BITS", "8")
+    assert job_digest(parse()) != base
+
+
+def test_canonical_spec_is_json_stable():
+    doc = canonical_spec(parse())
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    assert json.loads(blob) == doc
+    assert doc["v"] == 1
+    assert [p[0] for p in doc["params"]] == sorted(
+        p[0] for p in doc["params"]
+    ) or len(doc["params"]) > 0  # canonical member order, not ad hoc
+
+
+# ------------------------------------------------------- ResultCache
+
+
+class FakeVerifier:
+    """Stands in for the CRC audit: paths in ``bad`` raise, everything
+    else passes with a report."""
+
+    def __init__(self):
+        self.bad = set()
+        self.calls = []
+
+    def __call__(self, path):
+        self.calls.append(path)
+        if path in self.bad:
+            raise CorruptionError(f"fake CRC mismatch in {path}")
+        return {"path": path, "steps_audited": 3, "blocks_checked": 6,
+                "corrupt": []}
+
+
+def make_store(tmp_path, name="gs.m00.bp"):
+    store = tmp_path / name
+    store.mkdir(parents=True)
+    (store / "data.0").write_bytes(b"payload-bytes")
+    return str(store)
+
+
+def make_cache(tmp_path, verifier, **kw):
+    return ResultCache(
+        str(tmp_path / "cache"), events=NULL_EVENTS,
+        verifier=verifier, **kw,
+    )
+
+
+def test_publish_lookup_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    store = make_store(tmp_path)
+    entry = cache.publish(spec, store, job="j1")
+    assert entry is not None and entry["store"] == store
+    assert entry["steps_audited"] == 3
+    assert os.path.exists(cache.entry_path(entry["digest"]))
+    hit = cache.lookup(job_digest(spec))
+    assert hit is not None and hit["store"] == store
+    assert cache.describe()["entries"] == 1
+
+
+def test_publish_declines_missing_or_corrupt_store(tmp_path):
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    assert cache.publish(spec, str(tmp_path / "nowhere")) is None
+    store = make_store(tmp_path)
+    fake.bad.add(store)
+    assert cache.publish(spec, store) is None
+    assert cache.lookup(job_digest(spec)) is None
+
+
+def test_lookup_fails_over_to_mirror(tmp_path, monkeypatch):
+    """Primary rots after publish -> the on-disk ``.r1`` mirror is
+    served instead; the returned entry names the healthy copy."""
+    monkeypatch.setenv("GS_CKPT_REPLICAS", "2")
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    store = make_store(tmp_path)
+    entry = cache.publish(spec, store)
+    mirror = f"{store}.r1"
+    assert os.path.isdir(mirror)  # publish mirrored the artifact
+    fake.bad.add(store)
+    hit = cache.lookup(entry["digest"])
+    assert hit is not None and hit["store"] == mirror
+    # The entry survives: the next reader fails over again.
+    assert os.path.exists(cache.entry_path(entry["digest"]))
+
+
+def test_lookup_all_copies_corrupt_drops_entry(tmp_path, monkeypatch):
+    """Every copy corrupt -> the entry is dropped and the lookup
+    degrades to a miss (fresh launch), never a bad byte."""
+    monkeypatch.setenv("GS_CKPT_REPLICAS", "2")
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    store = make_store(tmp_path)
+    entry = cache.publish(spec, store)
+    fake.bad.update({store, f"{store}.r1"})
+    assert cache.lookup(entry["digest"]) is None
+    assert not os.path.exists(cache.entry_path(entry["digest"]))
+    assert cache.lookup(entry["digest"]) is None  # stays a miss
+
+
+def test_lookup_drops_entry_for_vanished_store(tmp_path, monkeypatch):
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    store = make_store(tmp_path)
+    entry = cache.publish(spec, store)
+    import shutil
+
+    shutil.rmtree(store)
+    assert cache.lookup(entry["digest"]) is None
+    assert not os.path.exists(cache.entry_path(entry["digest"]))
+
+
+def test_lookup_verify_off_trusts_entry(tmp_path, monkeypatch):
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake, verify=False)
+    spec = parse()
+    store = make_store(tmp_path)
+    entry = cache.publish(spec, store)
+    fake.calls.clear()
+    hit = cache.lookup(entry["digest"])
+    assert hit is not None and hit["store"] == store
+    assert fake.calls == []  # read gate bypassed by choice
+
+
+def test_publish_idempotent(tmp_path, monkeypatch):
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    fake = FakeVerifier()
+    cache = make_cache(tmp_path, fake)
+    spec = parse()
+    store = make_store(tmp_path)
+    first = cache.publish(spec, store)
+    second = cache.publish(spec, store)
+    assert first["digest"] == second["digest"]
+    assert cache.describe()["entries"] == 1
+
+
+# -------------------------------------------------- scheduler hit path
+
+
+def test_scheduler_serves_repeat_spec_from_cache(tmp_path, monkeypatch):
+    """A pre-published digest completes a repeat submit WITHOUT
+    queueing: no queue slot, no quota charge, terminal state with
+    ``cache="hit"`` provenance and the cached store."""
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    sched = Scheduler(
+        ServeConfig(
+            state_dir=str(tmp_path / "state"), pack_window_s=0.0,
+            supervise=False, queue_depth=1, tenant_quota=1,
+        ),
+        events=NULL_EVENTS,
+    )
+    fake = FakeVerifier()
+    sched.cache = make_cache(tmp_path, fake)
+    store = make_store(tmp_path)
+    sched.cache.publish(parse(), store)
+    # queue_depth=1 and tenant_quota=1: if the hit consumed either,
+    # the second identical submit would be rejected instead of served.
+    for _ in range(3):
+        job = sched.submit(dict(SPEC))
+        assert job.cache == "hit"
+        assert job.state == "complete"
+        assert job.store == store
+        assert job.finished_t is not None
+    assert list(sched._queue) == []  # nothing ever queued
+
+
+def test_scheduler_miss_marks_provenance(tmp_path, monkeypatch):
+    monkeypatch.delenv("GS_CKPT_REPLICAS", raising=False)
+    sched = Scheduler(
+        ServeConfig(
+            state_dir=str(tmp_path / "state"), pack_window_s=0.0,
+            supervise=False,
+        ),
+        events=NULL_EVENTS,
+    )
+    sched.cache = make_cache(tmp_path, FakeVerifier())
+    job = sched.submit(dict(SPEC))
+    assert job.cache == "miss"
+    assert job.state == "queued"
+    assert job.digest == job_digest(parse())

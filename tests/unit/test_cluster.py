@@ -1,0 +1,332 @@
+"""Unit coverage for the distributed serve fleet (ISSUE 17).
+
+FleetKV primitives (atomic put, torn-tolerant get, exclusive claim,
+exactly-one-winner take) and the ClusterScheduler protocol driven
+entirely in-process: two schedulers sharing one fleet dir play
+front door and worker through submit -> claim -> lease -> complete,
+lease expiry turns a dead worker's batch into a resume entry that a
+survivor re-adopts, orphaned claims re-enqueue, and the requeue
+budget turns a permanently failing batch terminal instead of looping
+forever. No JAX launch anywhere — completion is driven by hand, like
+the base scheduler's unit tests.
+"""
+
+import contextlib
+import json
+import os
+import time
+
+import pytest
+
+from grayscott_jl_tpu.obs.events import NULL_EVENTS
+from grayscott_jl_tpu.serve.cluster import ClusterScheduler, FleetKV
+from grayscott_jl_tpu.serve.scheduler import AdmissionError, ServeConfig
+
+SPEC = {
+    "tenant": "alice",
+    "model": "grayscott",
+    "L": 16,
+    "steps": 24,
+    "plotgap": 8,
+    "checkpoint_freq": 8,
+    "params": {"F": 0.03, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "dt": 1.0,
+    "noise": 0.1,
+    "seed": 11,
+}
+
+
+def spec(**kw):
+    return {**SPEC, **kw}
+
+
+# ------------------------------------------------------------- FleetKV
+
+
+def test_kv_put_get_roundtrip(tmp_path):
+    kv = FleetKV(str(tmp_path))
+    kv.put("jobs/j1", {"a": 1, "nested": {"b": 2}})
+    assert kv.get("jobs/j1") == {"a": 1, "nested": {"b": 2}}
+    assert kv.get("jobs/missing") is None
+
+
+def test_kv_get_tolerates_torn_document(tmp_path):
+    kv = FleetKV(str(tmp_path))
+    os.makedirs(tmp_path / "jobs", exist_ok=True)
+    (tmp_path / "jobs" / "torn").write_text('{"half": ')
+    assert kv.get("jobs/torn") is None
+    (tmp_path / "jobs" / "scalar").write_text("42")
+    assert kv.get("jobs/scalar") is None  # not a document
+
+
+def test_kv_keys_sorted_and_tmp_filtered(tmp_path):
+    kv = FleetKV(str(tmp_path))
+    kv.put("queue/b", {})
+    kv.put("queue/a", {})
+    (tmp_path / "queue" / f"c.tmp.{os.getpid()}").write_text("{}")
+    assert kv.keys("queue") == ["a", "b"]
+    assert kv.keys("nosuch") == []
+
+
+def test_kv_claim_exactly_one_winner(tmp_path):
+    a, b = FleetKV(str(tmp_path)), FleetKV(str(tmp_path))
+    assert a.claim("claims/m/x", {"t": 1}) is True
+    assert b.claim("claims/m/x", {"t": 2}) is False
+
+
+def test_kv_take_exactly_one_winner(tmp_path):
+    a, b = FleetKV(str(tmp_path)), FleetKV(str(tmp_path))
+    a.put("queue/q1", {"job": "j1"})
+    assert a.take("queue/q1", "claims/a/q1") is True
+    assert b.take("queue/q1", "claims/b/q1") is False
+    assert a.get("claims/a/q1") == {"job": "j1"}
+    b.delete("queue/never")  # deleting a missing key is a no-op
+
+
+# ------------------------------------------------- ClusterScheduler
+
+
+def make_cfg(tmp_path, **kw):
+    defaults = dict(
+        state_dir=str(tmp_path / "state"),
+        fleet_dir=str(tmp_path / "fleet"),
+        pack_window_s=0.0, supervise=False, workers=0,
+        lease_ttl_s=5.0, heartbeat_s=1.0, cache=False,
+    )
+    defaults.update(kw)
+    return ServeConfig(**defaults)
+
+
+@contextlib.contextmanager
+def cluster(tmp_path, role="frontdoor", **kw):
+    sched = ClusterScheduler(
+        make_cfg(tmp_path, **kw), role=role, events=NULL_EVENTS,
+    )
+    try:
+        yield sched
+    finally:
+        sched.close()
+
+
+def test_submit_writes_shared_docs(tmp_path):
+    with cluster(tmp_path) as sched:
+        job = sched.submit(spec())
+        kv = FleetKV(sched.cfg.fleet_dir)
+        doc = kv.get(f"jobs/{job.id}")
+        assert doc["state"] == "queued"
+        assert doc["tenant"] == "alice"
+        markers = kv.keys("queue")
+        assert len(markers) == 1
+        assert kv.get(f"queue/{markers[0]}")["job"] == job.id
+        # Any replica reconstructs the job from the shared doc.
+        assert sched.jobs.get(job.id).id == job.id
+        assert sched.status(job.id)["state"] == "queued"
+
+
+def test_queue_markers_sort_priority_then_fifo(tmp_path):
+    with cluster(tmp_path) as sched:
+        low = sched.submit(spec(priority="low", seed=1))
+        normal = sched.submit(spec(priority="normal", seed=2))
+        high = sched.submit(spec(priority="high", seed=3))
+        kv = FleetKV(sched.cfg.fleet_dir)
+        order = [kv.get(f"queue/{q}")["job"] for q in kv.keys("queue")]
+        assert order == [high.id, normal.id, low.id]
+
+
+def test_admission_queue_depth_and_quota(tmp_path):
+    with cluster(tmp_path, queue_depth=1, tenant_quota=5) as sched:
+        sched.submit(spec(seed=1))
+        with pytest.raises(AdmissionError) as e:
+            sched.submit(spec(seed=2))
+        assert e.value.reason == "queue_full"
+    with cluster(tmp_path / "b", tenant_quota=1) as sched:
+        sched.submit(spec(seed=1))
+        with pytest.raises(AdmissionError) as e:
+            sched.submit(spec(seed=2))
+        assert e.value.reason == "tenant_quota"
+
+
+def test_cancel_take_semantics(tmp_path):
+    with cluster(tmp_path) as sched:
+        job = sched.submit(spec())
+        assert sched.cancel(job.id) is True
+        assert sched.status(job.id)["state"] == "cancelled"
+        assert FleetKV(sched.cfg.fleet_dir).keys("queue") == []
+        assert sched.cancel(job.id) is False  # already terminal
+        assert sched.cancel("jnope-00001") is False
+
+
+def test_frontdoor_submits_worker_claims_and_completes(tmp_path):
+    """The cross-process protocol in one process: a front door admits,
+    a separate worker-role scheduler claims the batch through the KV
+    queue, leases it, and completes it; the front door then answers
+    status from the shared docs."""
+    with cluster(tmp_path, role="frontdoor") as fd, \
+            cluster(tmp_path, role="worker") as wk:
+        a = fd.submit(spec(seed=1))
+        b = fd.submit(spec(seed=2))
+        batch = wk.next_batch(timeout=1.0)
+        assert batch is not None
+        assert sorted(batch.job_ids) == sorted([a.id, b.id])
+        kv = FleetKV(fd.cfg.fleet_dir)
+        assert kv.keys("queue") == []  # markers consumed
+        lease = kv.get(f"leases/{batch.id}")
+        assert lease["worker"] == wk.member_id
+        assert fd.status(a.id)["state"] == "packed"
+        wk.complete(batch, ok=True, wall_s=0.1)
+        assert kv.get(f"leases/{batch.id}") is None
+        for jid in (a.id, b.id):
+            st = fd.status(jid)
+            assert st["state"] == "complete"
+            assert st["store"]
+        assert fd.idle() and wk.idle()
+
+
+def test_lease_expiry_fails_over_to_survivor(tmp_path):
+    """A dead worker's expired lease is reaped into a resume entry
+    (job_failover path) that a surviving worker re-adopts with a
+    bumped attempt — the fleet-wide requeue."""
+    with cluster(tmp_path, role="frontdoor") as fd, \
+            cluster(tmp_path, role="worker") as dead, \
+            cluster(tmp_path, role="worker") as survivor:
+        job = fd.submit(spec())
+        batch = dead.next_batch(timeout=1.0)
+        assert batch is not None
+        kv = FleetKV(fd.cfg.fleet_dir)
+        # Simulate the worker dying: it stops renewing (forget the
+        # held batch) and its lease expires.
+        dead._held.pop(batch.id)
+        lease = kv.get(f"leases/{batch.id}")
+        lease["expires_t"] = time.time() - 1.0
+        kv.put(f"leases/{batch.id}", lease)
+        fd._reap_leases(time.time())
+        assert kv.get(f"leases/{batch.id}") is None
+        resume = kv.get(f"resume/{batch.id}")
+        assert resume is not None and resume["attempt"] == 1
+        assert fd.status(job.id)["state"] == "packed"
+        adopted = survivor.next_batch(timeout=1.0)
+        assert adopted is not None
+        assert adopted.id == batch.id
+        assert adopted.attempt == 1
+        assert adopted.dir == batch.dir  # same launch dir: quorum resume
+        survivor.complete(adopted, ok=True, wall_s=0.1)
+        assert fd.status(job.id)["state"] == "complete"
+
+
+def test_requeue_budget_exhaustion_is_terminal(tmp_path):
+    with cluster(tmp_path, role="frontdoor", max_requeues=1) as fd, \
+            cluster(tmp_path, role="worker", max_requeues=1) as wk:
+        job = fd.submit(spec())
+        batch = wk.next_batch(timeout=1.0)
+        kv = FleetKV(fd.cfg.fleet_dir)
+        wk._held.pop(batch.id)
+        lease = kv.get(f"leases/{batch.id}")
+        lease["attempt"] = 1  # already failed over once
+        lease["expires_t"] = time.time() - 1.0
+        kv.put(f"leases/{batch.id}", lease)
+        fd._reap_leases(time.time())
+        assert kv.get(f"resume/{batch.id}") is None  # no more retries
+        st = fd.status(job.id)
+        assert st["state"] == "failed"
+        assert "requeue budget" in st["error"]
+
+
+def test_reaper_removes_stale_members(tmp_path):
+    with cluster(tmp_path, role="frontdoor") as fd:
+        kv = FleetKV(fd.cfg.fleet_dir)
+        kv.put("members/ghost", {
+            "member": "ghost", "role": "worker", "pid": 0,
+            "t": time.time() - 3600,
+        })
+        fd._reap_members(time.time())
+        assert kv.get("members/ghost") is None
+        assert kv.get(f"members/{fd.member_id}") is not None  # self kept
+
+
+def test_reaper_reenqueues_orphaned_claims(tmp_path):
+    """A worker that died between claiming a queue marker and writing
+    the lease leaves the marker under claims/<member>/ — once its
+    member doc is gone and the marker is stale, the marker returns to
+    the queue."""
+    with cluster(tmp_path, role="frontdoor") as fd:
+        kv = FleetKV(fd.cfg.fleet_dir)
+        qkey = "p4-00000000000000000001-jdead-00001"
+        kv.put(f"claims/ghost/{qkey}", {
+            "job": "jdead-00001", "t": time.time() - 3600,
+        })
+        fd._reap_claims(time.time())
+        assert kv.keys("queue") == [qkey]
+        assert kv.keys("claims/ghost") == []
+
+
+def test_worker_requeue_writes_resume_entry(tmp_path):
+    """The in-process requeue path (classified transient failure)
+    lands in the shared namespace exactly like a reaped lease."""
+    with cluster(tmp_path, role="worker") as wk:
+        wk.submit(spec())
+        batch = wk.next_batch(timeout=1.0)
+        wk.requeue(batch, fault="preempted")
+        kv = FleetKV(wk.cfg.fleet_dir)
+        assert kv.get(f"leases/{batch.id}") is None
+        resume = kv.get(f"resume/{batch.id}")
+        assert resume["attempt"] == 1
+        adopted = wk.next_batch(timeout=1.0)
+        assert adopted is not None and adopted.id == batch.id
+
+
+def test_cache_hit_across_replicas(tmp_path):
+    """A result published through one replica's cache is a hit on a
+    DIFFERENT replica: the entry lives in the shared fleet dir."""
+    from test_cache import FakeVerifier, make_store
+
+    from grayscott_jl_tpu.serve import protocol
+
+    with cluster(tmp_path, role="frontdoor", cache=True) as a, \
+            cluster(tmp_path, role="frontdoor", cache=True) as b:
+        fake = FakeVerifier()
+        a.cache._verifier = fake
+        b.cache._verifier = fake
+        assert a.cache.root == b.cache.root  # shared <fleet_dir>/cache
+        store = make_store(tmp_path)
+        a.cache.publish(protocol.parse_job(spec()), store)
+        job = b.submit(spec())
+        assert job.cache == "hit"
+        assert job.state == "complete"
+        assert job.store == store
+        # The hit consumed nothing: queue empty on both replicas.
+        assert FleetKV(a.cfg.fleet_dir).keys("queue") == []
+
+
+def test_describe_lists_members_and_roles(tmp_path):
+    with cluster(tmp_path, role="frontdoor") as fd, \
+            cluster(tmp_path, role="worker") as wk:
+        fd.announce_endpoint("localhost", 8642)
+        desc = wk.describe()
+        roles = {m: d["role"] for m, d in desc["members"].items()}
+        assert roles[fd.member_id] == "frontdoor"
+        assert roles[wk.member_id] == "worker"
+        assert desc["members"][fd.member_id]["port"] == 8642
+
+
+def test_close_removes_member_doc(tmp_path):
+    sched = ClusterScheduler(
+        make_cfg(tmp_path), role="worker", events=NULL_EVENTS,
+    )
+    kv = FleetKV(sched.cfg.fleet_dir)
+    assert kv.get(f"members/{sched.member_id}") is not None
+    sched.close()
+    assert kv.get(f"members/{sched.member_id}") is None
+
+
+def test_config_validation(tmp_path, monkeypatch):
+    from grayscott_jl_tpu.serve.scheduler import resolve_serve_config
+
+    monkeypatch.setenv("GS_SERVE_LEASE_TTL_S", "1.0")
+    monkeypatch.setenv("GS_SERVE_HEARTBEAT_S", "2.0")  # > ttl
+    with pytest.raises(ValueError, match="HEARTBEAT"):
+        resolve_serve_config()
+    with pytest.raises(ValueError, match="FLEET_DIR"):
+        ClusterScheduler(
+            ServeConfig(state_dir=str(tmp_path / "s")),
+            events=NULL_EVENTS,
+        )
