@@ -1,0 +1,322 @@
+"""A/B the live in-job reshape against the kill -> restore round-trip
+it replaces (docs/RESHARD.md "In-job reshapes").
+
+Two arms per mesh pair, both measured move-to-restored-state (the
+post-move step round pays the same fresh mesh-B compile in both arms,
+so it belongs to neither):
+
+* **in-job** (`reshape_live`): build the target engine on mesh B and
+  move the LIVE mesh-A state through the tiered device path
+  (collective/put/host), inside the running process. This is what the
+  serve elastic controller triggers.
+* **kill->restore**: the path it replaces — a fresh process (full
+  interpreter + jax import + device init), engine build, checkpoint
+  restore onto mesh B (`restore_run` selection reads). The relaunch
+  cost is the point: an in-job reshape never pays it.
+
+One artifact row per round per arm (shared ``artifacts.py`` schema,
+``ab = "reshard"``; the ``metric`` label separates arms and mesh pairs
+into distinct regression-gate keys), plus an ungated summary row per
+pair carrying the speedup. ``--min-speedup`` (default 10) gates the
+run: the in-job median must beat the round-trip median by at least
+that factor, the acceptance bound the committed CPU artifact proves.
+
+Usage::
+
+    python benchmarks/reshard_bench.py [--L 24] [--warm-steps 4]
+        [--rounds 4] [--pairs 2,2,2:1,2,2 1,1,1:2,1,1]
+        [--out benchmarks/results/...jsonl] [--min-speedup 10]
+
+CPU-measurable by design (the put/host tiers need no ICI); the TPU
+rows queue behind ``benchmarks/hw_queue.sh`` like every hardware
+number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import artifacts  # noqa: E402 — shared JSONL record helpers
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+CONFIG = """\
+L = {L}
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+plotgap = {steps}
+steps = {steps}
+noise = 0.1
+output = "gs.bp"
+checkpoint = true
+checkpoint_freq = {steps}
+checkpoint_output = "ckpt.bp"
+precision = "Float32"
+backend = "CPU"
+kernel_language = "XLA"
+verbose = false
+"""
+
+#: The timed restore arm, run in a FRESH interpreter so the measured
+#: wall includes what a kill costs: process start, jax import, device
+#: init, checkpoint selection-read restore, one compiled step round.
+RESTORE_SCRIPT = """\
+import os
+from grayscott_jl_tpu.config.settings import Settings
+
+s = Settings()
+s.L = {L}
+s.steps = {steps}
+s.noise = 0.1
+s.precision = "Float32"
+s.kernel_language = "xla"
+s.autotune = "off"
+s.restart = True
+s.restart_input = {ckpt!r}
+s.restart_step = -1
+
+from grayscott_jl_tpu.simulation import Simulation
+from grayscott_jl_tpu.reshard.restore import restore_run
+
+sim = Simulation(s, n_devices={n_devices})
+step, plan = restore_run(sim, s)
+assert plan.changed, "bench expects a cross-mesh restore"
+sim.block_until_ready()
+"""
+
+
+def _mesh(text: str):
+    dims = tuple(int(d) for d in text.split(","))
+    if len(dims) != 3 or any(d < 1 for d in dims):
+        raise ValueError(f"bad mesh {text!r}")
+    return dims
+
+
+def _tag(dims) -> str:
+    return "".join(str(d) for d in dims)
+
+
+def _prod(dims) -> int:
+    return dims[0] * dims[1] * dims[2]
+
+
+def _base_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["GS_FUSE"] = "1"  # the cross-mesh CPU contract (docs/RESHARD.md)
+    return env
+
+
+def write_checkpoint(args, mesh_a, workdir: Path) -> Path:
+    """Untimed setup: a short run on mesh A leaves a durable
+    checkpoint at the last step — the wreckage both arms start from."""
+    cfg = workdir / "config.toml"
+    cfg.write_text(CONFIG.format(L=args.L, steps=args.warm_steps))
+    env = _base_env()
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={_prod(mesh_a)}"
+    )
+    env["GS_TPU_MESH_DIMS"] = ",".join(str(d) for d in mesh_a)
+    res = subprocess.run(
+        [sys.executable, str(REPO / "gray-scott.py"), str(cfg)],
+        cwd=workdir, env=env, capture_output=True, text=True,
+    )
+    if res.returncode != 0:
+        raise RuntimeError(res.stderr + res.stdout)
+    return workdir / "ckpt.bp"
+
+
+def time_killrestore(args, mesh_b, ckpt: Path, workdir: Path) -> float:
+    env = _base_env()
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={_prod(mesh_b)}"
+    )
+    env["GS_TPU_MESH_DIMS"] = ",".join(str(d) for d in mesh_b)
+    script = RESTORE_SCRIPT.format(
+        L=args.L, steps=args.warm_steps + 1, ckpt=str(ckpt),
+        n_devices=_prod(mesh_b),
+    )
+    t0 = time.perf_counter()
+    res = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=workdir, env=env, capture_output=True, text=True,
+    )
+    wall = time.perf_counter() - t0
+    if res.returncode != 0:
+        raise RuntimeError(res.stderr + res.stdout)
+    return wall
+
+
+def time_injob(args, sim, mesh_b):
+    """One in-job move off the live source sim — the driver's
+    `_apply_reshape` minus the store swap (store rebuilds append, they
+    don't move state). `device_all_to_all_restore` blocks on the moved
+    buffers before returning, so the wall is real."""
+    from grayscott_jl_tpu.reshard.restore import reshape_live
+
+    t0 = time.perf_counter()
+    target, plan = reshape_live(sim, mesh_dims=mesh_b)
+    wall = time.perf_counter() - t0
+    assert plan.changed
+    return wall, target.reshard
+
+
+def row_base(args, metric: str, mesh_b) -> dict:
+    return {
+        "ab": "reshard",
+        "t": artifacts.utc_stamp(),
+        "platform": args.platform,
+        "model": "grayscott",
+        "kernel": "xla",
+        "L": args.L,
+        "mesh": list(mesh_b),
+        "devices": _prod(mesh_b),
+        "precision": "Float32",
+        "metric": metric,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--L", type=int, default=24)
+    ap.add_argument("--warm-steps", type=int, default=4,
+                    help="steps run (and checkpointed) on mesh A "
+                    "before the move")
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--pairs", nargs="+",
+                    default=["2,2,2:1,2,2", "1,1,1:2,1,1"],
+                    help="mesh pairs as A:B, e.g. 2,2,2:1,2,2")
+    ap.add_argument("--out", default=None,
+                    help="append artifact rows here (default: the "
+                    "committed results naming convention)")
+    ap.add_argument("--min-speedup", type=float, default=10.0,
+                    help="fail (exit 1) when in-job is not at least "
+                    "this many times faster than kill->restore")
+    args = ap.parse_args(argv)
+
+    pairs = [
+        (_mesh(p.split(":")[0]), _mesh(p.split(":")[1]))
+        for p in args.pairs
+    ]
+    # Device inventory before jax import: every source/target mesh of
+    # the in-process arm must fit one forced-host-device pool.
+    n_dev = max(_prod(m) for pair in pairs for m in pair)
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        f"--xla_force_host_platform_device_count={n_dev}",
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["GS_FUSE"] = "1"
+
+    from grayscott_jl_tpu.config.settings import Settings
+    from grayscott_jl_tpu.simulation import Simulation
+    import jax
+
+    args.platform = jax.default_backend()
+    out = args.out or artifacts.default_out("reshard_ab", args.platform)
+    failures = []
+    for mesh_a, mesh_b in pairs:
+        pair_tag = f"{_tag(mesh_a)}to{_tag(mesh_b)}"
+
+        # --- arm 1: in-job live reshape off one warmed source sim
+        s = Settings()
+        s.L = args.L
+        s.steps = args.warm_steps
+        s.noise = 0.1
+        s.precision = "Float32"
+        s.kernel_language = "xla"
+        s.autotune = "off"
+        sim = Simulation(
+            s, n_devices=_prod(mesh_a), mesh_dims=mesh_a
+        )
+        sim.iterate(args.warm_steps)
+        sim.block_until_ready()
+        injob, prov = [], None
+        for r in range(args.rounds):
+            wall, prov = time_injob(args, sim, mesh_b)
+            injob.append(wall)
+            row = row_base(args, f"injob_{pair_tag}", mesh_b)
+            row.update({
+                "round": r,
+                "path": prov.get("path"),
+                "move_bytes": prov.get("bytes"),
+                "move_wall_s": prov.get("wall_s"),
+                "wall_s": round(wall, 4),
+                "us_per_step": round(wall * 1e6, 1),
+            })
+            artifacts.append_row(out, row)
+            print(json.dumps(row))
+
+        # --- arm 2: kill -> fresh process -> checkpoint restore
+        with tempfile.TemporaryDirectory() as td:
+            ckpt = write_checkpoint(args, mesh_a, Path(td))
+            restore = []
+            for r in range(args.rounds):
+                wall = time_killrestore(args, mesh_b, ckpt, Path(td))
+                restore.append(wall)
+                row = row_base(
+                    args, f"killrestore_{pair_tag}", mesh_b
+                )
+                row.update({
+                    "round": r,
+                    "wall_s": round(wall, 4),
+                    "us_per_step": round(wall * 1e6, 1),
+                })
+                artifacts.append_row(out, row)
+                print(json.dumps(row))
+
+        med_injob = statistics.median(injob)
+        med_restore = statistics.median(restore)
+        speedup = med_restore / med_injob if med_injob else float("inf")
+        summary = {
+            "ab": "reshard",
+            "t": artifacts.utc_stamp(),
+            "platform": args.platform,
+            "model": "grayscott",
+            "L": args.L,
+            "pair": pair_tag,
+            "summary": True,  # no *_us_per_step: the gate skips it
+            "device_path": (prov or {}).get("path"),
+            "median_injob_s": round(med_injob, 4),
+            "median_killrestore_s": round(med_restore, 4),
+            "speedup": round(speedup, 1),
+        }
+        artifacts.append_row(out, summary)
+        print(json.dumps(summary))
+        if speedup < args.min_speedup:
+            failures.append((pair_tag, speedup))
+
+    if failures:
+        for tag, sp in failures:
+            print(
+                f"reshard_bench: FAIL — {tag} in-job speedup "
+                f"{sp:.1f}x below the {args.min_speedup:.0f}x bound",
+                file=sys.stderr,
+            )
+        return 1
+    print(
+        f"reshard_bench: OK — every pair beats "
+        f"{args.min_speedup:.0f}x; artifact at {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,7 @@ through a lookup table instead of ``eval(Meta.parse(...))``
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 try:  # Python 3.11+
     import tomllib as _toml
@@ -452,6 +452,41 @@ def resolve_reshard(settings: Settings) -> str:
     if v not in ("auto", "off"):
         raise ValueError(
             f"reshard / GS_RESHARD must be auto/off, got {raw!r}"
+        )
+    return v
+
+
+#: Valid live device-reshard tiers (docs/RESHARD.md): ``auto`` picks
+#: the cheapest feasible tier per move, the named tiers pin one, and
+#: ``off`` refuses the live device path entirely (checkpoint restore
+#: stays available).
+RESHARD_DEVICE_MODES = ("auto", "collective", "put", "host", "off")
+
+
+def resolve_reshard_device(settings: Optional[Settings] = None) -> str:
+    """Normalized live-reshard tier selection (``GS_RESHARD_DEVICE``;
+    docs/RESHARD.md "The live device path"): how
+    ``reshard.restore.device_all_to_all_restore`` moves LIVE field
+    buffers from mesh A to mesh B between step rounds.
+
+    ``auto`` (default) compiles the one-program collective relayout
+    when both meshes span the same device set, falls back to a
+    ``jax.device_put`` cross-device-set move, and degrades to the
+    host-gather tier when the backend refuses the transfer; the named
+    modes pin one tier (a pinned infeasible tier is a loud
+    ``ReshardError``, never a silent fallback); ``off`` refuses live
+    reshapes outright.
+    """
+    import os
+
+    raw = os.environ.get("GS_RESHARD_DEVICE")
+    if raw is None:
+        raw = getattr(settings, "reshard_device", "") or ""
+    v = raw.strip().lower() or "auto"
+    if v not in RESHARD_DEVICE_MODES:
+        raise ValueError(
+            f"GS_RESHARD_DEVICE must be one of "
+            f"{'/'.join(RESHARD_DEVICE_MODES)}, got {raw!r}"
         )
     return v
 

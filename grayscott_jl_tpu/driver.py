@@ -51,6 +51,7 @@ must not leak open stores or a half-written rollback sidecar).
 
 from __future__ import annotations
 
+import copy
 import os
 import time
 from typing import List, Optional
@@ -66,6 +67,50 @@ def _next_boundary(step: int, period: int, limit: int) -> int:
     if period <= 0:
         return limit
     return min(limit, (step // period + 1) * period)
+
+
+def _resolve_reshape_dims(req, sim):
+    """Resolve a live-reshape request to concrete target mesh dims, or
+    None for an infeasible / no-op request (docs/RESHARD.md).
+
+    The serve side stays JAX-free, so its elastic policy sends scale
+    HINTS (``{"scale": "grow"|"shrink"}``) and the driver — the layer
+    that can see the device inventory — resolves them: grow doubles the
+    spatial device count toward the idle chips, shrink halves it to
+    donate the slice. An explicit ``{"mesh_dims": [x, y, z]}`` pins the
+    target outright.
+    """
+    import jax
+
+    from .parallel.domain import CartDomain, dims_create
+
+    if not isinstance(req, dict):
+        return None
+    member_shards = int(getattr(sim, "member_shards", 1))
+    cur = sim.domain.n_blocks
+    if req.get("mesh_dims"):
+        dims = tuple(int(d) for d in req["mesh_dims"])
+    else:
+        scale = req.get("scale")
+        if scale == "grow":
+            n = cur * 2
+        elif scale == "shrink":
+            n = cur // 2
+        else:
+            return None
+        if n < 1:
+            return None
+        dims = dims_create(n, 3)
+    n = dims[0] * dims[1] * dims[2]
+    if n * member_shards > len(jax.devices()):
+        return None  # not enough chips to grow into
+    try:
+        CartDomain.create(n, sim.settings.L, dims=dims)
+    except ValueError:
+        return None  # infeasible for this L — refuse the hint quietly
+    if dims == tuple(sim.domain.dims):
+        return None
+    return dims
 
 
 def maybe_initialize_distributed() -> None:
@@ -186,6 +231,7 @@ def run_once(
     seed: int = 0,
     context=None,
     sim_factory=None,
+    reshape_poll=None,
 ):
     """One supervised-or-not simulation attempt.
 
@@ -202,6 +248,15 @@ def run_once(
     rebound to this launch's members (``repack``), so a packed batch
     pays zero recompilation. Called as
     ``sim_factory(settings, n_devices=..., seed=...)``.
+
+    ``reshape_poll`` (optional) is the between-rounds live-reshape hook
+    (docs/RESHARD.md "In-job reshapes"): called at every round
+    boundary; a truthy return — ``{"mesh_dims": [x, y, z]}`` or
+    ``{"scale": "grow"|"shrink"}`` — moves the LIVE state onto the
+    target mesh via ``reshard.restore.reshape_live`` (no checkpoint
+    round-trip, continuation bitwise-identical) and the run keeps
+    stepping on the new layout. The serve elastic policy
+    (``serve/elastic.py``) feeds this hook.
     """
     from .resilience.faults import (
         FaultPlan,
@@ -242,6 +297,7 @@ def run_once(
             settings, n_devices=n_devices, seed=seed, context=context,
             plan=plan, journal=journal, guard=guard, wd=wd,
             shutdown=shutdown, sim_factory=sim_factory,
+            reshape_poll=reshape_poll,
         )
     except BaseException as exc:
         # A watchdog expiry unwinds as KeyboardInterrupt (the monitor's
@@ -282,6 +338,7 @@ def _run_once_inner(
     wd,
     shutdown,
     sim_factory=None,
+    reshape_poll=None,
 ):
     import jax
 
@@ -620,12 +677,83 @@ def _run_once_inner(
         pipe.close()
         raise GracefulShutdown(shutdown.signum, at_step, ckpt_step)
 
+    m_reshards = metrics.counter("reshards", **mlabels)
+    m_reshard_wall = metrics.gauge("reshard_wall_s", **mlabels)
+
+    def _apply_reshape(req) -> bool:
+        """Between-rounds live reshape (docs/RESHARD.md "In-job
+        reshapes"): move the LIVE state onto the target mesh with
+        :func:`~.reshard.restore.reshape_live` — no kill, no checkpoint
+        round-trip, continuation bitwise-identical — then swap in
+        stores that append at the current step on the new layout."""
+        nonlocal sim, stream, ckpt, first_round
+        from .reshard.plan import ReshardError
+        from .reshard.restore import reshape_live
+
+        dims = _resolve_reshape_dims(req, sim)
+        if dims is None:
+            return False
+        # The reshape pays a target compile — budget it like one.
+        _mark("compile", step)
+        # Retire in-flight writes against the OLD stores before the
+        # swap; the pipeline itself stays up.
+        pipe.drain()
+        try:
+            new_sim, rplan = reshape_live(
+                sim, mesh_dims=dims, seed=seed, log=log,
+                journal=journal,
+            )
+        except ReshardError as e:
+            log.warn(f"live reshape refused: {e}")
+            return False
+        if not rplan.changed:
+            return False
+        stream.close()
+        if ckpt is not None:
+            ckpt.close()
+        sim = new_sim
+        # The rebuilt stores must APPEND at the current step: the
+        # stores only open in append mode under settings.restart, and
+        # a fresh (non-restarted) run that reshapes mid-life would
+        # otherwise truncate every snapshot written before the move.
+        # Per-step block boxes make mixed layouts in one store legal.
+        resumed = copy.copy(settings)
+        resumed.restart = True
+        stream = stream_cls(
+            resumed, sim.domain, sim.dtype, writer_id=proc,
+            nwriters=nprocs, resume_step=step, **stream_kw,
+        )
+        if ckpt is not None:
+            ckpt = ckpt_cls(
+                resumed, sim.dtype, writer_id=proc, nwriters=nprocs,
+                resume_step=step, layout=sim.layout(), **ckpt_kw,
+            )
+        # Config echo + comm model follow the adopted layout so every
+        # artifact written after the move describes the mesh the run is
+        # actually on; the reshard record carries the old one.
+        stats.config["reshard"] = sim.reshard
+        stats.config["mesh_dims"] = list(sim.domain.dims)
+        stats.config["n_devices"] = sim.domain.n_blocks
+        stats.record_comm(icimodel.comm_report(sim))
+        m_reshards.inc()
+        if sim.reshard is not None:
+            m_reshard_wall.set(sim.reshard.get("wall_s"))
+        first_round = True
+        return True
+
     t0 = time.perf_counter()
     if profile is not None:
         profile.on_boundary(step)
     try:
         with trace(), pipe:
             while step < settings.steps:
+                if reshape_poll is not None:
+                    # Between-rounds elastic hook: the poll is cheap
+                    # (a dict read under the serve scheduler's lock);
+                    # only a truthy request pays the reshape.
+                    req = reshape_poll()
+                    if req:
+                        _apply_reshape(req)
                 # The first round pays jit (and, under Auto, any
                 # remaining autotune measurement) — its budget is
                 # the compile deadline, every later round the much

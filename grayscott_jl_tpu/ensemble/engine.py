@@ -30,7 +30,7 @@ harness reuse the solo machinery unchanged.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -191,6 +191,7 @@ class EnsembleSimulation(Simulation):
         *,
         n_devices: Optional[int] = None,
         seed: int = 0,
+        mesh_dims: Optional[Tuple[int, int, int]] = None,
     ):
         ens = getattr(settings, "ensemble", None)
         if ens is None:
@@ -209,7 +210,10 @@ class EnsembleSimulation(Simulation):
         self.member_active = (
             None if all(ens.active) else tuple(ens.active)
         )
-        super().__init__(settings, n_devices=n_devices, seed=seed)
+        super().__init__(
+            settings, n_devices=n_devices, seed=seed,
+            mesh_dims=mesh_dims,
+        )
 
     @property
     def active_member_count(self) -> int:
@@ -230,7 +234,10 @@ class EnsembleSimulation(Simulation):
         # decomposition (and therefore `self.sharded`, the halo
         # exchange, kernel dispatch, autotune mesh sweeps) sees only
         # the remaining count — unchanged solo semantics underneath.
-        return CartDomain.create(len(devices) // m, self.settings.L)
+        return CartDomain.create(
+            len(devices) // m, self.settings.L,
+            dims=self._mesh_dims_override,
+        )
 
     def _make_params(self):
         """Member-stacked Params pytree of the run's model: every leaf

@@ -279,6 +279,20 @@ class AsyncStepWriter:
         self._queue_hwm = max(self._queue_hwm, self._q.qsize())
         self._m_depth.set(self._q.qsize())
 
+    def drain(self) -> None:
+        """Block until every accepted step is durably written (or the
+        first failure has surfaced), WITHOUT stopping the worker — the
+        live-reshape path (docs/RESHARD.md) retires in-flight writes
+        against the old stores here before swapping in the new ones."""
+        if self._thread is not None:
+            with self._phase_cm("io_drain"):
+                t = time.perf_counter()
+                while (self._error is None
+                       and self._written < self._accepted):
+                    time.sleep(0.002)
+                self._drain_wait += time.perf_counter() - t
+        self._raise_pending()
+
     def close(self) -> None:
         """Drain and stop the worker; re-raise a pending writer error.
 

@@ -81,12 +81,33 @@ class CartDomain:
     dims: Tuple[int, int, int]
 
     @classmethod
-    def create(cls, n_devices: int, L: int) -> "CartDomain":
+    def create(
+        cls, n_devices: int, L: int,
+        dims: "Tuple[int, int, int] | None" = None,
+    ) -> "CartDomain":
         """Balanced MPI ``Dims_create`` factorization, overridable with
         ``GS_TPU_MESH_DIMS=nx,ny,nz`` (e.g. ``8,1,1`` selects the 1D
         x-sharded decomposition whose halos feed the Pallas kernel's
         in-kernel fused chain — the fastest pod-slice layout for the
-        Pallas language at <=16 chips, see BASELINE.md)."""
+        Pallas language at <=16 chips, see BASELINE.md).
+
+        An explicit ``dims`` wins over the env override: it is the
+        programmatic channel the live-reshape path uses to target a
+        specific factorization without mutating process-global env
+        state (thread-unsafe under the serve worker fleet)."""
+        if dims is not None:
+            dims = tuple(int(d) for d in dims)
+            if len(dims) != 3 or any(d < 1 for d in dims):
+                raise ValueError(
+                    f"mesh dims {dims!r} must be three positive "
+                    "integers"
+                )
+            if dims[0] * dims[1] * dims[2] != n_devices:
+                raise ValueError(
+                    f"mesh dims {dims!r} do not factor "
+                    f"{n_devices} devices"
+                )
+            return cls._validated(L, dims, n_devices)
         override = env_str("GS_TPU_MESH_DIMS", "")
         if n_devices == 1:
             # A single device has exactly one decomposition; ignoring
@@ -114,6 +135,10 @@ class CartDomain:
                 )
         else:
             dims = dims_create(n_devices, 3)
+        return cls._validated(L, dims, n_devices)
+
+    @classmethod
+    def _validated(cls, L, dims, n_devices) -> "CartDomain":
         if n_devices > 1:
             for d in dims:
                 # Non-divisible L runs via pad-and-mask (storage padded

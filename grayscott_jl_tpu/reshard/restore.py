@@ -1,34 +1,54 @@
 """Elastic resharding: executing the restore plan (docs/RESHARD.md).
 
-The host-side path is the one implemented here: each process
-selection-reads exactly its NEW shards from the global-indexed
-checkpoint store (``Simulation.restore_from_reader`` already reads per
-addressable shard, so no process ever materializes the full field),
-making the mesh shape a restore-time decision with zero data movement
-beyond what any restore pays. The plan (``reshard/plan.py``) supplies
-the validation and the provenance; this module supplies the
-orchestration the driver calls: open -> read layout -> plan -> restore
--> journal/event.
+Two execution paths, one plan:
 
-The ICI all-to-all device path — reshuffling LIVE device buffers
-between two meshes without a checkpoint round-trip — is a documented
-seam (:func:`device_all_to_all_restore`), not an implementation: the
-host path is correct and preemption-shaped (the replacement slice
-boots from the durable store anyway), while the device path only pays
-off for planned in-job reshapes, which need TPU hardware to validate.
+* **Host checkpoint path** (:func:`restore_run`): each process
+  selection-reads exactly its NEW shards from the global-indexed
+  checkpoint store (``Simulation.restore_from_reader`` already reads
+  per addressable shard, so no process ever materializes the full
+  field), making the mesh shape a restore-time decision with zero data
+  movement beyond what any restore pays. This remains the
+  preemption-shaped path — a replacement slice boots from the durable
+  store anyway.
+
+* **Live device path** (:func:`device_all_to_all_restore`, driven by
+  :func:`reshape_live`): re-slices LIVE mesh-A field buffers onto mesh
+  B between step rounds with no checkpoint round-trip. The plan's
+  ``overlapping_old_shards`` schedule is compiled into ONE device
+  program: when both meshes span the same device set, a single jitted
+  relayout whose ``out_shardings`` is the target placement (XLA GSPMD
+  lowers exactly the plan's send/recv pairs to ICI collectives —
+  ppermute/all-to-all on TPU); across device sets, a
+  ``jax.device_put`` transfer tier; and a host-gather tier for
+  backends without either. Tier choice is the ``GS_RESHARD_DEVICE``
+  knob (``config.resolve_reshard_device``). Every tier moves the true
+  L^3 values verbatim and reconstructs storage pad at the frozen
+  boundary value, so the continuation is bitwise identical to the
+  host-path restore of the same plan — and to a run that never moved.
+
+The plan (``reshard/plan.py``) supplies validation and provenance;
+this module supplies orchestration: plan -> move -> journal/event,
+with ``path`` / ``bytes`` / ``wall_s`` timing provenance on every
+``reshard`` record.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
-from ..config.settings import Settings, resolve_reshard
+from ..config.settings import (
+    Settings,
+    resolve_reshard,
+    resolve_reshard_device,
+)
 from . import plan as plan_mod
 from .plan import LayoutMeta, ReshardError, ReshardPlan
 
 __all__ = [
     "device_all_to_all_restore",
     "layout_of",
+    "reshape_live",
     "restore_run",
 ]
 
@@ -56,12 +76,34 @@ def layout_of(sim, *, process_count: Optional[int] = None) -> LayoutMeta:
     )
 
 
-def _announce(sim, plan: ReshardPlan, *, log=None, journal=None) -> None:
+def _move_bytes(plan: ReshardPlan, sim) -> int:
+    """Bytes the plan's schedule re-slices: the sum of every new
+    shard's true-domain selection box, over all fields (and members) —
+    what the device program moves, and what a host restore reads."""
+    import numpy as np
+
+    cells = 0
+    for _coords, _start, count in plan.boxes:
+        vol = 1
+        for c in count:
+            vol *= int(c)
+        cells += vol
+    members = int(getattr(sim, "n_members", 1))
+    itemsize = int(np.dtype(sim.dtype).itemsize)
+    return cells * sim.model.n_fields * members * itemsize
+
+
+def _announce(
+    sim, plan: ReshardPlan, *, log=None, journal=None, prov=None
+) -> None:
     """One ``reshard`` record on every observer: the unified event
     stream (GS_EVENTS), the fault journal (and through it the final
-    RunStats ``faults`` section), and the console log."""
+    RunStats ``faults`` section), and the console log. ``prov`` is the
+    timing provenance (``path`` / ``bytes`` / ``wall_s``) the executing
+    tier measured — every record carries it."""
     from ..obs import events as obs_events
 
+    prov = prov or {}
     old = plan.old.describe() if plan.old is not None else None
     obs_events.get_events().emit(
         "reshard", step=sim.step,
@@ -70,11 +112,16 @@ def _announce(sim, plan: ReshardPlan, *, log=None, journal=None) -> None:
         old_procs=(old or {}).get("process_count"),
         new_procs=plan.new.process_count,
         members=plan.members,
+        path=prov.get("path"),
+        bytes=prov.get("bytes"),
+        wall_s=prov.get("wall_s"),
     )
     if journal is not None:
         journal.record(
             event="reshard", step=sim.step,
             old=old, new=plan.new.describe(), members=plan.members,
+            path=prov.get("path"), bytes=prov.get("bytes"),
+            wall_s=prov.get("wall_s"),
         )
     if log is not None:
         old_mesh = (
@@ -83,10 +130,12 @@ def _announce(sim, plan: ReshardPlan, *, log=None, journal=None) -> None:
         )
         new_mesh = "x".join(str(d) for d in plan.new.mesh_dims)
         log.info(
-            f"Resharded restore: checkpoint layout {old_mesh} "
+            f"Resharded restore: layout {old_mesh} "
             f"({plan.old.process_count if plan.old else '?'} proc) -> "
             f"adopted {new_mesh} ({plan.new.process_count} proc) "
-            f"at step {sim.step}"
+            f"at step {sim.step} via {prov.get('path', '?')} "
+            f"({prov.get('bytes', '?')} B in "
+            f"{prov.get('wall_s', '?')}s)"
         )
 
 
@@ -105,6 +154,7 @@ def restore_run(
     the stats config echo says whether this attempt moved.
     """
     allow = resolve_reshard(settings)
+    t0 = time.perf_counter()
     ens = getattr(settings, "ensemble", None)
     if ens is not None:
         from ..ensemble.io import restore_ensemble
@@ -140,26 +190,294 @@ def restore_run(
             settings.restart_input, restore_from, journal=journal,
             log=log,
         )
-    sim.reshard = plan.describe() if plan.changed else None
     if plan.changed:
-        _announce(sim, plan, log=log, journal=journal)
+        prov = {
+            "path": "ckpt",
+            "bytes": _move_bytes(plan, sim),
+            "wall_s": round(time.perf_counter() - t0, 6),
+        }
+        sim.reshard = {**plan.describe(), **prov}
+        _announce(sim, plan, log=log, journal=journal, prov=prov)
+    else:
+        sim.reshard = None
     return step, plan
 
 
-def device_all_to_all_restore(sim, plan: ReshardPlan):
-    """SEAM — the ICI device path for planned in-job reshapes.
+# --------------------------------------------------------------- live path
 
-    Contract (not yet implemented; the host selection-read path above
-    is the production restore): given live device buffers laid out on
-    mesh A and a plan targeting mesh B over the SAME device set, emit
-    one ``jax.device_put``-free all-to-all that re-slices every shard
-    on-fabric — ``plan.boxes`` with
-    :func:`~.plan.overlapping_old_shards` is exactly the send/recv
-    schedule. Needs TPU hardware to validate (the standing note in
-    ROADMAP.md); on CPU the host path is measurably equivalent.
-    """
-    raise NotImplementedError(
-        "the ICI all-to-all reshard path is a documented seam "
-        "(docs/RESHARD.md); use the host-side checkpoint restore "
-        "(reshard.restore.restore_run)"
+
+def _device_set(sim) -> frozenset:
+    """The devices a simulation's field buffers live on."""
+    mesh = getattr(sim, "mesh", None)
+    if mesh is not None:
+        return frozenset(mesh.devices.flat)
+    return frozenset([sim.device])
+
+
+def _target_sharding(target):
+    import jax
+
+    if getattr(target, "mesh", None) is not None:
+        return target.field_sharding
+    return jax.sharding.SingleDeviceSharding(target.device)
+
+
+def _spatial_pads(target):
+    L = target.settings.L
+    return [(0, g - L) for g in target.domain.storage_shape]
+
+
+def _relayout_fn(sim, target):
+    """The pure old->new relayout the collective tier jits: slice to
+    the true L^3 domain (dropping mesh A's storage pad), re-pad to
+    mesh B's storage shape at the frozen boundary values, and — for
+    ensembles — grow/shrink the member axis (grown members take the
+    broadcast init block, the same state ``restore_ensemble`` gives a
+    grown member). ``out_shardings`` = mesh B's placement turns this
+    into the plan's send/recv schedule when XLA lowers it."""
+    import jax.numpy as jnp
+
+    L = sim.settings.L
+    pads = _spatial_pads(target)
+    padded = any(p[1] for p in pads)
+    bvs = [float(b) for b in target.model.boundaries]
+    if not getattr(sim, "is_ensemble", False):
+        def move(fields, _init):
+            out = []
+            for f, bv in zip(fields, bvs):
+                t = f[:L, :L, :L]
+                if padded:
+                    t = jnp.pad(t, pads, constant_values=bv)
+                out.append(t)
+            return tuple(out)
+
+        return move
+
+    old_n = int(sim.n_members)
+    new_n = int(target.n_members)
+    keep = min(old_n, new_n)
+    mpads = [(0, 0)] + pads
+
+    def move(fields, init_blocks):
+        out = []
+        for f, ib, bv in zip(fields, init_blocks, bvs):
+            t = f[:keep, :L, :L, :L]
+            if new_n > keep:
+                grown = jnp.broadcast_to(
+                    ib[None], (new_n - keep,) + ib.shape
+                )
+                t = jnp.concatenate([t, grown], axis=0)
+            if padded:
+                t = jnp.pad(t, mpads, constant_values=bv)
+            out.append(t)
+        return tuple(out)
+
+    return move
+
+
+def _init_blocks(sim, target):
+    """Broadcast init blocks for grown ensemble members (zeros-shaped
+    placeholders otherwise — the relayout never reads them then)."""
+    import jax.numpy as jnp
+
+    if (getattr(sim, "is_ensemble", False)
+            and int(target.n_members) > int(sim.n_members)):
+        return tuple(
+            jnp.asarray(b, target.dtype)
+            for b in target.member_init_fields()
+        )
+    L = sim.settings.L
+    shape = (L, L, L)
+    return tuple(
+        jnp.zeros(shape, target.dtype)
+        for _ in range(target.model.n_fields)
     )
+
+
+def _collective_tier(sim, target) -> None:
+    """Same-device-set relayout as ONE compiled program: the jit's
+    ``out_shardings`` is mesh B's placement, so XLA GSPMD emits exactly
+    the plan's overlap schedule as on-fabric collectives (ICI
+    ppermute/all-to-all on TPU; shared-memory copies on CPU)."""
+    import jax
+
+    sharding = _target_sharding(target)
+    move = _relayout_fn(sim, target)
+    n = target.model.n_fields
+    moved = jax.jit(move, out_shardings=(sharding,) * n)(
+        sim.fields, _init_blocks(sim, target)
+    )
+    target.fields = tuple(moved)
+    target.step = int(sim.step)
+
+
+def _put_tier(sim, target) -> None:
+    """Cross-device-set move: compute the relayout on mesh A's devices
+    (one jit — slice, member grow/shrink, re-pad), then
+    ``jax.device_put`` the result onto mesh B's placement. No host
+    round-trip in user code; the runtime picks the cheapest transfer
+    it supports."""
+    import jax
+
+    move = jax.jit(_relayout_fn(sim, target))
+    staged = move(sim.fields, _init_blocks(sim, target))
+    sharding = _target_sharding(target)
+    target.fields = tuple(
+        jax.device_put(f, sharding) for f in staged
+    )
+    target.step = int(sim.step)
+
+
+def _host_tier(sim, target) -> None:
+    """Backstop tier: gather the true-domain fields to host and
+    re-place them through the same restore entrypoints the checkpoint
+    path uses — still no checkpoint round-trip, just a D->H->D copy."""
+    if getattr(sim, "is_ensemble", False):
+        old = sim.get_fields()  # (N, L, L, L) per field, pad-stripped
+        old_n, new_n = int(sim.n_members), int(target.n_members)
+        blocks = []
+        for i in range(new_n):
+            if i < old_n:
+                blocks.append(tuple(f[i] for f in old))
+            else:
+                blocks.append(target.member_init_fields())
+        target.restore_members(blocks, int(sim.step))
+    else:
+        target.restore_fields(sim.get_fields(), int(sim.step))
+
+
+def device_all_to_all_restore(
+    sim, plan: ReshardPlan, target, *, mode: Optional[str] = None
+) -> dict:
+    """Move ``sim``'s LIVE field buffers onto ``target``'s layout per
+    ``plan`` — the in-job device reshard (docs/RESHARD.md "The live
+    device path"). No checkpoint round-trip; the continuation on
+    ``target`` is bitwise identical to a host-path restore of the same
+    plan (asserted in tests/unit/test_reshard_device.py).
+
+    Tier selection (``mode``, default ``config.
+    resolve_reshard_device``): ``collective`` compiles the plan's
+    ``overlapping_old_shards`` schedule into one jitted program whose
+    ``out_shardings`` is mesh B (same device set only — that is when
+    the relayout is pure data movement XLA can lower to ICI
+    collectives); ``put`` stages the relayout on mesh A and
+    ``jax.device_put``s across device sets; ``host`` gathers and
+    re-places through the restore entrypoints. ``auto`` picks
+    collective when the device sets match, else put, degrading to host
+    if the runtime refuses the transfer. Returns the timing provenance
+    ``{"path", "bytes", "wall_s"}`` recorded on the ``reshard`` event.
+    """
+    import jax
+
+    if mode is None:
+        mode = resolve_reshard_device(sim.settings)
+    if mode == "off":
+        raise ReshardError(
+            "live device resharding is disabled (GS_RESHARD_DEVICE="
+            "off); use the checkpoint restore path "
+            "(reshard.restore.restore_run)"
+        )
+    same_set = _device_set(sim) == _device_set(target)
+    t0 = time.perf_counter()
+    if mode == "collective" or (mode == "auto" and same_set):
+        if not same_set:
+            raise ReshardError(
+                "GS_RESHARD_DEVICE=collective needs mesh A and mesh B "
+                "to span the SAME device set (the one-program relayout "
+                f"is a pure re-slice there); old spans "
+                f"{len(_device_set(sim))} device(s), new "
+                f"{len(_device_set(target))} — use auto/put/host"
+            )
+        _collective_tier(sim, target)
+        path = "collective"
+    elif mode == "put" or mode == "auto":
+        try:
+            _put_tier(sim, target)
+            path = "put"
+        except Exception:
+            if mode == "put":
+                raise
+            # auto degrades to the host tier when the backend refuses
+            # the cross-set transfer (jaxlib version / platform gaps).
+            _host_tier(sim, target)
+            path = "host"
+    else:  # mode == "host"
+        _host_tier(sim, target)
+        path = "host"
+    target.step = int(sim.step)
+    jax.block_until_ready(target.fields)
+    return {
+        "path": path,
+        "bytes": _move_bytes(plan, target),
+        "wall_s": round(time.perf_counter() - t0, 6),
+    }
+
+
+def reshape_live(
+    sim,
+    *,
+    mesh_dims: Optional[Tuple[int, int, int]] = None,
+    settings: Optional[Settings] = None,
+    seed: int = 0,
+    mode: Optional[str] = None,
+    log=None,
+    journal=None,
+):
+    """In-job reshape: build the TARGET simulation on ``mesh_dims``
+    (and/or a new ensemble spec via ``settings``) and move the live
+    state onto it — the between-rounds hook the driver calls when the
+    serve elastic policy (docs/SERVICE.md) grants or reclaims chips.
+
+    Returns ``(target, plan)``; the caller swaps ``target`` in for
+    ``sim`` and continues stepping. The target is constructed with the
+    SOURCE's resolved kernel language pinned and the autotuner off —
+    a reshape must not re-litigate tuning mid-run (the adopted mesh
+    joins the tuning-cache key; a later run on this shape tunes
+    normally). ``target.reshard`` carries the plan + timing provenance
+    and the ``reshard`` event/journal record is emitted, so stats and
+    reports attribute the move.
+    """
+    import dataclasses
+
+    import jax
+
+    settings = sim.settings if settings is None else settings
+    dims = tuple(
+        int(d) for d in (mesh_dims or sim.domain.dims)
+    )
+    allow = resolve_reshard(settings)
+    ens = getattr(settings, "ensemble", None)
+    member_shards = int(ens.member_shards) if ens is not None else 1
+    n_devices = dims[0] * dims[1] * dims[2] * member_shards
+    pinned = dataclasses.replace(
+        settings,
+        kernel_language=sim.kernel_language,
+        autotune="off",
+    )
+    target = type(sim)(
+        pinned, n_devices=n_devices, seed=seed, mesh_dims=dims
+    )
+    old = layout_of(sim)
+    new = layout_of(target)
+    plan = plan_mod.plan_restore(old, new, L=settings.L, allow=allow)
+    old_n = int(getattr(sim, "n_members", 1))
+    new_n = int(getattr(target, "n_members", 1))
+    if old_n != new_n:
+        if allow == "off":
+            raise ReshardError(
+                f"live member reshape {old_n} -> {new_n} refused: "
+                "reshard='off' (set reshard='auto' / GS_RESHARD=auto)"
+            )
+        plan = dataclasses.replace(
+            plan, changed=True, members={
+                "restored": min(old_n, new_n),
+                "grown": max(0, new_n - old_n),
+                "new_n": new_n,
+            },
+        )
+    prov = device_all_to_all_restore(sim, plan, target, mode=mode)
+    jax.block_until_ready(target.fields)
+    if plan.changed:
+        target.reshard = {**plan.describe(), **prov}
+        _announce(target, plan, log=log, journal=journal, prov=prov)
+    return target, plan

@@ -414,7 +414,7 @@ def _apply_resume(settings, resume: Optional[int], actions: list) -> None:
 
 
 def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
-              sim_factory=None):
+              sim_factory=None, reshape_poll=None):
     """Run ``driver.run_once`` under the restart loop; returns the
     completed attempt's :class:`~..simulation.Simulation`.
 
@@ -426,6 +426,9 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
     passes through to ``run_once`` (the serve worker fleet's
     warm-ensemble seam, ``serve/worker.py``) — every restart attempt
     asks the factory again, so a warm engine is rebound per attempt.
+    ``reshape_poll`` likewise passes through to every attempt — the
+    serve elastic controller's between-rounds live-reshape hook
+    (docs/RESHARD.md) keeps polling across restarts.
     """
     from ..driver import run_once
     from ..utils.log import Logger
@@ -512,7 +515,7 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
         try:
             return run_once(
                 settings, n_devices=n_devices, seed=seed, context=ctx,
-                sim_factory=sim_factory,
+                sim_factory=sim_factory, reshape_poll=reshape_poll,
             )
         except BaseException as exc:  # noqa: BLE001 — classify, then re-raise
             if isinstance(exc, GracefulShutdown):

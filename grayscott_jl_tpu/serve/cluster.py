@@ -579,6 +579,10 @@ class ClusterScheduler(Scheduler):
             )
         self._held.pop(batch.id, None)
         self._kv.delete(f"leases/{batch.id}")
+        # A pending reshape request targeted live state that no longer
+        # exists; the relaunch restores from the checkpoint quorum on
+        # whatever mesh it starts with (docs/RESHARD.md).
+        self._kv.delete(f"reshape/{batch.id}")
         self._kv.put(f"resume/{batch.id}", {
             "batch": batch.id, "jobs": batch.job_ids,
             "attempt": batch.attempt, "dir": batch.dir,
@@ -612,6 +616,7 @@ class ClusterScheduler(Scheduler):
                 ).observe((job.first_step_t - job.submitted_t) * 1e3)
         self._held.pop(batch.id, None)
         self._kv.delete(f"leases/{batch.id}")
+        self._kv.delete(f"reshape/{batch.id}")
         self.metrics.counter(
             "serve_batches_complete", ok=str(ok).lower()
         ).inc()
@@ -717,6 +722,10 @@ class ClusterScheduler(Scheduler):
             ):
                 continue  # another replica noticed first
             self._kv.delete(f"reaped/{bid}")
+            # Any in-flight reshape request dies with the worker: the
+            # live state it addressed is gone, and the failover restore
+            # is byte-identical without it.
+            self._kv.delete(f"reshape/{bid}")
             attempt = int(lease.get("attempt", 0)) + 1
             dead_worker = lease.get("worker", "?")
             if attempt > self.cfg.max_requeues:
@@ -774,6 +783,64 @@ class ClusterScheduler(Scheduler):
                         f"fleet reaper: re-enqueued orphaned claim "
                         f"{qkey} of dead member {mid}"
                     )
+
+    # ---------------------------------------------------------- elastic
+
+    def queue_depth(self) -> int:
+        depth = len(self._kv.keys("queue"))
+        self.metrics.gauge("serve_queue_depth").set(depth)
+        return depth
+
+    def running_batches(self) -> List[Batch]:
+        """Every leased batch with a RUNNING member, fleet-wide: held
+        launches directly, other members' through the shared job docs
+        (a front-door elastic controller steers workers it never
+        launched)."""
+        out: List[Batch] = []
+        for bid in self._kv.keys("leases"):
+            lease = self._kv.get(f"leases/{bid}")
+            if lease is None:
+                continue
+            held = self._held.get(bid)
+            if held is not None:
+                if any(j.state == "running" for j in held.jobs):
+                    out.append(held)
+                continue
+            jobs = [j for jid in lease.get("jobs", [])
+                    if (j := self._load_job(jid)) is not None]
+            if not any(j.state == "running" for j in jobs):
+                continue
+            out.append(Batch(
+                id=bid, jobs=jobs, key=(),
+                n_slots=int(lease.get("n_slots", 1)), settings=None,
+                dir=lease.get("dir") or "",
+                created_t=float(
+                    lease.get("expires_t", time.time())
+                ) - self.cfg.lease_ttl_s,
+            ))
+        return out
+
+    def request_reshape(self, batch_id: str, req: dict) -> bool:
+        """Publish the request as a ``reshape/<batch>`` KV doc; the
+        LEASING member's worker consumes it (:meth:`take_reshape`)
+        at its next between-rounds poll — the relay that lets any
+        replica steer any worker's live mesh. Latest-wins."""
+        if self._kv.get(f"leases/{batch_id}") is None:
+            return False
+        self._kv.put(f"reshape/{batch_id}", {
+            "batch": batch_id, "req": dict(req),
+            "by": self.member_id, "t": time.time(),
+        })
+        return True
+
+    def take_reshape(self, batch_id: str) -> Optional[dict]:
+        taken = f"reshape-taken/{self.member_id}-{batch_id}"
+        if not self._kv.take(f"reshape/{batch_id}", taken):
+            return None
+        doc = self._kv.get(taken)
+        self._kv.delete(taken)
+        req = (doc or {}).get("req")
+        return req if isinstance(req, dict) else None
 
     # ----------------------------------------------------------- status
 
@@ -848,6 +915,9 @@ def worker_main(argv=None) -> int:
     sched = ClusterScheduler(cfg, role="worker", log=log)
     sched.attach_events()
     fleet = WorkerFleet(sched, cfg, log=log)
+    from .elastic import ElasticController
+
+    elastic = ElasticController(sched, fleet, log=log)
     stop = threading.Event()
 
     def _request_stop(signum, frame):  # noqa: ARG001
@@ -856,6 +926,7 @@ def worker_main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _request_stop)
     signal.signal(signal.SIGINT, _request_stop)
     fleet.start()
+    elastic.start()
     log.info(
         f"gs-serve worker {sched.member_id}: draining fleet "
         f"{cfg.fleet_dir} ({cfg.workers} thread(s))"
@@ -864,6 +935,7 @@ def worker_main(argv=None) -> int:
         while not stop.is_set():
             stop.wait(0.5)
     finally:
+        elastic.close()
         fleet.stop()
         sched.close()
         log.info(f"gs-serve worker {sched.member_id}: bye")

@@ -249,6 +249,11 @@ class Batch:
     attempt: int = 0
     warm: bool = False
     created_t: float = 0.0
+    #: Pending live-reshape request for the worker running this batch
+    #: (``{"scale": "grow"|"shrink"}`` or ``{"mesh_dims": [x, y, z]}``;
+    #: docs/RESHARD.md "In-job reshapes"). Consume-once via
+    #: :meth:`Scheduler.take_reshape`.
+    reshape_request: Optional[dict] = None
 
     @property
     def job_ids(self) -> List[str]:
@@ -648,10 +653,64 @@ class Scheduler:
                         digest=job.digest,
                     )
 
+    # ---------------------------------------------------------- elastic
+
+    def queue_depth(self) -> int:
+        """Current admitted-but-unpacked depth, refreshing the
+        ``serve_queue_depth`` gauge as a side effect — the elastic
+        controller (``serve/elastic.py``) polls this, so the gauge
+        stays live even when no submit/cancel/pack mutation happens."""
+        with self._cond:
+            depth = len(self._queue)
+            self.metrics.gauge("serve_queue_depth").set(depth)
+            return depth
+
+    def running_batches(self) -> List[Batch]:
+        """Batches with at least one RUNNING member — the population
+        the elastic policy may reshape (packed-but-unlaunched batches
+        have no live state to move)."""
+        with self._cond:
+            return [
+                b for b in self.batches.values()
+                if any(j.state == "running" for j in b.jobs)
+            ]
+
+    def request_reshape(self, batch_id: str, req: dict) -> bool:
+        """Post a live-reshape request against a RUNNING batch; the
+        worker's between-rounds poll (:meth:`take_reshape`) consumes
+        it. Latest-wins if one is already pending. False when the
+        batch is unknown or has no running member."""
+        with self._cond:
+            batch = self.batches.get(batch_id)
+            if batch is None or not any(
+                j.state == "running" for j in batch.jobs
+            ):
+                return False
+            batch.reshape_request = dict(req)
+            self._cond.notify_all()
+            return True
+
+    def take_reshape(self, batch_id: str) -> Optional[dict]:
+        """Consume-once pop of a pending reshape request (the worker's
+        ``reshape_poll`` closure calls this between step rounds)."""
+        with self._cond:
+            batch = self.batches.get(batch_id)
+            if batch is None or batch.reshape_request is None:
+                return None
+            req, batch.reshape_request = batch.reshape_request, None
+            return req
+
     # ----------------------------------------------------------- status
 
     def status(self, job_id: str) -> Optional[dict]:
         with self._cond:
+            # Satellite fix (docs/SERVICE.md): the depth gauge used to
+            # refresh only on mutation paths (submit/cancel/pack), so a
+            # poll-heavy idle service could report a stale depth
+            # forever. Status IS the poll path — refresh here too.
+            self.metrics.gauge("serve_queue_depth").set(
+                len(self._queue)
+            )
             job = self.jobs.get(job_id)
             return None if job is None else job.describe()
 

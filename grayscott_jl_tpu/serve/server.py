@@ -139,6 +139,15 @@ class ServeService:
             self.scheduler = Scheduler(cfg, events=self.events)
         self.scheduler.attach_events()
         self.fleet = WorkerFleet(self.scheduler, cfg, log=self.log)
+        from .elastic import ElasticController
+
+        # Off unless GS_SERVE_ELASTIC=1 (start() is then a no-op): the
+        # control loop turning queue depth + worker utilization into
+        # live mesh reshapes on running batches (docs/SERVICE.md).
+        self.elastic = ElasticController(
+            self.scheduler, self.fleet if cfg.workers else None,
+            log=self.log,
+        )
         handler = _make_handler(self)
         self.httpd = _Server((cfg.host, cfg.port), handler)
         if cfg.fleet_dir:
@@ -152,6 +161,7 @@ class ServeService:
 
     def start(self) -> "ServeService":
         self.fleet.start()
+        self.elastic.start()
         self._http_thread = threading.Thread(
             target=self.httpd.serve_forever, name="gs-serve-http",
             daemon=True,
@@ -168,6 +178,7 @@ class ServeService:
         """Drain: stop admitting, let workers finish in-flight batches,
         then stop the HTTP loop."""
         self.scheduler.drain()
+        self.elastic.close()
         self.fleet.stop(timeout)
         self.scheduler.close()
         self.httpd.shutdown()

@@ -75,6 +75,7 @@ class WorkerFleet:
         # Per-worker warm engine cache; a compiled engine belongs to
         # exactly one thread for its whole life.
         self._warm: Dict[int, Dict[Tuple, object]] = {}
+        self._busy: Dict[int, bool] = {}
         self.launches = 0
         self.warm_hits = 0
 
@@ -103,7 +104,20 @@ class WorkerFleet:
             batch = self.scheduler.next_batch(timeout=0.2)
             if batch is None:
                 continue
-            self._launch(worker_id, batch)
+            self._busy[worker_id] = True
+            try:
+                self._launch(worker_id, batch)
+            finally:
+                self._busy[worker_id] = False
+
+    def utilization(self) -> float:
+        """Fraction of worker threads currently inside a launch — one
+        of the two signals the elastic policy (``serve/elastic.py``)
+        trades off against queue depth."""
+        n = len(self._threads)
+        if n == 0:
+            return 0.0
+        return sum(1 for b in self._busy.values() if b) / n
 
     def _factory(self, worker_id: int, batch: Batch):
         """The driver's ``sim_factory`` seam: hand back a warm engine
@@ -170,6 +184,14 @@ class WorkerFleet:
             # it (obs/events.bound) — plus the launching worker's
             # fleet identity, so a merged multi-rank report can
             # attribute every run event to the process that ran it.
+            # Between-rounds elastic hook: the driver polls this
+            # closure each step round; a posted reshape request
+            # (Scheduler.take_reshape, consume-once) moves the live
+            # ensemble onto the target mesh with no checkpoint
+            # round-trip (docs/RESHARD.md "In-job reshapes").
+            def reshape_poll(batch_id=batch.id):
+                return self.scheduler.take_reshape(batch_id)
+
             with obs_events.bound(batch=batch.id,
                                   worker=f"{member}.{worker_id}"):
                 if batch.supervise:
@@ -178,6 +200,7 @@ class WorkerFleet:
                     supervise(
                         settings, seed=0,
                         sim_factory=self._factory(worker_id, batch),
+                        reshape_poll=reshape_poll,
                     )
                 else:
                     from ..driver import run_once
@@ -185,6 +208,7 @@ class WorkerFleet:
                     run_once(
                         settings, seed=0,
                         sim_factory=self._factory(worker_id, batch),
+                        reshape_poll=reshape_poll,
                     )
         except BaseException as exc:  # noqa: BLE001 — classified below
             kind = classify_failure(exc)

@@ -460,8 +460,19 @@ class Simulation:
         *,
         n_devices: Optional[int] = None,
         seed: int = 0,
+        mesh_dims: Optional[Tuple[int, int, int]] = None,
     ):
         self.settings = settings
+        #: Programmatic mesh-dims override (docs/RESHARD.md): the live
+        #: in-job reshape path builds the TARGET simulation with an
+        #: explicit factorization instead of mutating GS_TPU_MESH_DIMS
+        #: (process-global env is thread-unsafe under the serve worker
+        #: fleet). Wins over the env override in ``_make_domain``, and
+        #: pins the mesh against auto-kernel mesh adoption below.
+        self._mesh_dims_override = (
+            tuple(int(d) for d in mesh_dims)
+            if mesh_dims is not None else None
+        )
         #: The registered model declaration this run integrates —
         #: fields, boundaries, params, reaction (``models/``).
         self.model = get_model(
@@ -607,7 +618,10 @@ class Simulation:
                 kind = devices[0].device_kind
             except Exception:
                 kind = ""
-            mesh_forced = bool(env_str("GS_TPU_MESH_DIMS", ""))
+            mesh_forced = (
+                bool(env_str("GS_TPU_MESH_DIMS", ""))
+                or self._mesh_dims_override is not None
+            )
             if self._kernel_gate_reason is not None:
                 # Generator feasibility gate (docs/KERNELGEN.md): the
                 # fused kernel is generated from the model's reaction,
@@ -925,7 +939,10 @@ class Simulation:
 
     def _make_domain(self, devices) -> CartDomain:
         """Spatial decomposition over the selected devices."""
-        return CartDomain.create(len(devices), self.settings.L)
+        return CartDomain.create(
+            len(devices), self.settings.L,
+            dims=self._mesh_dims_override,
+        )
 
     def _make_params(self):
         """Typed params pytree, routed through the model declaration

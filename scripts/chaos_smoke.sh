@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
-# Chaos smoke test, nine scenarios (1-3 against one uninterrupted
+# Chaos smoke test, ten scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
 # elastic — resume on a DIFFERENT mesh / member count than the kill,
 # 6 serve — a worker killed mid-batch under the service front door,
 # 7 integrity — silent checkpoint corruption survived by replica
 # failover, 8 precision — lossy output resumed from an exact
 # checkpoint, 9 fleet — a front-door replica AND a leaseholding
-# worker process SIGKILLed mid-load under the distributed fleet):
+# worker process SIGKILLed mid-load under the distributed fleet,
+# 10 serve elastic — live in-job grow+shrink reshapes under load with
+# a worker SIGKILLed mid-reshape):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -66,7 +68,21 @@
 #      cache with cache="hit" provenance and a byte-identical store;
 #      the merged multi-rank event stream (worker_join/worker_lost/
 #      job_failover/cache_* kinds included) validates via
-#      gs_report.py --check.
+#      gs_report.py --check;
+#  10. serve elastic reshapes (docs/RESHARD.md "In-job reshapes",
+#      docs/SERVICE.md "Elastic capacity"): a fleet (one front door,
+#      two workers) under packed load; one RUNNING batch is steered
+#      through a live shrink -> grow cycle via the ``reshape/<batch>``
+#      KV relay (no kill, no checkpoint round-trip — reshard events
+#      with device-path provenance land on the merged stream), while
+#      the OTHER batch's leaseholding worker is SIGKILLed the moment
+#      its own reshape request lands; the orphaned request dies with
+#      the lease (the reaper deletes the doc), the surviving worker
+#      adopts the resume, and ALL accepted jobs complete with stores
+#      identical to an uninterrupted no-reshape service run — raw
+#      bytes for the globally-written .vtk series, served-value
+#      bitwise for the mesh-changed .bp stores (the scenario-5
+#      equality fine print).
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -819,7 +835,236 @@ grep -aq '"kind": "cache_hit"' "$WORK/fleet"/events.jsonl.rank* || {
   exit 1
 }
 
-echo "chaos_smoke: PASS — all nine scenarios recovered byte-identical" \
+echo "chaos_smoke: [10/10] serve elastic — live grow+shrink, worker SIGKILL mid-reshape..."
+# The reshape relay is driven directly through the fleet KV (the same
+# doc shape ClusterScheduler.request_reshape publishes) so the timing
+# is deterministic; the elastic CONTROLLER policy itself is covered by
+# tier-1 unit tests — this scenario proves the machinery under it: a
+# live between-rounds reshape on a RUNNING packed batch, and the
+# lease-reap cleanup of a request whose worker died mid-reshape.
+mkdir -p "$WORK/elserve"
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS=cpu \
+  REPO_DIR="$REPO" \
+  ELSERVE_WORK="$WORK/elserve" \
+  python3 - <<'EOF'
+import filecmp, json, os, signal, subprocess, sys, time
+import urllib.request
+
+import numpy as np
+
+repo = os.environ["REPO_DIR"]
+work = os.environ["ELSERVE_WORK"]
+fleet_dir = os.path.join(work, "fleet")
+
+# The in-process reference service below shares this interpreter, so
+# arm the device pool and the cross-mesh bitwise contract BEFORE any
+# jax import (docs/RESHARD.md "Equality fine print").
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["GS_FUSE"] = "1"
+
+sys.path.insert(0, repo)
+from grayscott_jl_tpu.serve.cluster import FleetKV
+
+
+def member_env(rank, workers):
+    env = dict(os.environ)
+    env["GS_SERVE_FLEET_DIR"] = fleet_dir
+    env["GS_SERVE_FLEET_RANK"] = str(rank)
+    env["GS_SERVE_PORT"] = "0"
+    env["GS_SERVE_WORKERS"] = str(workers)
+    env["GS_SERVE_STATE_DIR"] = os.path.join(work, f"state{rank}")
+    env["GS_SERVE_LEASE_TTL_S"] = "3.0"
+    env["GS_SERVE_HEARTBEAT_S"] = "0.5"
+    env["GS_SERVE_PACK_MAX"] = "2"
+    env["GS_SERVE_PACK_WINDOW_S"] = "0.1"
+    env["GS_SERVE_SUPERVISE"] = "0"
+    env["GS_EVENTS"] = os.path.join(work, "events.jsonl")
+    return env
+
+
+def post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode()
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+def spec(i):
+    # Long enough (12 step rounds) that the reshape requests land
+    # strictly mid-run; checkpoints arm the killed batch's resume.
+    return {
+        "tenant": "chaos", "model": "grayscott", "L": 16, "steps": 48,
+        "plotgap": 4, "checkpoint_freq": 8, "dt": 1.0, "noise": 0.1,
+        "seed": 300 + i,
+        "params": {"F": 0.03 + 0.002 * i, "k": 0.062,
+                   "Du": 0.2, "Dv": 0.1},
+    }
+
+
+procs = []
+for rank, role in ((0, "frontdoor"), (1, "worker"), (2, "worker")):
+    args = [sys.executable, os.path.join(repo, "scripts", "gs_serve.py")]
+    if role == "worker":
+        args += ["--role", "worker"]
+    procs.append(subprocess.Popen(
+        args, env=member_env(rank, 1 if role == "worker" else 0),
+        cwd=work,
+        stdout=open(os.path.join(work, f"member{rank}.log"), "w"),
+        stderr=subprocess.STDOUT,
+    ))
+
+kv = FleetKV(fleet_dir)
+base = None
+deadline = time.time() + 120
+while time.time() < deadline and base is None:
+    for mid in kv.keys("members"):
+        doc = kv.get(f"members/{mid}")
+        if doc and doc.get("role") == "frontdoor" and doc.get("port"):
+            base = f"http://{doc['host']}:{doc['port']}"
+    time.sleep(0.2)
+assert base is not None, "the front door never announced"
+
+jobs = [post(base, "/v1/jobs", spec(i))["job"] for i in range(4)]
+
+# Two packed batches, one lease per worker. batch A gets the live
+# grow+shrink cycle; batch B's worker is the SIGKILL victim.
+leases = {}
+deadline = time.time() + 120
+while time.time() < deadline and len(leases) < 2:
+    for bid in kv.keys("leases"):
+        lease = kv.get(f"leases/{bid}")
+        mdoc = lease and kv.get(f"members/{lease['worker']}")
+        if mdoc:
+            leases[bid] = mdoc["pid"]
+    time.sleep(0.05)
+assert len(leases) == 2, f"expected two concurrent leases: {leases}"
+(batch_a, pid_a), (batch_b, pid_b) = sorted(leases.items())
+
+
+def steer(batch_id, scale, wait=True):
+    # The exact doc ClusterScheduler.request_reshape publishes; the
+    # leasing worker's between-rounds poll consumes it atomically.
+    kv.put(f"reshape/{batch_id}", {
+        "batch": batch_id, "req": {"scale": scale},
+        "by": "chaos", "t": time.time(),
+    })
+    if not wait:
+        return
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if kv.get(f"reshape/{batch_id}") is None:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"{scale} request for {batch_id} never consumed")
+
+
+# Live cycle on batch A: halve the mesh, then double it back — both
+# consumed while the batch is RUNNING (reshard events prove the moves
+# really executed in-job).
+steer(batch_a, "shrink")
+steer(batch_a, "grow")
+
+# Batch B: the reshape request lands and its worker dies on the spot —
+# mid-reshape. The lease expires, the reaper deletes the orphaned doc,
+# and the surviving worker adopts the checkpoint-quorum resume.
+steer(batch_b, "shrink", wait=False)
+time.sleep(0.1)
+os.kill(pid_b, signal.SIGKILL)
+
+deadline = time.time() + 420
+records = []
+while time.time() < deadline:
+    records = [get(base, f"/v1/jobs/{j}") for j in jobs]
+    if all(r["state"] in ("complete", "failed") for r in records):
+        break
+    time.sleep(0.3)
+states = [r["state"] for r in records]
+assert states == ["complete"] * 4, f"elastic serve job states: {states}"
+
+for p in procs:
+    if p.poll() is None:
+        p.send_signal(signal.SIGTERM)
+for p in procs:
+    try:
+        p.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+
+# Uninterrupted, never-reshaped reference: the same four specs through
+# one in-process service with the same packing.
+os.environ["GS_SERVE_STATE_DIR"] = os.path.join(work, "ref")
+os.environ["GS_SERVE_PORT"] = "0"
+os.environ["GS_SERVE_WORKERS"] = "1"
+os.environ["GS_SERVE_PACK_MAX"] = "2"
+os.environ["GS_SERVE_PACK_WINDOW_S"] = "0.2"
+from grayscott_jl_tpu.serve.scheduler import resolve_serve_config
+from grayscott_jl_tpu.serve.server import ServeService
+
+svc = ServeService(resolve_serve_config()).start()
+ref_base = f"http://127.0.0.1:{svc.port}"
+ref_jobs = [post(ref_base, "/v1/jobs", spec(i))["job"] for i in range(4)]
+deadline = time.time() + 300
+while time.time() < deadline:
+    ref_records = [get(ref_base, f"/v1/jobs/{j}") for j in ref_jobs]
+    if all(r["state"] in ("complete", "failed") for r in ref_records):
+        break
+    time.sleep(0.3)
+svc.close()
+assert [r["state"] for r in ref_records] == ["complete"] * 4
+
+# Store identity per job: the .vtk series is written globally and must
+# stay RAW-byte identical; a .bp store that changed mesh mid-life
+# frames later steps in the new blocks, so it is compared on what it
+# SERVES — every step's assembled arrays, bitwise (the scenario-5
+# equality fine print).
+from grayscott_jl_tpu.io.bplite import BpReader
+
+for r, ref in zip(records, ref_records):
+    a, b = BpReader(r["store"]), BpReader(ref["store"])
+    assert a.attributes() == b.attributes(), (r["store"], ref["store"])
+    assert a.num_steps() == b.num_steps(), (r["store"], ref["store"])
+    for i in range(a.num_steps()):
+        for name in a.available_variables():
+            x = np.asarray(a.get(name, step=i))
+            y = np.asarray(b.get(name, step=i))
+            assert x.dtype == y.dtype and np.array_equal(x, y), (
+                r["store"], name, i)
+    va = r["store"].replace(".bp", ".vtk")
+    vb = ref["store"].replace(".bp", ".vtk")
+    cmp = filecmp.dircmp(va, vb)
+    assert not (cmp.left_only or cmp.right_only or cmp.diff_files), (
+        f"{va} differs from uninterrupted {vb}")
+    assert all(
+        open(os.path.join(va, f), "rb").read()
+        == open(os.path.join(vb, f), "rb").read()
+        for f in cmp.common_files
+    ), f"{va} not byte-identical to {vb}"
+
+print(f"elastic serve chaos: batch {batch_a} grew+shrank live, "
+      f"worker {pid_b} SIGKILLed mid-reshape on {batch_b}; "
+      f"4/4 jobs complete, stores identical to the unmoved reference")
+EOF
+# The live moves must be on the merged stream with device-path
+# provenance, and the whole multi-rank stream must validate.
+grep -aq '"kind": "reshard"' "$WORK/elserve"/events.jsonl.rank* || {
+  echo "chaos_smoke: FAIL — no reshard event from the live serve reshapes" >&2
+  exit 1
+}
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/elserve/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the elastic serve events" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all ten scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

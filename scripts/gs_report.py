@@ -74,8 +74,14 @@ EVENT_KIND_SCHEMA = {
     "graceful_shutdown": ("signal",),
     "hang": ("fault", "deadline_s", "threads"),
     "hang_exit": ("fault", "exit_code"),
-    # elastic resharding
-    "reshard": ("members",),
+    # elastic resharding: every move — host checkpoint restore or
+    # live device reshape — records its path tier (ckpt / collective /
+    # put / host), true-domain bytes moved, and wall time, so reshard
+    # cost is first-class provenance (docs/RESHARD.md).
+    "reshard": ("members", "path", "bytes", "wall_s"),
+    # the serve elastic policy's grow/shrink decisions
+    # (serve/elastic.py, docs/SERVICE.md "Elastic capacity")
+    "elastic": ("action", "batch", "depth", "utilization"),
     # data integrity (resilience/integrity.py, docs/RESILIENCE.md):
     # detected silent corruption (CRC / device-checksum mismatch,
     # damaged writer metadata), a restore failing over to a healthy
@@ -222,6 +228,25 @@ def check(trace_path, events_path, stats_path,
                             f"a Pallas run must record an integer "
                             f"generator_version"
                         )
+            rs = (cfg.get("reshard")
+                  if isinstance(cfg, dict) else None)
+            if isinstance(rs, dict) and rs.get("changed"):
+                # Reshard provenance (docs/RESHARD.md): a run that
+                # moved must say HOW — which path tier carried it,
+                # how many bytes, how long.
+                if rs.get("path") not in ("ckpt", "collective",
+                                          "put", "host"):
+                    problems.append(
+                        f"stats {stats_path}: reshard record must "
+                        f"carry a path tier (ckpt/collective/put/"
+                        f"host), got {rs.get('path')!r}"
+                    )
+                for k in ("bytes", "wall_s"):
+                    if not isinstance(rs.get(k), (int, float)):
+                        problems.append(
+                            f"stats {stats_path}: reshard record "
+                            f"missing numeric {k!r}"
+                        )
             comm = stats.get("comm") if isinstance(stats, dict) else None
             if isinstance(comm, dict):
                 # The s-step visibility fields (docs/TEMPORAL.md) are
@@ -299,6 +324,7 @@ def report_stats(stats: dict) -> None:
             print(f"  halo_depth={comm.get('halo_depth')}: one exchange "
                   f"per {per} steps, "
                   f"{comm.get('halo_bytes_per_step')} halo B/step")
+    report_reshard(cfg.get("reshard"))
     metrics = stats.get("metrics")
     if metrics:
         for h in metrics.get("histograms", []):
@@ -309,6 +335,31 @@ def report_stats(stats: dict) -> None:
                       f"over {h.get('count')} rounds")
     report_numerics(stats.get("numerics"))
     report_executables(stats.get("executables"))
+
+
+def report_reshard(rs) -> None:
+    """The reshard provenance section: which path tier moved the run
+    (host checkpoint restore vs the live device tiers), between which
+    layouts, how many bytes, how fast (docs/RESHARD.md)."""
+    if not isinstance(rs, dict) or not rs.get("changed"):
+        return
+    old = rs.get("old") or {}
+    new = rs.get("new") or {}
+    print(f"== reshard (path={rs.get('path')}) ==")
+    print(f"  mesh {old.get('mesh_dims')} -> {new.get('mesh_dims')}, "
+          f"procs {old.get('process_count')} -> "
+          f"{new.get('process_count')}, "
+          f"{rs.get('n_shards')} target shard(s)")
+    by = rs.get("bytes")
+    wall = rs.get("wall_s")
+    if isinstance(by, (int, float)) and isinstance(wall, (int, float)):
+        rate = by / wall / 1e6 if wall else float("inf")
+        print(f"  moved {by} B in {_fmt_s(wall)} ({rate:.1f} MB/s)")
+    members = rs.get("members")
+    if members:
+        print(f"  members: restored={members.get('restored')} "
+              f"grown={members.get('grown')} "
+              f"-> n={members.get('new_n')}")
 
 
 def report_numerics(num) -> None:

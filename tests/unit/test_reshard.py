@@ -667,8 +667,14 @@ def test_reshard_plan_describe_shape():
     assert json.dumps(d)  # JSON-serializable for events/stats
 
 
-def test_device_all_to_all_is_a_documented_seam():
+def test_device_all_to_all_is_implemented():
+    """Once a documented NotImplementedError seam, now the live
+    device-path mover (tests/unit/test_reshard_device.py covers the
+    tiers); a pinned-off knob must still refuse it loudly."""
     from grayscott_jl_tpu.reshard import restore as restore_mod
 
-    with pytest.raises(NotImplementedError, match="RESHARD"):
-        restore_mod.device_all_to_all_restore(None, None)
+    assert callable(restore_mod.device_all_to_all_restore)
+    with pytest.raises(ReshardError, match="GS_RESHARD_DEVICE"):
+        restore_mod.device_all_to_all_restore(
+            None, None, None, mode="off"
+        )

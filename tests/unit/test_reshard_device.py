@@ -1,0 +1,223 @@
+"""Device-path live resharding (grayscott_jl_tpu/reshard/restore.py,
+docs/RESHARD.md "In-job reshapes").
+
+The contract under test: :func:`reshape_live` moves LIVE mesh-A state
+onto mesh B between step rounds — no checkpoint round-trip — through
+the tiered device path (collective for a same-device-set relayout,
+``jax.device_put`` across device sets, host gather as the floor), and
+the continuation is bitwise identical BOTH to a run that never moved
+and to the host selection-read restore of the same plan. Plus the
+driver's between-rounds ``reshape_poll`` hook: the store swap must
+append (the pre-move snapshots survive) and the reshard provenance
+(path/bytes/wall_s) must land on ``sim.reshard``.
+
+Everything runs on the 8-virtual-CPU-device platform from conftest;
+``GS_FUSE=1`` arms the cross-mesh bitwise contract off-TPU
+(docs/RESHARD.md "Equality fine print").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.ensemble import spec as ens_spec
+from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+from grayscott_jl_tpu.io.bplite import BpReader
+from grayscott_jl_tpu.reshard.restore import reshape_live
+from grayscott_jl_tpu.simulation import Simulation
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+S = dict(L=24, steps=8, noise=0.0, kernel_language="XLA")
+
+
+@pytest.fixture(autouse=True)
+def _fused(monkeypatch):
+    monkeypatch.setenv("GS_FUSE", "1")
+
+
+def _run(n_devices, mesh, steps):
+    sim = Simulation(
+        Settings(**S), n_devices=n_devices, seed=0, mesh_dims=mesh
+    )
+    sim.iterate(steps)
+    return sim
+
+
+def _assert_bitwise(a_sim, b_sim):
+    for a, b in zip(a_sim.get_fields(), b_sim.get_fields()):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+# --------------------------------------------------- device-path tiers
+
+
+@requires8
+def test_shrink_bitwise_vs_unmoved_and_host():
+    """(2,2,2) -> (1,2,2): the device move halfway through the run
+    continues bitwise-identical to the run that never moved, and to
+    the host-tier restore of the same plan."""
+    ref = _run(4, (1, 2, 2), 8)
+
+    sim = _run(8, (2, 2, 2), 4)
+    tgt, plan = reshape_live(sim, mesh_dims=(1, 2, 2))
+    assert plan.changed
+    prov = tgt.reshard
+    assert prov["path"] in ("collective", "put")
+    assert prov["bytes"] > 0 and prov["wall_s"] > 0
+    assert prov["old"]["mesh_dims"] == [2, 2, 2]
+    assert prov["new"]["mesh_dims"] == [1, 2, 2]
+    tgt.iterate(4)
+    _assert_bitwise(ref, tgt)
+
+    host_src = _run(8, (2, 2, 2), 4)
+    host_tgt, _ = reshape_live(
+        host_src, mesh_dims=(1, 2, 2), mode="host"
+    )
+    assert host_tgt.reshard["path"] == "host"
+    host_tgt.iterate(4)
+    _assert_bitwise(tgt, host_tgt)
+
+
+@requires8
+def test_grow_bitwise_vs_unmoved():
+    """(1,1,1) -> (2,1,1): growing onto devices the source never used
+    (the device_put tier) stays bitwise."""
+    ref = _run(2, (2, 1, 1), 8)
+    sim = _run(1, None, 4)
+    tgt, plan = reshape_live(sim, mesh_dims=(2, 1, 1))
+    assert plan.changed and tgt.reshard["path"] in ("put", "collective")
+    tgt.iterate(4)
+    _assert_bitwise(ref, tgt)
+
+
+@requires8
+def test_collective_tier_same_device_set():
+    """(2,2,2) -> (8,1,1) keeps the full 8-device set, so auto must
+    pick the one-jit collective relayout — and match the host tier."""
+    sim = _run(8, (2, 2, 2), 4)
+    tgt, _ = reshape_live(sim, mesh_dims=(8, 1, 1))
+    assert tgt.reshard["path"] == "collective"
+
+    host_src = _run(8, (2, 2, 2), 4)
+    host_tgt, _ = reshape_live(
+        host_src, mesh_dims=(8, 1, 1), mode="host"
+    )
+    tgt.iterate(4)
+    host_tgt.iterate(4)
+    _assert_bitwise(tgt, host_tgt)
+
+
+# ----------------------------------------------------------- ensembles
+
+
+def _ens_settings(presets, shards):
+    s = Settings(**S)
+    s.ensemble = ens_spec.from_toml(
+        {"presets": presets, "member_shards": shards}, s
+    )
+    return s
+
+
+@requires8
+def test_ensemble_grow_and_shrink_on_member_mesh():
+    """N=2 -> N'=4 on the (member_shards=2) member mesh: the collective
+    tier matches host, and shrinking back keeps the leading members
+    bitwise."""
+    grown = _ens_settings(["spots", "chaos", "stripes", "waves"], 2)
+    base = _ens_settings(["spots", "chaos"], 2)
+
+    esim = EnsembleSimulation(base, n_devices=2, seed=0)
+    esim.iterate(4)
+    etgt, eplan = reshape_live(esim, settings=grown)
+    assert eplan.changed
+    assert etgt.reshard["path"] == "collective"
+    members = etgt.reshard["members"]
+    assert members["restored"] == 2 and members["grown"] == 2
+
+    ehost_src = EnsembleSimulation(base, n_devices=2, seed=0)
+    ehost_src.iterate(4)
+    ehost, _ = reshape_live(ehost_src, settings=grown, mode="host")
+    etgt.iterate(4)
+    ehost.iterate(4)
+    _assert_bitwise(etgt, ehost)
+
+    shrunk, _ = reshape_live(etgt, settings=base)
+    for a, b in zip(shrunk.get_fields(), etgt.get_fields()):
+        assert (
+            np.asarray(a).tobytes() == np.asarray(b)[:2].tobytes()
+        )
+
+
+# -------------------------------------------------- driver poll hook
+
+
+@requires8
+def test_driver_reshape_poll_moves_live_and_appends(tmp_path):
+    """``run_once(reshape_poll=...)``: a ``{"mesh_dims"}`` request
+    posted after round one moves the run onto the new mesh mid-life;
+    the trajectory matches an unmoved run bitwise, the provenance
+    lands on ``sim.reshard``, and the swapped-in stores APPEND — the
+    snapshots written before the move survive in both stores."""
+    from grayscott_jl_tpu.driver import run_once
+
+    def mk(sub):
+        d = tmp_path / sub
+        d.mkdir()
+        return Settings(
+            L=24, steps=8, plotgap=4, noise=0.0,
+            kernel_language="xla", autotune="off",
+            output=str(d / "gs.bp"),
+            checkpoint=True, checkpoint_freq=4,
+            checkpoint_output=str(d / "ckpt.bp"),
+            restart_input=str(d / "ckpt.bp"),
+        )
+
+    polls = {"n": 0}
+
+    def poll():
+        polls["n"] += 1
+        if polls["n"] == 2:  # after the first step round
+            return {"mesh_dims": [1, 2, 2]}
+        return None
+
+    moved_s = mk("moved")
+    moved = run_once(moved_s, n_devices=8, reshape_poll=poll)
+    assert tuple(moved.domain.dims) == (1, 2, 2)
+    assert moved.reshard is not None
+    assert moved.reshard["path"] in ("collective", "put", "host")
+    assert moved.reshard["bytes"] > 0
+
+    ref = run_once(mk("ref"), n_devices=8)
+    _assert_bitwise(ref, moved)
+
+    # Append contract: the pre-move snapshot (step 4) is still in both
+    # stores after the mid-run swap — a fresh run's stores must not be
+    # truncated by the reshape (regression: the rebuild used to open
+    # non-restart stores from scratch).
+    for store in (moved_s.output, moved_s.checkpoint_output):
+        r = BpReader(store)
+        steps = [int(r.get("step", step=i)) for i in range(r.num_steps())]
+        assert steps == [4, 8], (store, steps)
+
+
+@requires8
+def test_driver_infeasible_scale_is_refused_not_fatal(tmp_path):
+    """A grow hint with no devices to grow into degrades to a no-op:
+    the run completes on its original mesh."""
+    from grayscott_jl_tpu.driver import run_once
+
+    s = Settings(
+        L=24, steps=8, plotgap=4, noise=0.0,
+        kernel_language="xla", autotune="off",
+        output=str(tmp_path / "gs.bp"),
+    )
+    sim = run_once(
+        s, n_devices=8, reshape_poll=lambda: {"scale": "grow"}
+    )
+    assert sim.domain.n_blocks == 8
+    assert sim.reshard is None

@@ -1,0 +1,223 @@
+"""The serve elastic control loop (grayscott_jl_tpu/serve/elastic.py,
+docs/SERVICE.md "Elastic capacity").
+
+Policy unit coverage drives :meth:`ElasticController.tick` directly
+(no thread, no sleeping): pressure (deep queue + saturated workers)
+sustained long enough shrinks the oldest running batch, relief grows
+it, cooldown and broken sustain streaks suppress actions. The
+scheduler seams ride along: ``request_reshape`` only targets RUNNING
+batches, ``take_reshape`` is consume-once and latest-wins, and the
+``serve_queue_depth`` gauge refreshes on the status/poll path — not
+only on mutations.
+"""
+
+import pytest
+
+from grayscott_jl_tpu.obs.events import NULL_EVENTS
+from grayscott_jl_tpu.serve.elastic import (
+    ElasticConfig,
+    ElasticController,
+    resolve_elastic_config,
+)
+from grayscott_jl_tpu.serve.scheduler import Scheduler, ServeConfig
+
+SPEC = {
+    "tenant": "alice",
+    "model": "grayscott",
+    "L": 16,
+    "steps": 24,
+    "plotgap": 8,
+    "checkpoint_freq": 8,
+    "params": {"F": 0.03, "k": 0.062, "Du": 0.2, "Dv": 0.1},
+    "dt": 1.0,
+    "noise": 0.1,
+    "seed": 11,
+}
+
+
+# ------------------------------------------------------------ knob family
+
+
+def test_resolve_elastic_defaults():
+    cfg = resolve_elastic_config()
+    assert cfg.enabled is False
+    assert cfg.high == 4 and cfg.low == 0
+    assert cfg.sustain == 2
+    assert cfg.cooldown_s == 5.0 and cfg.tick_s == 0.5
+
+
+@pytest.mark.parametrize("knob,value,match", [
+    ("GS_SERVE_ELASTIC_HIGH", "0", "GS_SERVE_ELASTIC_HIGH"),
+    ("GS_SERVE_ELASTIC_LOW", "9", "GS_SERVE_ELASTIC_LOW"),
+    ("GS_SERVE_ELASTIC_SUSTAIN", "0", "GS_SERVE_ELASTIC_SUSTAIN"),
+    ("GS_SERVE_ELASTIC_COOLDOWN_S", "-1", "GS_SERVE_ELASTIC_COOLDOWN_S"),
+    ("GS_SERVE_ELASTIC_TICK_S", "0", "GS_SERVE_ELASTIC_TICK_S"),
+])
+def test_resolve_elastic_rejects_loudly(monkeypatch, knob, value, match):
+    monkeypatch.setenv(knob, value)
+    with pytest.raises(ValueError, match=match):
+        resolve_elastic_config()
+
+
+def test_start_is_a_noop_when_disabled():
+    ctl = ElasticController(
+        FakeScheduler(), cfg=ElasticConfig(enabled=False),
+        events=NULL_EVENTS,
+    )
+    assert ctl.start()._thread is None
+    ctl.close()
+
+
+# --------------------------------------------------------------- policy
+
+
+class FakeBatch:
+    def __init__(self, bid, created_t):
+        self.id = bid
+        self.created_t = created_t
+
+
+class FakeScheduler:
+    def __init__(self, depth=0, running=(), accept=True):
+        self.depth = depth
+        self.running = list(running)
+        self.accept = accept
+        self.requests = []
+
+    def queue_depth(self):
+        return self.depth
+
+    def running_batches(self):
+        return list(self.running)
+
+    def request_reshape(self, batch_id, req):
+        if not self.accept:
+            return False
+        self.requests.append((batch_id, dict(req)))
+        return True
+
+
+class FakeFleet:
+    def __init__(self, util):
+        self.util = util
+
+    def utilization(self):
+        return self.util
+
+
+def make_controller(sched, fleet=None, **cfg_kw):
+    defaults = dict(
+        enabled=True, high=2, low=0, sustain=2, cooldown_s=60.0,
+        tick_s=0.01,
+    )
+    defaults.update(cfg_kw)
+    return ElasticController(
+        sched, fleet, ElasticConfig(**defaults), events=NULL_EVENTS,
+    )
+
+
+def test_sustained_pressure_shrinks_oldest():
+    sched = FakeScheduler(depth=3, running=[
+        FakeBatch("b-young", 20.0), FakeBatch("b-old", 10.0),
+    ])
+    ctl = make_controller(sched, FakeFleet(1.0))
+    assert ctl.tick() is None  # one pressured tick is not sustained
+    assert ctl.tick() == "shrink"
+    assert sched.requests == [("b-old", {"scale": "shrink"})]
+    # cooldown: still pressured, no second action inside the window
+    assert ctl.tick() is None
+    assert ctl.actions == 1
+
+
+def test_sustained_relief_grows():
+    sched = FakeScheduler(depth=0, running=[FakeBatch("b", 1.0)])
+    ctl = make_controller(sched, FakeFleet(0.5), sustain=1)
+    assert ctl.tick() == "grow"
+    assert sched.requests == [("b", {"scale": "grow"})]
+
+
+def test_broken_streak_resets_sustain():
+    sched = FakeScheduler(depth=3, running=[FakeBatch("b", 1.0)])
+    fleet = FakeFleet(1.0)
+    ctl = make_controller(sched, fleet)
+    assert ctl.tick() is None
+    fleet.util = 0.5  # pressure relieved for one tick
+    assert ctl.tick() is None
+    fleet.util = 1.0
+    assert ctl.tick() is None  # streak restarted, not resumed
+    assert ctl.tick() == "shrink"
+
+
+def test_no_action_without_running_batches():
+    sched = FakeScheduler(depth=9, running=[])
+    ctl = make_controller(sched, FakeFleet(1.0), sustain=1)
+    assert ctl.tick() is None
+    assert ctl.actions == 0
+
+
+def test_no_fleet_reads_as_saturated():
+    # A pure front door (fleet=None) can only see queue pressure.
+    sched = FakeScheduler(depth=3, running=[FakeBatch("b", 1.0)])
+    ctl = make_controller(sched, None, sustain=1)
+    assert ctl.tick() == "shrink"
+
+
+def test_declined_request_arms_no_cooldown():
+    sched = FakeScheduler(
+        depth=3, running=[FakeBatch("b", 1.0)], accept=False
+    )
+    ctl = make_controller(sched, FakeFleet(1.0), sustain=1)
+    assert ctl.tick() is None
+    sched.accept = True
+    assert ctl.tick() == "shrink"
+
+
+# ----------------------------------------------------- scheduler seams
+
+
+def make_scheduler(tmp_path, **kw) -> Scheduler:
+    defaults = dict(
+        state_dir=str(tmp_path / "state"), pack_window_s=0.0,
+        supervise=False,
+    )
+    defaults.update(kw)
+    return Scheduler(ServeConfig(**defaults), events=NULL_EVENTS)
+
+
+def test_request_reshape_targets_running_batches_only(tmp_path):
+    sched = make_scheduler(tmp_path)
+    sched.submit(dict(SPEC))
+    batch = sched.next_batch(timeout=0.0)
+    assert not sched.request_reshape(batch.id, {"scale": "grow"})
+    assert not sched.request_reshape("nope", {"scale": "grow"})
+
+    batch.jobs[0].state = "running"
+    assert sched.running_batches() == [batch]
+    assert sched.request_reshape(batch.id, {"scale": "grow"})
+
+
+def test_take_reshape_consume_once_latest_wins(tmp_path):
+    sched = make_scheduler(tmp_path)
+    sched.submit(dict(SPEC))
+    batch = sched.next_batch(timeout=0.0)
+    batch.jobs[0].state = "running"
+
+    assert sched.take_reshape(batch.id) is None
+    sched.request_reshape(batch.id, {"scale": "grow"})
+    sched.request_reshape(batch.id, {"scale": "shrink"})
+    assert sched.take_reshape(batch.id) == {"scale": "shrink"}
+    assert sched.take_reshape(batch.id) is None
+
+
+def test_queue_depth_gauge_refreshes_on_status_path(tmp_path):
+    from grayscott_jl_tpu.obs.metrics import MetricsRegistry
+
+    sched = make_scheduler(tmp_path, pack_window_s=60.0)
+    sched.metrics = MetricsRegistry(enabled=True)
+    job = sched.submit(dict(SPEC))
+    gauge = sched.metrics.gauge("serve_queue_depth")
+    gauge.set(-1)  # stale value a mutation-only refresh would leave
+    sched.status(job.id)
+    assert gauge.value == 1
+    assert sched.queue_depth() == 1
+    assert gauge.value == 1
