@@ -62,6 +62,14 @@ def main() -> int:
         "model's HALO_DEPTH_EFFICIENCY",
     )
     ap.add_argument(
+        "--lang", default=None, metavar="LANG1,LANG2,...",
+        help="with --ab --halo-depths: comma list of kernel languages "
+        "to sweep in one invocation (e.g. xla,pallas); every row is "
+        "tagged with its lang so benchmarks/update_halo_depth.py can "
+        "calibrate HALO_DEPTH_EFFICIENCY per language (default: the "
+        "--kernel language only)",
+    )
+    ap.add_argument(
         "--out", default=None,
         help="JSONL artifact path for --ab rows (default "
         "benchmarks/results/overlap_ab_<platform>_<date>.jsonl)",
@@ -126,65 +134,80 @@ def main() -> int:
         os.environ.pop("GS_COMM_OVERLAP", None)
         os.environ.pop("GS_HALO_DEPTH", None)
         ks = sorted({int(s) for s in args.halo_depths.split(",")} | {1})
+        langs = ([s.strip() for s in args.lang.split(",") if s.strip()]
+                 if args.lang else [args.kernel])
         out = args.out
         if out is None:
             out = artifacts.default_out("halo_depth_ab", backend)
-        single = Simulation(Settings(L=args.local, **base), n_devices=1)
-        t_single = time_sim(single, args.steps, args.rounds)
-        times = {}
-        sims = {}
-        for k in ks:
-            sims[k] = Simulation(
-                Settings(L=L_global, halo_depth=k, **base),
-                n_devices=args.devices,
+        for lang in langs:
+            lbase = dict(base, kernel_language=lang)
+            # Per-language single-device anchor: the two languages'
+            # compute baselines differ, and the comm attribution must
+            # subtract the right one.
+            single = Simulation(
+                Settings(L=args.local, **lbase), n_devices=1
             )
-            times[k] = time_sim(sims[k], args.steps, args.rounds)
-        fuse_base = min(sims[1]._fuse_base(),
-                        min(sims[1].domain.local_shape))
-        for k in ks:
-            t_k = times[k]
-            comm_k = max(t_k - t_single, 0.0)
-            comm_1 = max(times[1] - t_single, 0.0)
-            row = {
-                "ab": "halo_depth",
-                "t": artifacts.utc_stamp(),
-                "platform": backend.lower(),
-                "devices": args.devices,
-                "mesh": list(sims[k].domain.dims),
-                "L_global": L_global,
-                "local_block": [L_global // d
-                                for d in sims[k].domain.dims],
-                "kernel": args.kernel,
-                # Chain base d (GS_FUSE-resolved): each k exchanges a
-                # (d x k)-deep frame once per d*k steps.
-                "fuse_base": fuse_base,
-                "halo_depth": k,
-                # The constructed sim's resolved k (a Pallas-language
-                # sweep gates to 1; such rows carry no s-step signal).
-                "engaged": sims[k].halo_depth == k,
-                "us_per_step": round(t_k * 1e6, 1),
-                "us_per_step_k1": round(times[1] * 1e6, 1),
-                "us_per_step_single_equivalent": round(
-                    t_single * 1e6, 1
-                ),
-                "speedup_vs_k1": round(times[1] / t_k, 4)
-                if t_k > 0 else None,
-                "comm_us": round(comm_k * 1e6, 1),
-                "comm_us_k1": round(comm_1 * 1e6, 1),
-                # Net exchange-cost reduction vs exchanging every chain
-                # round; the ideal is the 1/k latency amortization —
-                # their ratio is the realized HALO_DEPTH_EFFICIENCY.
-                "measured_comm_reduction": (
-                    round(1.0 - comm_k / comm_1, 4)
-                    if k > 1 and comm_1 > 0 else None
-                ),
-                "model_ideal_reduction": (
-                    round(1.0 - 1.0 / k, 4) if k > 1 else None
-                ),
-                "model_comm": icimodel.comm_report(sims[k]),
-            }
-            print(json.dumps(row))
-            artifacts.append_row(out, row)
+            t_single = time_sim(single, args.steps, args.rounds)
+            times = {}
+            sims = {}
+            for k in ks:
+                sims[k] = Simulation(
+                    Settings(L=L_global, halo_depth=k, **lbase),
+                    n_devices=args.devices,
+                )
+                times[k] = time_sim(sims[k], args.steps, args.rounds)
+            fuse_base = min(sims[1]._fuse_base(),
+                            min(sims[1].domain.local_shape))
+            for k in ks:
+                t_k = times[k]
+                comm_k = max(t_k - t_single, 0.0)
+                comm_1 = max(times[1] - t_single, 0.0)
+                row = {
+                    "ab": "halo_depth",
+                    "t": artifacts.utc_stamp(),
+                    "platform": backend.lower(),
+                    "devices": args.devices,
+                    "mesh": list(sims[k].domain.dims),
+                    "L_global": L_global,
+                    "local_block": [L_global // d
+                                    for d in sims[k].domain.dims],
+                    "kernel": lang,
+                    # The resolved language this arm actually ran —
+                    # what update_halo_depth.py groups by to calibrate
+                    # HALO_DEPTH_EFFICIENCY per language.
+                    "lang": sims[k].kernel_language,
+                    # Chain base d (GS_FUSE-resolved): each k exchanges
+                    # a (d x k)-deep frame once per d*k steps.
+                    "fuse_base": fuse_base,
+                    "halo_depth": k,
+                    # The constructed sim's resolved k (a geometry-
+                    # infeasible k degrades with halo_depth_gate
+                    # provenance; such rows carry no s-step signal).
+                    "engaged": sims[k].halo_depth == k,
+                    "us_per_step": round(t_k * 1e6, 1),
+                    "us_per_step_k1": round(times[1] * 1e6, 1),
+                    "us_per_step_single_equivalent": round(
+                        t_single * 1e6, 1
+                    ),
+                    "speedup_vs_k1": round(times[1] / t_k, 4)
+                    if t_k > 0 else None,
+                    "comm_us": round(comm_k * 1e6, 1),
+                    "comm_us_k1": round(comm_1 * 1e6, 1),
+                    # Net exchange-cost reduction vs exchanging every
+                    # chain round; the ideal is the 1/k latency
+                    # amortization — their ratio is the realized
+                    # HALO_DEPTH_EFFICIENCY for this language.
+                    "measured_comm_reduction": (
+                        round(1.0 - comm_k / comm_1, 4)
+                        if k > 1 and comm_1 > 0 else None
+                    ),
+                    "model_ideal_reduction": (
+                        round(1.0 - 1.0 / k, 4) if k > 1 else None
+                    ),
+                    "model_comm": icimodel.comm_report(sims[k]),
+                }
+                print(json.dumps(row))
+                artifacts.append_row(out, row)
         print(f"# appended to {out}", file=sys.stderr)
         return 0
 

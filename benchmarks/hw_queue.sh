@@ -23,7 +23,7 @@ STAMP=$(date +%F_%H%M)
 # itself and always exits 0 — an OUTER kill there would be the exact
 # mid-run client death the wedge postmortem forbids, so it runs bare.
 
-echo "== 1/7 hardware test suite (xy-chain Mosaic lowering FIRST) =="
+echo "== 1/8 hardware test suite (xy-chain Mosaic lowering FIRST) =="
 # The xy-chain Mosaic lowering test settles compile-or-not for the
 # kernel every (n, m, 1) pod mesh launches — on a minutes-long grant
 # window that answer must land before anything else can time out the
@@ -40,7 +40,7 @@ GS_TPU_TESTS=1 timeout -k 30 1800 python -m pytest \
     2>&1 \
     | tee "benchmarks/results/hw_tests_${STAMP}.log" | tail -3
 
-echo "== 2/7 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
+echo "== 2/8 FUSE_COST_RATIO re-measurement (k=2,3 are interpolations) =="
 # k=6 re-measured alongside (the deep-chain lever, BASELINE r4 queue);
 # k=8 is excluded — it fails Mosaic compile (BASELINE.md Mosaic gates).
 timeout -k 30 1800 python benchmarks/ab_probe.py \
@@ -54,13 +54,13 @@ timeout -k 30 1800 python benchmarks/ab_probe.py \
         >/dev/null \
     && echo "model updated + sweep re-run (remember: commit the diff)"
 
-echo "== 3/7 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
+echo "== 3/8 bf16-mid A/B (expected win: mid VMEM movement is binding) =="
 timeout -k 30 1800 python benchmarks/ab_probe.py \
     --case fuse=5 --case fuse=5,midbf16=1 \
     --case fuse=4 --case fuse=4,midbf16=1 \
     --rounds 6 --out "benchmarks/results/ab_r5_midbf16_${STAMP}.jsonl"
 
-echo "== 4/7 per-model Pallas vs XLA A/B (generated kernels, all models) =="
+echo "== 4/8 per-model Pallas vs XLA A/B (generated kernels, all models) =="
 # First hardware numbers for the generator era (docs/KERNELGEN.md):
 # every registered model times its generated Pallas kernel against the
 # XLA path round-robin, rows land in the artifacts.py schema, and the
@@ -72,13 +72,13 @@ timeout -k 30 1800 python benchmarks/model_ab.py \
         --fresh "benchmarks/results/model_ab_tpu_${STAMP}.jsonl" \
     && echo "per-model A/B gated clean (commit the artifact)"
 
-echo "== 5/7 headline sample (self-bounding bench, no outer kill) =="
+echo "== 5/8 headline sample (self-bounding bench, no outer kill) =="
 GS_BENCH_TPU_HORIZON=0 python bench.py \
     >"benchmarks/results/bench_r5_sample_${STAMP}.json" \
     2>"benchmarks/results/bench_r5_sample_${STAMP}.err"
 tail -c 400 "benchmarks/results/bench_r5_sample_${STAMP}.json"; echo
 
-echo "== 6/7 reshard A/B (in-job live reshape vs kill->restore) =="
+echo "== 6/8 reshard A/B (in-job live reshape vs kill->restore) =="
 # TPU rows for the docs/RESHARD.md "In-job reshapes" speedup claim —
 # the CPU artifact proves >=10x, these rows price the real ICI move
 # (collective tier) instead of the host-device put path.
@@ -88,7 +88,23 @@ timeout -k 30 900 python benchmarks/reshard_bench.py \
         --fresh "benchmarks/results/reshard_ab_tpu_${STAMP}.jsonl" \
     && echo "reshard A/B gated clean (commit the artifact)"
 
-echo "== 7/7 launching the long-horizon headline hunter =="
+echo "== 7/8 per-language halo-depth A/B (Pallas s-step chains, v8) =="
+# First hardware rows for the communication-avoiding Pallas schedule
+# (docs/TEMPORAL.md): both languages sweep k at the same local volume,
+# rows carry the lang tag, and update_halo_depth.py folds each
+# language's realized efficiency into its HALO_DEPTH_EFFICIENCY entry
+# (the CPU artifact only proves the row schema — TPU comm is the
+# signal the per-language literals await, ROADMAP "TPU-unreachable").
+timeout -k 30 1800 python benchmarks/halo_bench.py \
+    --devices 8 --local 64 --ab --halo-depths 2,4 --lang xla,pallas \
+    --out "benchmarks/results/halo_depth_ab_tpu_${STAMP}.jsonl" \
+    && python benchmarks/update_halo_depth.py --apply \
+        "benchmarks/results/halo_depth_ab_tpu_${STAMP}.jsonl" \
+    && python benchmarks/regression_gate.py \
+        --fresh "benchmarks/results/halo_depth_ab_tpu_${STAMP}.jsonl" \
+    && echo "halo-depth A/B applied + gated clean (commit the diff)"
+
+echo "== 8/8 launching the long-horizon headline hunter =="
 if ! hunter_running hw_queue; then
     launch_hunter
     echo "hunter launched"

@@ -75,9 +75,10 @@ import artifacts  # noqa: E402 — shared JSONL record helpers
 #: Absent fields participate as absent — a row without ``fuse`` only
 #: compares against other rows without ``fuse``.
 KEY_FIELDS = (
-    "ab", "platform", "model", "kernel", "L", "L_global", "devices",
-    "mesh", "local_block", "fuse", "fuse_base", "halo_depth",
-    "precision", "members", "comm_overlap", "bx", "metric",
+    "ab", "platform", "model", "kernel", "lang", "L", "L_global",
+    "devices", "mesh", "local_block", "fuse", "fuse_base",
+    "halo_depth", "precision", "members", "comm_overlap", "bx",
+    "metric",
 )
 
 #: Lower-is-better metrics, in preference order — the first one a row

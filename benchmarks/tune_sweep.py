@@ -158,20 +158,17 @@ def overlap_ab_row(out: str, backend: str, settings, sim, L: int,
 def halo_depth_ab_rows(out: str, backend: str, settings, sim, L: int,
                        steps: int, rounds: int, ks=(1, 2, 4)):
     """halo_bench-style s-step depth A/B at the tuned winner config —
-    the rows ``update_halo_depth.py`` calibrates HALO_DEPTH_EFFICIENCY
-    from. XLA-language winners only (the Pallas chains gate
-    halo_depth); needs a cubic local block for the single-device comm
-    anchor, like the overlap A/B."""
+    the rows ``update_halo_depth.py`` calibrates the winner language's
+    HALO_DEPTH_EFFICIENCY entry from (both languages run the s-step
+    schedule since v8; a Pallas winner sweeps the Pallas chain); needs
+    a cubic local block for the single-device comm anchor, like the
+    overlap A/B."""
     import dataclasses
 
     from grayscott_jl_tpu.parallel import icimodel
     from grayscott_jl_tpu.simulation import Simulation
     from grayscott_jl_tpu.utils.benchmark import time_sim
 
-    if sim.kernel_language == "pallas":
-        print("# halo-depth A/B skipped: the Pallas chains have no "
-              "s-step schedule (docs/TEMPORAL.md)", file=sys.stderr)
-        return
     dims = sim.domain.dims
     locals_ = [L // d for d in dims]
     if len(set(locals_)) != 1 or any(L % d for d in dims):
@@ -179,7 +176,8 @@ def halo_depth_ab_rows(out: str, backend: str, settings, sim, L: int,
               "cubic local block for the single-device anchor",
               file=sys.stderr)
         return
-    base = dataclasses.replace(settings, kernel_language="Plain")
+    lang = ("Pallas" if sim.kernel_language == "pallas" else "Plain")
+    base = dataclasses.replace(settings, kernel_language=lang)
     os.environ.pop("GS_HALO_DEPTH", None)
     fuse = max(1, min(sim._fuse_base(), min(sim.domain.local_shape)))
     ks = sorted({k for k in ks
@@ -204,7 +202,8 @@ def halo_depth_ab_rows(out: str, backend: str, settings, sim, L: int,
             "mesh": list(dims),
             "L_global": L,
             "local_block": locals_,
-            "kernel": "Plain",
+            "kernel": lang,
+            "lang": sims[k].kernel_language,
             "fuse_base": fuse,
             "halo_depth": k,
             "engaged": sims[k].halo_depth == k,

@@ -1,20 +1,26 @@
 #!/usr/bin/env python3
 """Fold a measured s-step halo-depth A/B artifact into the ICI model.
 
-Reads a ``halo_bench.py --ab --halo-depths`` JSONL artifact (one row
-per depth with ``measured_comm_reduction`` — the net exchange-cost
-reduction of halo_depth=k vs k=1 at identical local volume — and
+Reads a ``halo_bench.py --ab --halo-depths [--lang ...]`` JSONL
+artifact (one row per (language, depth) with
+``measured_comm_reduction`` — the net exchange-cost reduction of
+halo_depth=k vs k=1 at identical local volume — and
 ``model_ideal_reduction`` — the ideal 1/k latency amortization),
-computes the realized efficiency ``measured / ideal`` per k>1 row, and
-— with ``--apply`` — rewrites the ``HALO_DEPTH_EFFICIENCY`` literal in
-``grayscott_jl_tpu/parallel/icimodel.py`` with the median (the same
-measurement-replaces-default loop as ``update_overlap.py`` /
+computes the realized efficiency ``measured / ideal`` per k>1 row
+GROUPED BY LANGUAGE, and — with ``--apply`` — rewrites the per-language
+``HALO_DEPTH_EFFICIENCY`` dict entries in
+``grayscott_jl_tpu/parallel/icimodel.py`` with each group's median (the
+same measurement-replaces-default loop as ``update_overlap.py`` /
 ``update_fuse_ratio.py``; median because the tunnel chip's clock state
-spreads identical configs, BASELINE.md "artifact hygiene").
+spreads identical configs, BASELINE.md "artifact hygiene"). A language
+with no measured rows keeps its current literal — an XLA-only artifact
+never clobbers the Pallas calibration, and vice versa.
 
 Rows where the s-step schedule never engaged (``engaged: false`` — a
-Pallas-language sweep gates halo_depth to 1) or where the k=1 run
-exposed no measurable comm carry no signal and are skipped.
+geometry-infeasible k degraded at construction) or where the k=1 run
+exposed no measurable comm carry no signal and are skipped. Rows
+predating the ``lang`` tag calibrate the ``xla`` entry (the only
+language that ran s-step schedules before v8).
 
     python benchmarks/update_halo_depth.py \
         benchmarks/results/halo_depth_ab_*.jsonl
@@ -34,17 +40,28 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import artifacts  # noqa: E402 — shared JSONL record helpers
 
+#: The calibratable languages — the keys of the model's
+#: HALO_DEPTH_EFFICIENCY dict. A row tagged outside this set is a
+#: producer bug and refuses loudly rather than silently dropping.
+LANGS = ("xla", "pallas")
+
 
 def load_efficiency(path: str) -> dict:
-    """Per-row realized s-step efficiencies from an --ab --halo-depths
-    artifact, plus their median. Raises SystemExit when no row carries
-    signal."""
+    """Per-language realized s-step efficiencies from an
+    ``--ab --halo-depths`` artifact, plus each group's median. Raises
+    SystemExit when no row carries signal."""
     rows = artifacts.read_rows(path)
-    effs = []
+    effs = {}
     skipped = 0
     for r in rows:
         if r.get("ab") != "halo_depth":
             continue
+        lang = str(r.get("lang", "xla")).lower()
+        if lang not in LANGS:
+            raise SystemExit(
+                f"row in {path} carries unknown lang {lang!r} "
+                f"(expected one of {list(LANGS)})"
+            )
         k = int(r.get("halo_depth", 1))
         ideal = r.get("model_ideal_reduction")
         if k <= 1 or not r.get("engaged", True) or not ideal:
@@ -54,32 +71,39 @@ def load_efficiency(path: str) -> dict:
         if measured is None:
             skipped += 1
             continue
-        effs.append(max(0.0, min(1.0, float(measured) / float(ideal))))
+        effs.setdefault(lang, []).append(
+            max(0.0, min(1.0, float(measured) / float(ideal)))
+        )
     if not effs:
         raise SystemExit(
             f"no usable halo_depth A/B rows in {path} "
             f"({skipped} rows without signal)"
         )
     return {
-        "efficiencies": [round(e, 4) for e in effs],
-        "median": round(statistics.median(effs), 4),
+        "efficiencies": {lang: [round(e, 4) for e in v]
+                         for lang, v in sorted(effs.items())},
+        "median": {lang: round(statistics.median(v), 4)
+                   for lang, v in sorted(effs.items())},
         "skipped": skipped,
     }
 
 
-def apply_to_model(efficiency: float, model_path: str) -> None:
-    """Rewrite the ``HALO_DEPTH_EFFICIENCY`` literal in place (the
-    model keeps its docstring; only the number changes)."""
+def apply_to_model(medians: dict, model_path: str) -> None:
+    """Rewrite the measured languages' ``HALO_DEPTH_EFFICIENCY`` dict
+    entries in place (the model keeps its docstring and the other
+    language's literal; only the measured numbers change)."""
     src = open(model_path, encoding="utf-8").read()
-    m = re.search(r"HALO_DEPTH_EFFICIENCY = [0-9.]+", src)
-    if m is None:
-        raise SystemExit(
-            f"HALO_DEPTH_EFFICIENCY literal not found in {model_path}"
-        )
-    new_src = (src[:m.start()]
-               + f"HALO_DEPTH_EFFICIENCY = {round(efficiency, 4)}"
-               + src[m.end():])
-    open(model_path, "w", encoding="utf-8").write(new_src)
+    for lang, eff in medians.items():
+        pat = rf'("{lang}": )[0-9.]+'
+        new_src, n = re.subn(pat, rf"\g<1>{round(eff, 4)}", src,
+                             count=1)
+        if n != 1:
+            raise SystemExit(
+                f"HALO_DEPTH_EFFICIENCY entry for {lang!r} not found "
+                f"in {model_path}"
+            )
+        src = new_src
+    open(model_path, "w", encoding="utf-8").write(src)
 
 
 def main() -> int:
@@ -88,7 +112,8 @@ def main() -> int:
                     help="halo_bench --ab --halo-depths JSONL with "
                     "halo_depth rows")
     ap.add_argument("--apply", action="store_true",
-                    help="rewrite HALO_DEPTH_EFFICIENCY in "
+                    help="rewrite the measured languages' "
+                    "HALO_DEPTH_EFFICIENCY entries in "
                     "grayscott_jl_tpu/parallel/icimodel.py")
     args = ap.parse_args()
 

@@ -20,6 +20,8 @@ through a lookup table instead of ``eval(Meta.parse(...))``
 from __future__ import annotations
 
 import dataclasses
+import os
+import tempfile
 from typing import Any, Dict, List, Optional, Tuple
 
 try:  # Python 3.11+
@@ -47,7 +49,15 @@ class Settings:
     Du: float = 0.05
     Dv: float = 0.1
     noise: float = 0.0
-    output: str = "foo.bp"
+    #: Deliberate divergence from the reference default (``foo.bp``,
+    #: ``Structs.jl:12``): an unconfigured run writes under the system
+    #: temp dir instead of littering the launch directory — every real
+    #: config sets ``output`` explicitly, so only scratch runs see this.
+    output: str = dataclasses.field(
+        default_factory=lambda: os.path.join(
+            tempfile.gettempdir(), "gs_output.bp"
+        )
+    )
     checkpoint: bool = False
     checkpoint_freq: int = 2000
     checkpoint_output: str = "ckpt.bp"

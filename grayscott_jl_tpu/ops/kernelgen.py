@@ -41,6 +41,18 @@ program is operation-for-operation the hand-written kernel it replaced,
 and the trajectory is bitwise-identical (asserted against
 ``tests/golden/pallas_hand_kernel.npz``, captured from the last
 hand-written build).
+
+s-step exchange rounds (docs/TEMPORAL.md): the generated kernel's
+in-kernel chain at depth k IS an s-step round — one (d x k)-deep
+corner-propagated frame in, d*k Euler steps over progressively
+shrinking VMEM-resident valid regions, full width restored at the next
+exchange. ``halo_depth=k`` at fuse=d therefore lowers to the SAME
+traced program as ``halo_depth=1`` at fuse=k*d (simulation.py chain
+dispatch), which is what makes the program-identity contract bitwise
+for every generated model; feasibility of the deepened working set is
+the VMEM slab ledger (``pallas_stencil.max_feasible_chain_depth``).
+GENERATOR_VERSION is unchanged by that schedule: the generated program
+family is the same, only the dispatch-selected depth moved.
 """
 
 from __future__ import annotations

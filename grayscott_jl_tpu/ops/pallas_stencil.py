@@ -350,6 +350,37 @@ def max_feasible_fuse_ypad(nx: int, ny: int, nz: int, itemsize: int,
     return 0
 
 
+def max_feasible_chain_depth(local, dims, itemsize: int, depth: int,
+                             sublane: int = 8, mid_itemsize: int = None,
+                             n_fields: int = 2) -> int:
+    """Deepest in-kernel chain depth <= ``depth`` the SHARDED chain
+    dispatch for mesh ``dims`` can serve on local block ``local`` —
+    the runner's own geometry caps (x-chain: depth <= nx; xy-chain:
+    depth <= nx, ny, and nz // 2 when z is sharded) composed with the
+    VMEM slab ledger (:func:`max_feasible_fuse` /
+    :func:`max_feasible_fuse_ypad`). The ONE statement of chain-depth
+    feasibility shared by the s-step ``halo_depth`` gate
+    (``simulation.py``) and the autotune shortlist
+    (``tune/candidates.py``), so neither ever promises a depth the
+    kernel would decline; 0 when not even depth 1 fits."""
+    nx, ny, nz = local
+    if dims[1] == 1 and dims[2] == 1:
+        cap = min(depth, nx)
+        if cap < 1:
+            return 0
+        return max_feasible_fuse(nx, ny, nz, itemsize, cap,
+                                 mid_itemsize=mid_itemsize,
+                                 n_fields=n_fields)
+    cap = min(depth, nx, ny)
+    if dims[2] > 1:
+        cap = min(cap, nz // 2)
+    if cap < 1:
+        return 0
+    return max_feasible_fuse_ypad(nx, ny, nz, itemsize, cap, sublane,
+                                  mid_itemsize=mid_itemsize,
+                                  n_fields=n_fields)
+
+
 def _kernel_pm1(bits, dtype):
     """uint32 bits -> uniform [-1, 1), Mosaic form of
     ``noise.bits_to_pm1`` (``pltpu.bitcast`` instead of lax bitcast)."""

@@ -69,15 +69,23 @@ STAGE_RATIO = {"Pallas": FUSE_COST_RATIO[1], "XLA": 1.0}
 OVERLAP_EFFICIENCY = 0.85
 
 #: Fraction of the *ideal* 1/k s-step latency amortization
-#: (``halo_depth``, docs/TEMPORAL.md) the schedule actually realizes:
-#: exchanging a (d x k)-deep frame once per k chain rounds removes
-#: (1 - 1/k) of the per-round hop latency in the ideal model, but the
-#: wider frame costs serialization, cache pressure, and ring-recompute
-#: growth the latency term does not see. The default is the analytic
-#: guess until ``benchmarks/update_halo_depth.py --apply`` rewrites
-#: this literal from a measured ``halo_bench.py --ab --halo-depths``
-#: artifact (the same calibration loop as OVERLAP_EFFICIENCY).
-HALO_DEPTH_EFFICIENCY = 0.9
+#: (``halo_depth``, docs/TEMPORAL.md) the schedule actually realizes,
+#: PER KERNEL LANGUAGE: exchanging a (d x k)-deep frame once per k
+#: chain rounds removes (1 - 1/k) of the per-round hop latency in the
+#: ideal model, but the wider frame costs serialization, cache
+#: pressure, and ring-recompute growth the latency term does not see —
+#: and the two languages pay it differently (the XLA chain re-windows
+#: in HBM; the Pallas chains deepen the in-kernel VMEM-resident walk,
+#: whose >6-deep cost has no measured FUSE_COST_RATIO entry, so this
+#: literal absorbs it). The defaults are the analytic guesses until
+#: ``benchmarks/update_halo_depth.py --apply`` rewrites each entry
+#: from a measured language-tagged ``halo_bench.py --ab --halo-depths``
+#: artifact (the same calibration loop as OVERLAP_EFFICIENCY). The
+#: "xla" value is the PR 9 literal, unchanged.
+HALO_DEPTH_EFFICIENCY = {
+    "xla": 0.9,
+    "pallas": 0.9,
+}
 
 
 #: Single-chip compute-cost ratio of the ``bf16_f32acc`` posture
@@ -103,15 +111,18 @@ def precision_compute_ratio(compute_precision: str) -> float:
             if compute_precision == "bf16_f32acc" else 1.0)
 
 
-def sstep_amortization(halo_depth: int, efficiency: float = None) -> float:
+def sstep_amortization(halo_depth: int, efficiency: float = None,
+                       lang: str = "xla") -> float:
     """Fraction of the per-chain-round exchange hop latency that
     REMAINS under s-step exchange at depth ``halo_depth`` — 1.0 at
     k=1 (every round exchanges), approaching ``1 - efficiency`` as k
-    grows (the calibrated share of the ideal 1/k win)."""
+    grows (the calibrated share of the ideal 1/k win). ``lang``
+    selects the per-language calibrated efficiency
+    (:data:`HALO_DEPTH_EFFICIENCY`) when ``efficiency`` is None."""
     k = max(1, int(halo_depth))
     if k == 1:
         return 1.0
-    eff = HALO_DEPTH_EFFICIENCY if efficiency is None else efficiency
+    eff = HALO_DEPTH_EFFICIENCY[lang] if efficiency is None else efficiency
     return 1.0 - eff * (1.0 - 1.0 / k)
 
 
@@ -280,10 +291,21 @@ def project_chain(
     hop_us: float = 1.0,
     overlap: float = 0.0,
     xla_us_per_cell: float = None,
+    halo_depth: int = 1,
     n_fields: int = 2,
 ) -> dict:
     """Weak-scaling projection for the round-4 cross-shard fused chain
     (``parallel/temporal.xy_chain``) on an (n, m, p) mesh.
+
+    ``halo_depth`` (s-step exchange, docs/TEMPORAL.md) multiplies the
+    in-kernel steps per exchange round: the frame deepens to
+    ``fuse * halo_depth`` (pricing the wider y planes, x ring, and z
+    bands exactly) while the per-stage cost stays keyed on the BASE
+    fuse's measured ratio and the hop-latency amortization beyond one
+    chain round is discounted by the calibrated Pallas
+    :data:`HALO_DEPTH_EFFICIENCY` — the same scheme as
+    :func:`project_1d`, because the generated kernel realizes
+    halo_depth=k at fuse=d as the fuse=k*d chain program.
 
     Every sharded stage runs IN-KERNEL at the fused schedule (the 1.46x
     single-step penalty of the retired round-3 design is gone); the
@@ -324,32 +346,36 @@ def project_chain(
     if r is None:
         raise ValueError(f"no measured fuse-cost ratio for k={fuse}")
     k = fuse
-    ny_ext = ny + 2 * k
+    sk = max(1, int(halo_depth))
+    s_steps = k * sk  # in-kernel steps per exchange round
+    ny_ext = ny + 2 * s_steps
     ny_ext += (-ny_ext) % sublane
     y_over = ny_ext / ny if (m > 1 or p > 1) else 1.0
-    x_ring = 1.0 + (k - 1) / nx
+    x_ring = 1.0 + (s_steps - 1) / nx
     compute_us = us_base * r * y_over * x_ring
 
     if p > 1:
         if xla_us_per_cell is None:
             xla_us_per_cell = MEASURED_US[("XLA", 256)] / 256**3
-        band_us = band_cells_per_round(local, k) * xla_us_per_cell / k
+        band_us = (band_cells_per_round(local, s_steps) * xla_us_per_cell
+                   / s_steps)
         # Frame faces span the padded extents (corner propagation).
-        zx, zy = nz + 2 * k, ny + 2 * k
+        zx, zy = nz + 2 * s_steps, ny + 2 * s_steps
         face_bytes = max(
-            zy * zx, (nx + 2 * k) * zx, (nx + 2 * k) * zy
+            zy * zx, (nx + 2 * s_steps) * zx, (nx + 2 * s_steps) * zy
         ) * itemsize * n_fields
         n_faces = 6
     else:
         band_us = 0.0
         face_bytes = max(ny_ext * nz, nx * nz) * itemsize * n_fields
         n_faces = (2 if n > 1 else 0) + (2 if m > 1 else 0)
-    # k-wide slabs every k steps -> per-step bytes are k-independent;
-    # completion at the MAX-loaded link: with fewer links than faces
-    # (v5e/v6e 2D torus) some links carry ceil(n_faces/links) faces.
+    # Depth-wide slabs every s_steps steps -> per-step bytes are
+    # depth-independent; completion at the MAX-loaded link: with fewer
+    # links than faces (v5e/v6e 2D torus) some links carry
+    # ceil(n_faces/links) faces.
     faces_per_link = -(-n_faces // links) if n_faces else 0
     ser_us = faces_per_link * face_bytes / (link_gbps * 1e3)
-    lat_us = n_faces * hop_us / k
+    lat_us = n_faces * hop_us / k * sstep_amortization(sk, lang="pallas")
     raw_us = ser_us + lat_us
     # Only the kernel pass is comm-independent dataflow in the split-
     # phase round; the band recomputes consume the exchange, so they
@@ -362,14 +388,16 @@ def project_chain(
         "mesh": f"{n},{m},{p}",
         "local": list(local),
         "fuse": k,
-        # The Pallas chains amortize via in-kernel depth only; s-step
-        # halo_depth is an XLA-chain schedule (gated in simulation.py).
-        "halo_depth": 1,
+        # s-step exchange depth: the generated kernel realizes it as a
+        # (fuse x halo_depth)-deep in-kernel chain per exchange round
+        # (simulation.py Pallas chain paths, docs/TEMPORAL.md).
+        "halo_depth": sk,
         "fuse_cost_ratio": r,
         "fuse_cost_ratio_interpolated": k in (2, 3),
         "compute_us_per_step": round(us_base, 1),
-        "halo_bytes_per_step": round(n_faces * face_bytes / k),
-        "exchanges_per_step": round(1.0 / k, 4) if n_faces else 0.0,
+        "halo_bytes_per_step": round(n_faces * face_bytes / s_steps),
+        "exchanges_per_step": (round(1.0 / s_steps, 4)
+                               if n_faces else 0.0),
         "y_plane_overhead": round(y_over, 4),
         "x_ring_recompute": round(x_ring, 4),
         "z_band_us_per_step": round(band_us, 2),
@@ -512,7 +540,7 @@ def project_1d(
     faces_per_link = -(-2 // links)
     ser_us = (faces_per_link * ny * nz * itemsize * n_fields
               / (link_gbps * 1e3))
-    lat_us = 2 * hop_us / fuse * sstep_amortization(sk)
+    lat_us = 2 * hop_us / fuse * sstep_amortization(sk, lang="pallas")
     raw_us = ser_us + lat_us
     ov = _resolve_overlap(overlap, us_base * r * recompute, raw_us)
     comm_us = raw_us * (1.0 - ov)
@@ -774,11 +802,12 @@ def projected_step_us(
     language, :func:`project_1d`/:func:`project_chain` for the Pallas
     chains, the single-chip anchors for one device) and converts
     efficiency back to absolute time against the language's own base.
-    ``halo_depth`` prices the s-step exchange for XLA candidates
-    (``None`` for a Pallas candidate requesting k > 1 — no such
-    schedule exists). ``None`` when the model has nothing to say (no
-    measured fuse ratio, no chain at this depth) — unscored candidates
-    rank last, they are not excluded."""
+    ``halo_depth`` prices the s-step exchange for BOTH languages —
+    :func:`project` for XLA, :func:`project_1d`/:func:`project_chain`
+    for the Pallas chains (whose generated kernel realizes k at fuse=d
+    as the fuse=k*d chain program). ``None`` when the model has
+    nothing to say (no measured fuse ratio, no chain at this depth) —
+    unscored candidates rank last, they are not excluded."""
     n, m, p = dims
     ndev = n * m * p
     ratio = precision_compute_ratio(compute_precision)
@@ -794,17 +823,16 @@ def projected_step_us(
                       overlap=overlap, halo_depth=halo_depth,
                       n_fields=n_fields)
         return base / row["projected_weak_scaling_eff"]
-    if max(1, int(halo_depth)) > 1:
-        return None  # the Pallas chains have no s-step schedule
     base_full = anchor_us("Pallas", L) * ratio
     r = FUSE_COST_RATIO.get(fuse)
     if ndev == 1:
+        # halo_depth is a no-op unsharded (no exchange to amortize).
         return None if r is None else base_full * r
     if fuse < 2 or r is None:
         return None
     kw = dict(local=local, itemsize=itemsize, links=links,
               link_gbps=link_gbps, hop_us=hop_us, overlap=overlap,
-              n_fields=n_fields)
+              halo_depth=halo_depth, n_fields=n_fields)
     try:
         if m == 1 and p == 1:
             row = project_1d(n, L, fuse, base_full, **kw)
@@ -867,13 +895,14 @@ def comm_report(sim) -> dict:
             f for f in FUSE_COST_RATIO if f <= k
         )
         base_full = anchor_us("Pallas", L)
+        sk = max(1, int(getattr(sim, "halo_depth", 1)))
         try:
             if dims[1] == 1 and dims[2] == 1:
                 row = project_1d(dims[0], L, k, base_full, local=local,
-                                 **kw)
+                                 halo_depth=sk, **kw)
             else:
                 row = project_chain(dims, L, k, base_full, local=local,
-                                    **kw)
+                                    halo_depth=sk, **kw)
         except ValueError:
             row = None
     if row is None:

@@ -211,7 +211,11 @@ def xy_chain(
     Model-generic: ``fields`` is the model's field tuple in declaration
     order, and every faces tuple is field-major (lo, hi) pairs — the
     generated kernel's x-chain operand order
-    (``ops/pallas_stencil.fused_step``).
+    (``ops/pallas_stencil.fused_step``). The s-step exchange schedule
+    (``halo_depth=k``, docs/TEMPORAL.md) reuses this round unchanged at
+    ``depth = fuse*k`` — one k-times-deeper ``halo_pad_wide`` frame per
+    round, the same 6 (z-sharded) or 4 collectives, amortized over k
+    times the steps.
 
     ``chain_kernel(fields_p, faces, step, offs_p)`` runs the fused
     kernel (or its bitwise XLA fallback) at ``fuse=depth`` on the

@@ -590,16 +590,19 @@ class Simulation:
         #: Communication-avoiding s-step exchange depth (GS_HALO_DEPTH
         #: / halo_depth key; docs/TEMPORAL.md): each exchange round
         #: transfers a (chain_depth x halo_depth)-deep ghost frame once
-        #: and the XLA chain advances that many steps on progressively
-        #: shrinking valid regions. 1 = today's one-exchange-per-round
+        #: and the chain advances that many steps on progressively
+        #: shrinking valid regions — the XLA window chain in HBM, the
+        #: generated Pallas chains as a deepened VMEM-resident
+        #: in-kernel walk. 1 = today's one-exchange-per-round
         #: schedule (byte-identical); resolved "auto" stays 1 unless
         #: the measured autotuner adopts a deeper k below.
         self._halo_depth_pinned, self.halo_depth = (
             config.resolve_halo_depth(settings)
         )
-        #: Set when a requested halo_depth > 1 was degraded to 1
-        #: because the resolved kernel language has no s-step schedule
-        #: (the Pallas in-kernel chains) — provenance for stats/tests.
+        #: Set when a requested halo_depth > 1 was degraded because
+        #: the Pallas chain's deepened working set is geometry- or
+        #: VMEM-infeasible for this local block (the slab ledger's
+        #: numbers ride along) — provenance for stats/tests.
         self.halo_depth_gate = None
         self._auto_fuse = None
         if self.kernel_language == "auto":
@@ -817,37 +820,83 @@ class Simulation:
                 self.kernel_selection["generator_version"] = (
                     kernelgen.GENERATOR_VERSION
                 )
-        if self.kernel_language == "pallas" and self.halo_depth > 1:
-            # The Pallas in-kernel chains have no s-step schedule (the
-            # fused chain IS their exchange amortization, and its depth
-            # is VMEM-bound) — degrade to k=1 LOUDLY and record it, so
-            # a config written for the XLA path never silently changes
-            # meaning here (docs/TEMPORAL.md "Interactions").
-            self.halo_depth_gate = {
-                "requested": self.halo_depth,
-                "applied": 1,
-                "reason": (
-                    "the Pallas in-kernel chain amortizes its exchange "
-                    "via fuse depth; s-step halo_depth applies to the "
-                    "XLA chain paths only"
-                ),
-            }
-            if isinstance(self.kernel_selection, dict):
-                self.kernel_selection["halo_depth_gate"] = (
-                    self.halo_depth_gate
-                )
-            if _is_primary():
-                import sys as _sys
+        if (self.kernel_language == "pallas" and self.halo_depth > 1
+                and self.sharded):
+            # The generated Pallas chains run a REAL s-step schedule
+            # (docs/TEMPORAL.md): one (fuse x halo_depth)-deep exchange
+            # round feeds fuse*halo_depth in-kernel Euler steps over
+            # progressively shrinking VMEM-resident valid regions — no
+            # HBM round-trip between the inner steps. Feasibility is
+            # the chain dispatch geometry composed with the VMEM slab
+            # ledger (``pallas_stencil.max_feasible_chain_depth``);
+            # infeasible k degrades to the deepest feasible k' LOUDLY,
+            # with the ledger numbers in the provenance, so a config
+            # written against the old blanket degrade fails
+            # loud-and-explained instead of silently changing schedule.
+            from .ops import pallas_stencil as _ps
 
-                print(
-                    f"gray-scott: warning: halo_depth="
-                    f"{self.halo_depth} ignored for the Pallas kernel "
-                    "language (s-step exchange is an XLA-chain "
-                    "schedule); running with halo_depth=1",
-                    file=_sys.stderr,
+            local = tuple(int(x) for x in self.domain.local_shape)
+            dims = self.domain.dims
+            itemsize = int(jnp.dtype(self.dtype).itemsize)
+            sublane = 16 if self.dtype == jnp.bfloat16 else 8
+            mid = _ps.mid_itemsize_for(self.dtype)
+            nf = self.model.n_fields
+            path = ("x-chain" if dims[1] == 1 and dims[2] == 1
+                    else "xy-chain")
+
+            def _cap(depth):
+                return _ps.max_feasible_chain_depth(
+                    local, dims, itemsize, depth, sublane,
+                    mid_itemsize=mid, n_fields=nf,
                 )
-            self.halo_depth = 1
-        if self.sharded and self.halo_depth > 1:
+
+            d = max(1, _cap(self._fuse_base()))
+            applied = next(
+                (k for k in range(self.halo_depth, 0, -1)
+                 if _cap(d * k) == d * k), 1,
+            )
+            if applied < self.halo_depth:
+                self.halo_depth_gate = {
+                    "requested": self.halo_depth,
+                    "applied": applied,
+                    "kind": "geometry-infeasible",
+                    "reason": (
+                        f"halo_depth={self.halo_depth} needs a "
+                        f"{d * self.halo_depth}-deep in-kernel chain "
+                        f"(fuse base {d} x halo_depth) on the Pallas "
+                        f"{path}, but local block {local} "
+                        f"({itemsize}-byte fields x {nf}) serves at "
+                        f"most depth {d * applied} under the chain "
+                        "geometry caps and the "
+                        f"{_ps._vmem_budget()}-byte VMEM slab budget; "
+                        f"running halo_depth={applied}"
+                    ),
+                    "geometry": {
+                        "path": path,
+                        "local_shape": list(local),
+                        "fuse_base": int(d),
+                        "requested_depth": int(d * self.halo_depth),
+                        "feasible_depth": int(d * applied),
+                        "vmem_budget_bytes": int(_ps._vmem_budget()),
+                        "itemsize": itemsize,
+                        "n_fields": int(nf),
+                    },
+                }
+                if isinstance(self.kernel_selection, dict):
+                    self.kernel_selection["halo_depth_gate"] = (
+                        self.halo_depth_gate
+                    )
+                if _is_primary():
+                    import sys as _sys
+
+                    print(
+                        "gray-scott: warning: "
+                        + self.halo_depth_gate["reason"],
+                        file=_sys.stderr,
+                    )
+                self.halo_depth = applied
+        if (self.sharded and self.halo_depth > 1
+                and self.kernel_language != "pallas"):
             # The s-step frame is exchanged in ONE single-hop round:
             # every slab must consist of owned cells, so the effective
             # exchange depth (chain depth x k) cannot exceed the local
@@ -1166,6 +1215,22 @@ class Simulation:
                     self._fuse_base(), max(nsteps, 1),
                     self.domain.local_shape[0],
                 )
+                if self.halo_depth > 1:
+                    # Communication-avoiding s-step schedule
+                    # (docs/TEMPORAL.md): the exchange round carries a
+                    # (fuse x halo_depth)-deep slab pair and the
+                    # in-kernel chain walks all of it before the next
+                    # exchange — the EXACT program a halo_depth=1
+                    # chain at depth fuse*halo_depth lowers to, so
+                    # k at depth d is bitwise identical to k=1 at
+                    # depth k*d. Feasibility was gated at
+                    # construction; nsteps still bounds the final
+                    # round, and the VMEM cap below re-checks the
+                    # realized depth.
+                    fuse = min(
+                        fuse * self.halo_depth, max(nsteps, 1),
+                        self.domain.local_shape[0],
+                    )
                 # The exchange width must match a chain depth the
                 # Mosaic kernel can actually serve — an infeasible
                 # depth would silently run every step on the XLA
@@ -1289,6 +1354,16 @@ class Simulation:
                 # mesh) must degrade to the depth-1 12-face path, not
                 # divide by zero in run_chain_rounds.
                 fuse = max(1, min(self._fuse_base(), max(nsteps, 1), *cap))
+                if self.halo_depth > 1:
+                    # s-step exchange (docs/TEMPORAL.md): deepen the
+                    # in-kernel chain to fuse*halo_depth — one
+                    # (fuse x halo_depth)-deep ``halo_pad_wide`` frame
+                    # per round, same program as halo_depth=1 at the
+                    # product depth, so the round count (and the
+                    # collective count with it) drops by halo_depth.
+                    fuse = max(1, min(
+                        fuse * self.halo_depth, max(nsteps, 1), *cap,
+                    ))
                 sublane = 16 if self.dtype == jnp.bfloat16 else 8
                 feasible = pallas_stencil.max_feasible_fuse_ypad(
                     *block, jnp.dtype(self.dtype).itemsize, fuse, sublane,

@@ -195,6 +195,11 @@ def autotune(
             "pallas_allowed": bool(pallas_allowed),
             "kernel_generator": int(kernel_generator),
             "halo_depth_pin": int(halo_depth),
+            # The schema the decision was keyed/measured under (v8:
+            # halo_depth semantics per-language, docs/TUNING.md) — in
+            # the provenance so an artifact reader can tell which
+            # halo_depth era a winner belongs to without the cache.
+            "cache_schema": int(cache.SCHEMA_VERSION),
             "compute_precision": compute_precision,
             "snapshot_codec": snapshot_codec}
     if mode == "off":

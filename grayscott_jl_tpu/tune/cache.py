@@ -63,8 +63,16 @@ from ..config.env import env_str
 #: rounding), so winners measured against one generator's kernels must
 #: never be adopted by another's; stale v6 entries are structurally
 #: invisible and degrade to the warned analytic pick like any other
+#: miss. v8: ``halo_depth`` semantics became per-language — the
+#: generated Pallas chains now run a real s-step schedule (the
+#: fuse*k-deep VMEM-resident in-kernel chain, docs/TEMPORAL.md), so
+#: the shortlist enumerates Pallas k > 1 and a winner's ``halo_depth``
+#: now changes the Pallas program too; v7 winners were measured under
+#: the blanket Pallas k=1 gate and must never apply to runs where
+#: k > 1 is a live schedule — stale v7 entries are structurally
+#: invisible and degrade to the warned analytic pick like any other
 #: miss.
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 
 def cache_dir() -> str:

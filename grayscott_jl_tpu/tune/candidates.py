@@ -32,8 +32,10 @@ class Candidate:
     fuse: int  # chain / temporal-blocking depth (GS_FUSE)
     comm_overlap: bool  # split-phase exchange armed (GS_COMM_OVERLAP)
     #: s-step exchange depth (GS_HALO_DEPTH, docs/TEMPORAL.md): one
-    #: (fuse x halo_depth)-deep exchange per halo_depth chain rounds.
-    #: Always 1 for Pallas candidates (no s-step schedule there).
+    #: (fuse x halo_depth)-deep exchange per halo_depth chain rounds —
+    #: the XLA window chain and the generated Pallas chains both run
+    #: it (a Pallas candidate realizes k as the fuse*k in-kernel
+    #: chain, VMEM-ledger-gated).
     halo_depth: int = 1
     bx: Optional[int] = None  # Pallas slab depth (GS_BX); None = auto
     projected_step_us: Optional[float] = None  # model rank, None = unscored
@@ -157,13 +159,16 @@ def generate(
     path is a correctness tool ~1000x off, and timing it would burn the
     whole budget saying so.
 
-    ``halo_depth`` is the s-step-exchange pin: 0 (auto) widens XLA
-    candidates across k in {1, 2, 4} wherever the local block supports
-    the (fuse x k)-deep exchange; an explicit value is respected, not
-    searched (infeasible fuse/k combinations are pruned by the same
-    geometry rule ``simulation.py`` validates with a SettingsError).
-    Pallas candidates always carry k=1 — no s-step schedule exists
-    there (docs/TEMPORAL.md "Interactions").
+    ``halo_depth`` is the s-step-exchange pin: 0 (auto) widens BOTH
+    languages across k in {1, 2, 4} wherever the schedule is feasible;
+    an explicit value is respected, not searched. XLA combinations are
+    pruned by the same geometry rule ``simulation.py`` validates with
+    a SettingsError (fuse x k <= min local extent); Pallas
+    combinations by the same chain-dispatch geometry + VMEM slab
+    ledger the runner's gate applies
+    (``pallas_stencil.max_feasible_chain_depth`` — the generated
+    kernel realizes k as the fuse*k in-kernel chain, so the deepened
+    working set must fit VMEM).
 
     ``compute_precision`` is the run's posture (docs/PRECISION.md):
     ``bf16_f32acc`` arms the precision AXIS — every (kernel, depth,
@@ -236,14 +241,26 @@ def generate(
 
     analytic_sk = max(1, int(halo_depth)) if halo_depth else 1
 
-    def sstep_depths(kernel, fuse):
-        """s-step depths to enumerate for one (kernel, fuse): Pallas
-        and single-device runs have no s-step schedule; XLA candidates
-        search {1, 2, 4} (or honor the pin) within the same geometry
-        bound the runner validates (fuse x k <= min local extent)."""
-        if kernel != "xla" or not sharded:
+    def sstep_depths(kernel, fuse, cp="f32"):
+        """s-step depths to enumerate for one (kernel, fuse):
+        single-device runs have no s-step schedule; sharded candidates
+        in BOTH languages search {1, 2, 4} (or honor the pin) within
+        the same feasibility rule the runner validates — XLA's
+        geometry bound (fuse x k <= min local extent), or the Pallas
+        chain-dispatch caps + VMEM slab ledger on the fuse*k-deep
+        working set (``max_feasible_chain_depth``)."""
+        if not sharded:
             return [1]
         ks = [halo_depth] if halo_depth else [1, 2, 4]
+        if kernel != "xla":
+            from ..ops import pallas_stencil as ps
+
+            isz = _isz(cp)
+            sublane = 16 if isz == 2 else 8
+            return [k for k in ks if ps.max_feasible_chain_depth(
+                local, dims, isz, fuse * k, sublane,
+                n_fields=n_fields,
+            ) == fuse * k] or [1]
         return [k for k in ks if fuse * k <= min(local)] or [1]
 
     ens_tag = member_shards if ensemble > 1 else None
@@ -252,7 +269,7 @@ def generate(
         for kernel, depths in _langs(cp).items():
             for fuse in depths:
                 for ov in overlaps if sharded else [False]:
-                    for sk in sstep_depths(kernel, fuse):
+                    for sk in sstep_depths(kernel, fuse, cp):
                         out.append(Candidate(
                             kernel=kernel, fuse=fuse, comm_overlap=ov,
                             halo_depth=sk,
@@ -313,11 +330,11 @@ def generate(
         out.append(Candidate(
             kernel=analytic_kernel, fuse=analytic_fuse,
             comm_overlap=comm_overlap if sharded else False,
-            halo_depth=analytic_sk if analytic_kernel == "xla" else 1,
+            halo_depth=analytic_sk if sharded else 1,
             projected_step_us=score(
                 analytic_kernel, analytic_fuse,
                 comm_overlap if sharded else False,
-                analytic_sk if analytic_kernel == "xla" else 1,
+                analytic_sk if sharded else 1,
                 analytic_cp),
             analytic=True,
             member_shards=ens_tag,

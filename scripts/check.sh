@@ -43,6 +43,10 @@ if [[ "${1:-}" != "--fast" ]]; then
     # generated-kernel equality contracts (GS bitwise vs the hand
     # kernel's golden, every model vs its XLA trajectory at the
     # documented tolerance; docs/KERNELGEN.md) hold on every push.
+    # test_halo_depth.py rides in tests/unit as well: the Pallas
+    # s-step program-identity contract (halo_depth=k bitwise vs
+    # GS_FUSE=k*d, all models, interpret mode) and the VMEM
+    # feasibility gate (docs/TEMPORAL.md) hold on every push.
     JAX_PLATFORMS=cpu python -m pytest tests/unit \
         tests/functional/test_integrity_run.py \
         tests/functional/test_precision_run.py -q -m 'not slow' \

@@ -149,6 +149,65 @@ def _check_event(path, i, e, problems) -> None:
                     )
 
 
+def _check_halo_depth_gate(stats_path, gate, problems) -> None:
+    """Validate a ``halo_depth_gate`` provenance record
+    (docs/TEMPORAL.md): a degraded s-step request must say what was
+    asked, what ran, and WHY.  Two generations exist: the legacy
+    blanket-degrade record (requested/applied/reason only) and the
+    geometry-infeasible record (``kind`` + the VMEM ledger numbers in
+    ``geometry``) — a ``kind`` outside that registry, or a ledger
+    record missing its numbers, is a producer bug."""
+    if gate is None:
+        return
+    if not isinstance(gate, dict):
+        problems.append(
+            f"stats {stats_path}: halo_depth_gate must be a dict, "
+            f"got {type(gate).__name__}"
+        )
+        return
+    for k in ("requested", "applied"):
+        if not isinstance(gate.get(k), int):
+            problems.append(
+                f"stats {stats_path}: halo_depth_gate missing "
+                f"integer {k!r}"
+            )
+    reason = gate.get("reason")
+    if not (isinstance(reason, str) and reason.strip()):
+        problems.append(
+            f"stats {stats_path}: halo_depth_gate must carry a "
+            f"nonempty reason string"
+        )
+    if "kind" not in gate:
+        return  # legacy blanket-degrade record (pre-v8): accepted
+    if gate["kind"] != "geometry-infeasible":
+        problems.append(
+            f"stats {stats_path}: halo_depth_gate kind must be "
+            f"'geometry-infeasible', got {gate['kind']!r}"
+        )
+        return
+    geo = gate.get("geometry")
+    if not isinstance(geo, dict):
+        problems.append(
+            f"stats {stats_path}: geometry-infeasible "
+            f"halo_depth_gate must carry a geometry ledger dict"
+        )
+        return
+    for k in ("fuse_base", "requested_depth", "feasible_depth",
+              "vmem_budget_bytes", "itemsize", "n_fields"):
+        if not isinstance(geo.get(k), int):
+            problems.append(
+                f"stats {stats_path}: halo_depth_gate geometry "
+                f"missing integer {k!r}"
+            )
+    shape = geo.get("local_shape")
+    if not (isinstance(shape, list) and len(shape) == 3
+            and all(isinstance(v, int) for v in shape)):
+        problems.append(
+            f"stats {stats_path}: halo_depth_gate geometry "
+            f"local_shape must be a 3-int list, got {shape!r}"
+        )
+
+
 def check(trace_path, events_path, stats_path,
           metrics_path=None) -> int:
     """Schema validation (the chaos_smoke / CI entry): returns the
@@ -228,6 +287,24 @@ def check(trace_path, events_path, stats_path,
                             f"a Pallas run must record an integer "
                             f"generator_version"
                         )
+                if isinstance(sel, dict):
+                    at = sel.get("autotune")
+                    if isinstance(at, dict) and "cache_schema" in at:
+                        # v8 tuning provenance (docs/TUNING.md): the
+                        # schema the decision was keyed under rides in
+                        # the artifact; pre-v8 records carry no field
+                        # and predate this check.
+                        if not isinstance(at["cache_schema"], int):
+                            problems.append(
+                                f"stats {stats_path}: autotune "
+                                f"provenance cache_schema must be an "
+                                f"integer, got "
+                                f"{at['cache_schema']!r}"
+                            )
+                    _check_halo_depth_gate(
+                        stats_path, sel.get("halo_depth_gate"),
+                        problems,
+                    )
             rs = (cfg.get("reshard")
                   if isinstance(cfg, dict) else None)
             if isinstance(rs, dict) and rs.get("changed"):

@@ -10,7 +10,10 @@ The contract under test, layer by layer:
   feeding ``k*d`` shrinking-window steps, so it is bitwise identical
   to ``halo_depth=1`` at ``GS_FUSE=k*d`` (same program, same HLO) —
   for every registered model, on even and uneven L, for ensembles,
-  and composed with split-phase overlap.
+  and composed with split-phase overlap. The generated Pallas chains
+  honor the SAME contract (k at fuse=d lowers to the fuse=k*d
+  in-kernel chain — one exchange, k*d VMEM-resident steps), so the
+  bitwise statement holds per kernel language.
 * **k=1 is a no-op** — default-config trajectories and compiled
   collective counts are reproduced exactly.
 * **Same-base comparison** — k>1 vs k=1 at the SAME fuse base changes
@@ -18,12 +21,17 @@ The contract under test, layer by layer:
   the documented ``assert_chain_equal`` ulp bound here, bitwise on
   TPU (the same backend caveat as every chain-vs-stepwise pair in
   ``test_sharded``).
-* **Gates** — Pallas chains have no s-step schedule (warned degrade
-  to 1, recorded in provenance); a k the local block cannot serve is
-  a construction-time ``SettingsError``.
-* **Tuning** — k joins the candidate axes (searched when auto, pinned
-  when explicit, geometry-pruned), the v4 cache key, and the cost
-  model; stale pre-v4 records degrade to analytic with a warning.
+* **Gates** — the generated Pallas chains run a REAL s-step schedule
+  (the fuse*k-deep VMEM-resident in-kernel chain); an infeasible k is
+  a warned degrade to the deepest feasible k' with the VMEM-ledger
+  geometry in the ``halo_depth_gate`` provenance
+  (kind="geometry-infeasible"), while an XLA k the local block cannot
+  serve stays a construction-time ``SettingsError``.
+* **Tuning** — k joins the candidate axes for BOTH languages
+  (searched when auto, pinned when explicit, feasibility-pruned), the
+  cache key (schema v8: per-language halo_depth semantics), and the
+  cost model; stale pre-v8 records degrade to analytic with a
+  warning.
 * **Visibility** — ``comm_report`` carries exchanges-per-step and
   halo-bytes-per-step, and ``gs_report.py --check`` rejects a stats
   file whose comm section lost them.
@@ -274,18 +282,164 @@ def test_infeasible_k_is_a_loud_settings_error(monkeypatch):
 
 
 @requires8
-def test_pallas_gate_degrades_to_1_with_provenance(monkeypatch, capsys):
-    """The Pallas in-kernel chains have no s-step schedule (fuse depth
-    IS their exchange amortization): an explicit k>1 warns, runs at
-    k=1, and records the gate in kernel_selection provenance."""
+def test_pallas_feasible_k_is_lifted(monkeypatch):
+    """The blanket Pallas degrade is GONE: a VMEM-feasible k>1 on the
+    generated chain runs at the requested depth with no gate record —
+    the fuse*k-deep in-kernel chain IS the s-step schedule."""
     monkeypatch.setenv("GS_FUSE", "1")
     sim = Simulation(
         _settings(halo_depth=2, kernel_language="Pallas"), n_devices=8
     )
+    assert sim.halo_depth == 2
+    assert sim.halo_depth_gate is None
+
+
+@requires8
+def test_pallas_gate_fires_for_infeasible_k_with_ledger(monkeypatch,
+                                                       capsys):
+    """A genuinely infeasible k keeps firing the gate LOUDLY (the
+    satellite-6 contract): chain base 1 x k=4 needs a 4-deep in-kernel
+    chain, but the (8,1,1) x-chain local block is only 2 planes deep —
+    degrade to the deepest feasible k' with the geometry ledger in the
+    provenance, never a silent schedule change."""
+    monkeypatch.setenv("GS_TPU_MESH_DIMS", "8,1,1")
+    monkeypatch.setenv("GS_FUSE", "1")
+    sim = Simulation(
+        _settings(halo_depth=4, kernel_language="Pallas"), n_devices=8
+    )
+    monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    gate = sim.halo_depth_gate
+    assert sim.halo_depth == 2  # deepest feasible, not a blanket 1
+    assert gate["requested"] == 4 and gate["applied"] == 2
+    assert gate["kind"] == "geometry-infeasible"
+    geo = gate["geometry"]
+    assert geo["path"] == "x-chain"
+    assert geo["local_shape"] == [2, 16, 16]
+    assert geo["requested_depth"] == 4
+    assert geo["feasible_depth"] == 2
+    assert geo["vmem_budget_bytes"] > 0
+    if isinstance(sim.kernel_selection, dict):
+        assert sim.kernel_selection["halo_depth_gate"] == gate
+    assert "halo_depth=4" in capsys.readouterr().err
+
+
+@requires8
+def test_pallas_gate_vmem_ledger_prunes_k(monkeypatch):
+    """The slab ledger side of the feasibility rule: shrink the VMEM
+    budget until not even the base chain fits and the gate must prune
+    k back to 1, naming the budget it judged against."""
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    monkeypatch.setenv("GS_FUSE", "1")
+    monkeypatch.setattr(ps, "_VMEM_BUDGET", 1024)
+    sim = Simulation(
+        _settings(halo_depth=2, kernel_language="Pallas"), n_devices=8
+    )
     assert sim.halo_depth == 1
-    assert sim.halo_depth_gate["requested"] == 2
-    assert sim.halo_depth_gate["applied"] == 1
-    assert "halo_depth=2 ignored" in capsys.readouterr().err
+    gate = sim.halo_depth_gate
+    assert gate["kind"] == "geometry-infeasible"
+    assert gate["geometry"]["vmem_budget_bytes"] == 1024
+    assert str(1024) in gate["reason"]
+
+
+# ----------------------------------------------- Pallas program identity
+
+def _run_pallas(k, fuse, monkeypatch, **kw):
+    return _run(k, fuse, monkeypatch, kernel_language="Pallas", **kw)
+
+
+@requires8
+@pytest.mark.parametrize("model", ["grayscott", "brusselator", "fhn",
+                                   "heat"])
+def test_pallas_sstep_identity_every_model(monkeypatch, model):
+    """THE tentpole contract (docs/KERNELGEN.md): generated Pallas at
+    halo_depth=k, fuse=d is BITWISE the generated Pallas at
+    halo_depth=1, fuse=k*d — the same one-exchange-per-round program
+    over the (2,2,2) mesh — for every registered model. On a CPU mesh
+    the sharded chain executes the kernel's bitwise XLA reference
+    (``_xla_xchain_fallback``), which is exactly what makes this
+    tier-1-testable off-TPU."""
+    kw = {} if model == "grayscott" else {"model": model}
+    a = _run_pallas(2, 1, monkeypatch, steps=6, **kw)
+    b = _run_pallas(1, 2, monkeypatch, steps=6, **kw)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("mesh", ["8,1,1", None])
+def test_pallas_sstep_identity_composes_with_base_depth(monkeypatch,
+                                                        mesh):
+    """k=2 over base 2 == one depth-4 chain on BOTH Pallas chain paths
+    (x-chain and xy-chain), bitwise."""
+    if mesh:
+        monkeypatch.setenv("GS_TPU_MESH_DIMS", mesh)
+    # x-chain depth is capped by the local x extent: 8 ranks along x
+    # need L=32 to hold a 4-deep chain (local nx=4).
+    L = 32 if mesh else 16
+    a = _run_pallas(2, 2, monkeypatch, L=L, seed=7)
+    b = _run_pallas(1, 4, monkeypatch, L=L, seed=7)
+    if mesh:
+        monkeypatch.delenv("GS_TPU_MESH_DIMS")
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+@pytest.mark.parametrize("k", [2, 4])
+def test_pallas_uneven_L_pad_and_mask_identity(monkeypatch, k):
+    """Non-divisible L at Pallas k>1: the shrinking valid regions MASK
+    the pad (global-coordinate pinning), never read it — bitwise vs
+    the equivalent deep chain on the same pad-and-mask blocks."""
+    a = _run_pallas(k, 1, monkeypatch, L=22, steps=5, seed=3)
+    b = _run_pallas(1, k, monkeypatch, L=22, steps=5, seed=3)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@requires8
+def test_pallas_ensemble_member_is_bitwise_solo_at_k2(monkeypatch):
+    """The ensemble equality contract survives Pallas s-step exchange:
+    member m of an N-member run at halo_depth=2 == the solo run with
+    member m's params and seed, bitwise."""
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+    from grayscott_jl_tpu.ensemble.io import member_settings
+
+    monkeypatch.setenv("GS_FUSE", "1")
+    s = _settings(halo_depth=2, kernel_language="Pallas")
+    s.ensemble = ens_spec.from_toml(
+        {"presets": ["spots", "chaos"], "member_shards": 1}, s
+    )
+    ens = EnsembleSimulation(s, n_devices=8, seed=3)
+    assert ens.halo_depth == 2
+    ens.iterate(6)
+    ue, ve = ens.get_fields()
+    for m in range(2):
+        solo = Simulation(member_settings(s, m), n_devices=8,
+                          seed=3 + m)
+        assert solo.halo_depth == 2
+        solo.iterate(6)
+        us, vs = solo.get_fields()
+        np.testing.assert_array_equal(ue[m], np.asarray(us))
+        np.testing.assert_array_equal(ve[m], np.asarray(vs))
+
+
+@requires8
+def test_pallas_sstep_round_collective_count(monkeypatch):
+    """The communication-avoiding claim in HLO: a Pallas k=2 round
+    over base 2 compiles to SIX collective-permutes per (now 4-step)
+    xy-chain round on the z-sharded (2,2,2) mesh — 6 per k*d steps,
+    same count as the k=1 round that advanced half the steps."""
+    monkeypatch.setenv("GS_FUSE", "2")
+    base = Simulation(
+        _settings(kernel_language="Pallas"), n_devices=8
+    )
+    deep = Simulation(
+        _settings(halo_depth=2, kernel_language="Pallas"), n_devices=8
+    )
+    assert deep.halo_depth == 2
+    assert _collective_count(base) == _collective_count(deep) == 6
 
 
 # ---------------------------------------------------------------- tuning
@@ -299,9 +453,52 @@ def test_candidates_auto_widens_across_k():
     cands = candidates.generate(halo_depth=0, **_GEN)
     xla_ks = {c.halo_depth for c in cands if c.kernel == "xla"}
     assert {1, 2, 4} <= xla_ks
-    assert all(c.halo_depth == 1 for c in cands if c.kernel == "pallas")
     # the s-step variants are labeled for provenance/artifacts
     assert any("sk=2" in c.label() for c in cands)
+
+
+def test_candidates_widen_pallas_k_on_tpu():
+    """Schema-v8 widening: on a TPU platform the Pallas shortlist
+    enumerates k in {1, 2, 4} wherever the fuse*k-deep working set
+    passes the chain-dispatch caps + VMEM ledger, prices every one
+    (``projected_step_us`` no longer returns None for Pallas k>1),
+    and honors an explicit pin."""
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    prev = ps._VMEM_BUDGET
+    icimodel.pin_big_vmem()
+    try:
+        gen = dict(_GEN, platform="tpu", L=256, fuse_cap=4,
+                   analytic_fuse=2)
+        cands = candidates.generate(halo_depth=0, **gen)
+        pallas = [c for c in cands if c.kernel == "pallas"]
+        assert {1, 2, 4} <= {c.halo_depth for c in pallas}
+        assert all(c.projected_step_us is not None for c in pallas)
+        pinned = candidates.generate(halo_depth=2, **gen)
+        assert {c.halo_depth for c in pinned
+                if c.kernel == "pallas"} == {2}
+    finally:
+        ps._VMEM_BUDGET = prev
+
+
+def test_max_feasible_chain_depth_caps_and_ledger():
+    """The ONE shared feasibility rule (runner gate + shortlist):
+    x-chain depth caps at nx, z-sharded xy-chain at nz // 2, and the
+    VMEM slab ledger prunes what geometry alone would admit."""
+    from grayscott_jl_tpu.ops import pallas_stencil as ps
+
+    prev = ps._VMEM_BUDGET
+    icimodel.pin_big_vmem()
+    try:
+        assert ps.max_feasible_chain_depth(
+            (2, 16, 16), (8, 1, 1), 4, 8) == 2
+        assert ps.max_feasible_chain_depth(
+            (16, 16, 4), (2, 2, 2), 4, 8) == 2
+        ps._VMEM_BUDGET = 1024
+        assert ps.max_feasible_chain_depth(
+            (128, 128, 128), (2, 2, 2), 4, 2) == 0
+    finally:
+        ps._VMEM_BUDGET = prev
 
 
 def test_candidates_respect_an_explicit_pin():
@@ -319,9 +516,10 @@ def test_candidates_prune_infeasible_k():
 
 
 def test_model_prices_sstep_latency_amortization():
-    """On a latency-dominated config the projected step time strictly
-    improves with k, and the Pallas language is unscored at k>1 (no
-    such schedule exists to project)."""
+    """On a latency-dominated config the projected XLA step time
+    strictly improves with k, and the Pallas language is now PRICED at
+    k>1 (the v8 contract — ``sstep_amortization`` via the per-language
+    efficiency) instead of returning None."""
     us = {
         k: icimodel.projected_step_us(
             "xla", (2, 2, 2), 16, 1, local=(8, 8, 8), halo_depth=k
@@ -329,9 +527,25 @@ def test_model_prices_sstep_latency_amortization():
         for k in (1, 2, 4)
     }
     assert us[4] < us[2] < us[1]
-    assert icimodel.projected_step_us(
-        "pallas", (2, 2, 2), 16, 1, local=(8, 8, 8), halo_depth=2
-    ) is None
+    pus = {
+        k: icimodel.projected_step_us(
+            "pallas", (2, 2, 2), 16, 2, local=(8, 8, 8), halo_depth=k
+        )
+        for k in (1, 2, 4)
+    }
+    assert all(v is not None and v > 0 for v in pus.values())
+
+
+def test_model_chain_row_carries_sstep_schedule():
+    """``project_chain`` prices halo_depth: the row reports the
+    deepened exchange cadence (1 exchange per fuse*k steps) and the
+    requested k, with less remaining hop latency than the k=1 row."""
+    base = icimodel.project_chain((2, 2, 2), 256, 2, 1000.0)
+    deep = icimodel.project_chain((2, 2, 2), 256, 2, 1000.0,
+                                  halo_depth=2)
+    assert base["halo_depth"] == 1 and deep["halo_depth"] == 2
+    assert base["exchanges_per_step"] == pytest.approx(1 / 2)
+    assert deep["exchanges_per_step"] == pytest.approx(1 / 4)
 
 
 def test_sstep_amortization_shape():
@@ -342,6 +556,11 @@ def test_sstep_amortization_shape():
     assert icimodel.sstep_amortization(4, efficiency=1.0) == (
         pytest.approx(0.25)
     )
+    # per-language calibration (v8): both entries exist, XLA's is the
+    # PR 9 literal, and the Pallas lens resolves through the dict
+    assert set(icimodel.HALO_DEPTH_EFFICIENCY) == {"xla", "pallas"}
+    pal = icimodel.sstep_amortization(2, lang="pallas")
+    assert pal == 1.0 - icimodel.HALO_DEPTH_EFFICIENCY["pallas"] * 0.5
 
 
 def test_probe_sim_carries_the_candidate_k(monkeypatch):
@@ -365,7 +584,7 @@ def test_cache_key_v4_carries_halo_depth(tmp_path):
         dtype="float32", noise=0.1, jax_version=jax.__version__,
         halo_depth=2,
     )
-    assert key["schema"] == cache.SCHEMA_VERSION == 7
+    assert key["schema"] == cache.SCHEMA_VERSION == 8
     assert key["halo_depth"] == 2
     auto = cache.cache_key(
         device_kind="cpu", platform="cpu", dims=(2, 2, 2), L=16,
@@ -425,3 +644,143 @@ def test_gs_report_check_rejects_missing_sstep_fields(tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text(json.dumps({"comm": {"hidden_us": 1.0}}))
     assert gs_report.check(None, None, str(bad)) == 1
+
+
+def _load_update_halo_depth():
+    spec = importlib.util.spec_from_file_location(
+        "update_halo_depth",
+        os.path.join(os.path.dirname(__file__), "..", "..",
+                     "benchmarks", "update_halo_depth.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _hd_row(**kw):
+    row = {"ab": "halo_depth", "halo_depth": 2, "engaged": True,
+           "measured_comm_reduction": 0.4,
+           "model_ideal_reduction": 0.5}
+    row.update(kw)
+    return row
+
+
+def test_update_halo_depth_groups_by_language(tmp_path):
+    """The calibrator splits rows on their ``lang`` tag — one median
+    per language — and rows predating the tag count toward ``xla``
+    (the only language that ran s-step schedules before v8)."""
+    uhd = _load_update_halo_depth()
+    p = tmp_path / "ab.jsonl"
+    rows = [
+        _hd_row(lang="xla"),                             # eff 0.8
+        _hd_row(),                                       # legacy -> xla
+        _hd_row(lang="pallas",
+                measured_comm_reduction=0.3),            # eff 0.6
+        _hd_row(lang="pallas", engaged=False),           # no signal
+        _hd_row(lang="xla", halo_depth=1,
+                model_ideal_reduction=None),             # k=1 baseline
+        {"ab": "something-else"},                        # foreign row
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    out = uhd.load_efficiency(str(p))
+    assert out["median"] == {"xla": 0.8, "pallas": 0.6}
+    assert out["efficiencies"] == {"xla": [0.8, 0.8], "pallas": [0.6]}
+    assert out["skipped"] == 2
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(_hd_row(lang="fortran")) + "\n")
+    with pytest.raises(SystemExit, match="fortran"):
+        uhd.load_efficiency(str(bad))
+
+
+def test_update_halo_depth_apply_rewrites_measured_langs(tmp_path):
+    """--apply rewrites only the measured languages' dict entries —
+    an XLA-only artifact never clobbers the Pallas literal."""
+    uhd = _load_update_halo_depth()
+    model = tmp_path / "icimodel.py"
+    model.write_text(
+        "HALO_DEPTH_EFFICIENCY = {\n"
+        '    "xla": 0.9,\n'
+        '    "pallas": 0.9,\n'
+        "}\n"
+    )
+    uhd.apply_to_model({"xla": 0.8125}, str(model))
+    text = model.read_text()
+    assert '"xla": 0.8125' in text and '"pallas": 0.9' in text
+    uhd.apply_to_model({"pallas": 0.65, "xla": 0.7}, str(model))
+    text = model.read_text()
+    assert '"xla": 0.7' in text and '"pallas": 0.65' in text
+    with pytest.raises(SystemExit, match="mosaic"):
+        uhd.apply_to_model({"mosaic": 0.5}, str(model))
+
+
+def _load_gs_report():
+    spec = importlib.util.spec_from_file_location(
+        "gs_report",
+        os.path.join(os.path.dirname(__file__), "..", "..", "scripts",
+                     "gs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stats_with_selection(tmp_path, sel, name="s.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps({
+        "config": {"kernel_language": "xla", "kernel_selection": sel},
+        "comm": {"halo_depth": 1, "exchanges_per_step": 1.0,
+                 "halo_bytes_per_step": 4096},
+    }))
+    return str(p)
+
+
+def test_gs_report_check_validates_halo_depth_gate_schema(tmp_path):
+    """The two gate generations (docs/TEMPORAL.md): a legacy record
+    (no ``kind``) and a geometry-infeasible record with its full VMEM
+    ledger both validate; a bad kind, a ledger missing its numbers, or
+    a record missing requested/applied/reason fails --check."""
+    gs_report = _load_gs_report()
+    legacy = {"requested": 2, "applied": 1,
+              "reason": "not supported on this path"}
+    geo = {"requested": 4, "applied": 1, "kind": "geometry-infeasible",
+           "reason": "needs a 4-deep chain; serves 1",
+           "geometry": {"path": "x-chain", "local_shape": [2, 16, 16],
+                        "fuse_base": 1, "requested_depth": 4,
+                        "feasible_depth": 1,
+                        "vmem_budget_bytes": 1024, "itemsize": 4,
+                        "n_fields": 2}}
+    for i, gate in enumerate([legacy, geo, None]):
+        path = _stats_with_selection(
+            tmp_path, {"halo_depth_gate": gate}, f"ok{i}.json")
+        assert gs_report.check(None, None, path) == 0, gate
+    bad_kind = dict(geo, kind="vibes")
+    no_reason = {"requested": 2, "applied": 1}
+    no_ledger = {k: v for k, v in geo.items() if k != "geometry"}
+    torn_ledger = dict(
+        geo, geometry={**geo["geometry"], "vmem_budget_bytes": None})
+    bad_shape = dict(
+        geo, geometry={**geo["geometry"], "local_shape": [2, 16]})
+    for i, gate in enumerate([bad_kind, no_reason, no_ledger,
+                              torn_ledger, bad_shape, "oops"]):
+        path = _stats_with_selection(
+            tmp_path, {"halo_depth_gate": gate}, f"bad{i}.json")
+        assert gs_report.check(None, None, path) == 1, gate
+
+
+def test_gs_report_check_validates_autotune_cache_schema(tmp_path):
+    """v8 tuning provenance: ``cache_schema``, when present, must be
+    an integer; records predating the field still validate."""
+    gs_report = _load_gs_report()
+    ok = _stats_with_selection(
+        tmp_path, {"autotune": {"mode": "cached", "source": "analytic",
+                                "cache_schema": 8}}, "at_ok.json")
+    legacy = _stats_with_selection(
+        tmp_path, {"autotune": {"mode": "cached",
+                                "source": "analytic"}}, "at_old.json")
+    bad = _stats_with_selection(
+        tmp_path, {"autotune": {"mode": "cached", "source": "analytic",
+                                "cache_schema": "8"}}, "at_bad.json")
+    assert gs_report.check(None, None, ok) == 0
+    assert gs_report.check(None, None, legacy) == 0
+    assert gs_report.check(None, None, bad) == 1

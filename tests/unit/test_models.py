@@ -398,8 +398,9 @@ def test_tune_cache_key_separates_models():
     # v3 grew model/n_fields; v4 grew halo_depth (s-step exchange
     # pin); v5 grew member_shards/procs (the adopted placement); v6
     # grew compute_precision/snapshot_codec (docs/PRECISION.md); v7
-    # grew kernel_generator (docs/KERNELGEN.md).
-    assert gs["schema"] == cache.SCHEMA_VERSION == 7
+    # grew kernel_generator (docs/KERNELGEN.md); v8 made halo_depth
+    # semantics per-language (Pallas s-step chains, docs/TUNING.md).
+    assert gs["schema"] == cache.SCHEMA_VERSION == 8
     assert gs["model"] == "grayscott" and gs["n_fields"] == 2
     digests = {cache.key_digest(k) for k in (gs, br, ht)}
     assert len(digests) == 3  # a Brusselator run can never adopt a

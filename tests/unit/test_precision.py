@@ -354,7 +354,7 @@ def test_cache_v6_key_separates_postures(tmp_path):
     base = dict(device_kind="cpu", platform="cpu", dims=(2, 2, 2),
                 L=32, dtype="float32", noise=0.1, jax_version="j")
     k0 = cache.cache_key(**base)
-    assert k0["schema"] == cache.SCHEMA_VERSION == 7
+    assert k0["schema"] == cache.SCHEMA_VERSION == 8
     assert k0["compute_precision"] == "f32"
     assert k0["snapshot_codec"] == "off"
     variants = [

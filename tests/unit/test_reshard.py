@@ -549,7 +549,7 @@ def test_cache_key_separates_placements(tmp_path):
         dtype="float32", noise=0.1, jax_version="j",
     )
     k0 = cache.cache_key(**base)
-    assert k0["schema"] == 7
+    assert k0["schema"] == 8
     assert k0["member_shards"] == 1 and k0["procs"] == 1
     variants = [
         cache.cache_key(**base, member_shards=2),

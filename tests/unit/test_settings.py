@@ -46,7 +46,13 @@ def test_defaults_match_reference():
     assert s.Du == 0.05
     assert s.Dv == 0.1
     assert s.noise == 0.0
-    assert s.output == "foo.bp"
+    # Divergence from the reference's "foo.bp": the unconfigured
+    # default writes under the system temp dir, never the launch dir.
+    import os
+    import tempfile
+
+    assert s.output == os.path.join(tempfile.gettempdir(),
+                                    "gs_output.bp")
     assert s.checkpoint is False
     assert s.checkpoint_freq == 2000
     assert s.checkpoint_output == "ckpt.bp"
