@@ -80,8 +80,6 @@ def _resolve_reshape_dims(req, sim):
     donate the slice. An explicit ``{"mesh_dims": [x, y, z]}`` pins the
     target outright.
     """
-    import jax
-
     from .parallel.domain import CartDomain, dims_create
 
     if not isinstance(req, dict):
@@ -102,8 +100,10 @@ def _resolve_reshape_dims(req, sim):
             return None
         dims = dims_create(n, 3)
     n = dims[0] * dims[1] * dims[2]
-    if n * member_shards > len(jax.devices()):
-        return None  # not enough chips to grow into
+    from .resilience.sdc import usable_devices
+
+    if n * member_shards > len(usable_devices()):
+        return None  # not enough (non-quarantined) chips to grow into
     try:
         CartDomain.create(n, sim.settings.L, dims=dims)
     except ValueError:
@@ -583,6 +583,27 @@ def _run_once_inner(
                        every=icfg["scrub_every"])
         if icfg["scrub"] and ckpt is not None else None
     )
+    # Compute-path SDC screening (resilience/sdc.py, docs/RESILIENCE.md
+    # "Silent data corruption"): GS_SDC_CHECK=spot|shadow replays the
+    # rounds since the previous boundary from a retained anchor and
+    # compares exact in-graph checksums — a mismatch is attributed to a
+    # device and unwinds as SDCError before any store write.
+    # Single-process only, like the snapshot checksum above (the
+    # screener compares addressable shards for attribution).
+    from .resilience import sdc as sdc_mod
+
+    scfg = sdc_mod.resolve_sdc(settings)
+    screener = (
+        sdc_mod.Screener(
+            sim, mode=scfg["mode"], every=scfg["every"],
+            journal=journal, log=log.info,
+        )
+        if scfg["mode"] != "off" and nprocs == 1 else None
+    )
+    if screener is not None:
+        screener.rearm(restart_step)
+    stats.config["sdc"] = dict(scfg)
+    m_sdc_checks = metrics.counter("sdc_checks", **mlabels)
     # The reference side of the live model-vs-measured residual gauge:
     # what the ICI model projects one step should cost on this exact
     # config. Computed once — the observed p50 moves, the projection
@@ -645,6 +666,10 @@ def _run_once_inner(
     stats.config["async_io_depth"] = pipe.depth
     step = restart_step
     first_round = True
+    # Quarantine poll state: only a CHANGED blocklist pays the overlap
+    # check + reshape attempt, so a refused move warns once, not every
+    # round.
+    quarantine_handled: frozenset = frozenset()
 
     def _graceful(at_step: int, ckpt_written: bool):
         """The preemption grace path: checkpoint NOW (off-schedule if
@@ -693,8 +718,10 @@ def _run_once_inner(
         dims = _resolve_reshape_dims(req, sim)
         if dims is None:
             return False
-        # The reshape pays a target compile — budget it like one.
-        _mark("compile", step)
+        # The reshape pays a target compile plus the device-path move —
+        # its own watchdog phase (GS_WATCHDOG_RESHAPE_S) so a wedged
+        # move cannot hide under the looser compile budget forever.
+        _mark("reshape", step)
         # Retire in-flight writes against the OLD stores before the
         # swap; the pipeline itself stays up.
         pipe.drain()
@@ -712,6 +739,13 @@ def _run_once_inner(
         if ckpt is not None:
             ckpt.close()
         sim = new_sim
+        if screener is not None:
+            # The screener's anchor/checksum closures are bound to the
+            # old mesh; rebind and re-anchor on the adopted layout (the
+            # move is bitwise-transparent, so the next replay segment
+            # simply starts here).
+            screener.rebind(sim)
+            screener.rearm(step)
         # The rebuilt stores must APPEND at the current step: the
         # stores only open in append mode under settings.restart, and
         # a fresh (non-restarted) run that reshapes mid-life would
@@ -754,6 +788,40 @@ def _run_once_inner(
                     req = reshape_poll()
                     if req:
                         _apply_reshape(req)
+                # Quarantine poll (resilience/sdc.py): when a device
+                # this run computes on lands in the blocklist — this
+                # worker's own screener via the supervisor, a fleet
+                # peer's quarantine doc, or an operator export — move
+                # the live state onto the surviving inventory between
+                # rounds, the live-path analog of the supervisor's
+                # restart-with-exclusion.
+                blocked = sdc_mod.resolve_blocklist()
+                if blocked and blocked != quarantine_handled:
+                    in_use = {
+                        sdc_mod.device_name(d) for d in (
+                            sim.mesh.devices.flat
+                            if sim.mesh is not None else (sim.device,)
+                        )
+                    }
+                    if blocked & in_use:
+                        shards_per = max(
+                            1, int(getattr(sim, "member_shards", 1))
+                        )
+                        dims = sdc_mod.feasible_dims(
+                            len(sdc_mod.usable_devices()) // shards_per,
+                            settings.L,
+                        )
+                        moved = dims is not None and _apply_reshape(
+                            {"mesh_dims": dims}
+                        )
+                        if not moved:
+                            log.warn(
+                                "quarantined device(s) "
+                                f"{sorted(blocked & in_use)} in use but "
+                                "no feasible reshape target — continuing "
+                                "on the current mesh"
+                            )
+                    quarantine_handled = blocked
                 # The first round pays jit (and, under Auto, any
                 # remaining autotune measurement) — its budget is
                 # the compile deadline, every later round the much
@@ -778,6 +846,21 @@ def _run_once_inner(
                             step=boundary, planned_step=fault.step,
                         )
                         raise InjectedKernelError(fault.step)
+                fault = plan.take("sdc", boundary)
+                if fault is not None:
+                    # Compute-path corruption (faults.py kind catalog):
+                    # flip one live cell on the named device BEFORE the
+                    # round runs, so the corruption is an INPUT to the
+                    # step program — unlike `bitflip`, which hits only
+                    # the write-path snapshot copy. GS_SDC_CHECK replays
+                    # from the pre-poison anchor and must diverge.
+                    name = sim.poison_sdc(
+                        device=sdc_mod.resolve_fault_device(settings)
+                    )
+                    journal.record(
+                        event="injected", kind="sdc", step=step,
+                        planned_step=fault.step, device=name,
+                    )
                 t_round = time.perf_counter()
                 with stats.phase("compute", step=step):
                     sim.iterate(boundary - step)
@@ -807,6 +890,14 @@ def _run_once_inner(
                 if profile is not None:
                     profile.on_boundary(step)
 
+                if screener is not None:
+                    # Screen BEFORE this boundary's poison faults (an
+                    # injected nan/drift is a modeled failure the
+                    # health/drift gates own, not compute-path SDC) and
+                    # BEFORE any store write, so a mismatch unwinds as
+                    # SDCError without persisting a single corrupt byte.
+                    if screener.check(step):
+                        m_sdc_checks.inc()
                 fault = plan.take("nan", step)
                 if fault is not None:
                     journal.record(
@@ -852,6 +943,14 @@ def _run_once_inner(
                         planned_step=fault.step,
                     )
                     injected_hang_wait(watchdog=wd, shutdown=shutdown)
+
+                if screener is not None:
+                    # Re-anchor every boundary (a device-side copy, no
+                    # D2H) AFTER the poison takes above, so an injected
+                    # nan/drift lands inside the anchor and the next
+                    # replay segment reproduces it — faults change
+                    # WHEN, never WHAT the screener compares.
+                    screener.rearm(step)
 
                 at_plot = (
                     settings.plotgap > 0 and step % settings.plotgap == 0
@@ -1011,6 +1110,11 @@ def _run_once_inner(
             _mark("drain", step)
             pipe.close()
 
+        if screener is not None:
+            # Echo what the screener actually did into the stats
+            # artifact (boundaries seen, checks run, last verified
+            # step) next to its resolved config.
+            stats.config["sdc"].update(screener.describe())
         elapsed = time.perf_counter() - t0
         # Idle pack slots never count toward the work actually served
         # (docs/SERVICE.md): only ACTIVE members scale the aggregate.

@@ -403,8 +403,10 @@ class EnsembleSimulation(Simulation):
 
     # ------------------------------------------------------------ runner
 
-    def _runner(self, nsteps: int):
-        """Compiled ``nsteps``-step ensemble advance, cached per nsteps.
+    def _make_step_fn(self, nsteps: int, mesh=None):
+        """The un-jitted ``nsteps``-step ensemble advance (see the base
+        class: shared by the donating live runner and the non-donating
+        SDC replay, optionally on a permuted ``mesh``).
 
         ``vmap`` of the per-member body over the leading axis; under a
         mesh, ``shard_map`` wraps the vmapped body with the member axis
@@ -413,10 +415,6 @@ class EnsembleSimulation(Simulation):
         every per-member value (noise draws included) is computed by
         the same program a solo run compiles.
         """
-        fn = self._runners.get(nsteps)
-        if fn is not None:
-            return fn
-
         local = partial(self._local_run, nsteps=nsteps)
         nf = self.model.n_fields
         member_local = jax.vmap(
@@ -425,17 +423,22 @@ class EnsembleSimulation(Simulation):
         if self.mesh is not None:
             fspec = P(MEMBER_AXIS, *AXIS_NAMES)
             mspec = P(MEMBER_AXIS)  # keys (N, 2) / params leaves (N,)
-            fn = shard_map(
+            return shard_map(
                 member_local,
-                mesh=self.mesh,
+                mesh=self.mesh if mesh is None else mesh,
                 in_specs=(fspec,) * nf + (mspec, P(), mspec),
                 out_specs=(fspec,) * nf,
                 **{_SHARD_MAP_CHECK_FLAG: False},
             )
-        else:
-            fn = member_local
-        fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
-        return self._register_runner(nsteps, fn)
+        return member_local
+
+    def _replay_arg_shardings(self, mesh):
+        """(base_key, params) ride the member axis: both are
+        member-stacked inputs sharded on 'm' (see ``_make_step_fn``'s
+        in_specs), so a shadow replay must place them on the permuted
+        mesh the same way."""
+        ms = NamedSharding(mesh, P(MEMBER_AXIS))
+        return ms, ms
 
     # ------------------------------------------------------------ output
 
@@ -511,6 +514,33 @@ class EnsembleSimulation(Simulation):
         self.fields = (
             self.fields[:i] + (poisoned,) + self.fields[i + 1:]
         )
+
+    def _sdc_site(self, arr, device=None):
+        """Member-addressable ``sdc`` poison site: the spatial center
+        of the target device's shard, with the member coordinate pinned
+        from ``GS_FAULT_MEMBER`` when set. Under ``member_shards > 1``
+        pinning the member can move the cell into ANOTHER device's
+        member-block — the owning device is re-resolved so the
+        injection record (and the attribution the test asserts) names
+        the device that actually holds the poisoned cell."""
+        from ..config.env import env_int
+
+        name, index = super()._sdc_site(arr, device)
+        member = env_int("GS_FAULT_MEMBER", -1)
+        if member >= 0:
+            index = (member % self.n_members,) + index[1:]
+            for sh in arr.addressable_shards:
+                idx = (sh.index if isinstance(sh.index, tuple)
+                       else (sh.index,))
+                if all(
+                    (sl.start or 0) <= c < (
+                        g if sl.stop is None else sl.stop)
+                    for sl, c, g in zip(idx, index, arr.shape)
+                ):
+                    d = sh.device
+                    name = f"{d.platform}:{d.id}"
+                    break
+        return name, index
 
     # ------------------------------------------------------------ repack
 

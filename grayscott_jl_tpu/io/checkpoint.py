@@ -209,9 +209,15 @@ class CheckpointWriter:
             w.close()
 
 
-def latest_durable_step(path: str) -> Optional[int]:
+def latest_durable_step(path: str,
+                        max_step: Optional[int] = None) -> Optional[int]:
     """Simulation step of the latest *complete* checkpoint entry in
     ``path``, or None (missing/empty store).
+
+    ``max_step`` caps the answer: the latest durable entry whose step
+    is ``<= max_step`` (the SDC recovery path resumes from the last
+    *verified* boundary — a durable-but-unscreened entry written after
+    it may carry the corruption; ``resilience/sdc.py``).
 
     The BP-lite reader validates every step entry against the payload
     file sizes and exposes only complete steps, so whatever this
@@ -244,7 +250,13 @@ def latest_durable_step(path: str) -> Optional[int]:
         n = r.num_steps()
         if n == 0:
             return None
-        return int(r.get("step", step=n - 1))
+        # Steps are appended in order; scan descending for the newest
+        # entry under the cap instead of assuming which index it is.
+        for k in range(n - 1, -1, -1):
+            s = int(r.get("step", step=k))
+            if max_step is None or s <= max_step:
+                return s
+        return None
     except Exception as e:  # noqa: BLE001 — torn step entry, documented
         print(
             f"gray-scott: warning: checkpoint store {path} has no "

@@ -1079,6 +1079,15 @@ def _xla_xchain_fallback(fields, params, seeds, faces, *, spec, fuse,
     n_f = spec.n_fields
     nx, ny, nz = fields[0].shape
     dtype = fields[0].dtype
+    # The chain carries the STORAGE dtype between stages, but the
+    # params carry the compute posture: under bf16_f32acc their f32
+    # would promote the whole update (a carry-dtype crash in
+    # run_chain_rounds), so each stage accumulates in the params'
+    # dtype and rounds back. In the matched postures (f32/f64,
+    # pure-bf16) this resolves to the no-cast fast path, keeping the
+    # fallback bitwise-equal to single-device stepwise Plain.
+    pdt = jnp.asarray(params.noise).dtype
+    acc = None if pdt == dtype else pdt
     k = fuse
     bvs = tuple(jnp.asarray(b, dtype) for b in spec.boundaries)
     wins = [
@@ -1113,6 +1122,7 @@ def _xla_xchain_fallback(fields, params, seeds, faces, *, spec, fuse,
         wins = list(stencil.reaction_update(
             tuple(pad_yz(w, bv) for w, bv in zip(wins, bvs)), nz_field,
             params, spec.model,
+            compute_dtype=acc,
         ))
         if s == k - 1:
             # Mirror the kernel: the final stage writes its output
@@ -1159,7 +1169,13 @@ def _xla_fallback(fields, params, seeds, faces, *, spec, use_noise,
         nz_field = params.noise * unit
     else:
         nz_field = jnp.asarray(0.0, dtype)
-    return stencil.reaction_update(pads, nz_field, params, spec.model)
+    # Accumulate in the params' dtype only when the posture splits
+    # storage from compute (bf16_f32acc) — see _xla_xchain_fallback.
+    pdt = jnp.asarray(params.noise).dtype
+    return stencil.reaction_update(
+        pads, nz_field, params, spec.model,
+        compute_dtype=None if pdt == dtype else pdt,
+    )
 
 
 def _pad_from_faces(x, xlo, xhi, ylo, yhi, zlo, zhi):

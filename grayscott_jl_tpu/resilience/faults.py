@@ -66,6 +66,18 @@ Fault kinds:
     restore (replica failover when ``GS_CKPT_REPLICAS`` mirrors
     exist; a loud refusal when not) or by the ``GS_SCRUB`` boundary
     scrubber, which quarantines the entry.
+``sdc``
+    Fail-silent COMPUTE-path corruption: flips one mantissa bit of one
+    LIVE cell in the shard owned by a named device
+    (``Simulation.poison_sdc``; target via ``GS_FAULT_DEVICE``, member
+    via ``GS_FAULT_MEMBER``) *before* the round runs — the corrupted
+    value is an input to the step program, so the trajectory itself
+    diverges. Distinct from ``bitflip``, which corrupts the write-path
+    copy only and must stay invisible to SDC screening. Detected by
+    ``GS_SDC_CHECK`` redundant-compute screening
+    (``resilience/sdc.py``), attributed to the device, and raised as
+    :class:`~.sdc.SDCError` (classified ``sdc``: restart from the last
+    *verified* checkpoint; a repeat at the same device quarantines it).
 
 This module also hosts the preemption-aware graceful-shutdown pieces
 (they share the failure taxonomy): :class:`ShutdownListener` turns
@@ -102,7 +114,7 @@ __all__ = [
 
 FAULT_KINDS = (
     "io_error", "nan", "preempt", "kernel", "hang", "bitflip",
-    "ckpt_corrupt", "drift",
+    "ckpt_corrupt", "drift", "sdc",
 )
 
 #: Distinct process exit codes, chosen from the sysexits "temporary

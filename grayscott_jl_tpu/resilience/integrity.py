@@ -224,14 +224,21 @@ def device_field_checksum(*fields):
     return out
 
 
-def apply_bitflip(arr, index: Sequence[int]):
-    """XOR the lowest bit of one element's bit pattern — the
-    ``bitflip`` fault body, applied to the snapshot's device-side copy
+def apply_bitflip(arr, index: Sequence[int], bit: int = 0):
+    """XOR one bit of one element's bit pattern — the ``bitflip``
+    fault body, applied to the snapshot's device-side copy
     (field/member-addressable via ``index``) so the live trajectory is
     untouched while the bytes bound for the stores are silently wrong.
     Any single-bit flip changes the wrapped word sum by a nonzero
     delta, so :func:`device_field_checksum` detection is guaranteed,
-    not probabilistic."""
+    not probabilistic.
+
+    ``bit`` selects which bit of the storage word flips (default 0,
+    the lowest — PR 14's at-rest fault). The compute-path ``sdc``
+    fault flips a HIGH mantissa bit instead: a lowest-bit flip in a
+    flat region can be diffusively absorbed below one ulp within a
+    single round, and the screening contract is about *persistent*
+    wrong answers, not sub-ulp transients."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -241,7 +248,7 @@ def apply_bitflip(arr, index: Sequence[int]):
         word = jnp.uint16 if width == 2 else jnp.uint32
         bits = lax.bitcast_convert_type(x, word)
         idx = tuple(index) + (0,) * (bits.ndim - len(index))
-        flipped = bits.at[idx].set(bits[idx] ^ word(1))
+        flipped = bits.at[idx].set(bits[idx] ^ word(1 << bit))
         return lax.bitcast_convert_type(flipped, x.dtype)
 
     return jax.jit(flip)(arr)
@@ -289,15 +296,18 @@ def restore_candidates(path: str) -> List[str]:
     return sorted(cands, key=health, reverse=True)  # stable: primary first
 
 
-def latest_durable_step_replicated(path: str) -> Optional[int]:
+def latest_durable_step_replicated(
+        path: str, max_step: Optional[int] = None) -> Optional[int]:
     """The best "latest durable checkpoint step" any replica of
     ``path`` can serve — the replicated form of
     ``io.checkpoint.latest_durable_step`` the supervisor's resume
     quorum consults (a half-written primary must not drag the quorum
-    down while a mirror holds the step)."""
+    down while a mirror holds the step). ``max_step`` caps the answer
+    at the last *verified* boundary (SDC recovery,
+    ``resilience/sdc.py``)."""
     from ..io.checkpoint import latest_durable_step
 
-    steps = [latest_durable_step(p)
+    steps = [latest_durable_step(p, max_step=max_step)
              for p in [path] + _existing_replicas(path)]
     live = [s for s in steps if s is not None]
     return max(live) if live else None

@@ -1,0 +1,516 @@
+"""Compute-path silent-data-corruption (SDC) screening, attribution
+and degraded-device quarantine.
+
+PR 14's integrity layer guards data *at rest*: every byte that leaves
+the device is CRC'd, checksummed, replicated and verified on read. None
+of that helps when a device silently computes a wrong answer — the bad
+trajectory is then CRC'd, replicated, content-address-cached and served
+as truth. At scale this is the dominant unguarded failure class
+(cf. the Frontier end-to-end experience, arxiv 2309.10292), and the
+framework's bitwise-determinism contract makes the classic defense —
+redundant compute — uniquely cheap here: recompute the round, compare
+one exact checksum.
+
+Modes (``GS_SDC_CHECK``, cadence ``GS_SDC_EVERY``):
+
+* ``off``    — no screening (default). Zero overhead, zero change.
+* ``spot``   — every Nth boundary, re-run the step rounds since the
+  previous boundary from a retained device-side anchor copy and compare
+  the exact wrapped-uint field checksums
+  (:func:`~.integrity.device_field_checksum` — reduction-order-free, so
+  replay-vs-live is an **equality**, not a tolerance). The comparison is
+  fused in-graph; only scalars cross D2H.
+* ``shadow`` — like spot, but the replay is placed on a rotated
+  device/shard permutation of the same mesh, so a deterministic
+  per-core fault cannot re-corrupt its own replay and self-confirm.
+
+A mismatch is attributed to a device by pulling the diverging shards to
+the host and bisecting over **disjoint device subsets**
+(:func:`bisect_failing`), then picking the blast center (the failing
+device with the most differing words — the injected fault model lands
+at a shard center, so the short screening window keeps the divergence
+inside one block). The ensemble engine's per-member checksum vectors
+additionally name the diverging member(s) for free.
+
+Detection raises :class:`SDCError` — supervisor classification
+``sdc``: restartable from the last **verified** checkpoint (a step the
+screener has proven, not just a durable one), and *repeated attribution
+to the same device* is treated as non-transient **for that device**:
+it is quarantined (:func:`quarantine_device` — fleet KV doc when
+serving, ``GS_DEVICE_BLOCKLIST`` solo) so device selection excludes it
+on the restart and the driver reshapes a live run away from it between
+rounds (PR 18's ``reshape_live``).
+
+Knobs (documented in docs/RESILIENCE.md):
+
+* ``GS_SDC_CHECK``       — off | spot | shadow.
+* ``GS_SDC_EVERY``       — screen every Nth write boundary (default 1).
+* ``GS_DEVICE_BLOCKLIST``— comma-separated quarantined device names
+  (``cpu:3,tpu:0``); union'd with fleet KV ``quarantine/*`` docs.
+* ``GS_FAULT_DEVICE``    — device name the injected ``sdc`` chaos
+  fault poisons (default: highest-id device in the mesh).
+
+Single-process scope: screening compares addressable shards and is
+armed by the driver only when ``jax.process_count() == 1`` (the same
+gate as PR 14's snapshot checksums); multi-host screening would need a
+cross-host checksum gather and is out of scope here.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.env import env_int, env_raw, env_str
+
+__all__ = [
+    "SDCError",
+    "Screener",
+    "bisect_failing",
+    "device_name",
+    "feasible_dims",
+    "quarantine_device",
+    "resolve_blocklist",
+    "resolve_fault_device",
+    "resolve_sdc",
+    "usable_devices",
+]
+
+_MODES = ("off", "spot", "shadow")
+
+#: Fleet-KV key prefix for quarantine docs (``serve/cluster.FleetKV``).
+QUARANTINE_PREFIX = "quarantine/"
+
+
+class SDCError(RuntimeError):
+    """A redundant-compute screen disagreed with the live trajectory —
+    some device computed (or stored an intermediate) wrong, silently.
+
+    Carries the attribution the supervisor's ``sdc`` classification
+    acts on: ``device`` (blast-center attribution, None when it could
+    not be localized), ``member`` (ensemble member index, when the
+    per-member checksum vectors localized one), ``step`` (the boundary
+    that failed screening) and ``verified_step`` (the last boundary
+    screening *proved* — resume must not trust anything newer, even if
+    durable)."""
+
+    def __init__(
+        self, detail: str, *, step: Optional[int] = None,
+        verified_step: Optional[int] = None,
+        device: Optional[str] = None, member: Optional[int] = None,
+        mode: str = "spot",
+    ) -> None:
+        parts = [detail]
+        if step is not None:
+            parts.append(f"step={step}")
+        if device is not None:
+            parts.append(f"device={device}")
+        if member is not None:
+            parts.append(f"member={member}")
+        parts.append(f"verified_step={verified_step}")
+        super().__init__("; ".join(parts))
+        self.detail = detail
+        self.step = step
+        self.verified_step = verified_step
+        self.device = device
+        self.member = member
+        self.mode = mode
+
+
+# ------------------------------------------------------------ resolvers
+
+
+def resolve_sdc(settings=None) -> dict:
+    """Resolve the screening posture: ``{"mode", "every"}`` from
+    ``GS_SDC_CHECK``/``GS_SDC_EVERY`` (env wins) over the optional
+    ``sdc_check``/``sdc_every`` settings keys. Invalid values fail
+    loudly — a typo'd screening mode must not silently mean "off"."""
+    mode = env_str("GS_SDC_CHECK", "").strip().lower()
+    if not mode:
+        mode = str(getattr(settings, "sdc_check", "") or "").strip().lower()
+    mode = mode or "off"
+    if mode not in _MODES:
+        raise ValueError(
+            f"GS_SDC_CHECK={mode!r} is not one of {'/'.join(_MODES)}"
+        )
+    if env_raw("GS_SDC_EVERY") is not None:
+        every = env_int("GS_SDC_EVERY")
+    else:
+        every = int(getattr(settings, "sdc_every", 0) or 0) or 1
+    if every < 1:
+        raise ValueError(f"GS_SDC_EVERY={every} must be >= 1")
+    return {"mode": mode, "every": every}
+
+
+def resolve_fault_device(settings=None) -> Optional[str]:
+    """Device name the injected ``sdc`` chaos fault targets
+    (``GS_FAULT_DEVICE``, e.g. ``cpu:5``), or None for the default
+    (highest-id device owning a shard)."""
+    name = env_str("GS_FAULT_DEVICE", "").strip()
+    return name or None
+
+
+def device_name(dev) -> str:
+    """Canonical device name used everywhere attribution/quarantine
+    speaks about hardware: ``<platform>:<id>`` (matches
+    ``device_memory_stats``)."""
+    return f"{dev.platform}:{dev.id}"
+
+
+def resolve_blocklist() -> frozenset:
+    """The quarantined-device set: ``GS_DEVICE_BLOCKLIST`` (comma-
+    separated names) union'd with the fleet KV ``quarantine/*`` docs
+    when a serve fleet namespace is armed (``GS_SERVE_FLEET_DIR``) —
+    one worker's attribution quarantines the device fleet-wide. Fast
+    empty-frozenset path when neither source is set."""
+    names = {
+        tok.strip()
+        for tok in env_str("GS_DEVICE_BLOCKLIST", "").split(",")
+        if tok.strip()
+    }
+    fleet = env_str("GS_SERVE_FLEET_DIR", "")
+    if fleet:
+        try:
+            from ..serve.cluster import FleetKV
+
+            kv = FleetKV(fleet)
+            for key in kv.keys("quarantine"):
+                doc = kv.get(QUARANTINE_PREFIX + key)
+                if isinstance(doc, dict) and doc.get("device"):
+                    names.add(str(doc["device"]))
+        except OSError:
+            pass  # unreadable namespace: env blocklist still applies
+    return frozenset(names)
+
+
+def quarantine_device(
+    name: str, *, journal=None, step: Optional[int] = None,
+    reason: str = "",
+) -> None:
+    """Quarantine ``name``: extend ``GS_DEVICE_BLOCKLIST`` in this
+    process's environment (in-process supervisor restarts and child
+    launches both inherit it), publish a fleet KV quarantine doc when
+    serving (any worker's screener protects the whole fleet), and
+    journal a ``device_quarantined`` event."""
+    current = [
+        tok.strip()
+        for tok in env_str("GS_DEVICE_BLOCKLIST", "").split(",")
+        if tok.strip()
+    ]
+    if name not in current:
+        current.append(name)
+        os.environ["GS_DEVICE_BLOCKLIST"] = ",".join(current)
+    fleet = env_str("GS_SERVE_FLEET_DIR", "")
+    if fleet:
+        try:
+            from ..serve.cluster import FleetKV
+
+            kv = FleetKV(fleet)
+            key = QUARANTINE_PREFIX + name.replace(":", "_")
+            if kv.get(key) is None:
+                # First verdict wins: a re-quarantine must not clobber
+                # the original attribution's provenance.
+                kv.put(key, {
+                    "device": name,
+                    "reason": reason,
+                    "step": step,
+                    "t": round(time.time(), 3),
+                })
+        except OSError:
+            pass  # env blocklist above is the durable-enough fallback
+    if journal is not None:
+        journal.record(
+            event="device_quarantined", kind="sdc", device=name,
+            step=step, reason=reason,
+        )
+
+
+def usable_devices(platform: Optional[str] = None) -> list:
+    """The device inventory minus the quarantine set — what mesh
+    construction, reshape targeting and the supervisor's exhaustion
+    check may actually use."""
+    import jax
+
+    blocked = resolve_blocklist()
+    devices = jax.devices(platform) if platform else jax.devices()
+    if not blocked:
+        return list(devices)
+    return [d for d in devices if device_name(d) not in blocked]
+
+
+def feasible_dims(
+    max_blocks: int, L: int,
+) -> Optional[Tuple[int, int, int]]:
+    """The largest ``n <= max_blocks`` whose balanced factorization
+    decomposes an ``L``-cube with every block owning true-domain cells,
+    as mesh dims — the reshape-away target when quarantine shrinks the
+    inventory to an awkward count (7 devices cannot split L=32; 4
+    can). None when even one block is infeasible (never for L >= 1)."""
+    from ..parallel.domain import CartDomain
+
+    for n in range(max_blocks, 0, -1):
+        try:
+            return CartDomain.create(n, L).dims
+        except ValueError:
+            continue
+    return None
+
+
+# ---------------------------------------------------------- attribution
+
+
+def bisect_failing(
+    items: Sequence, healthy: Callable[[Tuple], bool],
+) -> List:
+    """Group-test localization over **disjoint subsets**: return every
+    item of ``items`` implicated by the predicate, probing
+    ``healthy(subset)`` on recursively halved disjoint subsets — a
+    single faulty device costs O(log n) probes instead of n. ``healthy``
+    must be monotone (a subset containing no faulty item reports
+    True)."""
+    items = tuple(items)
+    if not items:
+        return []
+    if healthy(items):
+        return []
+    if len(items) == 1:
+        return [items[0]]
+    mid = len(items) // 2
+    return (
+        bisect_failing(items[:mid], healthy)
+        + bisect_failing(items[mid:], healthy)
+    )
+
+
+def _bits(a: np.ndarray) -> np.ndarray:
+    """The array's raw bit pattern as unsigned words — bitwise
+    comparison that treats NaN payloads exactly (``!=`` would mark
+    equal NaNs as diverged and identical bits as converged is all we
+    need)."""
+    a = np.ascontiguousarray(a)
+    return a.view(np.dtype(f"uint{a.dtype.itemsize * 8}"))
+
+
+# -------------------------------------------------------------- screener
+
+
+class Screener:
+    """The boundary-time redundant-compute screen.
+
+    Protocol (driven by ``driver.py`` at each write boundary, **before**
+    any poison faults and before the boundary's stores are written so a
+    detection unwinds without persisting a corrupt byte):
+
+    1. ``check(step)`` — on every ``every``-th boundary, replay the
+       rounds since the anchor via ``Simulation.replay_fields`` (a
+       non-donating twin of the live runner; ``shadow`` mode places it
+       on a rotated device permutation) and compare the in-graph
+       per-field checksums. Equal: journal ``sdc_check`` and advance
+       ``verified_step``. Unequal: attribute and raise
+       :class:`SDCError`.
+    2. ``rearm(step)`` — retain a fresh device-side copy of the live
+       fields as the next anchor. Called after the boundary's chaos
+       poisons so an injected ``nan``/``drift`` never masquerades as
+       compute-path SDC.
+
+    Bitwise transparency: the screener only ever *reads* the live
+    buffers (the anchor is the same +0-copy idiom as
+    ``snapshot_async``), so a screened run's trajectory and stores are
+    byte-identical to ``GS_SDC_CHECK=off`` — asserted across the model
+    x kernel x precision matrix in tier-1.
+    """
+
+    def __init__(
+        self, sim, *, mode: str = "spot", every: int = 1,
+        journal=None, log: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        if mode not in _MODES or mode == "off":
+            raise ValueError(f"Screener mode {mode!r}")
+        self.mode = mode
+        self.every = max(1, int(every))
+        self.journal = journal
+        self.log = log
+        self.checks = 0
+        self.mismatches = 0
+        self.verified_step: Optional[int] = None
+        #: Set when shadow mode degraded to same-placement replay
+        #: because the sim only has one device to run on.
+        self.shadow_degraded = False
+        self._bind(sim)
+
+    def _bind(self, sim) -> None:
+        self.sim = sim
+        self._anchor: Optional[Tuple[int, tuple]] = None
+        self._boundaries = 0
+        self._ck_fn = None
+        self._shadow: Optional[list] = None
+        if self.mode == "shadow":
+            devs = self._devices()
+            if len(devs) > 1:
+                self._shadow = devs[1:] + devs[:1]
+                self.shadow_degraded = False
+            else:
+                self.shadow_degraded = True
+
+    def rebind(self, sim) -> None:
+        """Adopt a new Simulation (the driver swapped it via
+        ``reshape_live``) — anchors, jitted probes and the shadow
+        permutation are all placement-specific and rebuilt lazily."""
+        self._bind(sim)
+
+    def _devices(self) -> list:
+        mesh = getattr(self.sim, "mesh", None)
+        if mesh is not None:
+            return list(mesh.devices.flat)
+        return [self.sim.device]
+
+    def _checksums(self, fields) -> tuple:
+        import jax
+
+        fn = self._ck_fn
+        if fn is None:
+            probe = self.sim._checksum_probe_fn()
+
+            def run(*fs):
+                return probe(*fs)
+
+            fn = self._ck_fn = jax.jit(run)
+        return tuple(np.asarray(c) for c in fn(*fields))
+
+    def rearm(self, step: int) -> None:
+        """Retain the live fields (fresh non-donated device copies) as
+        the anchor the next check replays from."""
+        self._anchor = (int(step), self.sim.retain_fields())
+
+    def describe(self) -> dict:
+        return {
+            "mode": self.mode,
+            "every": self.every,
+            "checks": self.checks,
+            "mismatches": self.mismatches,
+            "verified_step": self.verified_step,
+            "shadow_degraded": self.shadow_degraded,
+        }
+
+    def check(self, step: int) -> bool:
+        """Screen this boundary. Returns True when a replay comparison
+        actually ran (cadence due and an anchor existed), False when
+        skipped. Raises :class:`SDCError` on mismatch."""
+        step = int(step)
+        self._boundaries += 1
+        if self._anchor is None:
+            return False
+        if self._boundaries % self.every:
+            return False
+        a_step, a_fields = self._anchor
+        nsteps = step - a_step
+        if nsteps <= 0:
+            return False
+        replay = self.sim.replay_fields(
+            a_fields, a_step, nsteps, devices=self._shadow,
+        )
+        live_ck = self._checksums(self.sim.fields)
+        rep_ck = self._checksums(replay)
+        self.checks += 1
+        if all(
+            np.array_equal(a, b) for a, b in zip(live_ck, rep_ck)
+        ):
+            self.verified_step = step
+            if self.journal is not None:
+                self.journal.record(
+                    event="sdc_check", step=step, mode=self.mode,
+                    replayed_steps=nsteps, status="ok",
+                )
+            return True
+        self.mismatches += 1
+        device, member, diverged = self._attribute(replay, live_ck, rep_ck)
+        detail = (
+            f"SDC screen ({self.mode}) mismatch: replay of "
+            f"{nsteps} step(s) from verified anchor at step {a_step} "
+            f"disagrees with the live trajectory "
+            f"({diverged} diverging word(s) localized)"
+        )
+        if self.journal is not None:
+            self.journal.record(
+                event="sdc_mismatch", kind="sdc", step=step,
+                mode=self.mode, device=device, member=member,
+                replayed_steps=nsteps,
+                verified_step=self.verified_step,
+            )
+        if self.log is not None:
+            self.log(
+                f"SDC mismatch at step {step} attributed to "
+                f"device={device} member={member}"
+            )
+        raise SDCError(
+            detail, step=step, verified_step=self.verified_step,
+            device=device, member=member, mode=self.mode,
+        )
+
+    # -- attribution ----------------------------------------------------
+
+    def _attribute(
+        self, replay, live_ck, rep_ck,
+    ) -> Tuple[Optional[str], Optional[int], int]:
+        """Localize the mismatch: ``(device, member, n_diff_words)``.
+
+        Member (ensemble): the per-member checksum vectors disagree at
+        the diverging members' rows — no extra device work.
+
+        Device: pull the diverging shards to the host lazily, bisect
+        over disjoint device subsets (:func:`bisect_failing` — a
+        deterministic per-device fault implicates its subset in every
+        probe), then take the blast center among the implicated
+        devices: the one owning the most diverging words."""
+        member: Optional[int] = None
+        rows = set()
+        for a, b in zip(live_ck, rep_ck):
+            if a.shape and a.shape == b.shape:
+                rows.update(int(i) for i in np.nonzero(a != b)[0])
+        if rows:
+            member = min(rows)
+
+        live = self.sim.fields
+        rep_host = [np.asarray(r) for r in replay]
+        shards: Dict[str, list] = {}
+        for fi, f in enumerate(live):
+            for sh in f.addressable_shards:
+                shards.setdefault(device_name(sh.device), []).append(
+                    (fi, sh)
+                )
+        pulled: Dict[int, np.ndarray] = {}
+
+        def diff_words(fi: int, sh) -> int:
+            key = id(sh)
+            if key not in pulled:
+                idx = (
+                    sh.index if isinstance(sh.index, tuple)
+                    else (sh.index,)
+                )
+                a = _bits(np.asarray(sh.data))
+                b = _bits(rep_host[fi][idx])
+                pulled[key] = (a != b)
+            return int(pulled[key].sum())
+
+        def healthy(subset) -> bool:
+            return all(
+                diff_words(fi, sh) == 0
+                for dev in subset
+                for fi, sh in shards[dev]
+            )
+
+        failing = bisect_failing(tuple(sorted(shards)), healthy)
+        if not failing:
+            return None, member, 0
+        counts = {
+            dev: sum(diff_words(fi, sh) for fi, sh in shards[dev])
+            for dev in failing
+        }
+        total = sum(counts.values())
+        device = sorted(
+            failing, key=lambda d: (-counts[d], d)
+        )[0]
+        return device, member, total

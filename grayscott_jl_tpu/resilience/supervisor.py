@@ -296,7 +296,15 @@ def classify_failure(exc: BaseException) -> Optional[str]:
     """
     from ..io.async_writer import AsyncIOError
     from .integrity import CorruptionError
+    from .sdc import SDCError
 
+    if isinstance(exc, SDCError):
+        # Compute-path silent corruption caught by the redundant-compute
+        # screener (``resilience/sdc.py``): restartable from the last
+        # *verified* checkpoint. supervise() owns the escalation —
+        # repeated attribution to the SAME device is a deterministic
+        # fault, quarantined rather than retried forever.
+        return "sdc"
     if isinstance(exc, PreemptionError):
         # GracefulShutdown is a PreemptionError too: same taxonomy slot,
         # but supervise() re-raises it without an in-process restart.
@@ -351,7 +359,9 @@ def _corruption_signature(exc: BaseException):
     return (getattr(exc, "step", None), None, None)
 
 
-def latest_durable_checkpoint(settings) -> Optional[int]:
+def latest_durable_checkpoint(settings,
+                              max_step: Optional[int] = None
+                              ) -> Optional[int]:
     """Simulation step of the latest *complete* checkpoint entry, or
     None. Checkpoints are always BP-lite stores
     (``io/checkpoint.py`` pins ``prefer_adios2=False``), and the
@@ -364,6 +374,11 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
     multi-host quorum: a crash mid-boundary (some members saved, some
     not) rolls the whole ensemble back to the last step every member
     holds.
+
+    ``max_step`` caps the answer at the last *verified* boundary: the
+    SDC recovery path must not resume from a durable-but-unscreened
+    entry written after the screener's last clean check
+    (``resilience/sdc.py``).
     """
     if not settings.checkpoint:
         return None
@@ -375,7 +390,8 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
 
         steps = [
             latest_durable_step_replicated(
-                member_path(settings.checkpoint_output, i, ens.n)
+                member_path(settings.checkpoint_output, i, ens.n),
+                max_step=max_step,
             )
             for i in range(ens.n)
         ]
@@ -385,7 +401,8 @@ def latest_durable_checkpoint(settings) -> Optional[int]:
     # Per store, the best step ANY replica serves (docs/RESILIENCE.md
     # "Data integrity"): a half-written or quarantined primary entry
     # must not drag the resume point down while a mirror holds it.
-    return latest_durable_step_replicated(settings.checkpoint_output)
+    return latest_durable_step_replicated(settings.checkpoint_output,
+                                          max_step=max_step)
 
 
 def _resolved_language(settings) -> str:
@@ -442,6 +459,10 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
     attempt = 0
     degraded: Optional[dict] = None
     corrupt_seen: set = set()
+    # Devices the SDC screener has attributed a mismatch to, once: a
+    # second attribution to the same device within this supervision is
+    # a deterministic compute fault, not a cosmic ray — quarantine.
+    sdc_seen: set = set()
 
     def _agree(resume_local: Optional[int]):
         """Quorum (attempt, restart step) across hosts; single-process
@@ -573,11 +594,58 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
                     raise
                 corrupt_seen.add(sig)
 
+            sdc_actions: list = []
+            sdc_scratch = False
+            if kind == "sdc":
+                # Compute-path SDC ladder (docs/RESILIENCE.md "Silent
+                # data corruption"): first mismatch attributed to a
+                # device → restart from the last VERIFIED checkpoint
+                # (a transient upset replays clean); a SECOND mismatch
+                # attributed to the SAME device → deterministic fault,
+                # quarantine it so the restarting attempt's device
+                # selection (and every fleet peer) excludes it.
+                from .sdc import quarantine_device, usable_devices
+
+                dev = getattr(exc, "device", None)
+                if dev is not None and dev in sdc_seen:
+                    quarantine_device(
+                        dev, journal=journal,
+                        step=getattr(exc, "step", None),
+                        reason="repeated SDC attribution to this device",
+                    )
+                    sdc_actions.append(f"quarantined_{dev}")
+                    if not usable_devices():
+                        journal.record(
+                            event="gave_up", kind=kind, attempt=attempt,
+                            error=f"{type(exc).__name__}: {exc}",
+                            reason="every device quarantined — no "
+                                   "compute inventory left to restart on",
+                        )
+                        raise
+                elif dev is not None:
+                    sdc_seen.add(dev)
+                verified = getattr(exc, "verified_step", None)
+                if verified is None:
+                    # Nothing this attempt wrote was ever screened —
+                    # the trajectory restarts from scratch (or from the
+                    # operator's own configured restart point).
+                    sdc_scratch = True
+                    sdc_actions.append("no_verified_boundary")
+
             # Cluster consensus BEFORE the budget check: the adopted
             # attempt counter is the cluster max, so GS_MAX_RESTARTS
             # bounds the whole cluster, not each rank independently.
             try:
-                resume = _agree(latest_durable_checkpoint(settings))
+                if kind == "sdc":
+                    resume_local = (
+                        None if sdc_scratch else latest_durable_checkpoint(
+                            settings,
+                            max_step=getattr(exc, "verified_step", None),
+                        )
+                    )
+                else:
+                    resume_local = latest_durable_checkpoint(settings)
+                resume = _agree(resume_local)
             except rdv_mod.RendezvousTimeout as e:
                 journal.record(
                     event="gave_up", kind=kind, attempt=attempt,
@@ -595,7 +663,7 @@ def supervise(settings, *, n_devices: Optional[int] = None, seed: int = 0,
                 )
                 raise
 
-            actions = []
+            actions = sdc_actions
             if kind == "kernel":
                 lang = _resolved_language(settings)
                 if lang in ("pallas", "auto"):

@@ -74,6 +74,10 @@ DEFAULT_DEADLINES: Dict[str, float] = {
     "checkpoint": 600.0,
     "collective": 300.0,
     "probe_loop": 360.0,
+    # A live reshape (reshard/restore.reshape_live) pays a target-mesh
+    # compile plus the device-path move — budget it like a compile
+    # (GS_WATCHDOG_RESHAPE_S overrides).
+    "reshape": 1800.0,
 }
 
 

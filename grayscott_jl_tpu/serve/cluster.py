@@ -205,6 +205,7 @@ class ClusterScheduler(Scheduler):
         self._member_doc = {
             "member": self.member_id, "role": role, "pid": os.getpid(),
             "host": socket.gethostname(), "port": None,
+            "degraded": None,
             "t": time.time(),
         }
         self._kv.put(f"members/{self.member_id}", self._member_doc)
@@ -404,6 +405,8 @@ class ClusterScheduler(Scheduler):
             time.sleep(0.05)
 
     def _adopt_resume(self) -> Optional[Batch]:
+        if self.degraded:
+            return None
         for bid in self._kv.keys("resume"):
             doc = self._kv.get(f"resume/{bid}")
             if doc is None:
@@ -423,7 +426,22 @@ class ClusterScheduler(Scheduler):
             return batch
         return None
 
+    def mark_degraded(self, reason: str = "") -> None:
+        """Cluster form: publish the degraded flag in this member's
+        doc (the heartbeat keeps republishing it) and stop claiming
+        work — healthy fleet peers drain the queue instead. Leased
+        batches this member already holds stay leased; a crash expires
+        them into the normal failover."""
+        super().mark_degraded(reason)
+        self._member_doc["degraded"] = self.degraded
+        self._kv.put(f"members/{self.member_id}", self._member_doc)
+
     def _claim_fresh(self) -> Optional[Batch]:
+        if self.degraded:
+            # Suspect compute must not claim fresh work (or adopt a
+            # peer's failover — next_batch checks there too): the
+            # queue drains through healthy members.
+            return None
         head_doc = head_qkey = None
         for qkey in self._kv.keys("queue"):
             marker = self._kv.get(f"queue/{qkey}")

@@ -297,6 +297,11 @@ class Scheduler:
         self._seq = 0
         self._batch_seq = 0
         self._closed = False
+        #: Set by mark_degraded (SDC quarantine, ``resilience/sdc.py``):
+        #: this process's compute inventory is suspect. The solo
+        #: scheduler only records it (no peers to route to); the
+        #: cluster scheduler stops claiming fresh work.
+        self.degraded: Optional[str] = None
         self._chaos_pending = cfg.chaos.strip()
         self._unsubscribe = None
         self.cache: Optional[cache_mod.ResultCache] = None
@@ -744,6 +749,14 @@ class Scheduler:
                 for j in self.jobs.values()
             )
 
+    def mark_degraded(self, reason: str = "") -> None:
+        """Record that this process's devices are suspect (an SDC
+        classification the supervisor could not recover in place,
+        ``resilience/sdc.py``). The base scheduler only echoes it —
+        with no peers there is nobody else to serve the queue."""
+        self.degraded = reason or "degraded"
+        self.events.emit("worker_degraded", reason=self.degraded)
+
     def describe(self) -> dict:
         with self._cond:
             states: Dict[str, int] = {}
@@ -754,5 +767,6 @@ class Scheduler:
                 "resume_batches": len(self._resume),
                 "jobs": states,
                 "batches": len(self.batches),
+                "degraded": self.degraded,
                 "config": self.cfg.describe(),
             }

@@ -213,6 +213,15 @@ class WorkerFleet:
         except BaseException as exc:  # noqa: BLE001 — classified below
             kind = classify_failure(exc)
             label = kind or f"fatal:{type(exc).__name__}"
+            if kind == "sdc":
+                # Compute-path corruption that escaped the supervisor's
+                # in-place recovery (or ran unsupervised): this
+                # process's devices are suspect. Mark the member
+                # degraded BEFORE requeueing so the batch lands on a
+                # healthy fleet peer, not straight back here.
+                self.scheduler.mark_degraded(
+                    f"sdc: {type(exc).__name__}: {exc}"
+                )
             if kind is not None and batch.attempt < (
                 self.cfg.max_requeues
             ):

@@ -230,6 +230,18 @@ def select_devices(platform: str):
             f"devices are available: {e}"
         ) from e
     _reached_platforms.add(platform)
+    from .resilience.sdc import device_name, resolve_blocklist
+
+    blocked = resolve_blocklist()
+    if blocked:
+        kept = [d for d in devices if device_name(d) not in blocked]
+        if not kept:
+            raise RuntimeError(
+                f"all {len(devices)} {platform} devices are "
+                "quarantined (GS_DEVICE_BLOCKLIST / fleet quarantine "
+                "docs) — no compute inventory left"
+            )
+        devices = kept
     return devices
 
 
@@ -571,6 +583,21 @@ class Simulation:
                     f"{backend} devices available"
                 )
             devices = devices[:n_devices]
+        elif len(devices) > 1:
+            from .resilience.sdc import resolve_blocklist
+
+            if resolve_blocklist():
+                # Quarantine shrank the inventory to a count that may
+                # not decompose this L (7 devices cannot split a
+                # 32-cube): trim to the largest feasible prefix rather
+                # than failing a restart the quarantine itself forced.
+                for k in range(len(devices), 0, -1):
+                    try:
+                        self._make_domain(devices[:k])
+                    except ValueError:
+                        continue
+                    devices = devices[:k]
+                    break
 
         self.domain = self._make_domain(devices)
         self.sharded = self.domain.n_blocks > 1
@@ -940,6 +967,11 @@ class Simulation:
         self.executables: list = []
         self._runners: Dict[int, object] = {}
         self._snapshot_fns: Dict[Tuple[bool, bool], object] = {}
+        #: Non-donating replay twins of the runners, keyed by
+        #: (nsteps, device permutation) — the SDC screening seam
+        #: (resilience/sdc.py). Separate cache: a donating runner would
+        #: consume the retained anchor buffers it must preserve.
+        self._replay_fns: Dict[tuple, tuple] = {}
 
         self._build_mesh(devices, backend)
         #: The model's field arrays, declaration order (a tuple — the
@@ -1556,20 +1588,21 @@ class Simulation:
 
         return run_chain_rounds(chain, fuse, fields)
 
-    def _runner(self, nsteps: int):
-        """Compiled ``nsteps``-step advance, cached per nsteps."""
-        fn = self._runners.get(nsteps)
-        if fn is not None:
-            return fn
-
+    def _make_step_fn(self, nsteps: int, mesh=None):
+        """The un-jitted ``nsteps``-step advance — one construction
+        shared by :meth:`_runner` (jitted WITH field donation, the live
+        path) and :meth:`replay_fields` (jitted without donation,
+        optionally on a permuted ``mesh`` — the SDC screening path), so
+        replay runs the very same traced program as the trajectory it
+        checks."""
         local = partial(self._local_run, nsteps=nsteps)
         nf = self.model.n_fields
         if self.sharded:
             spec = P(*AXIS_NAMES)
             rep = P()
-            fn = shard_map(
+            return shard_map(
                 local,
-                mesh=self.mesh,
+                mesh=self.mesh if mesh is None else mesh,
                 in_specs=(spec,) * nf + (rep, rep, rep),
                 out_specs=(spec,) * nf,
                 # pallas_call outputs carry no varying-mesh-axes metadata;
@@ -1577,9 +1610,18 @@ class Simulation:
                 # explicit here; flag spelling is version-dependent).
                 **{_SHARD_MAP_CHECK_FLAG: False},
             )
-        else:
-            fn = local
-        fn = jax.jit(fn, donate_argnums=tuple(range(nf)))
+        return local
+
+    def _runner(self, nsteps: int):
+        """Compiled ``nsteps``-step advance, cached per nsteps."""
+        fn = self._runners.get(nsteps)
+        if fn is not None:
+            return fn
+
+        nf = self.model.n_fields
+        fn = jax.jit(
+            self._make_step_fn(nsteps), donate_argnums=tuple(range(nf))
+        )
         return self._register_runner(nsteps, fn)
 
     def _register_runner(self, nsteps: int, fn):
@@ -1617,6 +1659,108 @@ class Simulation:
             *self.fields, self.base_key, jnp.int32(self.step), self.params
         ).compile()
         self._runners[nsteps] = compiled
+
+    # ------------------------------------------------------------- replay
+    # The redundant-compute seam behind resilience/sdc.py: re-run rounds
+    # from a retained anchor WITHOUT donating or advancing the live
+    # state, optionally on a permuted device placement (shadow mode).
+
+    def retain_fields(self) -> tuple:
+        """Fresh non-donated device copies of the live fields — the SDC
+        screener's boundary anchor. Same +0-copy idiom as
+        :meth:`snapshot_async` (no D2H, no aliasing with the donated
+        runner buffers), so retaining is bitwise-transparent to the
+        trajectory."""
+        return self._copy_fields(self.fields)
+
+    def _copy_fields(self, fields) -> tuple:
+        """Fresh non-donated device copies of a field tuple (sharding
+        preserved)."""
+        fn = getattr(self, "_retain_fn", None)
+        if fn is None:
+
+            def copy(*fields):
+                return tuple(f + jnp.zeros((), f.dtype) for f in fields)
+
+            fn = self._retain_fn = jax.jit(copy)
+        return tuple(fn(*fields))
+
+    def _replay_arg_shardings(self, mesh):
+        """Shardings for (base_key, params) when the replay runs on an
+        alternate mesh — both replicated for the spatial engine (the
+        ensemble engine member-shards them)."""
+        rep = NamedSharding(mesh, P())
+        return rep, rep
+
+    def replay_fields(
+        self, fields, step0: int, nsteps: int, devices=None,
+    ) -> tuple:
+        """Recompute ``nsteps`` steps from ``fields`` (the state at
+        absolute step ``step0``) and return the resulting field tuple,
+        leaving the live state untouched.
+
+        The replay jits the SAME step construction as :meth:`iterate`
+        (:meth:`_make_step_fn`) *with the same donation signature*:
+        XLA:CPU's FP-contraction decisions are donation-sensitive (a
+        non-donating twin of the donating live runner drifts 1 ulp in
+        the Pallas overlap bands), so the replay donates fresh copies
+        of the anchor — never the caller's retained buffers — and the
+        compiled program is the live executable bit for bit. With that,
+        bitwise determinism — noise keyed by (key, absolute step,
+        global cell), exchange schedule fixed — makes replay-vs-live an
+        exact equality on any placement. ``devices`` optionally
+        rebuilds the mesh over a permuted device assignment of the same
+        shape (SDC shadow mode: a deterministic per-core fault cannot
+        self-confirm); inputs are device_put onto the permuted sharding
+        first."""
+        if nsteps <= 0:
+            return tuple(fields)
+        if devices is None:
+            # Same-placement (spot) replay IS the live runner: the one
+            # compiled executable serves both, so spot screening pays
+            # recompute only — no twin compile — and replay-equals-live
+            # is the executable's own determinism, not a compiler
+            # coincidence.
+            fn, sharding, device = self._runner(nsteps), None, None
+        else:
+            key = (int(nsteps), tuple(d.id for d in devices))
+            entry = self._replay_fns.get(key)
+            if entry is None:
+                mesh = None
+                sharding = None
+                device = None
+                if self.mesh is not None:
+                    mesh = Mesh(
+                        np.array(devices).reshape(self.mesh.devices.shape),
+                        self.mesh.axis_names,
+                    )
+                    sharding = NamedSharding(mesh, self.field_sharding.spec)
+                else:
+                    device = devices[0]
+                # Donation mirrors the live runner: XLA:CPU codegen is
+                # donation-sensitive (a non-donating twin drifts 1 ulp
+                # in the Pallas overlap bands).
+                fn = jax.jit(
+                    self._make_step_fn(nsteps, mesh),
+                    donate_argnums=tuple(range(self.model.n_fields)),
+                )
+                entry = self._replay_fns[key] = (fn, sharding, device)
+            fn, sharding, device = entry
+        base_key, params = self.base_key, self.params
+        # The donated field args must be fresh buffers: a bisection
+        # replays from one anchor several times, and device_put onto an
+        # unchanged sharding is an alias, not a copy.
+        fields = self._copy_fields(fields)
+        if sharding is not None:
+            fields = tuple(jax.device_put(f, sharding) for f in fields)
+            kck, pck = self._replay_arg_shardings(sharding.mesh)
+            base_key = jax.device_put(base_key, kck)
+            params = jax.device_put(params, pck)
+        elif device is not None:
+            fields = tuple(jax.device_put(f, device) for f in fields)
+            base_key = jax.device_put(base_key, device)
+            params = jax.device_put(params, device)
+        return tuple(fn(*fields, base_key, jnp.int32(step0), params))
 
     # ---------------------------------------------------------------- public
 
@@ -1881,6 +2025,74 @@ class Simulation:
         self.fields = (
             self.fields[:i] + (scaled,) + self.fields[i + 1:]
         )
+
+    def _sdc_site(self, arr, device=None):
+        """``(device_name, global index)`` for the ``sdc`` poison: the
+        center cell of the target device's shard, so the flip lands
+        squarely inside one device's block and diffusion keeps the
+        divergence centered there over a short screening window —
+        the attribution's blast-center rule sees a clean signal.
+        Default target: the highest-id device owning a shard."""
+        by_name = {}
+        for sh in arr.addressable_shards:
+            d = sh.device
+            by_name[f"{d.platform}:{d.id}"] = sh
+        if device is None:
+            name = max(
+                by_name,
+                key=lambda n: (
+                    n.rsplit(":", 1)[0], int(n.rsplit(":", 1)[1]),
+                ),
+            )
+        else:
+            name = device
+            if name not in by_name:
+                raise ValueError(
+                    f"sdc fault device {name!r} owns no addressable "
+                    f"shard (have: {', '.join(sorted(by_name))})"
+                )
+        sh = by_name[name]
+        idx = sh.index if isinstance(sh.index, tuple) else (sh.index,)
+        index = tuple(
+            (sl.start or 0) + ((sl.stop or g) - (sl.start or 0)) // 2
+            for sl, g in zip(idx, arr.shape)
+        )
+        return name, index
+
+    def poison_sdc(self, device=None, field="u") -> str:
+        """Chaos/testing hook (``resilience/faults.py`` kind ``sdc``):
+        XOR the lowest bit of ONE LIVE cell in the shard owned by the
+        named device, BEFORE the round runs — a compute-path fault
+        model. The corrupted value is an *input* to the step program,
+        so the trajectory diverges from a clean replay and SDC
+        screening must detect it and attribute it back to this device.
+        Contrast PR 14's snapshot-copy ``bitflip``, which corrupts
+        write-path bytes only and must stay invisible to SDC checks
+        (asserted in tier-1). Returns the poisoned device's name for
+        the injection record.
+
+        The flip hits the mantissa MSB of the storage word (bit 6 of a
+        2-byte word, bit 22 of a 4-byte one): a lowest-bit flip at a
+        flat-region cell is diffusively absorbed below one ulp within
+        a round, while real SDC flips arbitrary bits — the screening
+        contract targets persistent wrong answers. The flipped value
+        stays finite (mantissa-only), so the health guard stays green
+        and only screening can catch it."""
+        from .resilience.integrity import apply_bitflip
+
+        i = self._field_index(field)
+        arr = self.fields[i]
+        name, index = self._sdc_site(arr, device)
+        bit = 6 if jnp.dtype(arr.dtype).itemsize == 2 else 22
+        flipped = apply_bitflip(arr, index, bit=bit)
+        if getattr(self, "field_sharding", None) is not None:
+            # The scatter's jit can hand back a resharded (replicated)
+            # output; the live state must keep the mesh sharding.
+            flipped = jax.device_put(flipped, self.field_sharding)
+        self.fields = (
+            self.fields[:i] + (flipped,) + self.fields[i + 1:]
+        )
+        return name
 
     def local_blocks(self):
         """Per-addressable-shard ``(offsets, sizes, *field_blocks)``

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Chaos smoke test, ten scenarios (1-3 against one uninterrupted
+# Chaos smoke test, eleven scenarios (1-3 against one uninterrupted
 # solo reference run, 4 against an uninterrupted ensemble run, 5
 # elastic — resume on a DIFFERENT mesh / member count than the kill,
 # 6 serve — a worker killed mid-batch under the service front door,
@@ -8,7 +8,8 @@
 # checkpoint, 9 fleet — a front-door replica AND a leaseholding
 # worker process SIGKILLed mid-load under the distributed fleet,
 # 10 serve elastic — live in-job grow+shrink reshapes under load with
-# a worker SIGKILLed mid-reshape):
+# a worker SIGKILLed mid-reshape, 11 SDC — a device silently computing
+# wrong answers is caught, attributed, and quarantined):
 #
 #   1. injected preemption at a pseudo-random step -> supervised
 #      restart -> all stores byte-identical; runs with full
@@ -82,7 +83,20 @@
 #      identical to an uninterrupted no-reshape service run — raw
 #      bytes for the globally-written .vtk series, served-value
 #      bitwise for the mesh-changed .bp stores (the scenario-5
-#      equality fine print).
+#      equality fine print);
+#  11. silent data corruption (docs/RESILIENCE.md "Silent data
+#      corruption"): two seeded kind=sdc faults — compute-path
+#      bitflips into a step INPUT on one named device, the class the
+#      at-rest CRC layer cannot see — under GS_SDC_CHECK=spot and a
+#      supervisor; the boundary replay detects each mismatch with
+#      device attribution (sdc_mismatch on GS_EVENTS), the first
+#      recovery resumes from the last VERIFIED checkpoint, the
+#      same-device repeat QUARANTINES the chip (device_quarantined +
+#      GS_DEVICE_BLOCKLIST) and the restart rebuilds the mesh on the
+#      survivors; the finished stores are content-identical to a
+#      fault-free screened run (served-value bitwise for gs.bp — the
+#      post-quarantine mesh changes the chunk layout — raw bytes for
+#      the globally-written .vtk series).
 #
 # The fault steps are derived deterministically from a seed (crc32,
 # printed below), so a failing run is replayable bit-for-bit:
@@ -1064,7 +1078,94 @@ PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
   exit 1
 }
 
-echo "chaos_smoke: PASS — all ten scenarios recovered byte-identical" \
+echo "chaos_smoke: [11/11] SDC — compute-path bitflip -> detect, attribute, quarantine..."
+# Screening happens at plot/checkpoint boundaries (10/20/.../60,
+# checkpoints at 20/40), so a corrupt step in [21, 29] is caught by the
+# boundary-30 replay and resumed from the VERIFIED checkpoint 20, and
+# the same-device repeat in [41, 49] is caught at 50 and quarantines
+# the chip. Seeded like the other scenarios, printed for replay.
+SDC1="$(python3 -c "import zlib; print(21 + zlib.crc32(b'sdc1:${SEED}') % 9)")"
+SDC2="$(python3 -c "import zlib; print(41 + zlib.crc32(b'sdc2:${SEED}') % 9)")"
+echo "chaos_smoke: seed=${SEED} -> sdc faults at steps ${SDC1} and ${SDC2} on cpu:5"
+mkdir -p "$WORK/sdcref" "$WORK/sdc"
+for d in sdcref sdc; do write_config "$WORK/$d"; done
+# The reference is fault-free but SCREENED the same way: spot screening
+# is bitwise-transparent, so like compares with like.
+run "$WORK/sdcref" \
+  GS_SDC_CHECK=spot \
+  > "$WORK/sdcref.log" 2>&1
+run "$WORK/sdc" \
+  GS_SDC_CHECK=spot \
+  GS_SUPERVISE=1 \
+  GS_MAX_RESTARTS=5 \
+  GS_RESTART_BACKOFF_S=0.05 \
+  GS_EVENTS="$WORK/sdc/events.jsonl" \
+  GS_FAULTS="step=${SDC1}:kind=sdc;step=${SDC2}:kind=sdc" \
+  GS_FAULT_DEVICE=cpu:5 \
+  > "$WORK/sdc.log" 2>&1
+
+grep -aq '"kind": "sdc_mismatch"' "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — the screen never caught the injected SDC" >&2
+  exit 1
+}
+grep -aq '"device": "cpu:5"' "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no attribution to the injected device" >&2
+  exit 1
+}
+grep -aq '"kind": "device_quarantined"' "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — the repeat offender was never quarantined" >&2
+  exit 1
+}
+grep -aq 'resumed_from_checkpoint_step_20' "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — recovery did not resume from the verified checkpoint (20)" >&2
+  exit 1
+}
+grep -aq 'quarantined_cpu:5' "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — no quarantine action on the recovery record" >&2
+  exit 1
+}
+# Stores content-identical to the fault-free screened run: the
+# post-quarantine mesh has fewer devices, so gs.bp is compared on what
+# it SERVES (the scenario-5/10 fine print); the globally-written .vtk
+# series must match raw bytes.
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" \
+  python3 - "$WORK/sdcref" "$WORK/sdc" <<'EOF'
+import filecmp
+import os
+import sys
+
+import numpy as np
+
+from grayscott_jl_tpu.io.bplite import BpReader
+
+ref, chaos = sys.argv[1], sys.argv[2]
+a = BpReader(os.path.join(ref, "gs.bp"))
+b = BpReader(os.path.join(chaos, "gs.bp"))
+assert a.attributes() == b.attributes()
+assert a.num_steps() == b.num_steps(), (a.num_steps(), b.num_steps())
+for i in range(a.num_steps()):
+    for name in a.available_variables():
+        x = np.asarray(a.get(name, step=i))
+        y = np.asarray(b.get(name, step=i))
+        assert x.dtype == y.dtype and np.array_equal(x, y), (name, i)
+va, vb = os.path.join(ref, "gs.vtk"), os.path.join(chaos, "gs.vtk")
+cmp = filecmp.dircmp(va, vb)
+assert not (cmp.left_only or cmp.right_only or cmp.diff_files), vars(cmp)
+assert all(
+    open(os.path.join(va, f), "rb").read()
+    == open(os.path.join(vb, f), "rb").read()
+    for f in cmp.common_files
+), "vtk series not byte-identical"
+print("sdc chaos: detected, attributed, quarantined; stores identical")
+EOF
+PYTHONPATH="${REPO}${PYTHONPATH:+:${PYTHONPATH}}" python3 \
+  "${REPO}/scripts/gs_report.py" --check \
+  --events "$WORK/sdc/events.jsonl" || {
+  echo "chaos_smoke: FAIL — gs_report.py --check rejected the SDC events" >&2
+  exit 1
+}
+
+echo "chaos_smoke: PASS — all eleven scenarios recovered byte-identical" \
      "(journals: sup=$(wc -l < "$WORK/sup/gs.bp.faults.jsonl")" \
      "hang=$(wc -l < "$WORK/hang/gs.bp.faults.jsonl")" \
      "term=$(wc -l < "$WORK/term/gs.bp.faults.jsonl")" \

@@ -47,9 +47,16 @@ if [[ "${1:-}" != "--fast" ]]; then
     # s-step program-identity contract (halo_depth=k bitwise vs
     # GS_FUSE=k*d, all models, interpret mode) and the VMEM
     # feasibility gate (docs/TEMPORAL.md) hold on every push.
+    # test_sdc_run rides along: the compute-path SDC walk — detect,
+    # verified-checkpoint resume, quarantine + reshape, stores
+    # content-identical — plus the screening-off fault-blindness
+    # control (docs/RESILIENCE.md "Silent data corruption"); the
+    # tests/unit leg already carries test_sdc.py's transparency
+    # matrix and supervisor-ladder contracts.
     JAX_PLATFORMS=cpu python -m pytest tests/unit \
         tests/functional/test_integrity_run.py \
-        tests/functional/test_precision_run.py -q -m 'not slow' \
+        tests/functional/test_precision_run.py \
+        tests/functional/test_sdc_run.py -q -m 'not slow' \
         -p no:cacheprovider
 fi
 echo "check.sh: OK"

@@ -106,6 +106,17 @@ EVENT_KIND_SCHEMA = {
     # the fleet, and the content-addressed cache's hit/miss/publish
     # provenance (the digest names the physics; byte-identical replay
     # is the contract).
+    # compute-path SDC screening (resilience/sdc.py,
+    # docs/RESILIENCE.md "Silent data corruption"): every redundant-
+    # compute check (ok or not), a mismatch's device/member
+    # attribution, the quarantine verdict, and a serve member marking
+    # its own inventory suspect. The injected chaos kind (`sdc`) rides
+    # the `injected` record like every other fault.
+    "sdc_check": ("mode", "replayed_steps", "status"),
+    "sdc_mismatch": ("mode", "device", "replayed_steps",
+                     "verified_step"),
+    "device_quarantined": ("device", "reason"),
+    "worker_degraded": ("reason",),
     "worker_join": ("worker", "role"),
     "worker_lost": ("worker",),
     "job_failover": ("job", "tenant", "batch", "worker"),
@@ -686,6 +697,60 @@ def report_integrity(events) -> None:
               f"({attrs.get('detail')})")
 
 
+def report_sdc(events) -> None:
+    """The compute-path SDC story (resilience/sdc.py,
+    docs/RESILIENCE.md "Silent data corruption"): how many redundant-
+    compute screens ran, what they caught, which device got the blame,
+    and whether anything was quarantined — the section an operator
+    checks to answer "did any chip compute a wrong answer?"."""
+    def kind_of(e):
+        return e.get("kind") or e.get("event")
+
+    def attrs_of(e):
+        return e.get("attrs") or e
+
+    checks = [e for e in events if kind_of(e) == "sdc_check"]
+    mismatches = [e for e in events if kind_of(e) == "sdc_mismatch"]
+    quarantines = [e for e in events
+                   if kind_of(e) == "device_quarantined"]
+    degraded = [e for e in events if kind_of(e) == "worker_degraded"]
+    injected = [
+        e for e in events
+        if kind_of(e) == "injected"
+        and (attrs_of(e).get("fault") or attrs_of(e).get("kind")) == "sdc"
+    ]
+    if not (checks or mismatches or quarantines or degraded or injected):
+        return
+    ok = sum(1 for e in checks if attrs_of(e).get("status") == "ok")
+    replayed = sum(
+        (attrs_of(e).get("replayed_steps") or 0) for e in checks
+    )
+    modes = sorted({attrs_of(e).get("mode") for e in checks
+                    if attrs_of(e).get("mode")})
+    print("== sdc ==")
+    print(f"  screens={len(checks)} (ok={ok}, "
+          f"steps replayed={replayed}"
+          f"{', mode ' + '/'.join(modes) if modes else ''}) "
+          f"mismatches={len(mismatches)} "
+          f"quarantines={len(quarantines)} "
+          f"injected faults={len(injected)}")
+    for e in mismatches:
+        a = attrs_of(e)
+        member = a.get("member")
+        print(f"  mismatch step {e.get('step', a.get('step'))} "
+              f"({a.get('mode')}): device {a.get('device')}"
+              f"{', member ' + str(member) if member is not None else ''}"
+              f", last verified step {a.get('verified_step')}")
+    for e in quarantines:
+        a = attrs_of(e)
+        print(f"  quarantined {a.get('device')} "
+              f"at step {e.get('step', a.get('step'))}: "
+              f"{a.get('reason')}")
+    for e in degraded:
+        a = attrs_of(e)
+        print(f"  worker degraded: {a.get('reason')}")
+
+
 def report_timeline(events, top: int) -> None:
     """The fault/recovery story, oldest first, with relative times —
     one chronological timeline; multi-process streams (rank-merged by
@@ -790,6 +855,7 @@ def main() -> int:
         report_tenants(events)
         report_fleet(events)
         report_integrity(events)
+        report_sdc(events)
         report_timeline(events, args.top)
     return 0
 

@@ -1,0 +1,154 @@
+"""Functional: compute-path SDC screening end to end (chaos_smoke
+scenario 11's fast deterministic tier-1 variant; docs/RESILIENCE.md
+"Silent data corruption").
+
+A compute-path bitflip (`kind=sdc` — corruption of a step INPUT, the
+fault the device checksum layer cannot see) is injected twice on the
+same named device under `GS_SDC_CHECK=spot` and a supervisor:
+
+* the first boundary replay detects the mismatch, attributes it to the
+  injected device, and the supervisor restarts from the last *verified*
+  checkpoint — never a later one the screen hasn't cleared;
+* the same-device repeat quarantines the chip (journal verdict +
+  `GS_DEVICE_BLOCKLIST` extension), and the restart rebuilds the mesh
+  on the surviving devices;
+* the run completes with output stores byte-identical to a fault-free
+  run's — recovery never costs an answer.
+"""
+
+import json
+
+from test_async_io import _assert_trees_byte_identical
+from test_end_to_end import REPO, run_cli  # noqa: F401
+from test_reshard_run import _assert_bp_content_identical
+
+CONFIG = """\
+model = "grayscott"
+L = 16
+Du = 0.2
+Dv = 0.1
+F = 0.02
+k = 0.048
+dt = 1.0
+noise = 0.1
+steps = 16
+plotgap = 4
+checkpoint = true
+checkpoint_freq = 4
+checkpoint_output = "ckpt.bp"
+output = "gs.bp"
+precision = "Float32"
+backend = "CPU"
+verbose = true
+"""
+
+
+def _run(tmp_path, name, extra_env):
+    d = tmp_path / name
+    d.mkdir()
+    cfg = d / "config.toml"
+    cfg.write_text(CONFIG)
+    env = {"GS_SDC_CHECK": "spot", "GS_EVENTS": "events.jsonl"}
+    env.update(extra_env)
+    return d, run_cli(d, cfg, extra_env=env)
+
+
+def _events(d):
+    return [
+        json.loads(line)
+        for line in (d / "events.jsonl").read_text().splitlines()
+    ]
+
+
+def test_sdc_detected_quarantined_and_recovered(tmp_path):
+    """The ISSUE's acceptance walk: inject a compute-path bitflip on a
+    named device, watch spot screening catch and attribute it, the
+    supervisor resume from the last verified checkpoint, the repeat
+    quarantine the device and reshape onto survivors, and the finished
+    run match a fault-free run byte for byte."""
+    ref, res = _run(tmp_path, "ref", {})
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    d, res = _run(tmp_path, "chaos", {
+        "GS_FAULTS": "step=6:kind=sdc;step=10:kind=sdc",
+        "GS_FAULT_DEVICE": "cpu:5",
+        "GS_SUPERVISE": "1",
+        "GS_MAX_RESTARTS": "5",
+        "GS_RESTART_BACKOFF_S": "0.01",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+
+    # The post-quarantine mesh has fewer devices, so the BP store's
+    # per-shard chunk layout legitimately differs — the mesh-agnostic
+    # store-equality contract (test_reshard_run) is bitwise-identical
+    # assembled arrays; the globally-written VTK series must match
+    # raw bytes.
+    _assert_bp_content_identical(ref / "gs.bp", d / "gs.bp")
+    _assert_trees_byte_identical(ref / "gs.vtk", d / "gs.vtk")
+
+    events = _events(d)
+    kinds = [e["kind"] for e in events]
+
+    # Both injections fired and both were caught at the next boundary,
+    # attributed to the injected device.
+    injected = [e for e in events
+                if e["kind"] == "injected"
+                and e["attrs"].get("fault") == "sdc"]
+    assert len(injected) == 2
+    mismatches = [e for e in events if e["kind"] == "sdc_mismatch"]
+    assert len(mismatches) == 2
+    assert all(m["attrs"]["device"] == "cpu:5" for m in mismatches)
+    assert [m["step"] for m in mismatches] == [8, 12]
+
+    # First recovery resumed from the last VERIFIED boundary (step 4 —
+    # the fault landed at 6, so 8 is unverifiable), not the latest
+    # durable one; the repeat quarantined the repeat offender.
+    recoveries = [e for e in events if e["kind"] == "recovery"
+                  and e["attrs"].get("fault") == "sdc"]
+    assert len(recoveries) == 2
+    assert recoveries[0]["attrs"]["action"] == (
+        "resumed_from_checkpoint_step_4"
+    )
+    acts = recoveries[1]["attrs"]["action"].split(";")
+    assert "quarantined_cpu:5" in acts
+    assert "resumed_from_checkpoint_step_8" in acts
+    quarantined = [e for e in events if e["kind"] == "device_quarantined"]
+    assert len(quarantined) == 1
+    assert quarantined[0]["attrs"]["device"] == "cpu:5"
+
+    # The post-quarantine attempt ran (and finished) without the bad
+    # chip: a run_start after the quarantine, and a healthy screen
+    # record on the surviving mesh.
+    q_at = kinds.index("device_quarantined")
+    assert "run_start" in kinds[q_at:]
+    checks = [e for e in events[q_at:] if e["kind"] == "sdc_check"]
+    assert checks and all(
+        e["attrs"]["status"] == "ok" for e in checks
+    )
+
+
+def test_sdc_screening_off_is_fault_blind(tmp_path):
+    """The negative control: the same injected fault with screening off
+    sails through undetected — the run 'succeeds' with silently wrong
+    output. This is the exposure the screening tier exists to close
+    (and why the chaos walk above must byte-match the reference)."""
+    ref, res = _run(tmp_path, "ref", {"GS_SDC_CHECK": "off"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    d, res = _run(tmp_path, "blind", {
+        "GS_SDC_CHECK": "off",
+        "GS_FAULTS": "step=6:kind=sdc",
+        "GS_FAULT_DEVICE": "cpu:5",
+    })
+    assert res.returncode == 0, res.stderr + res.stdout
+    events = _events(d)
+    assert not [e for e in events if e["kind"] == "sdc_mismatch"]
+    # The corruption reached the stores: outputs differ from the
+    # fault-free run.
+    ref_files = sorted(
+        p.relative_to(ref / "gs.bp")
+        for p in (ref / "gs.bp").rglob("*") if p.is_file()
+    )
+    assert any(
+        (ref / "gs.bp" / p).read_bytes() != (d / "gs.bp" / p).read_bytes()
+        for p in ref_files
+    )

@@ -1,0 +1,474 @@
+"""Unit tests for compute-path SDC screening and degraded-device
+quarantine (``resilience/sdc.py``; docs/RESILIENCE.md "Silent data
+corruption").
+
+The contracts pinned here:
+
+* **Resolution** — ``GS_SDC_CHECK`` / ``GS_SDC_EVERY`` resolve loudly
+  (bad modes raise naming the knob), TOML-less defaults are off.
+* **Quarantine plumbing** — ``GS_DEVICE_BLOCKLIST`` and fleet
+  ``quarantine/*`` docs merge into one blocklist; ``quarantine_device``
+  extends the env, publishes the doc, journals the verdict; device
+  selection excludes quarantined chips and fails loudly when nothing
+  is left.
+* **Detection and attribution** — an injected compute-path bitflip on
+  a named device is caught by spot AND shadow replay and attributed to
+  exactly that device (shadow via disjoint-subset bisection over a
+  rotated placement); ensemble mismatches carry the member index too.
+* **False-positive floor** (the transparency matrix) — screening over
+  every model × kernel language × precision posture × halo depth is
+  bitwise-invisible: the screened trajectory equals the unscreened
+  one and every check verifies. PR 14's write-path ``bitflip`` fault
+  must stay invisible to the screener (the live trajectory is
+  untouched — that corruption belongs to the device checksum layer).
+* **Supervisor ladder** — first mismatch restarts from the last
+  *verified* checkpoint; a same-device repeat quarantines; quarantine
+  exhaustion gives up loudly instead of restart-looping.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from grayscott_jl_tpu.config.settings import Settings
+from grayscott_jl_tpu.resilience import sdc
+from grayscott_jl_tpu.resilience.sdc import (
+    Screener,
+    SDCError,
+    bisect_failing,
+    device_name,
+    feasible_dims,
+    quarantine_device,
+    resolve_blocklist,
+    resolve_sdc,
+    usable_devices,
+)
+from grayscott_jl_tpu.simulation import Simulation
+
+GS_PARAMS = dict(Du=0.2, Dv=0.1, F=0.02, k=0.048, dt=1.0)
+
+requires8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def _settings(model="grayscott", L=8, noise=0.1, **kw):
+    if model == "grayscott":
+        kw = {**GS_PARAMS, **kw}
+    else:
+        kw.setdefault("dt", 0.05)
+    s = Settings(
+        L=L, noise=noise, precision="Float32", backend="CPU", **kw
+    )
+    s.model = model
+    return s
+
+
+_SDC_ENV_VARS = ("GS_SDC_CHECK", "GS_SDC_EVERY", "GS_DEVICE_BLOCKLIST",
+                 "GS_FAULT_DEVICE", "GS_FAULT_MEMBER",
+                 "GS_SERVE_FLEET_DIR")
+
+
+@pytest.fixture(autouse=True)
+def _clean_sdc_env():
+    """Each test starts with no SDC env armed — and ends leak-free.
+
+    quarantine_device() writes GS_DEVICE_BLOCKLIST into os.environ
+    directly (the production path), which monkeypatch.delenv on an
+    absent var records nothing to undo for — a quarantine would leak
+    out of this file and starve later sharded tests of devices. Raw
+    save/erase/restore closes that hole regardless of how the test
+    (or the code under test) mutates the vars.
+    """
+    saved = {v: os.environ.pop(v, None) for v in _SDC_ENV_VARS}
+    yield
+    for v, val in saved.items():
+        if val is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = val
+
+
+def _np_fields(sim):
+    return [np.asarray(f) for f in sim.fields]
+
+
+# ------------------------------------------------------------ resolution
+
+
+def test_resolve_sdc_defaults_env_and_errors(monkeypatch):
+    assert resolve_sdc(Settings()) == {"mode": "off", "every": 1}
+    monkeypatch.setenv("GS_SDC_CHECK", "spot")
+    monkeypatch.setenv("GS_SDC_EVERY", "3")
+    assert resolve_sdc(Settings()) == {"mode": "spot", "every": 3}
+    monkeypatch.setenv("GS_SDC_CHECK", "sideways")
+    with pytest.raises(ValueError, match="GS_SDC_CHECK"):
+        resolve_sdc(Settings())
+    monkeypatch.setenv("GS_SDC_CHECK", "shadow")
+    monkeypatch.setenv("GS_SDC_EVERY", "0")
+    with pytest.raises(ValueError, match="GS_SDC_EVERY"):
+        resolve_sdc(Settings())
+
+
+def test_resolve_blocklist_merges_env_and_fleet_docs(
+        monkeypatch, tmp_path):
+    assert resolve_blocklist() == frozenset()
+    monkeypatch.setenv("GS_DEVICE_BLOCKLIST", "cpu:3, cpu:5,,cpu:3")
+    assert resolve_blocklist() == {"cpu:3", "cpu:5"}
+    # fleet quarantine docs (serve/cluster.py FleetKV) merge in
+    from grayscott_jl_tpu.serve.cluster import FleetKV
+
+    kv = FleetKV(str(tmp_path))
+    kv.put("quarantine/cpu_1", {"device": "cpu:1", "reason": "test"})
+    monkeypatch.setenv("GS_SERVE_FLEET_DIR", str(tmp_path))
+    assert resolve_blocklist() == {"cpu:1", "cpu:3", "cpu:5"}
+
+
+def test_quarantine_device_extends_env_publishes_and_journals(
+        monkeypatch, tmp_path):
+    from grayscott_jl_tpu.resilience import FaultJournal
+    from grayscott_jl_tpu.serve.cluster import FleetKV
+
+    monkeypatch.setenv("GS_SERVE_FLEET_DIR", str(tmp_path))
+    j = FaultJournal(None)
+    quarantine_device("cpu:2", journal=j, step=40, reason="test verdict")
+    quarantine_device("cpu:6", journal=j)
+    assert resolve_blocklist() == {"cpu:2", "cpu:6"}
+    # idempotent: re-quarantining does not duplicate the env token
+    quarantine_device("cpu:2")
+    assert os.environ["GS_DEVICE_BLOCKLIST"].count("cpu:2") == 1
+    doc = FleetKV(str(tmp_path)).get("quarantine/cpu_2")
+    assert doc and doc["device"] == "cpu:2"
+    assert doc["reason"] == "test verdict" and doc["step"] == 40
+    events = [e for e in j.events if e["event"] == "device_quarantined"]
+    assert [e["device"] for e in events] == ["cpu:2", "cpu:6"]
+    assert events[0]["kind"] == "sdc" and events[0]["step"] == 40
+
+
+@requires8
+def test_usable_devices_and_select_exclude_quarantined(monkeypatch):
+    from grayscott_jl_tpu.simulation import select_devices
+
+    all_names = [device_name(d) for d in jax.devices()]
+    monkeypatch.setenv("GS_DEVICE_BLOCKLIST", all_names[0])
+    usable = [device_name(d) for d in usable_devices()]
+    assert all_names[0] not in usable
+    assert len(usable) == len(all_names) - 1
+    picked = [device_name(d) for d in select_devices("cpu")]
+    assert all_names[0] not in picked and len(picked) == 7
+    # every device quarantined: selection fails loudly, never silently
+    monkeypatch.setenv("GS_DEVICE_BLOCKLIST", ",".join(all_names))
+    with pytest.raises(RuntimeError, match="quarantined"):
+        select_devices("cpu")
+
+
+def test_feasible_dims_walks_down_to_a_valid_mesh():
+    from grayscott_jl_tpu.parallel.domain import CartDomain
+
+    for n in (8, 7, 5, 1):
+        dims = feasible_dims(n, 16)
+        assert dims is not None and int(np.prod(dims)) <= n
+        CartDomain.create(int(np.prod(dims)), 16)  # actually buildable
+    assert feasible_dims(1, 16) == (1, 1, 1)
+    assert feasible_dims(0, 16) is None
+    # an infeasible count walks DOWN to one that fits, never up
+    assert int(np.prod(feasible_dims(7, 7))) <= 7
+
+
+def test_bisect_failing_finds_all_guilty_items():
+    for guilty in ([2], [0, 5], [1, 2, 6], []):
+        items = list(range(7))
+        calls = []
+
+        def healthy(subset, guilty=guilty):
+            calls.append(tuple(subset))
+            return not (set(subset) & set(guilty))
+
+        assert sorted(bisect_failing(items, healthy)) == sorted(guilty)
+        # group testing: a clean inventory costs exactly one probe
+        if not guilty:
+            assert len(calls) == 1
+
+
+# --------------------------------------------- detection and attribution
+
+
+@requires8
+def test_spot_detects_and_attributes_named_device(monkeypatch):
+    sim = Simulation(_settings(L=16, noise=0.1), n_devices=8, seed=1)
+    sc = Screener(sim, mode="spot")
+    sc.rearm(0)
+    sim.iterate(4)
+    assert sc.check(4) and sc.verified_step == 4
+    sim.poison_sdc(device="cpu:5")
+    sim.iterate(4)
+    with pytest.raises(SDCError) as ei:
+        sc.check(8)
+    assert ei.value.device == "cpu:5"
+    assert ei.value.step == 8 and ei.value.verified_step == 4
+
+
+@requires8
+def test_shadow_detects_on_rotated_placement():
+    """Shadow mode replays on a rotated device permutation: a
+    deterministic per-core fault cannot self-confirm, and the
+    bisection still blames the right live shard."""
+    sim = Simulation(_settings(L=16, noise=0.1), n_devices=8, seed=1)
+    sc = Screener(sim, mode="shadow")
+    assert not sc.shadow_degraded
+    sc.rearm(0)
+    sim.iterate(4)
+    assert sc.check(4)
+    sim.poison_sdc(device="cpu:2")
+    sim.iterate(4)
+    with pytest.raises(SDCError) as ei:
+        sc.check(8)
+    assert ei.value.device == "cpu:2" and ei.value.mode == "shadow"
+
+
+@requires8
+def test_every_n_cadence_rearms_every_boundary(monkeypatch):
+    """GS_SDC_EVERY=N amortization: the anchor re-arms every boundary
+    (cheap) but only every Nth boundary pays a replay — and the replay
+    covers only the rounds since the LAST boundary, not N rounds."""
+    sim = Simulation(_settings(L=8, noise=0.1), n_devices=1, seed=0)
+    sc = Screener(sim, mode="spot", every=2)
+    sc.rearm(0)
+    sim.iterate(2)
+    assert not sc.check(2)   # boundary 1 of 2: skipped
+    sc.rearm(2)
+    sim.iterate(2)
+    assert sc.check(4)       # boundary 2: replayed 2 steps, ok
+    assert sc.verified_step == 4
+
+
+@requires8
+def test_ensemble_mismatch_carries_member_attribution(monkeypatch):
+    from grayscott_jl_tpu.ensemble import spec as ens_spec
+    from grayscott_jl_tpu.ensemble.engine import EnsembleSimulation
+
+    s = _settings(L=8, noise=0.1)
+    s.ensemble = ens_spec.from_toml(
+        {"presets": ["spots", "waves", "chaos", "mitosis"],
+         "member_shards": 2},
+        s,
+    )
+    sim = EnsembleSimulation(s, n_devices=8, seed=3)
+    sc = Screener(sim, mode="spot")
+    sc.rearm(0)
+    sim.iterate(2)
+    assert sc.check(2)
+    sc.rearm(2)
+    monkeypatch.setenv("GS_FAULT_MEMBER", "2")
+    # Pinning member 2 may move the cell into another device's
+    # member-block (member_shards=2): poison_sdc reports the device
+    # that actually holds the poisoned cell, and attribution must
+    # name BOTH that device and the member.
+    name = sim.poison_sdc(device="cpu:3")
+    sim.iterate(2)
+    with pytest.raises(SDCError) as ei:
+        sc.check(4)
+    assert ei.value.member == 2 and ei.value.device == name
+
+
+@requires8
+def test_pr14_write_path_bitflip_is_invisible_to_screening():
+    """The ``bitflip`` fault corrupts the SNAPSHOT COPY on device —
+    the live trajectory is untouched, so the redundant-compute screen
+    must NOT fire (that corruption belongs to the device-checksum
+    layer, resilience/integrity.py)."""
+    sim = Simulation(_settings(L=16, noise=0.1), n_devices=8, seed=1)
+    sc = Screener(sim, mode="spot")
+    sc.rearm(0)
+    sim.iterate(4)
+    from grayscott_jl_tpu.resilience.integrity import CorruptionError
+
+    snap = sim.snapshot_async(exact=True, bitflip=True, checksum=True)
+    with pytest.raises(CorruptionError, match="checksum mismatch"):
+        snap.blocks()  # the WRITE path catches its own corruption...
+    assert sc.check(4)  # ...while the live-state screen stays green
+
+
+# ------------------------------------- false-positive floor (the matrix)
+
+
+#: The full 32-case cross product runs in tier-2 (``-m slow``); tier-1
+#: keeps a slice that still touches every axis VALUE (all four models,
+#: both kernel languages, both precision postures, both halo depths,
+#: and through the mode-by-model rule below both screening modes) so
+#: the false-positive floor is guarded on every push without paying
+#: the whole matrix inside the tier-1 wall budget.
+_MATRIX_TIER1 = {
+    ("grayscott", "Plain", "", 1),
+    ("grayscott", "Pallas", "bf16_f32acc", 2),
+    ("brusselator", "Pallas", "", 1),
+    ("fhn", "Plain", "bf16_f32acc", 2),
+    ("heat", "Pallas", "", 2),
+    ("heat", "Plain", "bf16_f32acc", 1),
+}
+
+_MATRIX = [
+    pytest.param(
+        model, lang, posture, halo,
+        marks=() if (model, lang, posture, halo) in _MATRIX_TIER1
+        else pytest.mark.slow,
+    )
+    for model in ("grayscott", "brusselator", "fhn", "heat")
+    for lang in ("Plain", "Pallas")
+    for posture in ("", "bf16_f32acc")
+    for halo in (1, 2)
+]
+
+
+@requires8
+@pytest.mark.parametrize("model,lang,posture,halo", _MATRIX)
+def test_screening_is_bitwise_transparent(model, lang, posture, halo):
+    """The transparency matrix (ISSUE satellite): screening-on equals
+    screening-off bitwise over every model × kernel language ×
+    precision posture × halo depth, with zero mismatch events — the
+    false-positive floor that makes an SDC alarm actionable."""
+    kw = dict(kernel_language=lang, compute_precision=posture,
+              halo_depth=halo)
+    plain = Simulation(_settings(model=model, **kw), n_devices=2, seed=2)
+    mode = "shadow" if model in ("grayscott", "heat") else "spot"
+    screened = Simulation(_settings(model=model, **kw), n_devices=2,
+                          seed=2)
+    sc = Screener(screened, mode=mode)
+    sc.rearm(0)
+    for boundary in (2, 4):
+        plain.iterate(2)
+        screened.iterate(2)
+        assert sc.check(boundary)  # every check verifies: no mismatch
+        sc.rearm(boundary)
+    assert sc.verified_step == 4
+    for a, b in zip(_np_fields(plain), _np_fields(screened)):
+        assert a.dtype == b.dtype
+        assert np.array_equal(
+            a.view(np.uint8), b.view(np.uint8)
+        )  # bitwise, not approx
+
+
+# ----------------------------------------------------- supervisor ladder
+
+
+class _FakeCkpt:
+    """Records the max_step cap latest_durable_checkpoint was asked
+    for and serves a fixed durable step."""
+
+    def __init__(self, durable):
+        self.durable = durable
+        self.caps = []
+
+    def __call__(self, settings, max_step=None):
+        self.caps.append(max_step)
+        if self.durable is None or (
+                max_step is not None and self.durable > max_step):
+            return None
+        return self.durable
+
+
+def _supervise_with(monkeypatch, failures, durable=4):
+    """Run supervise() against a fake run_once raising ``failures`` in
+    order, then succeeding. Returns (journal events, ckpt fake,
+    settings, outcome)."""
+    from grayscott_jl_tpu.resilience import supervisor as sup
+
+    monkeypatch.setenv("GS_RESTART_BACKOFF_S", "0.001")
+    monkeypatch.delenv("GS_FAULTS", raising=False)
+    seq = list(failures)
+    calls = []
+
+    def fake_run_once(settings, **kw):
+        calls.append(dict(restart=settings.restart,
+                          restart_step=settings.restart_step))
+        if seq:
+            raise seq.pop(0)
+        return "done"
+
+    import grayscott_jl_tpu.driver as driver_mod
+
+    monkeypatch.setattr(driver_mod, "run_once", fake_run_once)
+    ckpt = _FakeCkpt(durable)
+    monkeypatch.setattr(sup, "latest_durable_checkpoint", ckpt)
+    events = []
+    monkeypatch.setattr(
+        sup.FaultJournal, "record",
+        lambda self, **e: events.append(e) or e,
+    )
+    settings = _settings(L=8)
+    outcome = None
+    try:
+        outcome = sup.supervise(settings)
+    except BaseException as exc:  # noqa: BLE001 — inspected by tests
+        outcome = exc
+    return events, ckpt, settings, calls, outcome
+
+
+def test_sdc_ladder_first_mismatch_resumes_from_verified(monkeypatch):
+    events, ckpt, settings, calls, out = _supervise_with(
+        monkeypatch,
+        [SDCError("boom", step=8, verified_step=4, device="cpu:5")],
+    )
+    assert out == "done"
+    # the resume consulted the checkpoint CAPPED at the verified step
+    assert ckpt.caps == [4]
+    assert settings.restart and settings.restart_step == 4
+    rec = [e for e in events if e["event"] == "recovery"]
+    assert rec and rec[0]["kind"] == "sdc"
+    assert "resumed_from_checkpoint_step_4" in rec[0]["action"]
+    assert not [e for e in events if e["event"] == "device_quarantined"]
+    assert "cpu:5" not in os.environ.get("GS_DEVICE_BLOCKLIST", "")
+
+
+def test_sdc_ladder_same_device_repeat_quarantines(monkeypatch):
+    events, ckpt, settings, calls, out = _supervise_with(
+        monkeypatch,
+        [SDCError("a", step=8, verified_step=4, device="cpu:5"),
+         SDCError("b", step=12, verified_step=8, device="cpu:5")],
+    )
+    assert out == "done"
+    q = [e for e in events if e["event"] == "device_quarantined"]
+    assert len(q) == 1 and q[0]["device"] == "cpu:5"
+    assert "cpu:5" in resolve_blocklist()
+    rec = [e for e in events if e["event"] == "recovery"]
+    assert "quarantined_cpu:5" in rec[1]["action"]
+    # each resume capped at ITS failure's verified step
+    assert ckpt.caps == [4, 8]
+
+
+def test_sdc_ladder_unverified_failure_restarts_from_scratch(
+        monkeypatch):
+    events, ckpt, settings, calls, out = _supervise_with(
+        monkeypatch,
+        [SDCError("x", step=2, verified_step=None, device="cpu:1")],
+    )
+    assert out == "done"
+    assert ckpt.caps == []  # never consulted: nothing was verified
+    rec = [e for e in events if e["event"] == "recovery"]
+    assert "no_verified_boundary" in rec[0]["action"]
+    assert "restarted_from_scratch" in rec[0]["action"]
+
+
+def test_sdc_ladder_quarantine_exhaustion_gives_up(monkeypatch):
+    monkeypatch.setattr(sdc, "usable_devices", lambda platform=None: [])
+    events, ckpt, settings, calls, out = _supervise_with(
+        monkeypatch,
+        [SDCError("a", step=8, verified_step=4, device="cpu:0"),
+         SDCError("b", step=8, verified_step=4, device="cpu:0")],
+    )
+    assert isinstance(out, SDCError)
+    gave = [e for e in events if e["event"] == "gave_up"]
+    assert gave and gave[0]["kind"] == "sdc"
+    assert "no compute inventory" in gave[0]["reason"]
+    assert len(calls) == 2  # no third attempt
+
+
+def test_classify_sdc_is_restartable():
+    from grayscott_jl_tpu.resilience.supervisor import classify_failure
+
+    e = SDCError("boom", step=8, verified_step=4, device="cpu:5")
+    assert classify_failure(e) == "sdc"

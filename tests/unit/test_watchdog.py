@@ -329,3 +329,37 @@ def test_kv_rendezvous_timeout_wraps_client_error():
     r = KVRendezvous(_FakeKVClient(), 2, 0, timeout_s=0.2)
     with pytest.raises(RendezvousTimeout, match="process 1"):
         r.agree(0, 10)
+
+
+# ------------------------------------------------- reshape phase (PR 20)
+
+
+def test_reshape_phase_default_deadline_and_env_override(monkeypatch):
+    """A live reshape is its own watchdog phase: present by default
+    with a compile-class budget, tunable via GS_WATCHDOG_RESHAPE_S
+    like every other phase knob."""
+    for var in ("GS_WATCHDOG_DEADLINE_S", "GS_WATCHDOG_RESHAPE_S"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("GS_WATCHDOG", "on")
+    d = resolve_watchdog(Settings())
+    assert "reshape" in d and d["reshape"] >= d["compile"]
+    monkeypatch.setenv("GS_WATCHDOG_RESHAPE_S", "3.5")
+    d = resolve_watchdog(Settings())
+    assert d["reshape"] == 3.5
+    assert d["compile"] != 3.5  # only the reshape phase moved
+
+
+def test_watchdog_expiry_mid_reshape_is_restartable_hang():
+    """A wedged live reshape (device-path move that never completes)
+    expires the reshape deadline and unwinds as a HangError the
+    supervisor classifies as a restartable hang."""
+    j = FaultJournal(None)
+    with _quiet_watchdog({"reshape": 0.15}, journal=j) as wd:
+        wd.heartbeat("reshape", 24)  # driver's _apply_reshape marks this
+        time.sleep(0.6)
+        assert wd.expired is not None and wd.expired["phase"] == "reshape"
+        with pytest.raises(HangError, match="reshape.*step 24") as ei:
+            wd.check()
+    assert classify_failure(ei.value) == "hang"  # restartable
+    events = [e for e in j.events if e["event"] == "hang"]
+    assert len(events) == 1 and events[0]["phase"] == "reshape"
